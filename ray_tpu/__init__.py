@@ -1,0 +1,280 @@
+"""ray_tpu: a TPU-native distributed compute framework.
+
+Capability-compatible with the reference engine (tasks, actors, objects,
+placement groups, collectives, Train/Tune/Data/Serve layers — see SURVEY.md),
+re-designed for TPU pods: SPMD-first data plane (jax/XLA over ICI), thin
+control plane over DCN, process-per-host workers, typed TPU slice resources.
+
+Public API (reference: ``python/ray/_private/worker.py`` exports):
+    init, shutdown, remote, get, put, wait, kill, cancel, get_actor,
+    get_runtime_context, cluster_resources, available_resources, nodes
+"""
+from __future__ import annotations
+
+import atexit
+import inspect
+import os
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from ray_tpu import exceptions
+from ray_tpu._private import worker as _worker_mod
+from ray_tpu._private.gcs import HeadService
+from ray_tpu._private.ids import JobID
+from ray_tpu._private.node import LocalCluster, spawn_node
+from ray_tpu._private.worker import CoreWorker, get_global_worker
+from ray_tpu.actor import ActorClass, ActorHandle, exit_actor
+from ray_tpu.object_ref import ObjectRef
+from ray_tpu.remote_function import RemoteFunction
+from ray_tpu.runtime_context import get_runtime_context
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "cancel",
+    "get_actor",
+    "exit_actor",
+    "get_runtime_context",
+    "cluster_resources",
+    "available_resources",
+    "nodes",
+    "ObjectRef",
+    "ActorHandle",
+    "exceptions",
+    "__version__",
+]
+
+_init_lock = threading.Lock()
+_cluster: Optional[LocalCluster] = None
+_head: Optional[HeadService] = None
+
+
+def is_initialized() -> bool:
+    return _worker_mod.global_worker is not None
+
+
+def init(
+    address: Optional[str] = None,
+    *,
+    num_cpus: Optional[int] = None,
+    num_nodes: int = 1,
+    resources: Optional[Dict[str, float]] = None,
+    labels: Optional[Dict[str, str]] = None,
+    namespace: str = "default",
+    ignore_reinit_error: bool = False,
+    log_level: str = "WARNING",
+    _node_env: Optional[Dict[str, str]] = None,
+) -> "ClientContext":
+    """Start (or connect to) a cluster.
+
+    - No address: starts a head service in-process plus ``num_nodes`` node
+      processes, each with ``num_cpus`` CPUs (default: host cpu count) and any
+      extra ``resources`` (e.g. {"TPU": 4}).
+    - ``address="host:port"``: connect this driver to an existing head.
+
+    Reference analog: ``ray.init`` (``python/ray/_private/worker.py:1413``).
+    """
+    global _cluster, _head
+    with _init_lock:
+        if _worker_mod.global_worker is not None:
+            if ignore_reinit_error:
+                return ClientContext(_worker_mod.global_worker)
+            raise RuntimeError("ray_tpu.init() called twice")
+        job_id = JobID.from_random()
+        if address is None:
+            head = HeadService()
+            driver = CoreWorker(
+                is_driver=True,
+                gcs_addr=("127.0.0.1", 0),  # patched after head start
+                job_id=job_id,
+                head=head,
+            )
+            # Start head + driver service on one core loop.
+            ready = threading.Event()
+            boot_err: List[BaseException] = []
+
+            def runner():
+                import asyncio
+
+                loop = asyncio.new_event_loop()
+                asyncio.set_event_loop(loop)
+                driver.loop = loop
+
+                async def boot():
+                    addr = await head.start()
+                    driver.gcs_addr = addr
+                    await driver._async_setup()
+
+                try:
+                    loop.run_until_complete(boot())
+                except BaseException as e:  # surface boot failures to caller
+                    boot_err.append(e)
+                    ready.set()
+                    return
+                ready.set()
+                loop.run_forever()
+
+            t = threading.Thread(target=runner, name="rt-core-loop", daemon=True)
+            t.start()
+            driver.loop_thread = t
+            if not ready.wait(timeout=30):
+                raise RuntimeError("head service failed to start")
+            if boot_err:
+                raise boot_err[0]
+            driver._install_ref_hooks()
+            _worker_mod.global_worker = driver
+            _head = head
+            _cluster = LocalCluster(head, driver.gcs_addr, job_id, driver)
+            n_cpus = num_cpus if num_cpus is not None else (os.cpu_count() or 1)
+            node_res = dict(resources or {})
+            node_res["CPU"] = float(n_cpus)
+            for _ in range(num_nodes):
+                _cluster.add_node(
+                    dict(node_res), labels=labels, env=_node_env, wait=False
+                )
+            _cluster.wait_for_nodes(num_nodes)
+        else:
+            host, port = address.rsplit(":", 1)
+            driver = CoreWorker(
+                is_driver=True, gcs_addr=(host, int(port)), job_id=job_id
+            )
+            driver.start_driver()
+            _worker_mod.global_worker = driver
+        atexit.register(shutdown)
+        return ClientContext(driver)
+
+
+class ClientContext:
+    def __init__(self, worker: CoreWorker):
+        self.worker = worker
+        self.address_info = {
+            "gcs_address": worker.gcs_addr,
+            "node_id": worker.node_id,
+        }
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        shutdown()
+
+
+def shutdown():
+    global _cluster, _head
+    atexit.unregister(shutdown)
+    w = _worker_mod.global_worker
+    if w is None:
+        return
+    if _cluster is not None:
+        _cluster.shutdown()
+        _cluster = None
+    w.shutdown()
+    _head = None
+    _worker_mod.global_worker = None
+
+
+def remote(*args, **kwargs):
+    """``@remote`` decorator for functions and classes (reference:
+    ``python/ray/_private/worker.py:3479``)."""
+
+    def make(target):
+        if inspect.isclass(target):
+            return ActorClass(target, kwargs)
+        return RemoteFunction(target, kwargs)
+
+    if len(args) == 1 and not kwargs and (
+        inspect.isfunction(args[0]) or inspect.isclass(args[0])
+    ):
+        return make(args[0])
+    if args:
+        raise TypeError("@remote options must be keyword arguments")
+    return make
+
+
+def get(refs, *, timeout: Optional[float] = None):
+    w = get_global_worker()
+    if isinstance(refs, ObjectRef):
+        return w.get(refs, timeout)
+    if isinstance(refs, (list, tuple)):
+        if not all(isinstance(r, ObjectRef) for r in refs):
+            raise TypeError("get() accepts an ObjectRef or a list of ObjectRefs")
+        return w.get(list(refs), timeout)
+    raise TypeError(f"get() got {type(refs)}")
+
+
+def put(value: Any) -> ObjectRef:
+    return get_global_worker().put(value)
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+    fetch_local: bool = True,
+):
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    return get_global_worker().wait(
+        list(refs), num_returns=num_returns, timeout=timeout, fetch_local=fetch_local
+    )
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    get_global_worker().kill_actor(actor._actor_id_hex, no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    """Best-effort cancellation of a queued task (running tasks on TPU hosts
+    are compiled steps and are not preempted in round 1)."""
+    # Round-1: cancellation of queued-but-unleased work only.
+    return None
+
+
+def get_actor(name: str, namespace: str = "default") -> ActorHandle:
+    w = get_global_worker()
+    h = w.run_sync(w.gcs.call("get_actor", {"name": name, "namespace": namespace}))[0]
+    if not h.get("found") or h["actor"]["state"] == "DEAD":
+        raise ValueError(f"named actor '{name}' not found in namespace '{namespace}'")
+    info = h["actor"]
+    return ActorHandle(
+        info["actor_id"], tuple(info["addr"]) if info["addr"] else None,
+        0, info.get("class_name", "Actor"),
+    )
+
+
+def nodes() -> List[dict]:
+    w = get_global_worker()
+    return w.run_sync(w.gcs.call("get_nodes", {}))[0]["nodes"]
+
+
+def cluster_resources() -> Dict[str, float]:
+    total: Dict[str, float] = {}
+    for n in nodes():
+        if not n["alive"]:
+            continue
+        for k, v in n["resources"].items():
+            total[k] = total.get(k, 0) + v
+    return total
+
+
+def available_resources() -> Dict[str, float]:
+    total: Dict[str, float] = {}
+    for n in nodes():
+        if not n["alive"]:
+            continue
+        for k, v in n["available"].items():
+            total[k] = total.get(k, 0) + v
+    return total
+
+
+def _internal_cluster() -> Optional[LocalCluster]:
+    return _cluster

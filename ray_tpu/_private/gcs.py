@@ -1,0 +1,673 @@
+"""Head service: cluster metadata, scheduling, actor management, pubsub, KV.
+
+TPU-native analog of the reference GCS (``src/ray/gcs/gcs_server.h:97`` and its
+managers: GcsNodeManager, GcsResourceManager, GcsActorManager,
+GcsPlacementGroupManager, internal KV, function manager, pubsub). Design
+differences, deliberate (SURVEY.md §7):
+
+- **Process-per-host model**: a "node" here is one worker process (on a TPU pod
+  each host runs exactly one multi-chip worker process), so the reference's
+  raylet/worker split collapses into a single per-node service. The head
+  schedules leases directly onto nodes — there is no per-node secondary
+  scheduler in round 1.
+- **Typed TPU resources**: nodes advertise {"CPU": n, "TPU": m, ...} plus
+  labels (topology, slice name). Slice-aware gang placement lives in
+  ``placement_group`` with STRICT_PACK ≈ one ICI slice.
+- Transport is the framed-msgpack RPC in ``protocol.py`` (not gRPC); workers
+  keep one bidirectional connection to the head, over which the head also
+  pushes actor-creation requests and pubsub messages (reference's
+  long-poll pubsub ``src/ray/pubsub/publisher.h`` becomes a plain push).
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private import protocol
+from ray_tpu._private.ids import ActorID, NodeID, PlacementGroupID
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class NodeInfo:
+    node_id: str
+    addr: Tuple[str, int]          # worker-service address for task push
+    resources: Dict[str, float]    # total
+    available: Dict[str, float]    # currently available
+    labels: Dict[str, str] = field(default_factory=dict)
+    conn: Optional[protocol.Connection] = None  # head<->node control conn
+    alive: bool = True
+    start_time: float = field(default_factory=time.time)
+
+    def to_public(self) -> dict:
+        return {
+            "node_id": self.node_id,
+            "addr": list(self.addr),
+            "resources": dict(self.resources),
+            "available": dict(self.available),
+            "labels": dict(self.labels),
+            "alive": self.alive,
+        }
+
+
+@dataclass
+class ActorInfo:
+    actor_id: str
+    name: Optional[str]
+    namespace: str
+    state: str                     # PENDING | ALIVE | RESTARTING | DEAD
+    node_id: Optional[str]
+    addr: Optional[Tuple[str, int]]
+    resources: Dict[str, float]
+    max_restarts: int
+    restarts_used: int = 0
+    creation_frames: Optional[List[bytes]] = None  # replayed on restart
+    death_reason: str = ""
+    class_name: str = ""
+    pg_id: Optional[str] = None
+    bundle_index: int = -1
+
+    def to_public(self) -> dict:
+        return {
+            "actor_id": self.actor_id,
+            "name": self.name,
+            "namespace": self.namespace,
+            "state": self.state,
+            "node_id": self.node_id,
+            "addr": list(self.addr) if self.addr else None,
+            "class_name": self.class_name,
+            "restarts_used": self.restarts_used,
+            "death_reason": self.death_reason,
+        }
+
+
+@dataclass
+class PlacementGroupInfo:
+    pg_id: str
+    bundles: List[Dict[str, float]]
+    strategy: str
+    state: str                     # PENDING | CREATED | REMOVED
+    bundle_nodes: List[Optional[str]] = field(default_factory=list)
+    name: str = ""
+
+    def to_public(self) -> dict:
+        return {
+            "placement_group_id": self.pg_id,
+            "name": self.name,
+            "bundles": self.bundles,
+            "strategy": self.strategy,
+            "state": self.state,
+            "bundle_nodes": self.bundle_nodes,
+        }
+
+
+def _fits(avail: Dict[str, float], need: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in need.items())
+
+
+def _acquire(avail: Dict[str, float], need: Dict[str, float]):
+    for k, v in need.items():
+        avail[k] = avail.get(k, 0.0) - v
+
+
+def _release(avail: Dict[str, float], need: Dict[str, float]):
+    for k, v in need.items():
+        avail[k] = avail.get(k, 0.0) + v
+
+
+class HeadService:
+    """The cluster head. Runs inside the driver process's core event loop in
+    round 1 (single head service; reference runs it as a separate gcs_server
+    process — the RPC surface is identical so it can be split out later)."""
+
+    def __init__(self):
+        self.kv: Dict[str, Dict[str, bytes]] = defaultdict(dict)  # ns -> key -> val
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.actors: Dict[str, ActorInfo] = {}
+        self.named_actors: Dict[Tuple[str, str], str] = {}  # (ns, name) -> actor_id
+        self.pgs: Dict[str, PlacementGroupInfo] = {}
+        # pg_id -> bundle_index -> remaining reserved resources on that node
+        self.pg_reserved: Dict[str, List[Dict[str, float]]] = {}
+        self.subscribers: Dict[str, List[protocol.Connection]] = defaultdict(list)
+        self.object_dir: Dict[str, dict] = {}  # object hex -> shm layout metadata
+        self.server: Optional[protocol.RpcServer] = None
+        self.addr: Optional[Tuple[str, int]] = None
+        self._pending_waiters: List[asyncio.Future] = []  # resource-wait futures
+        self.task_events: List[dict] = []  # bounded task-event buffer for state API
+        self.jobs: Dict[str, dict] = {}
+        self._schedule_rr = 0  # round-robin cursor
+
+    # ------------------------------------------------------------------ setup
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0):
+        self.server = protocol.RpcServer(self._handle, host, port)
+        self.addr = await self.server.start()
+        logger.info("head service listening on %s", self.addr)
+        return self.addr
+
+    async def close(self):
+        if self.server:
+            await self.server.close()
+
+    # ------------------------------------------------------------- dispatcher
+
+    async def _handle(self, method, header, frames, conn):
+        fn = getattr(self, "rpc_" + method, None)
+        if fn is None:
+            raise protocol.RpcError(f"unknown head rpc {method}")
+        return await fn(header, frames, conn)
+
+    # ------------------------------------------------------------------- kv
+
+    async def rpc_kv_put(self, h, frames, conn):
+        ns = h.get("ns", "")
+        self.kv[ns][h["key"]] = frames[0] if frames else b""
+        return {}, []
+
+    async def rpc_kv_get(self, h, frames, conn):
+        val = self.kv[h.get("ns", "")].get(h["key"])
+        return {"found": val is not None}, ([val] if val is not None else [])
+
+    async def rpc_kv_del(self, h, frames, conn):
+        existed = self.kv[h.get("ns", "")].pop(h["key"], None) is not None
+        return {"deleted": existed}, []
+
+    async def rpc_kv_keys(self, h, frames, conn):
+        prefix = h.get("prefix", "")
+        keys = [k for k in self.kv[h.get("ns", "")] if k.startswith(prefix)]
+        return {"keys": keys}, []
+
+    async def rpc_kv_exists(self, h, frames, conn):
+        return {"exists": h["key"] in self.kv[h.get("ns", "")]}, []
+
+    # ------------------------------------------------------------------ nodes
+
+    async def rpc_register_node(self, h, frames, conn):
+        info = NodeInfo(
+            node_id=h["node_id"],
+            addr=tuple(h["addr"]),
+            resources=dict(h["resources"]),
+            available=dict(h["resources"]),
+            labels=dict(h.get("labels", {})),
+            conn=conn,
+        )
+        self.nodes[info.node_id] = info
+        conn.peer_info["node_id"] = info.node_id
+        conn.on_close = self._make_node_close_handler(info.node_id)
+        self._wake_waiters()
+        self.publish("nodes", {"event": "node_added", "node": info.to_public()})
+        return {"ok": True}, []
+
+    def _make_node_close_handler(self, node_id):
+        loop = asyncio.get_running_loop()
+
+        def _on_close(conn):
+            if not loop.is_closed():
+                loop.call_soon_threadsafe(
+                    lambda: loop.create_task(self._on_node_dead(node_id))
+                )
+        return _on_close
+
+    async def _on_node_dead(self, node_id: str, reason: str = "connection lost"):
+        info = self.nodes.get(node_id)
+        if info is None or not info.alive:
+            return
+        info.alive = False
+        logger.warning("node %s dead: %s", node_id[:8], reason)
+        self.publish("nodes", {"event": "node_dead", "node_id": node_id})
+        # Fail/restart actors that lived there.
+        for actor in list(self.actors.values()):
+            if actor.node_id == node_id and actor.state in ("ALIVE", "PENDING"):
+                await self._on_actor_dead(actor, f"node {node_id[:8]} died")
+        # Release PG reservations on that node.
+        for pg in self.pgs.values():
+            for i, nid in enumerate(pg.bundle_nodes):
+                if nid == node_id:
+                    pg.bundle_nodes[i] = None
+
+    async def rpc_drain_node(self, h, frames, conn):
+        await self._on_node_dead(h["node_id"], "drained")
+        return {}, []
+
+    async def rpc_get_nodes(self, h, frames, conn):
+        return {"nodes": [n.to_public() for n in self.nodes.values()]}, []
+
+    # -------------------------------------------------------------- scheduler
+
+    def _schedulable_nodes(self, need, labels=None, node_id=None):
+        out = []
+        for n in self.nodes.values():
+            if not n.alive:
+                continue
+            if node_id is not None and n.node_id != node_id:
+                continue
+            if labels and any(n.labels.get(k) != v for k, v in labels.items()):
+                continue
+            out.append(n)
+        return out
+
+    def _pick_node(self, need: Dict[str, float], strategy: dict) -> Optional[NodeInfo]:
+        """Hybrid policy (reference: ``scheduling/policy/hybrid_scheduling_policy.cc``):
+        pack onto earliest nodes with room, spread when strategy requests it."""
+        pg_id = strategy.get("pg_id")
+        if pg_id:
+            return self._pick_pg_node(need, pg_id, strategy.get("bundle_index", -1))
+        cands = self._schedulable_nodes(
+            need, strategy.get("labels"), strategy.get("node_id")
+        )
+        fitting = [n for n in cands if _fits(n.available, need)]
+        if not fitting:
+            return None
+        if strategy.get("spread"):
+            self._schedule_rr += 1
+            return fitting[self._schedule_rr % len(fitting)]
+        # pack: most-utilized first for binpacking; stable by id
+        fitting.sort(key=lambda n: (sum(n.available.values()), n.node_id))
+        return fitting[0]
+
+    def _pick_pg_node(self, need, pg_id, bundle_index) -> Optional[NodeInfo]:
+        pg = self.pgs.get(pg_id)
+        if pg is None or pg.state != "CREATED":
+            return None
+        indices = [bundle_index] if bundle_index >= 0 else range(len(pg.bundles))
+        for i in indices:
+            node_id = pg.bundle_nodes[i]
+            if node_id is None:
+                continue
+            node = self.nodes.get(node_id)
+            reserved = self.pg_reserved[pg_id][i]
+            if node and node.alive and _fits(reserved, need):
+                _acquire(reserved, need)
+                return node
+        return None
+
+    async def rpc_lease(self, h, frames, conn):
+        """Grant up to ``count`` leases for ``resources`` (one task slot each).
+
+        Reference shape: NormalTaskSubmitter's RequestWorkerLease
+        (``task_submission/normal_task_submitter.h:271``) against the raylet's
+        ClusterLeaseManager; here the head is the single lease authority.
+        """
+        need = {k: float(v) for k, v in h.get("resources", {}).items()}
+        strategy = h.get("strategy", {})
+        count = h.get("count", 1)
+        timeout = h.get("timeout", 30.0)
+        grants = []
+        deadline = time.monotonic() + timeout
+        while len(grants) < count:
+            node = self._pick_node(need, strategy)
+            if node is not None:
+                if not strategy.get("pg_id"):
+                    _acquire(node.available, need)
+                grants.append({"node_id": node.node_id, "addr": list(node.addr)})
+                continue
+            if grants:
+                break  # return partial grants rather than blocking
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            fut = asyncio.get_running_loop().create_future()
+            self._pending_waiters.append(fut)
+            try:
+                await asyncio.wait_for(fut, timeout=min(remaining, 1.0))
+            except asyncio.TimeoutError:
+                pass
+        return {"grants": grants, "resources": need}, []
+
+    async def rpc_release_lease(self, h, frames, conn):
+        need = {k: float(v) for k, v in h.get("resources", {}).items()}
+        strategy = h.get("strategy", {})
+        pg_id = strategy.get("pg_id")
+        if pg_id:
+            pg = self.pgs.get(pg_id)
+            if pg is not None:
+                # return to the bundle's reservation
+                idx = strategy.get("bundle_index", -1)
+                node_id = h.get("node_id")
+                indices = [idx] if idx >= 0 else range(len(pg.bundles))
+                for i in indices:
+                    if pg.bundle_nodes[i] == node_id:
+                        _release(self.pg_reserved[pg_id][i], need)
+                        break
+        else:
+            node = self.nodes.get(h["node_id"])
+            if node is not None:
+                _release(node.available, need)
+        self._wake_waiters()
+        return {}, []
+
+    def _wake_waiters(self):
+        waiters, self._pending_waiters = self._pending_waiters, []
+        for fut in waiters:
+            if not fut.done():
+                fut.set_result(None)
+
+    # ----------------------------------------------------------------- actors
+
+    async def rpc_create_actor(self, h, frames, conn):
+        """Register + schedule an actor (reference: GcsActorManager
+        ``HandleRegisterActor``/``HandleCreateActor``
+        ``gcs/actor/gcs_actor_manager.cc:310/:429`` + GcsActorScheduler)."""
+        actor_id = h["actor_id"]
+        name = h.get("name") or None
+        ns = h.get("namespace", "default")
+        if name:
+            key = (ns, name)
+            if key in self.named_actors:
+                existing = self.actors.get(self.named_actors[key])
+                if existing is not None and existing.state != "DEAD":
+                    if h.get("get_if_exists"):
+                        return {"existing": existing.to_public()}, []
+                    raise protocol.RpcError(
+                        f"actor name '{name}' already taken in namespace '{ns}'"
+                    )
+        info = ActorInfo(
+            actor_id=actor_id,
+            name=name,
+            namespace=ns,
+            state="PENDING",
+            node_id=None,
+            addr=None,
+            resources={k: float(v) for k, v in h.get("resources", {}).items()},
+            max_restarts=h.get("max_restarts", 0),
+            creation_frames=list(frames),
+            class_name=h.get("class_name", ""),
+            pg_id=(h.get("strategy") or {}).get("pg_id"),
+            bundle_index=(h.get("strategy") or {}).get("bundle_index", -1),
+        )
+        self.actors[actor_id] = info
+        if name:
+            self.named_actors[(ns, name)] = actor_id
+        ok = await self._schedule_actor(info, h.get("strategy") or {})
+        if not ok:
+            info.state = "DEAD"
+            info.death_reason = "unschedulable: insufficient resources"
+            raise protocol.RpcError(info.death_reason)
+        return {"addr": list(info.addr), "node_id": info.node_id}, []
+
+    async def _schedule_actor(self, info: ActorInfo, strategy: dict) -> bool:
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            node = self._pick_node(info.resources, strategy)
+            if node is None:
+                fut = asyncio.get_running_loop().create_future()
+                self._pending_waiters.append(fut)
+                try:
+                    await asyncio.wait_for(fut, timeout=1.0)
+                except asyncio.TimeoutError:
+                    pass
+                continue
+            if not strategy.get("pg_id"):
+                _acquire(node.available, info.resources)
+            try:
+                await node.conn.call(
+                    "create_actor",
+                    {"actor_id": info.actor_id},
+                    info.creation_frames,
+                )
+            except protocol.RpcError as e:
+                # Actor __init__ raised: actor is born dead; surface the error.
+                if not strategy.get("pg_id"):
+                    _release(node.available, info.resources)
+                info.state = "DEAD"
+                info.death_reason = str(e)
+                self.publish(f"actor:{info.actor_id}", info.to_public())
+                raise
+            except protocol.ConnectionLost:
+                continue  # node died mid-create; try another
+            info.node_id = node.node_id
+            info.addr = node.addr
+            info.state = "ALIVE"
+            self.publish(f"actor:{info.actor_id}", info.to_public())
+            return True
+        return False
+
+    def _release_actor_placement(self, actor: ActorInfo):
+        """Return the actor's reserved resources to its (still-alive) node or
+        PG bundle. No-op when the node is dead: its whole availability died
+        with it."""
+        if actor.node_id is None:
+            return
+        node = self.nodes.get(actor.node_id)
+        if node is None or not node.alive:
+            return
+        if actor.pg_id:
+            reserved = self.pg_reserved.get(actor.pg_id)
+            pg = self.pgs.get(actor.pg_id)
+            if reserved is None or pg is None:
+                return
+            indices = (
+                [actor.bundle_index]
+                if actor.bundle_index >= 0
+                else [
+                    i for i, nid in enumerate(pg.bundle_nodes)
+                    if nid == actor.node_id
+                ]
+            )
+            if indices:
+                _release(reserved[indices[0]], actor.resources)
+        else:
+            _release(node.available, actor.resources)
+        self._wake_waiters()
+
+    async def _on_actor_dead(self, actor: ActorInfo, reason: str):
+        if actor.state == "DEAD":
+            return
+        restartable = actor.restarts_used < actor.max_restarts or actor.max_restarts == -1
+        if restartable:
+            self._release_actor_placement(actor)
+            actor.restarts_used += 1
+            actor.state = "RESTARTING"
+            actor.death_reason = reason
+            self.publish(f"actor:{actor.actor_id}", actor.to_public())
+            strategy = {}
+            if actor.pg_id:
+                strategy = {"pg_id": actor.pg_id, "bundle_index": actor.bundle_index}
+            try:
+                ok = await self._schedule_actor(actor, strategy)
+            except protocol.RpcError:
+                ok = False
+            if not ok:
+                actor.state = "DEAD"
+                self.publish(f"actor:{actor.actor_id}", actor.to_public())
+        else:
+            actor.state = "DEAD"
+            actor.death_reason = reason
+            if actor.name:
+                self.named_actors.pop((actor.namespace, actor.name), None)
+            self._release_actor_placement(actor)
+            self.publish(f"actor:{actor.actor_id}", actor.to_public())
+
+    async def rpc_actor_exited(self, h, frames, conn):
+        """A node reports that an actor exited (clean exit or crash)."""
+        actor = self.actors.get(h["actor_id"])
+        if actor is None:
+            return {}, []
+        if h.get("clean"):
+            actor.max_restarts = 0  # intentional exit is never restarted
+        await self._on_actor_dead(actor, h.get("reason", "actor exited"))
+        return {}, []
+
+    async def rpc_kill_actor(self, h, frames, conn):
+        actor = self.actors.get(h["actor_id"])
+        if actor is None:
+            return {"found": False}, []
+        if h.get("no_restart", True):
+            actor.max_restarts = 0
+        node = self.nodes.get(actor.node_id) if actor.node_id else None
+        if node is not None and node.conn is not None and actor.state == "ALIVE":
+            try:
+                await node.conn.call("kill_actor", {"actor_id": actor.actor_id})
+            except (protocol.RpcError, protocol.ConnectionLost):
+                pass
+        await self._on_actor_dead(actor, "killed via kill_actor")
+        return {"found": True}, []
+
+    async def rpc_get_actor(self, h, frames, conn):
+        if "name" in h:
+            aid = self.named_actors.get((h.get("namespace", "default"), h["name"]))
+            if aid is None:
+                return {"found": False}, []
+            actor = self.actors.get(aid)
+        else:
+            actor = self.actors.get(h["actor_id"])
+        if actor is None:
+            return {"found": False}, []
+        return {"found": True, "actor": actor.to_public()}, []
+
+    async def rpc_list_actors(self, h, frames, conn):
+        return {"actors": [a.to_public() for a in self.actors.values()]}, []
+
+    # ------------------------------------------------------- placement groups
+
+    async def rpc_create_pg(self, h, frames, conn):
+        """Two-phase bundle reservation (reference: GcsPlacementGroupScheduler
+        prepare/commit ``gcs_placement_group_scheduler.h:115-117``). On a
+        single head the phases collapse, but bundles are still all-or-nothing."""
+        pg_id = h["pg_id"]
+        bundles = [
+            {k: float(v) for k, v in b.items()} for b in h["bundles"]
+        ]
+        strategy = h.get("pg_strategy", "PACK")
+        pg = PlacementGroupInfo(
+            pg_id=pg_id, bundles=bundles, strategy=strategy, state="PENDING",
+            bundle_nodes=[None] * len(bundles), name=h.get("name", ""),
+        )
+        self.pgs[pg_id] = pg
+        deadline = time.monotonic() + h.get("timeout", 30.0)
+        while time.monotonic() < deadline:
+            placement = self._try_place_bundles(pg)
+            if placement is not None:
+                for i, node in enumerate(placement):
+                    _acquire(node.available, bundles[i])
+                    pg.bundle_nodes[i] = node.node_id
+                self.pg_reserved[pg_id] = [dict(b) for b in bundles]
+                pg.state = "CREATED"
+                self.publish(f"pg:{pg_id}", pg.to_public())
+                return {"state": "CREATED", "bundle_nodes": pg.bundle_nodes}, []
+            fut = asyncio.get_running_loop().create_future()
+            self._pending_waiters.append(fut)
+            try:
+                await asyncio.wait_for(fut, timeout=1.0)
+            except asyncio.TimeoutError:
+                pass
+        return {"state": "PENDING"}, []
+
+    def _try_place_bundles(self, pg) -> Optional[List[NodeInfo]]:
+        # Work on a scratch copy of availability so it's all-or-nothing.
+        scratch = {
+            n.node_id: dict(n.available) for n in self.nodes.values() if n.alive
+        }
+        chosen: List[str] = []
+        nodes_sorted = sorted(
+            (n for n in self.nodes.values() if n.alive), key=lambda n: n.node_id
+        )
+        for i, bundle in enumerate(pg.bundles):
+            placed = None
+            if pg.strategy in ("STRICT_PACK",):
+                cands = [chosen[0]] if chosen else [n.node_id for n in nodes_sorted]
+            elif pg.strategy == "STRICT_SPREAD":
+                cands = [n.node_id for n in nodes_sorted if n.node_id not in chosen]
+            elif pg.strategy == "SPREAD":
+                cands = sorted(
+                    (n.node_id for n in nodes_sorted),
+                    key=lambda nid: chosen.count(nid),
+                )
+            else:  # PACK: prefer reusing nodes already chosen
+                cands = sorted(
+                    (n.node_id for n in nodes_sorted),
+                    key=lambda nid: (0 if nid in chosen else 1, nid),
+                )
+            for nid in cands:
+                if nid in scratch and _fits(scratch[nid], bundle):
+                    _acquire(scratch[nid], bundle)
+                    placed = nid
+                    break
+            if placed is None:
+                return None
+            chosen.append(placed)
+        return [self.nodes[nid] for nid in chosen]
+
+    async def rpc_remove_pg(self, h, frames, conn):
+        pg = self.pgs.get(h["pg_id"])
+        if pg is None or pg.state == "REMOVED":
+            return {}, []
+        if pg.state == "CREATED":
+            for i, nid in enumerate(pg.bundle_nodes):
+                node = self.nodes.get(nid) if nid else None
+                if node is not None and node.alive:
+                    # Return whatever of the bundle is not currently loaned out;
+                    # loaned resources return via release_lease.
+                    _release(node.available, pg.bundles[i])
+        pg.state = "REMOVED"
+        self.pg_reserved.pop(pg.pg_id, None)
+        self._wake_waiters()
+        self.publish(f"pg:{pg.pg_id}", pg.to_public())
+        return {}, []
+
+    async def rpc_get_pg(self, h, frames, conn):
+        pg = self.pgs.get(h["pg_id"])
+        if pg is None:
+            return {"found": False}, []
+        return {"found": True, "pg": pg.to_public()}, []
+
+    async def rpc_list_pgs(self, h, frames, conn):
+        return {"pgs": [p.to_public() for p in self.pgs.values()]}, []
+
+    # ----------------------------------------------------------------- pubsub
+
+    async def rpc_subscribe(self, h, frames, conn):
+        self.subscribers[h["channel"]].append(conn)
+        return {}, []
+
+    async def rpc_publish(self, h, frames, conn):
+        self.publish(h["channel"], h.get("data"), frames)
+        return {}, []
+
+    def publish(self, channel: str, data, frames: List[bytes] = ()):
+        for conn in list(self.subscribers.get(channel, [])):
+            try:
+                conn.notify("pubsub", {"channel": channel, "data": data}, frames)
+            except protocol.ConnectionLost:
+                self.subscribers[channel].remove(conn)
+
+    # --------------------------------------------------------- object dir
+
+    async def rpc_object_register(self, h, frames, conn):
+        self.object_dir[h["oid"]] = h["meta"]
+        return {}, []
+
+    async def rpc_object_lookup(self, h, frames, conn):
+        meta = self.object_dir.get(h["oid"])
+        return {"found": meta is not None, "meta": meta}, []
+
+    async def rpc_object_free(self, h, frames, conn):
+        metas = [self.object_dir.pop(oid, None) for oid in h["oids"]]
+        return {"metas": [m for m in metas if m]}, []
+
+    # ------------------------------------------------------------- jobs/state
+
+    async def rpc_register_job(self, h, frames, conn):
+        self.jobs[h["job_id"]] = {
+            "job_id": h["job_id"], "start_time": time.time(), "state": "RUNNING",
+        }
+        return {}, []
+
+    async def rpc_task_event(self, h, frames, conn):
+        """Task-event sink (reference: GcsTaskManager fed by the per-worker
+        ``task_event_buffer.h``); bounded ring for the state API."""
+        self.task_events.append(h["event"])
+        if len(self.task_events) > 10000:
+            del self.task_events[: len(self.task_events) - 10000]
+        return {}, []
+
+    async def rpc_list_task_events(self, h, frames, conn):
+        return {"events": self.task_events[-h.get("limit", 1000):]}, []
+
+    async def rpc_ping(self, h, frames, conn):
+        return {"t": time.time()}, []

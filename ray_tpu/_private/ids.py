@@ -1,0 +1,147 @@
+"""Binary IDs for jobs, tasks, actors, objects, nodes, placement groups.
+
+TPU-native analog of the reference ID system (reference:
+``src/ray/common/id.h``, spec in ``src/ray/design_docs/id_specification.md``).
+We keep the same *shape* of the scheme — fixed-width binary IDs, object IDs
+derived from (owner task, return index), actor IDs embedding the job — but the
+layout is our own: every ID is raw bytes with a short type tag, rendered as
+hex. IDs are hashable, comparable, and msgpack/pickle-friendly.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+_UNIQUE_LEN = 16  # bytes of entropy for top-level IDs
+
+
+class BaseID:
+    """Fixed-width binary identifier."""
+
+    __slots__ = ("_bytes",)
+    _len = _UNIQUE_LEN
+
+    def __init__(self, id_bytes: bytes):
+        if not isinstance(id_bytes, bytes) or len(id_bytes) != self._len:
+            raise ValueError(
+                f"{type(self).__name__} requires {self._len} bytes, got {id_bytes!r}"
+            )
+        self._bytes = id_bytes
+
+    @classmethod
+    def from_random(cls):
+        return cls(os.urandom(cls._len))
+
+    @classmethod
+    def from_hex(cls, hex_str: str):
+        return cls(bytes.fromhex(hex_str))
+
+    @classmethod
+    def nil(cls):
+        return cls(b"\x00" * cls._len)
+
+    def is_nil(self) -> bool:
+        return self._bytes == b"\x00" * self._len
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return self._bytes.hex()
+
+    def __hash__(self):
+        return hash((type(self).__name__, self._bytes))
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._bytes == self._bytes
+
+    def __lt__(self, other):
+        return self._bytes < other._bytes
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.hex()})"
+
+    def __reduce__(self):
+        return (type(self), (self._bytes,))
+
+
+class JobID(BaseID):
+    _len = 4
+
+
+class NodeID(BaseID):
+    pass
+
+
+class WorkerID(BaseID):
+    pass
+
+
+class ActorID(BaseID):
+    """ActorID = job id (4 bytes) + 12 random bytes."""
+
+    _len = 16
+
+    @classmethod
+    def of(cls, job_id: JobID):
+        return cls(job_id.binary() + os.urandom(12))
+
+    def job_id(self) -> JobID:
+        return JobID(self._bytes[:4])
+
+
+class TaskID(BaseID):
+    """TaskID = actor id (16 bytes, nil for normal tasks) + 8 random bytes."""
+
+    _len = 24
+
+    @classmethod
+    def of(cls, actor_id: ActorID | None = None):
+        prefix = actor_id.binary() if actor_id is not None else b"\x00" * 16
+        return cls(prefix + os.urandom(8))
+
+    def actor_id(self) -> ActorID:
+        return ActorID(self._bytes[:16])
+
+
+class ObjectID(BaseID):
+    """ObjectID = task id (24 bytes) + return index (4 bytes big-endian).
+
+    Deterministically derived from the producing task, so lineage
+    reconstruction can recompute the same IDs (reference semantics:
+    ``src/ray/common/id.h`` ObjectID::FromIndex).
+    """
+
+    _len = 28
+
+    @classmethod
+    def for_return(cls, task_id: TaskID, index: int):
+        return cls(task_id.binary() + index.to_bytes(4, "big"))
+
+    @classmethod
+    def for_put(cls, task_id: TaskID, put_index: int):
+        # Put objects use the high bit of the index space.
+        return cls(task_id.binary() + (0x80000000 | put_index).to_bytes(4, "big"))
+
+    def task_id(self) -> TaskID:
+        return TaskID(self._bytes[:24])
+
+    def return_index(self) -> int:
+        return int.from_bytes(self._bytes[24:], "big") & 0x7FFFFFFF
+
+
+class PlacementGroupID(BaseID):
+    _len = 16
+
+
+class _Counter:
+    """Thread-safe monotonically increasing counter."""
+
+    def __init__(self):
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def next(self) -> int:
+        with self._lock:
+            self._value += 1
+            return self._value

@@ -1,0 +1,134 @@
+"""Cluster bootstrap: start the head service and spawn node processes.
+
+Reference analog: ``python/ray/_private/node.py`` (``Node.start_head_processes``
+:1344, ``start_raylet`` :1144) + ``services.py``. Round-1 shape: the head
+service runs on the driver's core event loop (same RPC surface as an external
+head, so it can be moved out-of-process later); nodes are subprocesses.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu._private.ids import JobID, NodeID
+
+
+class NodeHandle:
+    def __init__(self, proc: subprocess.Popen, node_id: str, resources: dict):
+        self.proc = proc
+        self.node_id = node_id
+        self.resources = resources
+
+    def kill(self, sig=None):
+        try:
+            self.proc.kill()
+        except ProcessLookupError:
+            pass
+
+    def terminate(self):
+        try:
+            self.proc.terminate()
+        except ProcessLookupError:
+            pass
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+
+def spawn_node(
+    gcs_addr,
+    job_id: JobID,
+    resources: Dict[str, float],
+    labels: Optional[Dict[str, str]] = None,
+    env: Optional[Dict[str, str]] = None,
+    log_level: str = "WARNING",
+) -> NodeHandle:
+    node_id = NodeID.from_random().hex()
+    cmd = [
+        sys.executable,
+        "-m",
+        "ray_tpu._private.worker_main",
+        "--gcs-host", gcs_addr[0],
+        "--gcs-port", str(gcs_addr[1]),
+        "--resources", json.dumps(resources),
+        "--labels", json.dumps(labels or {}),
+        "--job-id", job_id.hex(),
+        "--node-id", node_id,
+        "--log-level", log_level,
+    ]
+    child_env = dict(os.environ)
+    if env:
+        child_env.update(env)
+    # Node processes must not inherit a driver-held TPU.
+    proc = subprocess.Popen(cmd, env=child_env)
+    return NodeHandle(proc, node_id, resources)
+
+
+class LocalCluster:
+    """In-process test/single-machine cluster (reference analog:
+    ``python/ray/cluster_utils.py:137 Cluster`` — multi-node simulated by
+    multiple node processes on one machine)."""
+
+    def __init__(self, head_service, gcs_addr, job_id: JobID, driver_worker):
+        self.head = head_service
+        self.gcs_addr = gcs_addr
+        self.job_id = job_id
+        self.driver = driver_worker
+        self.nodes: List[NodeHandle] = []
+        atexit.register(self.shutdown)
+
+    def add_node(
+        self,
+        resources: Optional[Dict[str, float]] = None,
+        labels: Optional[Dict[str, str]] = None,
+        env: Optional[Dict[str, str]] = None,
+        wait: bool = True,
+    ) -> NodeHandle:
+        resources = dict(resources or {"CPU": 1})
+        resources.setdefault("CPU", 1)
+        handle = spawn_node(self.gcs_addr, self.job_id, resources, labels, env)
+        self.nodes.append(handle)
+        if wait:
+            self.wait_for_nodes(len(self.alive_node_ids_expected()))
+        return handle
+
+    def alive_node_ids_expected(self):
+        return [n.node_id for n in self.nodes if n.alive()]
+
+    def wait_for_nodes(self, count: int, timeout: float = 30.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            alive = [n for n in self.head.nodes.values() if n.alive]
+            if len(alive) >= count:
+                return
+            time.sleep(0.02)
+        raise TimeoutError(
+            f"cluster: only {len([n for n in self.head.nodes.values() if n.alive])}"
+            f"/{count} nodes registered"
+        )
+
+    def kill_node(self, handle: NodeHandle):
+        handle.kill()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            info = self.head.nodes.get(handle.node_id)
+            if info is None or not info.alive:
+                return
+            time.sleep(0.02)
+
+    def shutdown(self):
+        atexit.unregister(self.shutdown)
+        for n in self.nodes:
+            n.terminate()
+        deadline = time.monotonic() + 3
+        for n in self.nodes:
+            while n.alive() and time.monotonic() < deadline:
+                time.sleep(0.02)
+            if n.alive():
+                n.kill()
+        self.nodes.clear()

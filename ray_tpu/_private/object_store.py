@@ -1,0 +1,160 @@
+"""Shared-memory object plane (plasma analog).
+
+Reference: ``src/ray/object_manager/plasma`` — a shm arena owned by the raylet,
+clients map segments and read zero-copy. Our single-machine round-1 design:
+
+- Every *large* object is one POSIX shm segment (``/dev/shm``), created and
+  written once by the producing process, attached read-only (zero-copy) by
+  consumers. Layout: [u32 nframes][u64 len]*nframes then the frame payloads,
+  8-byte aligned, so pickle5 out-of-band buffers deserialize as views into the
+  mapping — a ``numpy``/``jax`` host array read costs no copies.
+- The object *directory* (id → segment metadata) lives in the head service
+  (``gcs.py`` object_dir), standing in for the reference's
+  ``OwnershipObjectDirectory``.
+- The native C++ arena store (``ray_tpu/native/``) slots in behind the same
+  interface for allocation-rate-bound workloads; this file is the portable
+  fallback and the protocol owner.
+
+Small objects never come here — they live in the owner's in-process memory
+store and travel inline (reference: CoreWorkerMemoryStore).
+"""
+from __future__ import annotations
+
+import logging
+import struct
+from multiprocessing import resource_tracker, shared_memory
+from typing import Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+_ALIGN = 8
+_HDR_COUNT = struct.Struct("<I")
+_HDR_LEN = struct.Struct("<Q")
+
+# Segments whose name was freed but whose mapping may still back live
+# zero-copy views. Never GC'd: the mapping must outlive any exported pointer;
+# it is reclaimed at process exit (matches plasma's mmap lifetime).
+_graveyard: List[shared_memory.SharedMemory] = []
+
+
+def _align(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+def _unregister_tracker(shm: shared_memory.SharedMemory):
+    """Detach this segment from the resource_tracker: lifetime is managed by
+    the framework's distributed refcount, not by whichever process happened to
+    touch the segment last (the tracker would unlink at process exit and
+    double-warn)."""
+    try:
+        resource_tracker.unregister(shm._name, "shared_memory")  # noqa: SLF001
+    except Exception:
+        pass
+
+
+def _safe_unlink(shm: shared_memory.SharedMemory):
+    """unlink() itself unregisters with the tracker; re-register first so the
+    tracker's bookkeeping stays balanced (we unregistered at create/attach)."""
+    try:
+        resource_tracker.register(shm._name, "shared_memory")  # noqa: SLF001
+    except Exception:
+        pass
+    shm.unlink()
+
+
+class LocalShmStore:
+    """Create/attach/free shm segments for serialized objects on this machine."""
+
+    def __init__(self, prefix: str = "rt"):
+        self.prefix = prefix
+        # object hex -> (shm handle, pin count). Handles stay attached until
+        # freed; readers may hold zero-copy views into them.
+        self._segments: Dict[str, shared_memory.SharedMemory] = {}
+        self._created: Dict[str, bool] = {}
+
+    def seg_name(self, object_hex: str) -> str:
+        # shm names are limited (~255); object hex is 56 chars.
+        return f"{self.prefix}_{object_hex}"
+
+    def put_frames(self, object_hex: str, frames: List[bytes]) -> dict:
+        """Write frames into a fresh segment; returns directory metadata."""
+        total = _HDR_COUNT.size + _HDR_LEN.size * len(frames)
+        offsets = []
+        for f in frames:
+            total = _align(total)
+            offsets.append(total)
+            total += len(f)
+        name = self.seg_name(object_hex)
+        shm = shared_memory.SharedMemory(name=name, create=True, size=max(total, 1))
+        _unregister_tracker(shm)
+        buf = shm.buf
+        _HDR_COUNT.pack_into(buf, 0, len(frames))
+        pos = _HDR_COUNT.size
+        for f in frames:
+            _HDR_LEN.pack_into(buf, pos, len(f))
+            pos += _HDR_LEN.size
+        for off, f in zip(offsets, frames):
+            buf[off : off + len(f)] = f
+        self._segments[object_hex] = shm
+        self._created[object_hex] = True
+        return {"seg": name, "size": total}
+
+    def get_frames(self, object_hex: str, meta: dict) -> Optional[List[memoryview]]:
+        """Attach and return zero-copy frame views (None if segment is gone)."""
+        shm = self._segments.get(object_hex)
+        if shm is None:
+            try:
+                shm = shared_memory.SharedMemory(name=meta["seg"], create=False)
+            except FileNotFoundError:
+                return None
+            _unregister_tracker(shm)
+            self._segments[object_hex] = shm
+            self._created[object_hex] = False
+        buf = shm.buf
+        nframes = _HDR_COUNT.unpack_from(buf, 0)[0]
+        lens = []
+        pos = _HDR_COUNT.size
+        for _ in range(nframes):
+            lens.append(_HDR_LEN.unpack_from(buf, pos)[0])
+            pos += _HDR_LEN.size
+        frames = []
+        for ln in lens:
+            pos = _align(pos)
+            frames.append(buf[pos : pos + ln])
+            pos += ln
+        return frames
+
+    def contains(self, object_hex: str) -> bool:
+        return object_hex in self._segments
+
+    def free(self, object_hex: str, meta: Optional[dict] = None):
+        shm = self._segments.pop(object_hex, None)
+        created = self._created.pop(object_hex, False)
+        if shm is None and meta is not None:
+            try:
+                shm = shared_memory.SharedMemory(name=meta["seg"], create=False)
+                _unregister_tracker(shm)
+                created = True
+            except FileNotFoundError:
+                return
+        if shm is None:
+            return
+        try:
+            if created:
+                _safe_unlink(shm)
+        except FileNotFoundError:
+            pass
+        # We do NOT shm.close(): readers may still hold zero-copy views into
+        # the mapping. Unlink removes the name; the mapping dies with us.
+        _graveyard.append(shm)
+
+    def close_all(self):
+        for hex_, shm in list(self._segments.items()):
+            try:
+                if self._created.get(hex_):
+                    _safe_unlink(shm)
+            except FileNotFoundError:
+                pass
+            _graveyard.append(shm)
+        self._segments.clear()
+        self._created.clear()

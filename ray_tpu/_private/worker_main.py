@@ -1,0 +1,73 @@
+"""Worker (node) process entrypoint.
+
+TPU-native process-per-host model: one of these processes is one "node" —
+on a real TPU pod it owns all local chips via jax; in tests many of them
+simulate a cluster on one machine (reference analog: raylet + worker combined;
+spawned like ``services.py start_raylet``).
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import signal
+import sys
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs-host", required=True)
+    parser.add_argument("--gcs-port", type=int, required=True)
+    parser.add_argument("--resources", default="{}")
+    parser.add_argument("--labels", default="{}")
+    parser.add_argument("--job-id", required=True)
+    parser.add_argument("--node-id", default="")
+    parser.add_argument("--log-level", default="WARNING")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper(), logging.WARNING),
+        format=f"[rt-worker {os.getpid()}] %(levelname)s %(name)s: %(message)s",
+    )
+
+    # Workers default to CPU jax unless the node was explicitly given TPUs:
+    # only one process may own the TPU chips.
+    resources = json.loads(args.resources)
+    if resources.get("TPU", 0) <= 0:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu._private.ids import JobID
+    from ray_tpu._private.worker import CoreWorker
+
+    core = CoreWorker(
+        is_driver=False,
+        gcs_addr=(args.gcs_host, args.gcs_port),
+        job_id=JobID.from_hex(args.job_id),
+        node_resources=resources,
+        node_labels=json.loads(args.labels),
+    )
+    if args.node_id:
+        core.node_id = args.node_id
+    worker_mod.global_worker = core
+
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    core.loop = loop
+    loop.run_until_complete(core._async_setup())
+    core._install_ref_hooks()
+
+    def handle_term(*_):
+        loop.stop()
+
+    signal.signal(signal.SIGTERM, handle_term)
+    try:
+        loop.run_forever()
+    finally:
+        sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

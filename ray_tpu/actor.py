@@ -1,0 +1,142 @@
+"""Actor classes and handles (reference: ``python/ray/actor.py``)."""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+from ray_tpu._private.worker import get_global_worker
+from ray_tpu.remote_function import _build_resources, _build_strategy
+
+_ACTOR_OPTIONS = {
+    "num_cpus",
+    "num_tpus",
+    "num_gpus",
+    "resources",
+    "max_restarts",
+    "max_task_retries",
+    "max_concurrency",
+    "name",
+    "namespace",
+    "get_if_exists",
+    "lifetime",
+    "scheduling_strategy",
+    "runtime_env",
+    "label_selector",
+}
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor method '{self._method_name}' cannot be called directly; "
+            f"use .{self._method_name}.remote()."
+        )
+
+    def options(self, num_returns: int = 1, **_):
+        return ActorMethod(self._handle, self._method_name, num_returns)
+
+    def remote(self, *args, **kwargs):
+        worker = get_global_worker()
+        refs = worker.submit_actor_task(
+            self._handle._actor_id_hex,
+            self._method_name,
+            args,
+            kwargs,
+            num_returns=self._num_returns,
+            max_task_retries=self._handle._max_task_retries,
+        )
+        if self._num_returns == 1:
+            return refs[0]
+        return refs
+
+
+class ActorHandle:
+    def __init__(self, actor_id_hex: str, addr=None, max_task_retries: int = 0,
+                 class_name: str = "Actor"):
+        self._actor_id_hex = actor_id_hex
+        self._addr = tuple(addr) if addr else None
+        self._max_task_retries = max_task_retries
+        self._class_name = class_name
+        if addr is not None:
+            try:
+                get_global_worker().get_actor_channel(actor_id_hex, addr)
+            except Exception:
+                pass
+
+    @property
+    def _actor_id(self):
+        return self._actor_id_hex
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return ActorMethod(self, item)
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id_hex[:16]})"
+
+    def __reduce__(self):
+        return (
+            ActorHandle,
+            (self._actor_id_hex, self._addr, self._max_task_retries, self._class_name),
+        )
+
+
+class ActorClass:
+    def __init__(self, cls, options: Optional[Dict[str, Any]] = None):
+        self._cls = cls
+        self._options = dict(options or {})
+        functools.update_wrapper(self, cls, updated=[])
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class '{self._cls.__name__}' cannot be instantiated directly; "
+            f"use {self._cls.__name__}.remote()."
+        )
+
+    def options(self, **opts) -> "ActorClass":
+        bad = set(opts) - _ACTOR_OPTIONS
+        if bad:
+            raise ValueError(f"unknown actor options: {sorted(bad)}")
+        merged = dict(self._options)
+        merged.update(opts)
+        return ActorClass(self._cls, merged)
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        worker = get_global_worker()
+        opts = self._options
+        max_restarts = opts.get("max_restarts", 0)
+        actor_id, addr, existing = worker.create_actor(
+            self._cls,
+            args,
+            kwargs,
+            resources=_build_resources(opts),
+            strategy=_build_strategy(opts),
+            max_restarts=max_restarts,
+            max_concurrency=opts.get("max_concurrency", 1),
+            name=opts.get("name"),
+            namespace=opts.get("namespace", "default"),
+            get_if_exists=opts.get("get_if_exists", False),
+            runtime_env=opts.get("runtime_env"),
+        )
+        return ActorHandle(
+            actor_id if isinstance(actor_id, str) else actor_id.hex(),
+            addr,
+            opts.get("max_task_retries", 0),
+            self._cls.__name__,
+        )
+
+    @property
+    def underlying_class(self):
+        return self._cls
+
+
+def exit_actor():
+    """Terminate the current actor from inside one of its methods
+    (reference: ``ray.actor.exit_actor``)."""
+    raise SystemExit(0)

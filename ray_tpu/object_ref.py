@@ -1,0 +1,122 @@
+"""ObjectRef: a first-class future/handle to a value in the object plane.
+
+Reference: ``python/ray/includes/object_ref.pxi`` + ownership model in
+``src/ray/core_worker/reference_counter.h``. Each ref knows its id and its
+*owner* (the worker that created it); serializing a ref inside another value
+records a borrow with the owner so distributed refcounting stays correct.
+"""
+from __future__ import annotations
+
+import contextvars
+import threading
+from typing import Any, List, Optional
+
+from ray_tpu._private.ids import ObjectID
+
+# While serializing a value, collects ObjectRefs discovered inside it.
+_serialization_sink: contextvars.ContextVar[Optional[List["ObjectRef"]]] = (
+    contextvars.ContextVar("rt_ref_sink", default=None)
+)
+
+
+def collect_refs_during(fn):
+    """Run fn(), returning (result, refs_serialized_during_fn)."""
+    sink: List[ObjectRef] = []
+    token = _serialization_sink.set(sink)
+    try:
+        return fn(), sink
+    finally:
+        _serialization_sink.reset(token)
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owner", "_weakref_released", "__weakref__")
+
+    _release_hook = None  # installed by the worker; called on __del__
+    _deserialize_hook = None  # called when a ref is materialized from the wire
+    _lock = threading.Lock()
+
+    def __init__(self, object_id: ObjectID, owner_addr: Optional[tuple] = None):
+        self._id = object_id
+        self._owner = owner_addr
+        self._weakref_released = False
+
+    def id(self) -> ObjectID:
+        return self._id
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    @property
+    def owner_address(self):
+        return self._owner
+
+    def future(self):
+        """Return a concurrent.futures.Future resolving to the value."""
+        from ray_tpu._private.worker import global_worker
+
+        return global_worker.as_future(self)
+
+    def __await__(self):
+        from ray_tpu._private.worker import global_worker
+
+        return global_worker.as_asyncio_future(self).__await__()
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()})"
+
+    def __reduce__(self):
+        sink = _serialization_sink.get()
+        if sink is not None:
+            sink.append(self)
+        return (_deserialize_ref, (self._id, self._owner))
+
+    def __del__(self):
+        hook = ObjectRef._release_hook
+        if hook is not None and not self._weakref_released:
+            try:
+                hook(self._id)
+            except Exception:
+                pass
+
+
+def _deserialize_ref(object_id: ObjectID, owner: Optional[tuple]) -> ObjectRef:
+    ref = ObjectRef(object_id, owner)
+    hook = ObjectRef._deserialize_hook
+    if hook is not None:
+        try:
+            hook(ref)
+        except Exception:
+            pass
+    return ref
+
+
+class StreamingObjectRefGenerator:
+    """Iterator over a dynamic number of returns (reference: streaming generators,
+    ``core_worker/task_manager.h`` generator returns)."""
+
+    def __init__(self, refs: List[ObjectRef]):
+        self._refs = list(refs)
+        self._i = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ObjectRef:
+        if self._i >= len(self._refs):
+            raise StopIteration
+        ref = self._refs[self._i]
+        self._i += 1
+        return ref
+
+    def __len__(self):
+        return len(self._refs)

@@ -1,0 +1,40 @@
+"""Runtime context (reference: ``python/ray/runtime_context.py``)."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ray_tpu._private.worker import get_global_worker
+
+
+@dataclass
+class RuntimeContext:
+    job_id: str
+    node_id: str
+    worker_id: str
+    is_driver: bool
+    gcs_address: tuple
+
+    def get_job_id(self) -> str:
+        return self.job_id
+
+    def get_node_id(self) -> str:
+        return self.node_id
+
+    def get_worker_id(self) -> str:
+        return self.worker_id
+
+    def get_task_id(self):
+        w = get_global_worker()
+        tid = getattr(w.current_task_id, "value", None)
+        return tid.hex() if tid is not None else None
+
+
+def get_runtime_context() -> RuntimeContext:
+    w = get_global_worker()
+    return RuntimeContext(
+        job_id=w.job_id.hex(),
+        node_id=w.node_id,
+        worker_id=w.worker_id.hex(),
+        is_driver=w.is_driver,
+        gcs_address=w.gcs_addr,
+    )

@@ -1,0 +1,86 @@
+"""Placement groups (reference: ``python/ray/util/placement_group.py`` +
+GCS-side 2PC in ``gcs_placement_group_scheduler.h:115``)."""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu._private.ids import PlacementGroupID
+from ray_tpu._private.worker import get_global_worker
+from ray_tpu.exceptions import PlacementGroupUnavailableError
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: str, bundles: List[Dict[str, float]], strategy: str):
+        self.id = pg_id
+        self.bundles = bundles
+        self.strategy = strategy
+
+    @property
+    def bundle_specs(self) -> List[Dict[str, float]]:
+        return self.bundles
+
+    def ready(self, timeout: float = 30.0) -> bool:
+        w = get_global_worker()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            h = w.run_sync(w.gcs.call("get_pg", {"pg_id": self.id}))[0]
+            if h.get("found") and h["pg"]["state"] == "CREATED":
+                return True
+            if h.get("found") and h["pg"]["state"] == "REMOVED":
+                return False
+            time.sleep(0.02)
+        return False
+
+    def wait(self, timeout_seconds: float = 30.0) -> bool:
+        return self.ready(timeout_seconds)
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id, self.bundles, self.strategy))
+
+
+def placement_group(
+    bundles: List[Dict[str, float]],
+    strategy: str = "PACK",
+    name: str = "",
+    lifetime: Optional[str] = None,
+    timeout: float = 30.0,
+) -> PlacementGroup:
+    if strategy not in ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD"):
+        raise ValueError(f"invalid placement strategy {strategy}")
+    if not bundles:
+        raise ValueError("placement group requires at least one bundle")
+    w = get_global_worker()
+    pg_id = PlacementGroupID.from_random().hex()
+    h = w.run_sync(
+        w.gcs.call(
+            "create_pg",
+            {
+                "pg_id": pg_id,
+                "bundles": [{k: float(v) for k, v in b.items()} for b in bundles],
+                "pg_strategy": strategy,
+                "name": name,
+                "timeout": timeout,
+            },
+        ),
+        timeout=timeout + 10,
+    )[0]
+    pg = PlacementGroup(pg_id, bundles, strategy)
+    if h.get("state") != "CREATED":
+        # Stays PENDING server-side; caller can still .ready() poll.
+        pass
+    return pg
+
+
+def remove_placement_group(pg: PlacementGroup):
+    w = get_global_worker()
+    w.run_sync(w.gcs.call("remove_pg", {"pg_id": pg.id}))
+
+
+def get_placement_group(pg_id: str) -> Optional[PlacementGroup]:
+    w = get_global_worker()
+    h = w.run_sync(w.gcs.call("get_pg", {"pg_id": pg_id}))[0]
+    if not h.get("found"):
+        return None
+    info = h["pg"]
+    return PlacementGroup(info["placement_group_id"], info["bundles"], info["strategy"])

@@ -1,0 +1,44 @@
+"""Scheduling strategies (reference: ``python/ray/util/scheduling_strategies.py``:
+PlacementGroupSchedulingStrategy :17, NodeAffinitySchedulingStrategy :43,
+NodeLabelSchedulingStrategy :164)."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@dataclass
+class PlacementGroupSchedulingStrategy:
+    placement_group: object  # PlacementGroup
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False
+
+    def to_dict(self) -> dict:
+        return {
+            "pg_id": self.placement_group.id,
+            "bundle_index": self.placement_group_bundle_index,
+        }
+
+
+@dataclass
+class NodeAffinitySchedulingStrategy:
+    node_id: str
+    soft: bool = False
+
+    def to_dict(self) -> dict:
+        return {"node_id": self.node_id, "soft": self.soft}
+
+
+@dataclass
+class NodeLabelSchedulingStrategy:
+    hard: Dict[str, str] = field(default_factory=dict)
+    soft: Dict[str, str] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"labels": dict(self.hard)}
+
+
+@dataclass
+class SpreadSchedulingStrategy:
+    def to_dict(self) -> dict:
+        return {"spread": True}

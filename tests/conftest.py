@@ -1,0 +1,40 @@
+"""Test fixtures (reference analog: ``python/ray/tests/conftest.py`` —
+ray_start_regular :611 / ray_start_cluster :694).
+
+JAX tests run on a virtual 8-device CPU mesh: set before any jax import.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rt_start(request):
+    """Start a small cluster; params: dict(num_cpus=..., num_nodes=...)."""
+    import ray_tpu
+
+    kwargs = getattr(request, "param", None) or {}
+    kwargs.setdefault("num_cpus", 4)
+    ctx = ray_tpu.init(**kwargs)
+    yield ctx
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def rt_cluster(request):
+    """Multi-node cluster fixture: yields (module, LocalCluster)."""
+    import ray_tpu
+
+    kwargs = getattr(request, "param", None) or {}
+    kwargs.setdefault("num_cpus", 2)
+    kwargs.setdefault("num_nodes", 2)
+    ray_tpu.init(**kwargs)
+    yield ray_tpu, ray_tpu._internal_cluster()
+    ray_tpu.shutdown()

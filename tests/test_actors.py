@@ -1,0 +1,173 @@
+"""Actor API tests (reference analog: python/ray/tests/test_actor*.py)."""
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import ActorDiedError, ActorError, TaskError
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def inc(self, k=1):
+        self.n += k
+        return self.n
+
+    def read(self):
+        return self.n
+
+    def fail(self):
+        raise RuntimeError("actor method failed")
+
+    def die(self):
+        import os
+        os._exit(1)
+
+    def leave(self):
+        ray_tpu.exit_actor()
+
+
+def test_actor_basic(rt_start):
+    c = Counter.remote(10)
+    assert ray_tpu.get(c.inc.remote()) == 11
+    assert ray_tpu.get(c.inc.remote(5)) == 16
+    assert ray_tpu.get(c.read.remote()) == 16
+
+
+def test_actor_ordering(rt_start):
+    c = Counter.remote()
+    refs = [c.inc.remote() for _ in range(50)]
+    results = ray_tpu.get(refs)
+    assert results == list(range(1, 51))
+
+
+def test_actor_method_error(rt_start):
+    c = Counter.remote()
+    with pytest.raises(TaskError, match="actor method failed"):
+        ray_tpu.get(c.fail.remote())
+    # actor still alive after method error
+    assert ray_tpu.get(c.read.remote()) == 0
+
+
+def test_actor_init_error(rt_start):
+    @ray_tpu.remote
+    class Bad:
+        def __init__(self):
+            raise ValueError("bad init")
+
+    with pytest.raises(Exception, match="bad init"):
+        Bad.remote()
+
+
+def test_named_actor(rt_start):
+    Counter.options(name="global_counter").remote(5)
+    h = ray_tpu.get_actor("global_counter")
+    assert ray_tpu.get(h.read.remote()) == 5
+
+
+def test_named_actor_get_if_exists(rt_start):
+    a = Counter.options(name="shared", get_if_exists=True).remote(1)
+    b = Counter.options(name="shared", get_if_exists=True).remote(99)
+    ray_tpu.get(a.inc.remote())
+    assert ray_tpu.get(b.read.remote()) == 2  # same actor
+
+
+def test_actor_handle_passing(rt_start):
+    c = Counter.remote()
+
+    @ray_tpu.remote
+    def bump(handle, k):
+        return ray_tpu.get(handle.inc.remote(k))
+
+    assert ray_tpu.get(bump.remote(c, 7)) == 7
+    assert ray_tpu.get(c.read.remote()) == 7
+
+
+def test_kill_actor(rt_start):
+    c = Counter.remote()
+    assert ray_tpu.get(c.read.remote()) == 0
+    ray_tpu.kill(c)
+    with pytest.raises(ActorError):
+        ray_tpu.get(c.read.remote())
+
+
+def test_exit_actor(rt_start):
+    c = Counter.remote()
+    ref = c.leave.remote()
+    with pytest.raises(ActorError):
+        ray_tpu.get(ref)
+    with pytest.raises(ActorError):
+        ray_tpu.get(c.read.remote())
+
+
+def test_async_actor(rt_start):
+    @ray_tpu.remote
+    class AsyncWorkerActor:
+        async def work(self, x):
+            import asyncio
+            await asyncio.sleep(0.01)
+            return x * 2
+
+    a = AsyncWorkerActor.options(max_concurrency=8).remote()
+    refs = [a.work.remote(i) for i in range(16)]
+    assert ray_tpu.get(refs) == [i * 2 for i in range(16)]
+
+
+def test_max_concurrency_threads(rt_start):
+    @ray_tpu.remote
+    class Slow:
+        def work(self):
+            time.sleep(0.3)
+            return 1
+
+    a = Slow.options(max_concurrency=4).remote()
+    t0 = time.time()
+    ray_tpu.get([a.work.remote() for _ in range(4)])
+    elapsed = time.time() - t0
+    assert elapsed < 1.0, f"4 concurrent calls took {elapsed:.2f}s (not concurrent)"
+
+
+def test_actor_with_ref_args(rt_start):
+    """Regression: ObjectRef passed to an actor constructor must materialize."""
+    ref = ray_tpu.put(41)
+
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self, v):
+            self.v = v + 1
+
+        def get(self):
+            return self.v
+
+    h = Holder.remote(ref)
+    assert ray_tpu.get(h.get.remote()) == 42
+
+
+def test_actor_bad_method_does_not_wedge(rt_start):
+    """Regression: a failed call must not block later calls from same caller."""
+    c = Counter.remote()
+    bad = c.no_such_method.remote()
+    good = c.inc.remote()
+    with pytest.raises(Exception, match="no method"):
+        ray_tpu.get(bad)
+    assert ray_tpu.get(good, timeout=10) == 1
+
+
+def test_async_actor_blocking_get(rt_start):
+    """Regression: blocking ray_tpu.get inside an async method must not
+    deadlock the worker's core loop."""
+
+    @ray_tpu.remote
+    def produce():
+        return 7
+
+    @ray_tpu.remote
+    class AsyncGetter:
+        async def fetch(self):
+            return ray_tpu.get(produce.remote()) + 1
+
+    a = AsyncGetter.remote()
+    assert ray_tpu.get(a.fetch.remote(), timeout=30) == 8

@@ -1,0 +1,175 @@
+"""Core task/object API tests (reference analog: python/ray/tests/test_basic*.py)."""
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import GetTimeoutError, TaskError
+
+
+def test_put_get(rt_start):
+    ref = ray_tpu.put(42)
+    assert ray_tpu.get(ref) == 42
+    ref2 = ray_tpu.put({"a": [1, 2, 3], "b": "x"})
+    assert ray_tpu.get(ref2) == {"a": [1, 2, 3], "b": "x"}
+
+
+def test_put_get_large_numpy(rt_start):
+    arr = np.arange(1_000_000, dtype=np.float32)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_simple_task(rt_start):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_task_with_ref_args(rt_start):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    x = ray_tpu.put(10)
+    y = add.remote(x, 5)
+    z = add.remote(y, y)
+    assert ray_tpu.get(z) == 30
+
+
+def test_task_chain_many(rt_start):
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    ref = ray_tpu.put(0)
+    for _ in range(20):
+        ref = inc.remote(ref)
+    assert ray_tpu.get(ref) == 20
+
+
+def test_many_parallel_tasks(rt_start):
+    @ray_tpu.remote
+    def f(i):
+        return i * i
+
+    refs = [f.remote(i) for i in range(200)]
+    assert ray_tpu.get(refs) == [i * i for i in range(200)]
+
+
+def test_task_error_propagates(rt_start):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    with pytest.raises(TaskError, match="kaboom"):
+        ray_tpu.get(boom.remote())
+
+
+def test_num_returns(rt_start):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_get_timeout(rt_start):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return 1
+
+    ref = slow.remote()
+    with pytest.raises(GetTimeoutError):
+        ray_tpu.get(ref, timeout=0.2)
+
+
+def test_wait(rt_start):
+    @ray_tpu.remote
+    def sleepy(t):
+        time.sleep(t)
+        return t
+
+    fast = sleepy.remote(0.05)
+    slow = sleepy.remote(2.0)
+    ready, not_ready = ray_tpu.wait([fast, slow], num_returns=1, timeout=5)
+    assert ready == [fast]
+    assert not_ready == [slow]
+
+
+def test_nested_tasks(rt_start):
+    @ray_tpu.remote
+    def inner(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 1
+
+    assert ray_tpu.get(outer.remote(10)) == 21
+
+
+def test_large_return_via_shm(rt_start):
+    @ray_tpu.remote
+    def big():
+        return np.ones((512, 1024), dtype=np.float32)
+
+    out = ray_tpu.get(big.remote())
+    assert out.shape == (512, 1024)
+    assert out.dtype == np.float32
+    assert float(out.sum()) == 512 * 1024
+
+
+def test_options_override(rt_start):
+    @ray_tpu.remote
+    def f():
+        return "ok"
+
+    assert ray_tpu.get(f.options(num_cpus=2, name="custom").remote()) == "ok"
+
+
+def test_runtime_env_env_vars(rt_start):
+    import os as _os
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"RT_TEST_VAR": "hello"}})
+    def read_env():
+        return _os.environ.get("RT_TEST_VAR")
+
+    assert ray_tpu.get(read_env.remote()) == "hello"
+
+
+def test_cluster_resources(rt_start):
+    total = ray_tpu.cluster_resources()
+    assert total.get("CPU", 0) >= 4
+
+
+def test_runtime_context(rt_start):
+    ctx = ray_tpu.get_runtime_context()
+    assert ctx.is_driver
+    assert ctx.get_job_id()
+
+    @ray_tpu.remote
+    def in_task():
+        c = ray_tpu.get_runtime_context()
+        return (c.is_driver, c.get_task_id() is not None)
+
+    assert ray_tpu.get(in_task.remote()) == (False, True)
+
+
+def test_put_nested_ref_pinned(rt_start):
+    """Regression: a ref nested in a put() value pins the inner object."""
+    import gc
+
+    inner = ray_tpu.put(123)
+    outer = ray_tpu.put([inner])
+    del inner
+    gc.collect()
+    time.sleep(0.2)
+    inner_again = ray_tpu.get(outer)[0]
+    assert ray_tpu.get(inner_again, timeout=10) == 123
