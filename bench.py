@@ -1,0 +1,114 @@
+"""Round benchmark: prints ONE JSON line with the headline metric.
+
+Headline = single_client_tasks_async vs the reference's checked-in number
+(BASELINE.md: 7,096.8 tasks/s on a release CPU node). Extra fields carry the
+other core microbenchmarks plus GPT-2 train throughput on the local
+accelerator (tokens/sec/chip — the BASELINE.json north star; the reference
+publishes no TPU number for it, so vs_baseline stays anchored to tasks/s).
+
+Usage: python bench.py [--quick] [--no-train]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+BASELINE_TASKS_ASYNC = 7096.8  # reference release/perf_metrics/microbenchmark.json
+
+
+def bench_train_tokens_per_sec(quick: bool = False):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models import gpt2
+    from ray_tpu.train.step import (
+        OptimizerConfig,
+        create_train_state,
+        make_train_step,
+    )
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu and not quick:
+        config = gpt2.GPT2Config(
+            vocab_size=50304, max_seq_len=1024, num_layers=12, num_heads=12,
+            embed_dim=768,
+        )
+        B, T = 8, 1024
+        steps = 20
+    else:
+        config = gpt2.GPT2Config(
+            vocab_size=2048, max_seq_len=256, num_layers=4, num_heads=4,
+            embed_dim=256, dtype=jnp.float32,
+        )
+        B, T = 4, 256
+        steps = 5
+    opt = OptimizerConfig().build()
+    state = create_train_state(config, opt, jax.random.PRNGKey(0))
+    step = make_train_step(config, opt)
+    rng = np.random.RandomState(0)
+    batch = {
+        "tokens": jnp.asarray(rng.randint(0, config.vocab_size, (B, T + 1)))
+    }
+    state, m = step(state, batch)  # compile
+    jax.block_until_ready(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = step(state, batch)
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+    tokens_per_sec = steps * B * T / dt
+    mfu = None
+    if on_tpu:
+        flops = gpt2.flops_per_token(config) * tokens_per_sec
+        peak = 197e12  # v5e bf16 peak; approximate
+        mfu = flops / peak
+    return {
+        "gpt2_train_tokens_per_sec_per_chip": tokens_per_sec,
+        "gpt2_train_loss": float(m["loss"]),
+        "gpt2_train_mfu_est": mfu,
+        "train_backend": jax.default_backend(),
+    }
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true")
+    parser.add_argument("--no-train", action="store_true")
+    args = parser.parse_args()
+
+    import ray_tpu
+    from ray_tpu._private.perf import run_core_benchmarks
+
+    ray_tpu.init(num_cpus=4, num_nodes=1)
+    try:
+        core = run_core_benchmarks(quick=args.quick)
+    finally:
+        ray_tpu.shutdown()
+
+    extra = {}
+    if not args.no_train:
+        try:
+            extra = bench_train_tokens_per_sec(quick=args.quick)
+        except Exception as e:  # keep the headline metric even if jax breaks
+            extra = {"train_error": f"{type(e).__name__}: {e}"}
+
+    value = core["single_client_tasks_async_per_s"]
+    result = {
+        "metric": "single_client_tasks_async",
+        "value": round(value, 1),
+        "unit": "tasks/s",
+        "vs_baseline": round(value / BASELINE_TASKS_ASYNC, 3),
+        **{k: round(v, 2) for k, v in core.items()},
+        **{
+            k: (round(v, 2) if isinstance(v, float) else v)
+            for k, v in extra.items()
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,104 @@
+"""Core microbenchmarks (reference analog: ``python/ray/_private/ray_perf.py``
+run by ``release/microbenchmark/run_microbenchmark.py`` — same workload shapes
+so numbers are directly comparable to BASELINE.md)."""
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+import numpy as np
+
+import ray_tpu
+
+
+def _rate(n, t):
+    return n / t if t > 0 else float("inf")
+
+
+def bench_single_client_tasks_async(n: int = 2000) -> float:
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    ray_tpu.get([noop.remote() for _ in range(50)])  # warm the lease path
+    t0 = time.perf_counter()
+    refs = [noop.remote() for _ in range(n)]
+    ray_tpu.get(refs)
+    return _rate(n, time.perf_counter() - t0)
+
+
+def bench_single_client_tasks_sync(n: int = 300) -> float:
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    ray_tpu.get(noop.remote())
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ray_tpu.get(noop.remote())
+    return _rate(n, time.perf_counter() - t0)
+
+
+def bench_actor_calls_async(n: int = 2000) -> float:
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return None
+
+    a = A.remote()
+    ray_tpu.get(a.m.remote())
+    t0 = time.perf_counter()
+    ray_tpu.get([a.m.remote() for _ in range(n)])
+    return _rate(n, time.perf_counter() - t0)
+
+
+def bench_actor_calls_sync(n: int = 300) -> float:
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return None
+
+    a = A.remote()
+    ray_tpu.get(a.m.remote())
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ray_tpu.get(a.m.remote())
+    return _rate(n, time.perf_counter() - t0)
+
+
+def bench_put_gigabytes(total_gb: float = 2.0) -> float:
+    chunk = np.random.bytes(100 * 1024 * 1024)  # 100MB
+    n = max(int(total_gb * 1024 / 100), 1)
+    refs = []
+    t0 = time.perf_counter()
+    for _ in range(n):
+        refs.append(ray_tpu.put(chunk))
+    dt = time.perf_counter() - t0
+    gb = n * len(chunk) / (1024 ** 3)
+    del refs
+    return gb / dt
+
+
+def bench_get_calls(n: int = 2000) -> float:
+    ref = ray_tpu.put(np.zeros(1000, np.float64))  # ~8KB, memory-store path
+    ray_tpu.get(ref)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ray_tpu.get(ref)
+    return _rate(n, time.perf_counter() - t0)
+
+
+def run_core_benchmarks(quick: bool = False) -> Dict[str, float]:
+    scale = 0.25 if quick else 1.0
+    return {
+        "single_client_tasks_async_per_s": bench_single_client_tasks_async(
+            int(2000 * scale)
+        ),
+        "single_client_tasks_sync_per_s": bench_single_client_tasks_sync(
+            int(300 * scale)
+        ),
+        "actor_calls_async_per_s": bench_actor_calls_async(int(2000 * scale)),
+        "actor_calls_sync_per_s": bench_actor_calls_sync(int(300 * scale)),
+        "single_client_put_gb_per_s": bench_put_gigabytes(0.5 if quick else 2.0),
+        "single_client_get_calls_per_s": bench_get_calls(int(2000 * scale)),
+    }

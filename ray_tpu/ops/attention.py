@@ -1,0 +1,193 @@
+"""Attention ops: XLA reference impl + pallas TPU flash-attention kernel.
+
+The reference framework has no attention code of its own (it delegates to
+vLLM/torch — SURVEY.md §2.3/§5); in a TPU-native stack the kernel layer is
+ours. Design:
+
+- ``attention_xla``: einsum softmax attention. XLA fuses this well on TPU and
+  it is the autodiff path.
+- ``flash_attention``: blockwise online-softmax pallas kernel (VMEM-resident
+  q/k/v blocks, f32 accumulators, causal short-circuit per block row).
+  Forward = pallas; backward = recompute via the XLA path (custom_vjp), so
+  training gets flash's forward memory profile with correct grads.
+- ``attention``: dispatcher — pallas on TPU, interpret-mode pallas or XLA
+  elsewhere (tests run the same kernel code on the CPU mesh).
+
+Shapes follow [batch, seq, heads, head_dim] throughout.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def attention_xla(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    bias: Optional[jax.Array] = None,
+    q_offset: int | jax.Array = 0,
+    kv_offset: int | jax.Array = 0,
+) -> jax.Array:
+    """Dense attention. q: [B, Tq, H, D]; k/v: [B, Tk, Hkv, D].
+
+    Supports grouped-query attention (H a multiple of Hkv) and absolute
+    position offsets so callers holding only a chunk of the sequence (ring /
+    blockwise) mask correctly.
+    """
+    B, Tq, H, D = q.shape
+    _, Tk, Hkv, _ = k.shape
+    if Hkv != H:
+        rep = H // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = D ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    logits = logits.astype(jnp.float32)
+    if bias is not None:
+        logits = logits + bias
+    if causal:
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, (Tq, Tk), 0) + q_offset
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (Tq, Tk), 1) + kv_offset
+        mask = q_pos >= k_pos
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+# --------------------------------------------------------------------- pallas
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                  scale: float, seq_k: int):
+    """One (batch*head, q_block) program: stream K/V blocks with online
+    softmax. Block shapes: q/o [1, Bq, D], k/v [1, Tk, D]."""
+    q_idx = pl.program_id(1)
+    block_q = q_ref.shape[1]
+    d = q_ref.shape[2]
+    q = q_ref[0].astype(jnp.float32) * scale  # [Bq, D]
+
+    num_k_blocks = pl.cdiv(seq_k, block_k)
+    if causal:
+        # Highest K block this Q block row can see (short-circuits the rest).
+        last_block = jax.lax.div((q_idx + 1) * block_q - 1, block_k) + 1
+        num_iter = jnp.minimum(num_k_blocks, last_block)
+    else:
+        num_iter = num_k_blocks
+
+    def body(i, carry):
+        o_acc, m, l = carry
+        k_blk = k_ref[0, pl.ds(i * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(i * block_k, block_k), :]
+        s = jnp.dot(q, k_blk.astype(jnp.float32).T,
+                    preferred_element_type=jnp.float32)  # [Bq, Bk]
+        if causal:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        o_new = o_acc * alpha + jnp.dot(
+            p, v_blk.astype(jnp.float32), preferred_element_type=jnp.float32
+        )
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    o_acc, m, l = jax.lax.fori_loop(0, num_iter, body, (o0, m0, l0))
+    o_ref[0] = (o_acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_fwd_impl(q, k, v, *, causal: bool, block_q: int, block_k: int,
+                    interpret: bool) -> jax.Array:
+    B, Tq, H, D = q.shape
+    _, Tk, Hkv, _ = k.shape
+    if Hkv != H:
+        k = jnp.repeat(k, H // Hkv, axis=2)
+        v = jnp.repeat(v, H // Hkv, axis=2)
+    block_q = min(block_q, Tq)
+    block_k = min(block_k, Tk)
+    scale = D ** -0.5
+    # Fold batch and heads into the grid's leading dim.
+    qf = q.transpose(0, 2, 1, 3).reshape(B * H, Tq, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
+    grid = (B * H, pl.cdiv(Tq, block_q))
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel, block_k=block_k, causal=causal, scale=scale, seq_k=Tk
+        ),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Tk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Tk, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = True,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    interpret: bool = False):
+    """Flash attention: pallas forward, recompute-XLA backward."""
+    return _flash_fwd_impl(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = _flash_fwd_impl(
+        q, k, v, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: attention_xla(q, k, v, causal=causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def attention(
+    q, k, v, *, causal: bool = True, impl: str = "auto",
+    block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
+):
+    """Dispatcher. impl: auto | xla | flash | flash_interpret."""
+    if impl == "auto":
+        impl = "flash" if jax.default_backend() == "tpu" else "xla"
+    if impl == "xla":
+        return attention_xla(q, k, v, causal=causal)
+    if impl == "flash":
+        return flash_attention(q, k, v, causal, block_q, block_k, False)
+    if impl == "flash_interpret":
+        return flash_attention(q, k, v, causal, block_q, block_k, True)
+    raise ValueError(f"unknown attention impl {impl}")

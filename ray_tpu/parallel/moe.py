@@ -1,0 +1,117 @@
+"""Mixture-of-Experts with expert parallelism (GShard/Switch formulation).
+
+The reference delegates EP entirely to vLLM (SURVEY.md §2.3); here experts are
+a mesh axis. We use the sharded-einsum dispatch formulation (the original
+GShard/Switch TPU design): routing builds a dispatch one-hot
+[tokens, experts, capacity]; einsums against it ARE the all-to-alls once the
+expert dim is sharded — XLA lowers the dispatch/combine contractions to
+``all_to_all`` collectives over ICI when experts live on the "expert" axis.
+Fully differentiable; auxiliary load-balancing loss included.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    router_jitter: float = 0.0
+
+
+def init_moe_params(
+    key: jax.Array, embed_dim: int, mlp_dim: int, config: MoEConfig,
+    param_dtype=jnp.float32, num_layers: Optional[int] = None,
+) -> Dict[str, jax.Array]:
+    """Per-layer expert weights; with num_layers, adds a leading stacked dim."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    lead = () if num_layers is None else (num_layers,)
+    E = config.num_experts
+
+    def normal(key, shape, s=0.02):
+        return (jax.random.normal(key, shape) * s).astype(param_dtype)
+
+    return {
+        "router_w": normal(k1, lead + (embed_dim, E)),
+        "expert_fc": normal(k2, lead + (E, embed_dim, mlp_dim)),
+        "expert_out": normal(k3, lead + (E, mlp_dim, embed_dim)),
+    }
+
+
+def moe_param_axes(num_layers: Optional[int] = None) -> Dict[str, tuple]:
+    lead = () if num_layers is None else ("stage",)
+    return {
+        "router_w": lead + ("embed", None),
+        "expert_fc": lead + ("expert", "embed", "mlp"),
+        "expert_out": lead + ("expert", "mlp", "embed"),
+    }
+
+
+def _top_k_mask(probs: jax.Array, k: int) -> jax.Array:
+    """[T, E] probs → 0/1 mask of the top-k experts per token."""
+    _, idx = jax.lax.top_k(probs, k)
+    return jax.nn.one_hot(idx, probs.shape[-1], dtype=probs.dtype).sum(axis=1)
+
+
+def moe_layer(
+    params: Dict[str, jax.Array],
+    x: jax.Array,
+    config: MoEConfig,
+    *,
+    rng: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """x: [B, T, D] → (out [B, T, D], aux_loss scalar)."""
+    B, T, D = x.shape
+    E = config.num_experts
+    tokens = x.reshape(B * T, D)
+    n_tok = B * T
+    capacity = max(
+        int(n_tok * config.top_k * config.capacity_factor / E), config.top_k
+    )
+
+    router_logits = jnp.einsum(
+        "td,de->te", tokens.astype(jnp.float32),
+        params["router_w"].astype(jnp.float32),
+    )
+    if config.router_jitter and rng is not None:
+        router_logits += config.router_jitter * jax.random.normal(
+            rng, router_logits.shape
+        )
+    probs = jax.nn.softmax(router_logits, axis=-1)  # [T, E]
+    topk_mask = _top_k_mask(probs, config.top_k)    # [T, E] 0/1
+
+    # Position of each token within its expert's queue; drop overflow.
+    pos = jnp.cumsum(topk_mask, axis=0) * topk_mask          # [T, E] 1-based
+    keep = (pos > 0) & (pos <= capacity)
+    pos = (pos - 1).astype(jnp.int32)
+
+    gates = probs * topk_mask * keep                        # [T, E]
+    denom = jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
+    gates = gates / denom
+
+    # dispatch [T, E, C]: one-hot over capacity slots
+    dispatch = keep[..., None] * jax.nn.one_hot(pos, capacity, dtype=x.dtype)
+    combine = gates[..., None].astype(jnp.float32) * dispatch
+
+    # These einsums become all_to_all when "expert" is a sharded mesh axis.
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, tokens)  # [E, C, D]
+    h = jnp.einsum("ecd,edm->ecm", expert_in,
+                   params["expert_fc"].astype(x.dtype))
+    h = jax.nn.gelu(h)
+    expert_out = jnp.einsum("ecm,emd->ecd", h,
+                            params["expert_out"].astype(x.dtype))
+    out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_out)
+
+    # Load-balancing auxiliary loss (Switch §2.2): mean gate fraction ×
+    # token fraction per expert, scaled by E.
+    me = probs.mean(axis=0)
+    ce = topk_mask.mean(axis=0) / config.top_k
+    aux = config.aux_loss_weight * E * jnp.sum(me * ce)
+    return out.reshape(B, T, D), aux

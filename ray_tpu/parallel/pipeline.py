@@ -1,0 +1,98 @@
+"""Pipeline parallelism: GPipe-style microbatch loop as one XLA program.
+
+The reference's pipeline story is per-step RPC between actors through
+compiled-graph channels (``python/ray/dag/compiled_dag_node.py:804`` +
+shared-memory/NCCL channels); on TPU we compile the whole schedule into a
+single program instead (SURVEY.md §7.8): the "stage" mesh axis holds L/S
+layers each, activations hop stage→stage+1 with ``ppermute`` (one ICI
+neighbor hop), and a ``lax.scan`` runs the fill/steady/drain schedule.
+
+Differentiable end-to-end; combine freely with data/tensor axes.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_apply(
+    stage_params: Any,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    apply_stage: Callable[[Any, jax.Array], jax.Array],
+    num_microbatches: int,
+    axis: str = "stage",
+    params_spec: Optional[Any] = None,
+    x_spec: P = P(),
+):
+    """Run ``x`` through S pipeline stages.
+
+    stage_params: pytree whose leaves have leading dim [L] sharded over
+    ``axis`` (each stage sees its [L/S] slice).
+    x: [B, ...] activations (batch first). B % num_microbatches == 0.
+    apply_stage(local_params, mb) applies one stage's layers to a microbatch.
+
+    Schedule: M + S - 1 steps; stage 0 injects microbatch i at step i; the
+    last stage's result for microbatch i appears at step i + S - 1. Output is
+    re-broadcast with a masked psum over the stage axis (negligible next to
+    the matmuls for real models; keeps out_specs replicated on ``axis``).
+    """
+    S = mesh.shape[axis]
+    if S == 1:
+        return apply_stage(stage_params, x)
+    M = num_microbatches
+    B = x.shape[0]
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible by microbatches {M}")
+    if params_spec is None:
+        params_spec = jax.tree.map(lambda _: P(axis), stage_params)
+
+    def staged(params_local, x_local):
+        sidx = jax.lax.axis_index(axis)
+        mb = x_local.shape[0] // M
+        mbs = x_local.reshape((M, mb) + x_local.shape[1:])
+        perm = [(i, (i + 1) % S) for i in range(S)]
+        out0 = jnp.zeros_like(mbs)
+        recv0 = jnp.zeros((mb,) + x_local.shape[1:], x_local.dtype)
+
+        def step(carry, i):
+            recv, outs = carry
+            inject = mbs[jnp.minimum(i, M - 1)]
+            cur = jnp.where(sidx == 0, inject, recv)
+            y = apply_stage(params_local, cur)
+            # collect on the last stage once the pipe is full
+            oidx = jnp.maximum(i - (S - 1), 0)
+            updated = jax.lax.dynamic_update_slice(
+                outs, y[None].astype(outs.dtype),
+                (oidx,) + (0,) * (outs.ndim - 1),
+            )
+            take = jnp.logical_and(i >= S - 1, sidx == S - 1)
+            outs = jnp.where(take, updated, outs)
+            recv_next = jax.lax.ppermute(y, axis, perm)
+            return (recv_next, outs), None
+
+        (_, outs), _ = jax.lax.scan(
+            step, (recv0, out0), jnp.arange(M + S - 1)
+        )
+        # Broadcast the last stage's buffer to every stage.
+        outs = jax.lax.psum(
+            jnp.where(sidx == S - 1, outs, jnp.zeros_like(outs)), axis
+        )
+        return outs.reshape(x_local.shape)
+
+    # Manual only over the stage axis: batch/tensor/fsdp shardings of the
+    # activations and weights stay under XLA's automatic propagation.
+    return shard_map(
+        staged,
+        mesh=mesh,
+        axis_names={axis},
+        in_specs=(params_spec, x_spec),
+        out_specs=x_spec,
+        check_vma=False,
+    )(stage_params, x)

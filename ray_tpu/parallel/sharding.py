@@ -1,0 +1,96 @@
+"""Logical-axis sharding rules: name tensor dims, map them to mesh axes.
+
+The scaling-book recipe: pick a mesh, annotate arrays with logical axis names,
+resolve names → mesh axes through one rules table, let XLA insert collectives.
+(The reference has no analog — its data plane is NCCL calls; SURVEY.md §5.)
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# logical axis -> mesh axis (or tuple of mesh axes, or None = replicated)
+Rules = Dict[str, Union[str, Tuple[str, ...], None]]
+
+# Default rules for transformer training. Conventions:
+#   batch    -> data (+ fsdp when both shard the batch dimension of activations)
+#   embed    -> fsdp for params (ZeRO-3 gather-on-use)
+#   mlp/heads/kv -> tensor (Megatron column/row splits)
+#   seq      -> seq axis (context parallelism / ring attention)
+#   expert   -> expert
+#   stage    -> stage (stacked pipeline bodies)
+DEFAULT_RULES: Rules = {
+    "batch": ("data", "fsdp"),
+    "seq": "seq",
+    "embed": "fsdp",
+    "mlp": "tensor",
+    "heads": "tensor",
+    "kv": None,
+    "head_dim": None,
+    "vocab": "tensor",
+    "expert": "expert",
+    "stage": "stage",
+    "norm": None,
+}
+
+
+def spec_from_logical(
+    logical_axes: Sequence[Optional[str]], rules: Optional[Rules] = None
+) -> P:
+    rules = {**DEFAULT_RULES, **(rules or {})}
+    parts = []
+    used = set()
+    for name in logical_axes:
+        if name is None:
+            parts.append(None)
+            continue
+        mesh_axes = rules.get(name)
+        if mesh_axes is None:
+            parts.append(None)
+            continue
+        if isinstance(mesh_axes, str):
+            mesh_axes = (mesh_axes,)
+        # A mesh axis may appear only once in a PartitionSpec.
+        mesh_axes = tuple(a for a in mesh_axes if a not in used)
+        used.update(mesh_axes)
+        if not mesh_axes:
+            parts.append(None)
+        elif len(mesh_axes) == 1:
+            parts.append(mesh_axes[0])
+        else:
+            parts.append(mesh_axes)
+    return P(*parts)
+
+
+def named_sharding(
+    mesh: Mesh, logical_axes: Sequence[Optional[str]], rules: Optional[Rules] = None
+) -> NamedSharding:
+    return NamedSharding(mesh, spec_from_logical(logical_axes, rules))
+
+
+def tree_shardings(mesh: Mesh, logical_tree, rules: Optional[Rules] = None):
+    """Map a pytree of logical-axis tuples to a pytree of NamedShardings."""
+    return jax.tree.map(
+        lambda axes: named_sharding(mesh, axes, rules),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            a is None or isinstance(a, str) for a in x
+        ),
+    )
+
+
+def shard_batch_spec(mesh: Mesh, rules: Optional[Rules] = None) -> NamedSharding:
+    """Sharding for (batch, seq) token arrays."""
+    return named_sharding(mesh, ("batch", "seq"), rules)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def with_sharding_constraint(x, mesh: Mesh, logical_axes, rules=None):
+    return jax.lax.with_sharding_constraint(
+        x, named_sharding(mesh, logical_axes, rules)
+    )

@@ -1,18 +1,33 @@
 """Test fixtures (reference analog: ``python/ray/tests/conftest.py`` —
 ray_start_regular :611 / ray_start_cluster :694).
 
-JAX tests run on a virtual 8-device CPU mesh: set before any jax import.
+JAX tests run on a virtual 8-device CPU mesh. NOTE: jax may be preloaded by
+the interpreter with JAX_PLATFORMS pointing at real TPU hardware; env vars in
+this file would be too late, but backends initialize lazily, so
+jax.config.update still wins as long as no jax computation ran yet.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _assert_cpu_mesh():
+    devs = jax.devices()
+    assert devs[0].platform == "cpu", f"tests must run on CPU, got {devs[0]}"
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    yield
 
 
 @pytest.fixture
