@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import sys
 import time
 
 BASELINE_TASKS_ASYNC = 7096.8  # reference release/perf_metrics/microbenchmark.json

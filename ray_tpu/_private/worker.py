@@ -55,6 +55,41 @@ logger = logging.getLogger(__name__)
 INLINE_OBJECT_MAX = 100 * 1024  # small objects travel inline / live in memory store
 FN_NS = "fn"
 
+# Actor identity for async actor methods (sync methods use the thread-local
+# CoreWorker.current_actor_id; coroutines need a contextvar instead).
+import contextvars
+
+_async_actor_id: contextvars.ContextVar = contextvars.ContextVar(
+    "rt_async_actor_id", default=None
+)
+_async_task_id: contextvars.ContextVar = contextvars.ContextVar(
+    "rt_async_task_id", default=None
+)
+
+
+def current_task_id_hex() -> Optional[str]:
+    """Task ID of the currently-executing task/actor method, or None."""
+    tid = _async_task_id.get()
+    if tid is not None:
+        return tid
+    w = global_worker
+    if w is None:
+        return None
+    tid = getattr(w.current_task_id, "value", None)
+    return tid.hex() if tid is not None else None
+
+
+def current_actor_id_hex() -> Optional[str]:
+    """Actor ID of the currently-executing actor method/constructor, or None
+    (reference: ``runtime_context.get_actor_id``)."""
+    aid = _async_actor_id.get()
+    if aid is not None:
+        return aid
+    w = global_worker
+    if w is None:
+        return None
+    return getattr(w.current_actor_id, "value", None)
+
 
 def _loads_maybe(frames):
     ctx = SerializationContext()
@@ -150,6 +185,7 @@ class CoreWorker:
         # ownership: object hex -> {"count": local refs, "borrows": int}
         self.owned: Dict[str, dict] = {}
         self.current_task_id = threading.local()
+        self.current_actor_id = threading.local()
         self.put_counter = threading.local()
 
         self.fn_cache: Dict[str, Any] = {}
@@ -1095,6 +1131,7 @@ class CoreWorker:
             old = self._apply_runtime_env(h.get("renv"))
             tid = TaskID.from_hex(h["tid"])
             self.current_task_id.value = tid
+            self.current_actor_id.value = None
             self.put_counter.value = 0
             try:
                 return True, fn(*args, **kwargs)
@@ -1165,6 +1202,7 @@ class CoreWorker:
 
         def construct():
             old = self._apply_runtime_env(spec.get("renv"))
+            self.current_actor_id.value = h["actor_id"]
             try:
                 return True, real_cls(*args, **kwargs)
             except Exception as e:
@@ -1241,8 +1279,13 @@ class CoreWorker:
                     # Run on the dedicated async-actor loop, NOT the core
                     # loop: a blocking ray_tpu.get() inside the method would
                     # otherwise deadlock the whole process.
+                    async def _run_with_ctx():
+                        _async_actor_id.set(h["aid"])
+                        _async_task_id.set(h["tid"])
+                        return await method(*args, **kwargs)
+
                     afut = asyncio.run_coroutine_threadsafe(
-                        method(*args, **kwargs), self._get_async_loop()
+                        _run_with_ctx(), self._get_async_loop()
                     )
                     try:
                         result, ok = await asyncio.wrap_future(afut), True
@@ -1252,6 +1295,7 @@ class CoreWorker:
                 def run():
                     tid = TaskID.from_hex(h["tid"])
                     self.current_task_id.value = tid
+                    self.current_actor_id.value = h["aid"]
                     self.put_counter.value = 0
                     return method(*args, **kwargs)
 

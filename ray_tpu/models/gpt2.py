@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
-from ray_tpu.ops.attention import attention_xla, flash_attention
+from ray_tpu.ops.attention import attention
 from ray_tpu.parallel.moe import (
     MoEConfig,
     init_moe_params,
@@ -159,9 +159,9 @@ def _layer_norm(x, g, b, eps=1e-5):
 
 
 def _attention_dispatch(config: GPT2Config, q, k, v, mesh: Optional[Mesh]):
+    """Adds the mesh-aware ring/ulysses branches on top of the shared
+    single-device dispatcher (``ops.attention.attention``)."""
     impl = config.attention_impl
-    if impl == "auto":
-        impl = "flash" if jax.default_backend() == "tpu" else "xla"
     if impl == "ring":
         from ray_tpu.parallel.ring_attention import ring_attention
 
@@ -170,16 +170,12 @@ def _attention_dispatch(config: GPT2Config, q, k, v, mesh: Optional[Mesh]):
         from ray_tpu.parallel.ring_attention import ulysses_attention
 
         return ulysses_attention(q, k, v, mesh=mesh, axis=config.seq_axis, causal=True)
-    if impl == "flash":
-        return flash_attention(q, k, v, True)
-    if impl == "flash_interpret":
-        return flash_attention(q, k, v, True, 256, 256, True)
-    return attention_xla(q, k, v, causal=True)
+    return attention(q, k, v, causal=True, impl=impl)
 
 
-def _block(config: GPT2Config, mesh: Optional[Mesh], x, layer):
+def _block(config: GPT2Config, mesh: Optional[Mesh], x, layer, rng=None):
     """One transformer block. x: [B, T, E] (dtype), layer: one slice of the
-    stacked block params."""
+    stacked block params. ``rng`` (optional) feeds MoE router jitter."""
     h = _layer_norm(x, layer["ln1_g"], layer["ln1_b"])
     qkv = jnp.einsum("bte,eshd->btshd", h, layer["qkv_w"].astype(h.dtype))
     qkv = qkv + layer["qkv_b"].astype(h.dtype)
@@ -189,7 +185,7 @@ def _block(config: GPT2Config, mesh: Optional[Mesh], x, layer):
     x = x + attn + layer["proj_b"].astype(h.dtype)
     h = _layer_norm(x, layer["ln2_g"], layer["ln2_b"])
     if config.moe is not None:
-        h, aux = moe_layer(layer["moe"], h, config.moe)
+        h, aux = moe_layer(layer["moe"], h, config.moe, rng=rng)
         return x + h, aux
     h = jnp.einsum("bte,em->btm", h, layer["fc_w"].astype(h.dtype))
     h = jax.nn.gelu(h + layer["fc_b"].astype(h.dtype))
@@ -202,8 +198,10 @@ def forward(
     tokens: jax.Array,
     config: GPT2Config,
     mesh: Optional[Mesh] = None,
+    rng: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """tokens [B, T] int32 → (logits [B, T, V] f32, moe aux loss scalar)."""
+    """tokens [B, T] int32 → (logits [B, T, V] f32, moe aux loss scalar).
+    ``rng``: optional key enabling stochastic layers (MoE router jitter)."""
     B, T = tokens.shape
     x = params["wte"][tokens].astype(config.dtype)
     x = x + params["wpe"][:T][None].astype(config.dtype)
@@ -212,12 +210,28 @@ def forward(
     if config.remat:
         body = jax.checkpoint(body)
 
-    def scan_fn(carry, layer):
-        x, aux = carry
-        x, layer_aux = body(x, layer)
-        return (x, aux + layer_aux), None
+    if rng is not None:
+        layer_rngs = jax.random.split(rng, config.num_layers)
 
-    (x, aux), _ = jax.lax.scan(scan_fn, (x, jnp.float32(0.0)), params["blocks"])
+        def scan_fn(carry, xs):
+            layer, lrng = xs
+            x, aux = carry
+            x, layer_aux = body(x, layer, lrng)
+            return (x, aux + layer_aux), None
+
+        (x, aux), _ = jax.lax.scan(
+            scan_fn, (x, jnp.float32(0.0)), (params["blocks"], layer_rngs)
+        )
+    else:
+
+        def scan_fn(carry, layer):
+            x, aux = carry
+            x, layer_aux = body(x, layer)
+            return (x, aux + layer_aux), None
+
+        (x, aux), _ = jax.lax.scan(
+            scan_fn, (x, jnp.float32(0.0)), params["blocks"]
+        )
     x = _layer_norm(x, params["ln_f_g"], params["ln_f_b"])
     logits = jnp.einsum("bte,ve->btv", x, params["wte"].astype(x.dtype))
     return logits.astype(jnp.float32), aux
@@ -229,9 +243,11 @@ def loss_fn(
     config: GPT2Config,
     mesh: Optional[Mesh] = None,
     pipeline_microbatches: Optional[int] = None,
+    rng: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Next-token cross entropy. batch: {"tokens": [B, T+1]} or
-    {"inputs": [B,T], "targets": [B,T]}."""
+    {"inputs": [B,T], "targets": [B,T]}. ``rng`` feeds MoE router jitter
+    (unpipelined path only)."""
     if "tokens" in batch:
         inputs = batch["tokens"][:, :-1]
         targets = batch["tokens"][:, 1:]
@@ -242,7 +258,7 @@ def loss_fn(
             params, inputs, config, mesh, pipeline_microbatches
         )
     else:
-        logits, aux = forward(params, inputs, config, mesh)
+        logits, aux = forward(params, inputs, config, mesh, rng=rng)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     mask = batch.get("mask")
@@ -276,7 +292,6 @@ def forward_pipelined(
     from jax.sharding import PartitionSpec as P
 
     from ray_tpu.parallel.pipeline import pipeline_apply
-    from ray_tpu.parallel.sharding import spec_from_logical
 
     B, T = tokens.shape
     x = params["wte"][tokens].astype(config.dtype)

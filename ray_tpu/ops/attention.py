@@ -92,14 +92,18 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
         v_blk = v_ref[0, pl.ds(i * block_k, block_k), :]
         s = jnp.dot(q, k_blk.astype(jnp.float32).T,
                     preferred_element_type=jnp.float32)  # [Bq, Bk]
+        k_pos = i * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        # Inputs are padded to block multiples; mask keys past the true
+        # sequence end so the pad rows never contribute.
+        mask = k_pos < seq_k
         if causal:
             q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
-            k_pos = i * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            mask = mask & (q_pos >= k_pos)
+        s = jnp.where(mask, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -126,26 +130,37 @@ def _flash_fwd_impl(q, k, v, *, causal: bool, block_q: int, block_k: int,
     block_q = min(block_q, Tq)
     block_k = min(block_k, Tk)
     scale = D ** -0.5
+    # Pad sequences to block multiples: in-kernel dynamic slices on a
+    # non-multiple tail would clamp and silently re-read earlier rows.
+    # Pad keys are masked in-kernel via seq_k; pad q rows are sliced off.
+    Tq_p = block_q * ((Tq + block_q - 1) // block_q)
+    Tk_p = block_k * ((Tk + block_k - 1) // block_k)
+    if Tq_p != Tq:
+        q = jnp.pad(q, ((0, 0), (0, Tq_p - Tq), (0, 0), (0, 0)))
+    if Tk_p != Tk:
+        k = jnp.pad(k, ((0, 0), (0, Tk_p - Tk), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, Tk_p - Tk), (0, 0), (0, 0)))
     # Fold batch and heads into the grid's leading dim.
-    qf = q.transpose(0, 2, 1, 3).reshape(B * H, Tq, D)
-    kf = k.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
-    vf = v.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
-    grid = (B * H, pl.cdiv(Tq, block_q))
+    qf = q.transpose(0, 2, 1, 3).reshape(B * H, Tq_p, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * H, Tk_p, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * H, Tk_p, D)
+    grid = (B * H, Tq_p // block_q)
     out = pl.pallas_call(
         functools.partial(
             _flash_kernel, block_k=block_k, causal=causal, scale=scale, seq_k=Tk
         ),
-        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B * H, Tq_p, D), q.dtype),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, Tk, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, Tk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Tk_p, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Tk_p, D), lambda b, i: (b, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+    out = out.reshape(B, H, Tq_p, D).transpose(0, 2, 1, 3)
+    return out[:, :Tq] if Tq_p != Tq else out
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))

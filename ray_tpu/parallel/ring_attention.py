@@ -13,7 +13,6 @@ Both are shard_map programs over one mesh axis and differentiable end-to-end
 """
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax

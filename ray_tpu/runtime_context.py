@@ -24,9 +24,14 @@ class RuntimeContext:
         return self.worker_id
 
     def get_task_id(self):
-        w = get_global_worker()
-        tid = getattr(w.current_task_id, "value", None)
-        return tid.hex() if tid is not None else None
+        from ray_tpu._private.worker import current_task_id_hex
+
+        return current_task_id_hex()
+
+    def get_actor_id(self):
+        from ray_tpu._private.worker import current_actor_id_hex
+
+        return current_actor_id_hex()
 
 
 def get_runtime_context() -> RuntimeContext:

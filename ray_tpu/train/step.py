@@ -96,17 +96,39 @@ def make_train_step(
     rules=None,
     pipeline_microbatches: Optional[int] = None,
     donate: bool = True,
+    seed: int = 0,
 ) -> Callable:
-    """Build the jitted SPMD train step: (state, batch) → (state, metrics)."""
+    """Build the jitted SPMD train step: (state, batch) → (state, metrics).
 
-    def loss(params, batch):
+    ``rules`` override the logical-axis→mesh-axis sharding rules: when given
+    (with a mesh), the step constrains params to those shardings so custom
+    layouts are honored even if the input state arrived differently sharded.
+    Stochastic layers (MoE router jitter) draw from a per-step key folded
+    from ``seed`` and ``state["step"]``.
+    """
+    needs_rng = config.moe is not None and config.moe.router_jitter > 0
+    p_shard = (
+        param_shardings(mesh, config, rules)
+        if (mesh is not None and rules is not None)
+        else None
+    )
+
+    def loss(params, batch, rng):
         return gpt2.loss_fn(
             params, batch, config, mesh,
-            pipeline_microbatches=pipeline_microbatches,
+            pipeline_microbatches=pipeline_microbatches, rng=rng,
         )
 
     def step_fn(state, batch):
-        (loss_val), grads = jax.value_and_grad(loss)(state["params"], batch)
+        params = state["params"]
+        if p_shard is not None:
+            params = jax.lax.with_sharding_constraint(params, p_shard)
+        rng = (
+            jax.random.fold_in(jax.random.PRNGKey(seed), state["step"])
+            if needs_rng else None
+        )
+        (loss_val), grads = jax.value_and_grad(loss)(params, batch, rng)
+        state = dict(state, params=params)
         updates, new_opt = opt.update(
             grads, state["opt_state"], state["params"]
         )

@@ -1,0 +1,61 @@
+"""Collective communication across tasks/actors (reference:
+``python/ray/util/collective/``), re-based on TPU physics: XLA collectives
+over ICI in-jit; control-plane exchange over DCN out-of-jit."""
+from ray_tpu.util.collective.backend_registry import (
+    BackendRegistry,
+    get_collective_backend,
+    register_collective_backend,
+)
+from ray_tpu.util.collective.collective import (
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    create_collective_group,
+    destroy_collective_group,
+    get_collective_group_size,
+    get_rank,
+    init_collective_group,
+    is_group_initialized,
+    recv,
+    reduce,
+    reducescatter,
+    send,
+)
+from ray_tpu.util.collective.collective_group.xla_collective_group import (
+    ici_all_to_all,
+    ici_allgather,
+    ici_allreduce,
+    ici_broadcast,
+    ici_ppermute,
+    ici_reducescatter,
+)
+from ray_tpu.util.collective.types import Backend, ReduceOp
+
+__all__ = [
+    "init_collective_group",
+    "create_collective_group",
+    "destroy_collective_group",
+    "is_group_initialized",
+    "get_rank",
+    "get_collective_group_size",
+    "allreduce",
+    "barrier",
+    "reduce",
+    "broadcast",
+    "allgather",
+    "reducescatter",
+    "send",
+    "recv",
+    "ReduceOp",
+    "Backend",
+    "BackendRegistry",
+    "register_collective_backend",
+    "get_collective_backend",
+    "ici_allreduce",
+    "ici_allgather",
+    "ici_reducescatter",
+    "ici_broadcast",
+    "ici_ppermute",
+    "ici_all_to_all",
+]

@@ -1,0 +1,54 @@
+"""Pluggable collective-backend registry.
+
+Reference analog: ``python/ray/util/collective/backend_registry.py:7``
+(``BackendRegistry``, ``register_collective_backend`` :47).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+
+class BackendRegistry:
+    """Maps backend name -> group class (lazily constructed)."""
+
+    def __init__(self):
+        self._backends: Dict[str, Callable] = {}
+
+    def register(self, name: str, group_factory: Callable):
+        if name in self._backends:
+            raise ValueError(f"collective backend '{name}' already registered")
+        self._backends[name] = group_factory
+
+    def get(self, name: str) -> Callable:
+        if name not in self._backends:
+            raise ValueError(
+                f"collective backend '{name}' not registered; "
+                f"have {sorted(self._backends)}"
+            )
+        return self._backends[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._backends
+
+
+_registry = BackendRegistry()
+
+
+def register_collective_backend(name: str):
+    """Decorator registering a group class under ``name``."""
+
+    def deco(cls):
+        _registry.register(name, cls)
+        return cls
+
+    return deco
+
+
+def get_collective_backend(name: str):
+    # Import built-ins lazily so registration happens on first use.
+    from ray_tpu.util.collective.collective_group import (  # noqa: F401
+        host_collective_group,
+        xla_collective_group,
+    )
+
+    return _registry.get(name)

@@ -1,0 +1,188 @@
+"""Collective group API.
+
+Reference analog: ``python/ray/util/collective/collective.py`` —
+``init_collective_group`` (:149), ``create_collective_group`` (:188),
+``allreduce`` (:316), ``barrier`` (:356), ``reduce`` (:369), ``broadcast``
+(:431), ``allgather`` (:481), ``reducescatter`` (:530), ``send``/``recv``
+(:589/:652), ``GroupManager`` (:65).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from ray_tpu.util.collective.backend_registry import get_collective_backend
+from ray_tpu.util.collective.types import (
+    AllGatherOptions,
+    AllReduceOptions,
+    Backend,
+    BarrierOptions,
+    BroadcastOptions,
+    RecvOptions,
+    ReduceOp,
+    ReduceOptions,
+    ReduceScatterOptions,
+    SendOptions,
+)
+
+
+def _member_key(group_name: str) -> tuple:
+    """Group membership is per *logical member* — the calling actor if any,
+    else the process. (The reference assumes one actor per process; this
+    runtime can colocate actors, so identity must be the actor, not the
+    process.)"""
+    from ray_tpu._private.worker import current_actor_id_hex
+
+    return (current_actor_id_hex() or "__process__", group_name)
+
+
+class GroupManager:
+    """Per-member registry of collective groups (reference:
+    ``collective.py:65``)."""
+
+    def __init__(self):
+        self._groups: Dict[tuple, object] = {}
+        self._lock = threading.Lock()
+
+    def create_group(self, backend: str, world_size: int, rank: int,
+                     group_name: str):
+        backend = Backend.resolve(backend)
+        if backend == Backend.AUTO:
+            import jax
+
+            backend = (
+                Backend.XLA if jax.default_backend() == "tpu" else Backend.HOST
+            )
+        cls = get_collective_backend(backend)
+        key = _member_key(group_name)
+        with self._lock:
+            if key in self._groups:
+                raise RuntimeError(
+                    f"collective group '{group_name}' already initialized "
+                    f"by this member"
+                )
+            group = cls(world_size, rank, group_name)
+            self._groups[key] = group
+        return group
+
+    def get_group(self, group_name: str):
+        g = self._groups.get(_member_key(group_name))
+        if g is None:
+            raise RuntimeError(
+                f"collective group '{group_name}' is not initialized by this "
+                f"member; call init_collective_group() first"
+            )
+        return g
+
+    def is_group_exist(self, group_name: str) -> bool:
+        return _member_key(group_name) in self._groups
+
+    def destroy_group(self, group_name: str):
+        with self._lock:
+            g = self._groups.pop(_member_key(group_name), None)
+        if g is not None:
+            g.destroy_group()
+
+
+_group_mgr = GroupManager()
+
+
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    backend: str = Backend.AUTO,
+    group_name: str = "default",
+):
+    """Initialize this process's membership in a collective group.
+
+    Must be called by all ``world_size`` participants (typically inside actor
+    methods / tasks). Rendezvous happens through a named coordinator actor.
+    """
+    if world_size <= 0 or not (0 <= rank < world_size):
+        raise ValueError(f"bad world_size={world_size} rank={rank}")
+    _group_mgr.create_group(backend, world_size, rank, group_name)
+
+
+def create_collective_group(
+    actors: List,
+    world_size: int,
+    ranks: List[int],
+    backend: str = Backend.AUTO,
+    group_name: str = "default",
+):
+    """Declarative setup from the driver (reference: ``collective.py:188``):
+    instructs each actor to init the group with its assigned rank. Actors must
+    expose no particular method — we inject via a remote closure calling
+    ``init_collective_group`` on the actor's process is not possible without
+    cooperation, so (as in the reference) actors are expected to call
+    ``init_collective_group`` themselves; this helper instead validates and
+    pre-creates the coordinator so member init cannot race a missing
+    coordinator.
+    """
+    if len(actors) != len(ranks) or len(actors) != world_size:
+        raise ValueError("actors/ranks must both have world_size entries")
+    if sorted(ranks) != list(range(world_size)):
+        raise ValueError(f"ranks must be a permutation of 0..{world_size-1}")
+    from ray_tpu.util.collective.collective_group.coordinator import (
+        get_or_create_coordinator,
+    )
+
+    get_or_create_coordinator(group_name, world_size, 0)
+
+
+def destroy_collective_group(group_name: str = "default"):
+    _group_mgr.destroy_group(group_name)
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    return _group_mgr.is_group_exist(group_name)
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _group_mgr.get_group(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _group_mgr.get_group(group_name).world_size
+
+
+def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM):
+    return _group_mgr.get_group(group_name).allreduce(
+        tensor, AllReduceOptions(reduce_op=op)
+    )
+
+
+def barrier(group_name: str = "default"):
+    _group_mgr.get_group(group_name).barrier(BarrierOptions())
+
+
+def reduce(tensor, dst_rank: int = 0, group_name: str = "default",
+           op: ReduceOp = ReduceOp.SUM):
+    return _group_mgr.get_group(group_name).reduce(
+        tensor, ReduceOptions(reduce_op=op, root_rank=dst_rank)
+    )
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    return _group_mgr.get_group(group_name).broadcast(
+        tensor, BroadcastOptions(root_rank=src_rank)
+    )
+
+
+def allgather(tensor, group_name: str = "default"):
+    return _group_mgr.get_group(group_name).allgather(tensor, AllGatherOptions())
+
+
+def reducescatter(tensor, group_name: str = "default",
+                  op: ReduceOp = ReduceOp.SUM):
+    return _group_mgr.get_group(group_name).reducescatter(
+        tensor, ReduceScatterOptions(reduce_op=op)
+    )
+
+
+def send(tensor, dst_rank: int, group_name: str = "default"):
+    _group_mgr.get_group(group_name).send(tensor, SendOptions(dst_rank=dst_rank))
+
+
+def recv(src_rank: int, group_name: str = "default"):
+    return _group_mgr.get_group(group_name).recv(RecvOptions(src_rank=src_rank))

@@ -1,0 +1,75 @@
+"""Base collective group interface.
+
+Reference analog: ``python/ray/util/collective/collective_group/
+base_collective_group.py`` (BaseGroup) and the ``Communicator`` ABC
+(``python/ray/experimental/channel/communicator.py:18``) — one interface so
+transports stay pluggable.
+"""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+from ray_tpu.util.collective.types import (
+    AllGatherOptions,
+    AllReduceOptions,
+    BarrierOptions,
+    BroadcastOptions,
+    RecvOptions,
+    ReduceOptions,
+    ReduceScatterOptions,
+    SendOptions,
+)
+
+
+class BaseGroup(ABC):
+    def __init__(self, world_size: int, rank: int, group_name: str):
+        self._world_size = world_size
+        self._rank = rank
+        self._group_name = group_name
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def world_size(self) -> int:
+        return self._world_size
+
+    @property
+    def group_name(self) -> str:
+        return self._group_name
+
+    def destroy_group(self):
+        pass
+
+    @abstractmethod
+    def allreduce(self, tensor, opts: AllReduceOptions):
+        ...
+
+    @abstractmethod
+    def barrier(self, opts: BarrierOptions):
+        ...
+
+    @abstractmethod
+    def reduce(self, tensor, opts: ReduceOptions):
+        ...
+
+    @abstractmethod
+    def broadcast(self, tensor, opts: BroadcastOptions):
+        ...
+
+    @abstractmethod
+    def allgather(self, tensor, opts: AllGatherOptions):
+        ...
+
+    @abstractmethod
+    def reducescatter(self, tensor, opts: ReduceScatterOptions):
+        ...
+
+    @abstractmethod
+    def send(self, tensor, opts: SendOptions):
+        ...
+
+    @abstractmethod
+    def recv(self, opts: RecvOptions):
+        ...

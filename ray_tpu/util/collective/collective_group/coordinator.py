@@ -1,0 +1,82 @@
+"""Rendezvous + exchange coordinator actor for host-level collectives.
+
+The reference rendezvouses NCCL unique IDs through a named actor
+(``python/ray/util/collective/collective_group/nccl_util.py`` + ``GroupManager``
+``collective.py:65``) and then moves data over NCCL. On TPU the accelerator
+data plane is XLA-over-ICI *inside* compiled programs; host-level collectives
+(rendezvous, barriers, small-tensor control traffic) ride the control plane.
+This actor is that control-plane exchange point: every collective op is an
+all-to-all exchange keyed by a per-group sequence number (collectives are
+invoked in the same order on every rank, so a local monotone counter agrees
+across ranks).
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, Tuple
+
+
+class CollectiveCoordinator:
+    """Named async actor; one per collective group.
+
+    ``exchange`` implements an allgather of opaque payloads; every collective
+    primitive reduces to it client-side. ``p2p_send``/``p2p_recv`` implement
+    point-to-point mailboxes.
+    """
+
+    def __init__(self, world_size: int):
+        self._world = world_size
+        self._slots: Dict[Any, dict] = {}
+        self._mail: Dict[Tuple[int, int, int], Any] = {}
+        self._mail_evt: Dict[Tuple[int, int, int], asyncio.Event] = {}
+
+    def world_size(self) -> int:
+        return self._world
+
+    async def exchange(self, seq: int, rank: int, payload):
+        """Post ``payload`` for ``rank`` at step ``seq``; return all payloads
+        (rank-ordered) once every rank has posted."""
+        slot = self._slots.get(seq)
+        if slot is None:
+            slot = {"values": {}, "event": asyncio.Event(), "done": 0}
+            self._slots[seq] = slot
+        slot["values"][rank] = payload
+        if len(slot["values"]) == self._world:
+            slot["event"].set()
+        await slot["event"].wait()
+        out = [slot["values"][r] for r in range(self._world)]
+        slot["done"] += 1
+        if slot["done"] == self._world:
+            del self._slots[seq]
+        return out
+
+    async def p2p_send(self, key: Tuple[int, int, int], payload):
+        key = tuple(key)
+        self._mail[key] = payload
+        evt = self._mail_evt.get(key)
+        if evt is None:
+            evt = self._mail_evt[key] = asyncio.Event()
+        evt.set()
+
+    async def p2p_recv(self, key: Tuple[int, int, int]):
+        key = tuple(key)
+        evt = self._mail_evt.get(key)
+        if evt is None:
+            evt = self._mail_evt[key] = asyncio.Event()
+        await evt.wait()
+        payload = self._mail.pop(key)
+        del self._mail_evt[key]
+        return payload
+
+
+def get_or_create_coordinator(group_name: str, world_size: int, rank: int,
+                              timeout: float = 60.0):
+    """All ranks create-or-get the named coordinator atomically
+    (``get_if_exists`` resolves the race inside the head service)."""
+    import ray_tpu
+
+    name = f"__collective_coordinator:{group_name}"
+    actor_cls = ray_tpu.remote(max_concurrency=max(world_size * 2, 8))(
+        CollectiveCoordinator
+    )
+    return actor_cls.options(name=name, get_if_exists=True).remote(world_size)

@@ -1,0 +1,121 @@
+"""Host-level collective group over the control plane.
+
+TPU-era stand-in for the reference's torch-gloo backend
+(``python/ray/util/collective/collective_group/torch_gloo_collective_group.py``):
+small-tensor / control-plane collectives between worker processes, moved via
+the coordinator actor rather than a dedicated fabric. Payloads are numpy
+arrays (jax arrays are host-staged by the XLA group before delegating here).
+"""
+from __future__ import annotations
+
+from typing import Any, List
+
+import numpy as np
+
+from ray_tpu.util.collective.backend_registry import register_collective_backend
+from ray_tpu.util.collective.collective_group.base_collective_group import BaseGroup
+from ray_tpu.util.collective.collective_group.coordinator import (
+    get_or_create_coordinator,
+)
+from ray_tpu.util.collective.types import (
+    AllGatherOptions,
+    AllReduceOptions,
+    Backend,
+    BarrierOptions,
+    BroadcastOptions,
+    RecvOptions,
+    ReduceOp,
+    ReduceOptions,
+    ReduceScatterOptions,
+    SendOptions,
+)
+
+
+def _reduce(values: List[np.ndarray], op: ReduceOp) -> np.ndarray:
+    stack = np.stack([np.asarray(v) for v in values])
+    if op == ReduceOp.SUM:
+        return stack.sum(axis=0)
+    if op == ReduceOp.PRODUCT:
+        return stack.prod(axis=0)
+    if op == ReduceOp.MIN:
+        return stack.min(axis=0)
+    if op == ReduceOp.MAX:
+        return stack.max(axis=0)
+    if op == ReduceOp.AVERAGE:
+        return stack.mean(axis=0)
+    raise ValueError(f"unknown reduce op {op}")
+
+
+def _copy_inplace(dst, src: np.ndarray):
+    """NCCL-style in-place semantics for numpy inputs; return src otherwise."""
+    if isinstance(dst, np.ndarray) and dst.shape == src.shape:
+        np.copyto(dst, src.astype(dst.dtype, copy=False))
+        return dst
+    return src
+
+
+@register_collective_backend(Backend.HOST)
+class HostCollectiveGroup(BaseGroup):
+    def __init__(self, world_size: int, rank: int, group_name: str):
+        super().__init__(world_size, rank, group_name)
+        self._coord = get_or_create_coordinator(group_name, world_size, rank)
+        self._seq = 0
+        self._p2p_seq = {}
+
+    def _next_seq(self) -> int:
+        self._seq += 1
+        return self._seq
+
+    def _exchange(self, payload) -> List[Any]:
+        import ray_tpu
+
+        return ray_tpu.get(
+            self._coord.exchange.remote(self._next_seq(), self._rank, payload)
+        )
+
+    # ------------------------------------------------------------- collectives
+
+    def allreduce(self, tensor, opts: AllReduceOptions = AllReduceOptions()):
+        values = self._exchange(np.asarray(tensor))
+        return _copy_inplace(tensor, _reduce(values, opts.reduce_op))
+
+    def barrier(self, opts: BarrierOptions = BarrierOptions()):
+        self._exchange(None)
+
+    def reduce(self, tensor, opts: ReduceOptions = ReduceOptions()):
+        values = self._exchange(np.asarray(tensor))
+        if self._rank == opts.root_rank:
+            return _copy_inplace(tensor, _reduce(values, opts.reduce_op))
+        return tensor
+
+    def broadcast(self, tensor, opts: BroadcastOptions = BroadcastOptions()):
+        payload = np.asarray(tensor) if self._rank == opts.root_rank else None
+        values = self._exchange(payload)
+        return _copy_inplace(tensor, np.asarray(values[opts.root_rank]))
+
+    def allgather(self, tensor, opts: AllGatherOptions = AllGatherOptions()):
+        return [np.asarray(v) for v in self._exchange(np.asarray(tensor))]
+
+    def reducescatter(self, tensor, opts: ReduceScatterOptions = ReduceScatterOptions()):
+        reduced = _reduce(self._exchange(np.asarray(tensor)), opts.reduce_op)
+        shards = np.array_split(reduced, self._world_size, axis=0)
+        return shards[self._rank]
+
+    # ------------------------------------------------------------------- p2p
+
+    def _p2p_key(self, src: int, dst: int):
+        k = (src, dst)
+        self._p2p_seq[k] = self._p2p_seq.get(k, 0) + 1
+        return (src, dst, self._p2p_seq[k])
+
+    def send(self, tensor, opts: SendOptions):
+        import ray_tpu
+
+        key = self._p2p_key(self._rank, opts.dst_rank)
+        ray_tpu.get(self._coord.p2p_send.remote(key, np.asarray(tensor)))
+
+    def recv(self, opts: RecvOptions):
+        import ray_tpu
+
+        key = self._p2p_key(opts.src_rank, self._rank)
+        return np.asarray(ray_tpu.get(self._coord.p2p_recv.remote(key)))

@@ -1,0 +1,155 @@
+"""XLA/ICI collective group — the TPU replacement for the reference's NCCL
+backend (``python/ray/util/collective/collective_group/nccl_collective_group.py``).
+
+Two regimes, per SURVEY.md §2.3:
+
+1. **In-jit (the fast path)**: collectives inside compiled programs are not
+   runtime calls at all — they are XLA HLO collectives emitted from sharding
+   annotations or explicit ``jax.lax`` ops riding ICI. ``ici_*`` helpers below
+   are thin, named wrappers usable under ``shard_map``/``pjit`` so user code
+   has one vocabulary for both regimes.
+
+2. **Out-of-jit (host-level jax arrays)**: staged device→host, exchanged over
+   the control plane (DCN), and put back on device. This is the analog of the
+   reference's host-mediated paths, and is only for control traffic — bulk
+   data should stay inside jit.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.util.collective.backend_registry import register_collective_backend
+from ray_tpu.util.collective.collective_group.host_collective_group import (
+    HostCollectiveGroup,
+)
+from ray_tpu.util.collective.types import Backend, ReduceOp
+
+# --------------------------------------------------------------------------
+# In-jit helpers: use inside pjit/shard_map with a named mesh axis.
+# --------------------------------------------------------------------------
+
+
+def ici_allreduce(x, axis_name: str, op: ReduceOp = ReduceOp.SUM):
+    import jax
+
+    if op == ReduceOp.SUM:
+        return jax.lax.psum(x, axis_name)
+    if op == ReduceOp.AVERAGE:
+        return jax.lax.pmean(x, axis_name)
+    if op == ReduceOp.MAX:
+        return jax.lax.pmax(x, axis_name)
+    if op == ReduceOp.MIN:
+        return jax.lax.pmin(x, axis_name)
+    if op == ReduceOp.PRODUCT:
+        return jax.lax.pprod(x, axis_name) if hasattr(jax.lax, "pprod") else (
+            jax.lax.exp(jax.lax.psum(jax.lax.log(x), axis_name))
+        )
+    raise ValueError(f"unsupported in-jit reduce op {op}")
+
+
+def ici_allgather(x, axis_name: str, axis: int = 0, tiled: bool = True):
+    import jax
+
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def ici_reducescatter(x, axis_name: str, axis: int = 0):
+    import jax
+
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def ici_broadcast(x, axis_name: str, root: int = 0):
+    """Broadcast root's shard to every member of the axis."""
+    import jax
+
+    idx = jax.lax.axis_index(axis_name)
+    masked = jax.numpy.where(idx == root, x, jax.numpy.zeros_like(x))
+    return jax.lax.psum(masked, axis_name)
+
+
+def ici_ppermute(x, axis_name: str, perm):
+    import jax
+
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def ici_all_to_all(x, axis_name: str, split_axis: int, concat_axis: int):
+    import jax
+
+    return jax.lax.all_to_all(
+        x, axis_name, split_axis=split_axis, concat_axis=concat_axis, tiled=True
+    )
+
+
+# --------------------------------------------------------------------------
+# Out-of-jit group: host staging + control-plane exchange.
+# --------------------------------------------------------------------------
+
+
+def _to_host(tensor) -> np.ndarray:
+    import jax
+
+    if isinstance(tensor, jax.Array):
+        return np.asarray(jax.device_get(tensor))
+    return np.asarray(tensor)
+
+
+def _like(result: np.ndarray, template):
+    import jax
+    import jax.numpy as jnp
+
+    if isinstance(template, jax.Array):
+        arr = jnp.asarray(result).astype(template.dtype)
+        return jax.device_put(arr, list(template.devices())[0])
+    return result
+
+
+@register_collective_backend(Backend.XLA)
+class XlaCollectiveGroup(HostCollectiveGroup):
+    """Host-staged collectives for jax arrays outside jit.
+
+    Inherits the exchange machinery; overrides tensor conversion so jax
+    arrays round-trip device→host→device and land back on their device.
+    """
+
+    def allreduce(self, tensor, opts=None):
+        from ray_tpu.util.collective.types import AllReduceOptions
+
+        opts = opts or AllReduceOptions()
+        out = super().allreduce(_to_host(tensor), opts)
+        return _like(np.asarray(out), tensor)
+
+    def reduce(self, tensor, opts=None):
+        from ray_tpu.util.collective.types import ReduceOptions
+
+        opts = opts or ReduceOptions()
+        out = super().reduce(_to_host(tensor), opts)
+        return _like(np.asarray(out), tensor)
+
+    def broadcast(self, tensor, opts=None):
+        from ray_tpu.util.collective.types import BroadcastOptions
+
+        opts = opts or BroadcastOptions()
+        out = super().broadcast(_to_host(tensor), opts)
+        return _like(np.asarray(out), tensor)
+
+    def allgather(self, tensor, opts=None):
+        from ray_tpu.util.collective.types import AllGatherOptions
+
+        opts = opts or AllGatherOptions()
+        outs = super().allgather(_to_host(tensor), opts)
+        return [_like(o, tensor) for o in outs]
+
+    def reducescatter(self, tensor, opts=None):
+        from ray_tpu.util.collective.types import ReduceScatterOptions
+
+        opts = opts or ReduceScatterOptions()
+        out = super().reducescatter(_to_host(tensor), opts)
+        return _like(np.asarray(out), tensor)
+
+    def send(self, tensor, opts):
+        super().send(_to_host(tensor), opts)
+
+    def recv(self, opts):
+        return super().recv(opts)

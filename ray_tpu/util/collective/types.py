@@ -1,0 +1,94 @@
+"""Collective types: reduce ops, backend names, option structs.
+
+Reference analog: ``python/ray/util/collective/types.py``.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import List
+
+
+class ReduceOp(enum.Enum):
+    SUM = 0
+    PRODUCT = 1
+    MIN = 2
+    MAX = 3
+    AVERAGE = 4
+
+
+class Backend:
+    """Backend name constants (reference: ``types.py Backend``).
+
+    - ``HOST``: host-level collectives via the coordinator actor (control
+      plane over DCN). Works for numpy and host-staged jax arrays. This is
+      the TPU-era stand-in for the reference's torch-gloo backend.
+    - ``XLA``: TPU/ICI backend. In-jit collectives are sharding-induced XLA
+      ops (``psum``/``all_gather``/``ppermute``); host-level (out-of-jit)
+      tensors are staged device→host, moved over the control plane, and
+      restored device-side. Replaces the reference's NCCL backend
+      (``collective_group/nccl_collective_group.py``).
+    - ``AUTO``: XLA if the input is a jax array on TPU, else HOST.
+    """
+
+    HOST = "host"
+    XLA = "xla"
+    AUTO = "auto"
+
+    @staticmethod
+    def resolve(name: str) -> str:
+        name = (name or Backend.AUTO).lower()
+        if name in ("gloo", "torch_gloo", "cpu", Backend.HOST):
+            return Backend.HOST
+        if name in ("nccl", "ici", "tpu", Backend.XLA):
+            return Backend.XLA
+        if name == Backend.AUTO:
+            return Backend.AUTO
+        raise ValueError(f"unknown collective backend: {name}")
+
+
+@dataclass
+class AllReduceOptions:
+    reduce_op: ReduceOp = ReduceOp.SUM
+    timeout_ms: int = 30000
+
+
+@dataclass
+class BarrierOptions:
+    timeout_ms: int = 30000
+
+
+@dataclass
+class ReduceOptions:
+    reduce_op: ReduceOp = ReduceOp.SUM
+    root_rank: int = 0
+    timeout_ms: int = 30000
+
+
+@dataclass
+class BroadcastOptions:
+    root_rank: int = 0
+    timeout_ms: int = 30000
+
+
+@dataclass
+class AllGatherOptions:
+    timeout_ms: int = 30000
+
+
+@dataclass
+class ReduceScatterOptions:
+    reduce_op: ReduceOp = ReduceOp.SUM
+    timeout_ms: int = 30000
+
+
+@dataclass
+class SendOptions:
+    dst_rank: int = 0
+    timeout_ms: int = 30000
+
+
+@dataclass
+class RecvOptions:
+    src_rank: int = 0
+    timeout_ms: int = 30000

@@ -94,3 +94,14 @@ def test_gqa_xla():
     _, k, v = _make_qkv(H=2, seed=1)
     out = attention_xla(q, k, v, causal=True)
     assert out.shape == q.shape
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("T", [96, 1000])
+def test_flash_ragged_seq_len(causal, T):
+    """Seq lengths not divisible by the block size (regression: the kernel's
+    clamped dynamic slice silently re-read earlier K rows)."""
+    q, k, v = _make_qkv(B=1, T=T, H=2, D=16)
+    out = flash_attention(q, k, v, causal, 64, 64, True)
+    ref = attention_xla(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
