@@ -206,11 +206,16 @@ class HeadService:
     def _make_node_close_handler(self, node_id):
         loop = asyncio.get_running_loop()
 
+        def _spawn():
+            if not loop.is_closed():
+                loop.create_task(self._on_node_dead(node_id))
+
         def _on_close(conn):
             if not loop.is_closed():
-                loop.call_soon_threadsafe(
-                    lambda: loop.create_task(self._on_node_dead(node_id))
-                )
+                try:
+                    loop.call_soon_threadsafe(_spawn)
+                except RuntimeError:
+                    pass  # loop torn down concurrently
         return _on_close
 
     async def _on_node_dead(self, node_id: str, reason: str = "connection lost"):

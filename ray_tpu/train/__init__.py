@@ -1,0 +1,73 @@
+"""ray_tpu.train: the Train layer (reference: ``python/ray/train`` v2 API).
+
+User surface::
+
+    from ray_tpu import train
+    from ray_tpu.train import JaxTrainer, ScalingConfig, RunConfig
+
+    def train_fn(config):
+        ctx = train.get_context()          # rank / world_size / ...
+        ckpt = train.get_checkpoint()      # resume point after failure
+        ...
+        train.report({"loss": loss}, checkpoint=train.Checkpoint.from_directory(d))
+
+    result = JaxTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=4, use_tpu=True),
+        run_config=RunConfig(storage_path="/mnt/shared", name="run1"),
+    ).fit()
+"""
+from ray_tpu.train.checkpoint import (
+    Checkpoint,
+    CheckpointManager,
+    load_pytree,
+    save_pytree,
+)
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    JaxConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.context import get_checkpoint, get_context, report
+from ray_tpu.train.controller import TrainController, TrainingFailedError
+from ray_tpu.train.result import Result
+from ray_tpu.train.step import (
+    OptimizerConfig,
+    create_train_state,
+    make_eval_step,
+    make_train_step,
+)
+from ray_tpu.train.trainer import (
+    DataParallelTrainer,
+    JaxTrainer,
+    default_jax_train_loop,
+    get_dataset_shard,
+)
+
+__all__ = [
+    "Checkpoint",
+    "CheckpointConfig",
+    "CheckpointManager",
+    "DataParallelTrainer",
+    "FailureConfig",
+    "JaxConfig",
+    "JaxTrainer",
+    "OptimizerConfig",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "TrainController",
+    "TrainingFailedError",
+    "create_train_state",
+    "default_jax_train_loop",
+    "get_checkpoint",
+    "get_context",
+    "get_dataset_shard",
+    "load_pytree",
+    "make_eval_step",
+    "make_train_step",
+    "report",
+    "save_pytree",
+]

@@ -1,0 +1,137 @@
+"""Train controller: the control loop over the worker group.
+
+Reference analog: ``train/v2/_internal/execution/controller/controller.py:103``
+(``_run_control_loop_iteration`` :688, ``run`` :745). Differences, per
+SURVEY.md §7: the loop runs in the driver process rather than a dedicated
+controller actor — on a TPU pod the driver is itself a real host of the
+slice (multi-controller JAX), so an extra actor hop buys nothing; the
+controller-as-actor split can return when jobs outlive drivers.
+
+Loop shape: scaling decision → start worker group → poll → (aggregate
+reports, register rank-0 checkpoints) → on failure consult FailurePolicy +
+ScalingPolicy and restart from the latest checkpoint → on completion return
+:class:`Result`.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Optional
+
+from ray_tpu.train.checkpoint import CheckpointManager
+from ray_tpu.train.config import JaxConfig, RunConfig, ScalingConfig
+from ray_tpu.train.failure_policy import FailureDecision, FailurePolicy
+from ray_tpu.train.result import Result
+from ray_tpu.train.scaling_policy import make_scaling_policy
+from ray_tpu.train.worker_group import WorkerGroup
+
+
+class TrainingFailedError(RuntimeError):
+    pass
+
+
+class TrainController:
+    def __init__(
+        self,
+        train_fn: Callable,
+        train_loop_config: Optional[dict],
+        scaling: ScalingConfig,
+        run_config: RunConfig,
+        jax_config: Optional[JaxConfig] = None,
+        poll_interval: float = 0.05,
+        recovery_timeout: float = 15.0,
+    ):
+        self._recovery_timeout = recovery_timeout
+        self._train_fn = train_fn
+        self._train_loop_config = train_loop_config
+        self._scaling = scaling
+        self._run_config = run_config
+        self._jax_config = jax_config
+        self._poll_interval = poll_interval
+
+        name = run_config.name or f"train_{int(time.time())}"
+        self._run_dir = os.path.join(run_config.resolved_storage_path(), name)
+        self._ckpt_manager = CheckpointManager.restore_index(
+            run_config.checkpoint_config, self._run_dir
+        )
+        self._failure_policy = FailurePolicy(run_config.failure_config)
+        self._scaling_policy = make_scaling_policy(scaling)
+        self._metrics_history: list = []
+        self._latest_metrics: dict = {}
+
+    def run(self) -> Result:
+        decision = self._scaling_policy.initial_decision()
+        world_size = decision.world_size
+        attempt = 0
+        while True:
+            group = WorkerGroup(
+                self._scaling,
+                self._jax_config,
+                os.path.basename(self._run_dir),
+                self._run_dir,
+            )
+            try:
+                group.start(
+                    world_size,
+                    self._train_fn,
+                    self._train_loop_config,
+                    self._ckpt_manager.latest_checkpoint,
+                    attempt=attempt,
+                )
+                error = self._monitor(group)
+            except Exception as e:  # start failed (e.g. resources not yet
+                # released after a node death) — treat as a group failure
+                error = f"worker group start failed: {e}"
+            group.shutdown()
+            if error is None:
+                return Result(
+                    metrics=self._latest_metrics,
+                    checkpoint=self._ckpt_manager.latest_checkpoint,
+                    best_checkpoint=self._ckpt_manager.best_checkpoint,
+                    path=self._run_dir,
+                    metrics_history=self._metrics_history,
+                )
+            if self._failure_policy.make_decision(error) is FailureDecision.RAISE:
+                raise TrainingFailedError(
+                    f"training failed after {self._failure_policy.failures - 1} "
+                    f"retries: {error}"
+                )
+            # Let leases/health state settle before sizing the restart
+            # (resources of the failed group release asynchronously).
+            recovery = None
+            deadline = time.monotonic() + self._recovery_timeout
+            while time.monotonic() < deadline:
+                time.sleep(self._poll_interval * 4)
+                recovery = self._scaling_policy.recovery_decision()
+                if recovery is not None and recovery.world_size >= 1:
+                    break
+            if recovery is None:
+                raise TrainingFailedError(
+                    f"cannot restart: cluster below min_workers "
+                    f"({self._scaling.min_workers}); last error: {error}"
+                )
+            world_size = recovery.world_size
+            attempt += 1
+
+    def _monitor(self, group: WorkerGroup) -> Optional[str]:
+        """Poll until all workers finish. Returns an error string or None."""
+        while True:
+            statuses = group.poll()
+            error = None
+            for st in statuses:
+                for rep in st.reports:
+                    self._ingest_report(rep)
+                if st.error:
+                    error = st.error
+            if error:
+                return error
+            if all(st.done for st in statuses):
+                return None
+            time.sleep(self._poll_interval)
+
+    def _ingest_report(self, rep: dict):
+        if rep["rank"] == 0:
+            self._latest_metrics = rep["metrics"]
+            self._metrics_history.append(rep["metrics"])
+        if rep.get("checkpoint_path"):
+            self._ckpt_manager.register(rep["checkpoint_path"], rep["metrics"])

@@ -1,0 +1,268 @@
+"""Train worker group: N actors each running the user train_fn on a thread.
+
+Reference analog: ``python/ray/train/v2/_internal/execution/worker_group/
+worker_group.py:88`` (``_start`` :194, ``poll_status`` :663) and
+``thread_runner.py``. TPU-first notes: one worker per TPU *host* (process-
+per-host is the JAX multi-controller model), ranks assigned deterministically
+by (node, creation order) so rank 0 lands on the first host; the JAX backend
+setup (env + ``jax.distributed.initialize``) mirrors ``train/v2/jax/
+config.py:24`` ``_JaxBackend``.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import JaxConfig, ScalingConfig
+from ray_tpu.train.context import TrainContext, _set_context
+
+
+class TrainWorker:
+    """Actor hosting one train_fn run (one rank)."""
+
+    def __init__(self):
+        self._ctx: Optional[TrainContext] = None
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[str] = None
+        self._done = False
+        self._result: Any = None
+
+    def setup(
+        self,
+        world_rank: int,
+        world_size: int,
+        local_rank: int,
+        local_world_size: int,
+        node_rank: int,
+        experiment_name: str,
+        run_dir: str,
+        latest_checkpoint_path: Optional[str],
+        env_vars: Dict[str, str],
+        jax_distributed: Optional[dict] = None,
+        attempt: int = 0,
+    ) -> dict:
+        for k, v in env_vars.items():
+            os.environ[k] = v
+        if jax_distributed:
+            import jax
+
+            jax.distributed.initialize(
+                coordinator_address=jax_distributed["coordinator"],
+                num_processes=jax_distributed["num_processes"],
+                process_id=world_rank,
+            )
+        ckpt = (
+            Checkpoint(latest_checkpoint_path) if latest_checkpoint_path else None
+        )
+        self._ctx = TrainContext(
+            world_rank=world_rank,
+            world_size=world_size,
+            local_rank=local_rank,
+            local_world_size=local_world_size,
+            node_rank=node_rank,
+            experiment_name=experiment_name,
+            run_dir=run_dir,
+            latest_checkpoint=ckpt,
+            attempt=attempt,
+        )
+        import socket
+
+        return {"hostname": socket.gethostname(), "pid": os.getpid()}
+
+    def start(self, train_fn: Callable, train_loop_config: Optional[dict]) -> bool:
+        assert self._ctx is not None, "setup() must run before start()"
+        ctx = self._ctx
+        if train_loop_config and "_datasets" in train_loop_config:
+            train_loop_config = dict(train_loop_config)
+            ctx._datasets = train_loop_config.pop("_datasets")
+
+        def run():
+            _set_context(ctx)
+            try:
+                takes_arg = True
+                try:
+                    import inspect
+
+                    takes_arg = len(inspect.signature(train_fn).parameters) > 0
+                except (TypeError, ValueError):
+                    pass
+                self._result = (
+                    train_fn(train_loop_config or {}) if takes_arg else train_fn()
+                )
+            except BaseException as e:  # noqa: BLE001 — surfaced via poll()
+                self._error = (
+                    f"{type(e).__name__}: {e}\n{traceback.format_exc()}"
+                )
+            finally:
+                self._done = True
+                _set_context(None)
+
+        self._thread = threading.Thread(target=run, name="rt-train-fn", daemon=True)
+        self._thread.start()
+        return True
+
+    def poll(self) -> dict:
+        reports = self._ctx.drain_reports() if self._ctx else []
+        return {"reports": reports, "done": self._done, "error": self._error}
+
+    def request_stop(self) -> bool:
+        if self._ctx:
+            self._ctx.stop_event.set()
+        return True
+
+    def join(self, timeout: float = 10.0) -> dict:
+        if self._thread:
+            self._thread.join(timeout)
+        return self.poll()
+
+    def execute(self, fn: Callable, *args, **kwargs):
+        """Run an arbitrary function in the worker process (reference:
+        ``WorkerGroup.execute``)."""
+        return fn(*args, **kwargs)
+
+    def get_address(self) -> str:
+        """Routable IP of this worker's host (for the jax.distributed
+        coordinator, which must listen where other hosts can dial)."""
+        import socket
+
+        try:
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            s.connect(("8.8.8.8", 80))  # no packets sent; picks the route
+            ip = s.getsockname()[0]
+            s.close()
+            return ip
+        except OSError:
+            return socket.gethostbyname(socket.gethostname())
+
+
+@dataclass
+class WorkerStatus:
+    reports: List[dict] = field(default_factory=list)
+    done: bool = False
+    error: Optional[str] = None
+    dead: bool = False
+
+
+class WorkerGroup:
+    """Creates, polls, and tears down the rank-ordered actor group."""
+
+    def __init__(
+        self,
+        scaling: ScalingConfig,
+        jax_config: Optional[JaxConfig],
+        experiment_name: str,
+        run_dir: str,
+    ):
+        self._scaling = scaling
+        self._jax = jax_config or JaxConfig()
+        self._experiment_name = experiment_name
+        self._run_dir = run_dir
+        self.workers: List[Any] = []  # ActorHandles
+        self.world_size = 0
+
+    def start(
+        self,
+        world_size: int,
+        train_fn: Callable,
+        train_loop_config: Optional[dict],
+        latest_checkpoint: Optional[Checkpoint],
+        attempt: int = 0,
+    ):
+        import ray_tpu
+
+        res = self._scaling.worker_resources()
+        spread = self._scaling.placement_strategy in ("SPREAD", "STRICT_SPREAD")
+        actor_cls = ray_tpu.remote(TrainWorker)
+        opts: Dict[str, Any] = {
+            "num_cpus": res.get("CPU", 1.0),
+            "resources": {k: v for k, v in res.items() if k != "CPU"},
+        }
+        if spread:
+            opts["scheduling_strategy"] = "SPREAD"
+        # Append as we create so a mid-creation failure (e.g. unschedulable)
+        # still leaves the partial group reachable for shutdown() to kill —
+        # otherwise the created actors pin their resources forever.
+        self.workers = []
+        self.world_size = world_size
+        for _ in range(world_size):
+            self.workers.append(actor_cls.options(**opts).remote())
+
+        env_vars = dict(self._jax.env_vars)
+        if self._jax.jax_platforms:
+            env_vars["JAX_PLATFORMS"] = self._jax.jax_platforms
+        jax_dist = None
+        if self._jax.distributed_init and world_size > 1:
+            # The coordinator runs in rank 0's process; every host must dial
+            # rank 0's routable address, not its own loopback (reference:
+            # _JaxBackend + util/tpu.py:205 coordinator env construction).
+            coord_host = self._jax.coordinator_address or ray_tpu.get(
+                self.workers[0].get_address.remote(), timeout=60
+            )
+            jax_dist = {
+                "coordinator": f"{coord_host}:{self._jax.coordinator_port}",
+                "num_processes": world_size,
+            }
+
+        # Deterministic ranks: worker i = rank i. Node-locality metadata from
+        # setup() feeds local_rank; round-1 treats each worker as its own node
+        # slot (process-per-host model).
+        setups = [
+            w.setup.remote(
+                i,
+                world_size,
+                0,
+                1,
+                i,
+                self._experiment_name,
+                self._run_dir,
+                latest_checkpoint.path if latest_checkpoint else None,
+                env_vars,
+                jax_dist,
+                attempt,
+            )
+            for i, w in enumerate(self.workers)
+        ]
+        ray_tpu.get(setups, timeout=120)
+        ray_tpu.get(
+            [w.start.remote(train_fn, train_loop_config) for w in self.workers],
+            timeout=120,
+        )
+
+    def poll(self, timeout: float = 30.0) -> List[WorkerStatus]:
+        import ray_tpu
+
+        statuses: List[WorkerStatus] = []
+        for w in self.workers:
+            # Any failure to reach a worker — actor death, node death, RPC
+            # connection loss — is a worker failure the controller must see,
+            # not an exception to propagate.
+            try:
+                h = ray_tpu.get(w.poll.remote(), timeout=timeout)
+                statuses.append(
+                    WorkerStatus(h["reports"], h["done"], h["error"], dead=False)
+                )
+            except Exception as e:  # noqa: BLE001
+                statuses.append(
+                    WorkerStatus([], True, f"worker unreachable: {e}", dead=True)
+                )
+        return statuses
+
+    def shutdown(self, graceful_timeout: float = 5.0):
+        import ray_tpu
+
+        for w in self.workers:
+            try:
+                w.request_stop.remote()
+            except Exception:
+                pass
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self.workers = []
+        self.world_size = 0

@@ -68,11 +68,35 @@ class GroupManager:
     def get_group(self, group_name: str):
         g = self._groups.get(_member_key(group_name))
         if g is None:
+            g = self._try_lazy_init(group_name)
+        if g is None:
             raise RuntimeError(
                 f"collective group '{group_name}' is not initialized by this "
-                f"member; call init_collective_group() first"
+                f"member; call init_collective_group() first (or declare it "
+                f"from the driver with create_collective_group())"
             )
         return g
+
+    def _try_lazy_init(self, group_name: str):
+        """Self-init from a driver-side ``create_collective_group``
+        declaration stored on the coordinator (reference behavior: the
+        declarative API sets up the group without each actor calling init)."""
+        import ray_tpu
+        from ray_tpu._private.worker import current_actor_id_hex
+
+        me = current_actor_id_hex()
+        if me is None:
+            return None
+        try:
+            coord = ray_tpu.get_actor(f"__collective_coordinator:{group_name}")
+            spec = ray_tpu.get(coord.lookup.remote(me), timeout=30)
+        except Exception:
+            return None
+        if spec is None:
+            return None
+        return self.create_group(
+            spec["backend"], spec["world_size"], spec["rank"], group_name
+        )
 
     def is_group_exist(self, group_name: str) -> bool:
         return _member_key(group_name) in self._groups
@@ -110,28 +134,44 @@ def create_collective_group(
     backend: str = Backend.AUTO,
     group_name: str = "default",
 ):
-    """Declarative setup from the driver (reference: ``collective.py:188``):
-    instructs each actor to init the group with its assigned rank. Actors must
-    expose no particular method — we inject via a remote closure calling
-    ``init_collective_group`` on the actor's process is not possible without
-    cooperation, so (as in the reference) actors are expected to call
-    ``init_collective_group`` themselves; this helper instead validates and
-    pre-creates the coordinator so member init cannot race a missing
-    coordinator.
+    """Declarative setup from the driver (reference: ``collective.py:188``).
+
+    Creates the coordinator and records the actor→rank assignment on it;
+    each actor then self-initializes its membership lazily on its first
+    collective call (no explicit ``init_collective_group`` needed inside
+    the actors).
     """
     if len(actors) != len(ranks) or len(actors) != world_size:
         raise ValueError("actors/ranks must both have world_size entries")
     if sorted(ranks) != list(range(world_size)):
         raise ValueError(f"ranks must be a permutation of 0..{world_size-1}")
+    import ray_tpu
     from ray_tpu.util.collective.collective_group.coordinator import (
         get_or_create_coordinator,
     )
 
-    get_or_create_coordinator(group_name, world_size, 0)
+    coord = get_or_create_coordinator(group_name, world_size, 0)
+    ranks_by_actor = {
+        a._actor_id_hex: r for a, r in zip(actors, ranks)
+    }
+    ray_tpu.get(
+        coord.declare.remote(ranks_by_actor, Backend.resolve(backend)),
+        timeout=60,
+    )
 
 
 def destroy_collective_group(group_name: str = "default"):
     _group_mgr.destroy_group(group_name)
+    # Reap the named coordinator even if this process never joined (e.g. the
+    # driver after a declarative create_collective_group) so the group name
+    # can be reused with fresh state.
+    import ray_tpu
+
+    try:
+        coord = ray_tpu.get_actor(f"__collective_coordinator:{group_name}")
+        ray_tpu.kill(coord)
+    except Exception:
+        pass
 
 
 def is_group_initialized(group_name: str = "default") -> bool:

@@ -29,9 +29,28 @@ class CollectiveCoordinator:
         self._slots: Dict[Any, dict] = {}
         self._mail: Dict[Tuple[int, int, int], Any] = {}
         self._mail_evt: Dict[Tuple[int, int, int], asyncio.Event] = {}
+        self._declared: Dict[str, int] = {}  # actor_id_hex -> rank
+        self._declared_backend: str = "auto"
 
     def world_size(self) -> int:
         return self._world
+
+    def declare(self, ranks_by_actor: Dict[str, int], backend: str):
+        """Record the driver-side group declaration
+        (``create_collective_group``) so members can lazily self-init."""
+        self._declared = dict(ranks_by_actor)
+        self._declared_backend = backend
+
+    def lookup(self, actor_id_hex: str):
+        """Rank assignment for a declared member, or None."""
+        rank = self._declared.get(actor_id_hex)
+        if rank is None:
+            return None
+        return {
+            "rank": rank,
+            "world_size": self._world,
+            "backend": self._declared_backend,
+        }
 
     async def exchange(self, seq: int, rank: int, payload):
         """Post ``payload`` for ``rank`` at step ``seq``; return all payloads

@@ -61,6 +61,28 @@ class HostCollectiveGroup(BaseGroup):
         self._coord = get_or_create_coordinator(group_name, world_size, rank)
         self._seq = 0
         self._p2p_seq = {}
+        # A pre-existing coordinator (get_if_exists) may be from an older
+        # incarnation with a different world size — exchanges against it
+        # would hang forever, so fail loudly at init.
+        import ray_tpu
+
+        ws = ray_tpu.get(self._coord.world_size.remote(), timeout=30)
+        if ws != world_size:
+            raise RuntimeError(
+                f"collective group '{group_name}' coordinator has "
+                f"world_size={ws}, requested {world_size}; "
+                f"destroy_collective_group() the old group first"
+            )
+
+    def destroy_group(self):
+        """Kill the coordinator so a later re-creation of this group name
+        starts from fresh state (idempotent across ranks)."""
+        import ray_tpu
+
+        try:
+            ray_tpu.kill(self._coord)
+        except Exception:
+            pass  # another rank already killed it
 
     def _next_seq(self) -> int:
         self._seq += 1

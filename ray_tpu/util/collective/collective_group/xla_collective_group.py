@@ -41,9 +41,10 @@ def ici_allreduce(x, axis_name: str, op: ReduceOp = ReduceOp.SUM):
     if op == ReduceOp.MIN:
         return jax.lax.pmin(x, axis_name)
     if op == ReduceOp.PRODUCT:
-        return jax.lax.pprod(x, axis_name) if hasattr(jax.lax, "pprod") else (
-            jax.lax.exp(jax.lax.psum(jax.lax.log(x), axis_name))
-        )
+        # lax has no pprod; all_gather + prod is correct for zeros and
+        # negatives (a log/exp trick would NaN on x <= 0)
+        gathered = jax.lax.all_gather(x, axis_name)
+        return jax.numpy.prod(gathered, axis=0)
     raise ValueError(f"unsupported in-jit reduce op {op}")
 
 

@@ -93,6 +93,61 @@ def test_barrier_and_sendrecv(members):
     np.testing.assert_allclose(outs[1], [42.0])
 
 
+@ray_tpu.remote
+class PassiveMember:
+    """No init_collective_group call — membership comes from the driver's
+    declarative create_collective_group."""
+
+    def do_allreduce(self, value: float):
+        return col.allreduce(np.full((2,), value, np.float32), "gdecl")
+
+
+def test_declarative_create_collective_group(rt_start):
+    ms = [PassiveMember.remote() for _ in range(2)]
+    col.create_collective_group(ms, 2, [0, 1], backend="host",
+                                group_name="gdecl")
+    outs = ray_tpu.get([m.do_allreduce.remote(float(i + 1))
+                        for i, m in enumerate(ms)])
+    for o in outs:
+        np.testing.assert_allclose(o, np.full((2,), 3.0))
+    col.destroy_collective_group("gdecl")
+    for m in ms:
+        ray_tpu.kill(m)
+
+
+def test_world_size_mismatch_detected(rt_start):
+    ms = [Member.remote(2, r, "gsize") for r in range(2)]
+    ray_tpu.get([m.rank_info.remote() for m in ms])
+    # Same group name, different world size, coordinator still alive → the
+    # member's init fails loudly (raised from the actor's __init__)
+    with pytest.raises(Exception, match="world_size"):
+        bad = Member.remote(3, 0, "gsize")
+        ray_tpu.get(bad.rank_info.remote())
+    for m in ms:
+        ray_tpu.kill(m)
+
+
+def test_ici_product_allreduce_with_negatives():
+    """PRODUCT must be exact for negative/zero inputs (no log/exp trick)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from ray_tpu.util.collective.types import ReduceOp
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("x",))
+    x = jnp.array([[-2.0], [3.0], [-1.0], [0.5]])
+
+    f = shard_map(
+        lambda xs: col.ici_allreduce(xs, "x", op=ReduceOp.PRODUCT),
+        mesh=mesh, in_specs=P("x", None), out_specs=P("x", None),
+        check_vma=False,
+    )
+    out = jax.jit(f)(x)
+    np.testing.assert_allclose(np.asarray(out), np.full((4, 1), 3.0))
+
+
 def test_ici_collectives_in_jit():
     """In-jit collectives under shard_map on the 8-device CPU mesh."""
     import jax
