@@ -1,0 +1,46 @@
+"""ray_tpu.data: distributed datasets with streaming execution.
+
+Reference analog: ``python/ray/data`` (Dataset, read_api, streaming
+executor). Blocks are arrow tables in the cluster object store; transforms
+fuse into per-block tasks executed with a bounded in-flight window; batches
+feed jax via ``iter_jax_batches`` (double-buffered ``jax.device_put``).
+"""
+from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.dataset import Dataset, GroupedData
+from ray_tpu.data.datasource import (
+    from_arrow,
+    from_huggingface,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    range_tensor,
+    read_binary_files,
+    read_csv,
+    read_json,
+    read_numpy,
+    read_parquet,
+    read_text,
+)
+from ray_tpu.data.executor import StreamingExecutor
+
+__all__ = [
+    "Block",
+    "BlockAccessor",
+    "Dataset",
+    "GroupedData",
+    "StreamingExecutor",
+    "from_arrow",
+    "from_huggingface",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "range_tensor",
+    "read_binary_files",
+    "read_csv",
+    "read_json",
+    "read_numpy",
+    "read_parquet",
+    "read_text",
+]

@@ -1,0 +1,130 @@
+"""Blocks: the unit of data movement (reference: ``python/ray/data/block.py``
++ ``_internal/arrow_block.py``).
+
+A block is a ``pyarrow.Table``. ``BlockAccessor`` wraps one with the
+operations the executor and iterators need. Batches cross into user code as
+dicts of numpy arrays (the natural jax feed format), pandas, or arrow.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+import pyarrow as pa
+
+Block = pa.Table
+
+
+def _to_table(data: Any) -> pa.Table:
+    """Coerce rows/batches/frames into an arrow table."""
+    import pandas as pd
+
+    if isinstance(data, pa.Table):
+        return data
+    if isinstance(data, pd.DataFrame):
+        return pa.Table.from_pandas(data, preserve_index=False)
+    if isinstance(data, dict):  # dict of columns (numpy arrays or lists)
+        import json
+
+        arrays, fields = [], []
+        for k, v in data.items():
+            arr = np.asarray(v)
+            if arr.ndim > 1:  # tensor column → fixed-shape list array; the
+                # full inner shape rides in field metadata so >2-D tensors
+                # round-trip exactly (not silently flattened to 2-D)
+                fsl = pa.FixedSizeListArray.from_arrays(
+                    pa.array(arr.reshape(-1)), arr[0].size
+                )
+                arrays.append(fsl)
+                fields.append(pa.field(
+                    k, fsl.type,
+                    metadata={b"tensor_shape": json.dumps(
+                        list(arr.shape[1:])).encode()},
+                ))
+            else:
+                a = pa.array(arr)
+                arrays.append(a)
+                fields.append(pa.field(k, a.type))
+        return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
+    if isinstance(data, list):  # list of rows
+        if data and isinstance(data[0], dict):
+            return pa.Table.from_pylist(data)
+        return pa.table({"item": pa.array(data)})
+    raise TypeError(f"cannot convert {type(data)} to a block")
+
+
+def _column_to_numpy(table: pa.Table, name: str) -> np.ndarray:
+    import json
+
+    col = table.column(name)
+    if pa.types.is_fixed_size_list(col.type):
+        flat = col.combine_chunks().flatten().to_numpy(zero_copy_only=False)
+        field = table.schema.field(name)
+        meta = field.metadata or {}
+        if b"tensor_shape" in meta:
+            shape = json.loads(meta[b"tensor_shape"].decode())
+            return flat.reshape((len(table), *shape))
+        return flat.reshape(len(table), -1)
+    return col.to_numpy(zero_copy_only=False)
+
+
+class BlockAccessor:
+    def __init__(self, block: Block):
+        self._table = _to_table(block)
+
+    @staticmethod
+    def for_block(block: Any) -> "BlockAccessor":
+        return BlockAccessor(block)
+
+    @property
+    def table(self) -> pa.Table:
+        return self._table
+
+    def num_rows(self) -> int:
+        return self._table.num_rows
+
+    def size_bytes(self) -> int:
+        return self._table.nbytes
+
+    def schema(self) -> pa.Schema:
+        return self._table.schema
+
+    def slice(self, start: int, end: int) -> Block:
+        return self._table.slice(start, end - start)
+
+    def to_pandas(self):
+        return self._table.to_pandas()
+
+    def to_numpy(self, columns: Optional[List[str]] = None) -> Dict[str, np.ndarray]:
+        names = columns or self._table.column_names
+        return {n: _column_to_numpy(self._table, n) for n in names}
+
+    def to_pylist(self) -> List[dict]:
+        return self._table.to_pylist()
+
+    def iter_rows(self) -> Iterator[dict]:
+        for batch in self._table.to_batches():
+            yield from batch.to_pylist()
+
+    def batch(self, start: int, end: int, batch_format: str = "numpy"):
+        sub = self.slice(start, end)
+        if batch_format in ("numpy", "default"):
+            return BlockAccessor(sub).to_numpy()
+        if batch_format == "pandas":
+            return sub.to_pandas()
+        if batch_format in ("arrow", "pyarrow"):
+            return sub
+        raise ValueError(f"unknown batch_format {batch_format}")
+
+    @staticmethod
+    def concat(blocks: List[Block]) -> Block:
+        tables = [_to_table(b) for b in blocks if b is not None]
+        tables = [t for t in tables if t.num_rows > 0] or tables[:1]
+        if not tables:
+            return pa.table({})
+        return pa.concat_tables(tables, promote_options="default")
+
+
+def batch_to_block(batch: Any) -> Block:
+    """User map_batches output → block (accepts dict/pandas/arrow/list)."""
+    return _to_table(batch)

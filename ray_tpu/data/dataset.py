@@ -1,0 +1,501 @@
+"""Dataset: lazy, distributed, streaming-executed collections of blocks.
+
+Reference analog: ``python/ray/data/dataset.py`` (lazy logical plan →
+physical operators → StreamingExecutor). The plan here is a chain of fusable
+per-block transforms punctuated by barrier ops (repartition / shuffle /
+sort); execution materializes block ObjectRefs in the cluster object store.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Union
+
+import numpy as np
+import pyarrow as pa
+
+from ray_tpu.data.block import Block, BlockAccessor, batch_to_block
+from ray_tpu.data.executor import StreamingExecutor, put_block, resolve_block
+
+
+def _map_rows_fn(fn):
+    def apply(block: Block) -> Block:
+        rows = [fn(r) for r in BlockAccessor(block).iter_rows()]
+        return batch_to_block(rows) if rows else block.slice(0, 0)
+
+    return apply
+
+
+def _flat_map_fn(fn):
+    def apply(block: Block) -> Block:
+        rows = list(
+            itertools.chain.from_iterable(
+                fn(r) for r in BlockAccessor(block).iter_rows()
+            )
+        )
+        return batch_to_block(rows) if rows else block.slice(0, 0)
+
+    return apply
+
+
+def _filter_fn(fn):
+    def apply(block: Block) -> Block:
+        acc = BlockAccessor(block)
+        keep = [fn(r) for r in acc.iter_rows()]
+        return acc.table.filter(pa.array(keep, type=pa.bool_()))
+
+    return apply
+
+
+def _map_batches_fn(fn, batch_size: Optional[int], batch_format: str,
+                    fn_kwargs: Optional[dict]):
+    kwargs = fn_kwargs or {}
+
+    def apply(block: Block) -> Block:
+        acc = BlockAccessor(block)
+        n = acc.num_rows()
+        if n == 0:
+            return block
+        size = batch_size or n
+        outs = []
+        for start in range(0, n, size):
+            batch = acc.batch(start, min(start + size, n), batch_format)
+            outs.append(batch_to_block(fn(batch, **kwargs)))
+        return BlockAccessor.concat(outs)
+
+    return apply
+
+
+def _add_column_fn(name: str, fn):
+    def apply(block: Block) -> Block:
+        acc = BlockAccessor(block)
+        col = fn(acc.batch(0, acc.num_rows(), "pandas"))
+        return acc.table.append_column(name, pa.array(np.asarray(col)))
+
+    return apply
+
+
+def _drop_columns_fn(cols: List[str]):
+    def apply(block: Block) -> Block:
+        return block.drop_columns(cols)
+
+    return apply
+
+
+def _select_columns_fn(cols: List[str]):
+    def apply(block: Block) -> Block:
+        return block.select(cols)
+
+    return apply
+
+
+def _rename_columns_fn(mapping: Dict[str, str]):
+    def apply(block: Block) -> Block:
+        return block.rename_columns(
+            [mapping.get(c, c) for c in block.column_names]
+        )
+
+    return apply
+
+
+class Dataset:
+    """Lazy plan: input block refs + pending fused transforms."""
+
+    def __init__(self, blocks: List[Any], pending: Optional[List] = None,
+                 executor: Optional[StreamingExecutor] = None):
+        self._blocks = list(blocks)  # refs (cluster) or Blocks (local mode)
+        self._pending: List[Callable[[Block], Block]] = list(pending or [])
+        self._executor = executor or StreamingExecutor()
+
+    # ------------------------------------------------------------- plan ops
+
+    def _with(self, fn) -> "Dataset":
+        return Dataset(self._blocks, self._pending + [fn], self._executor)
+
+    def map(self, fn, **_) -> "Dataset":
+        return self._with(_map_rows_fn(fn))
+
+    def flat_map(self, fn, **_) -> "Dataset":
+        return self._with(_flat_map_fn(fn))
+
+    def filter(self, fn, **_) -> "Dataset":
+        return self._with(_filter_fn(fn))
+
+    def map_batches(self, fn, *, batch_size: Optional[int] = 1024,
+                    batch_format: str = "numpy",
+                    fn_kwargs: Optional[dict] = None, **_) -> "Dataset":
+        return self._with(_map_batches_fn(fn, batch_size, batch_format, fn_kwargs))
+
+    def add_column(self, name: str, fn, **_) -> "Dataset":
+        return self._with(_add_column_fn(name, fn))
+
+    def drop_columns(self, cols: List[str], **_) -> "Dataset":
+        return self._with(_drop_columns_fn(cols))
+
+    def select_columns(self, cols: List[str], **_) -> "Dataset":
+        return self._with(_select_columns_fn(cols))
+
+    def rename_columns(self, mapping: Dict[str, str], **_) -> "Dataset":
+        return self._with(_rename_columns_fn(mapping))
+
+    # -------------------------------------------------------- execution
+
+    def materialize(self) -> "Dataset":
+        """Execute pending transforms; blocks land in the object store."""
+        if not self._pending:
+            return self
+        out = list(
+            self._executor.execute(self._blocks, self._pending, name="fused")
+        )
+        return Dataset(out, [], self._executor)
+
+    def _materialized_blocks(self) -> List[Block]:
+        ds = self.materialize()
+        return [resolve_block(r) for r in ds._blocks]
+
+    def _streaming_blocks(self) -> Iterator[Block]:
+        """Stream blocks through pending transforms without full
+        materialization (the executor keeps a bounded window in flight)."""
+        for ref in self._executor.execute(self._blocks, self._pending,
+                                          name="stream"):
+            yield resolve_block(ref)
+
+    # ------------------------------------------------------- barrier ops
+
+    def repartition(self, num_blocks: int, **_) -> "Dataset":
+        if num_blocks <= 0:
+            raise ValueError("num_blocks must be positive")
+        table = BlockAccessor.concat(self._materialized_blocks())
+        return Dataset(
+            [put_block(t) for t in _split_table(table, num_blocks)],
+            [], self._executor,
+        )
+
+    def random_shuffle(self, *, seed: Optional[int] = None, **_) -> "Dataset":
+        blocks = self._materialized_blocks()
+        table = BlockAccessor.concat(blocks)
+        rng = np.random.default_rng(seed)
+        perm = rng.permutation(table.num_rows)
+        shuffled = table.take(pa.array(perm))
+        k = max(len(blocks), 1)
+        return Dataset([put_block(b) for b in _split_table(shuffled, k)],
+                       [], self._executor)
+
+    def sort(self, key: Union[str, List[str]], descending: bool = False,
+             **_) -> "Dataset":
+        table = BlockAccessor.concat(self._materialized_blocks())
+        keys = [key] if isinstance(key, str) else key
+        order = "descending" if descending else "ascending"
+        idx = pa.compute.sort_indices(
+            table, sort_keys=[(k, order) for k in keys]
+        )
+        return Dataset([put_block(table.take(idx))], [], self._executor)
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        blocks = list(self.materialize()._blocks)
+        for o in others:
+            blocks.extend(o.materialize()._blocks)
+        return Dataset(blocks, [], self._executor)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        a = BlockAccessor.concat(self._materialized_blocks())
+        b = BlockAccessor.concat(other._materialized_blocks())
+        if a.num_rows != b.num_rows:
+            raise ValueError("zip requires equal row counts")
+        for name in b.column_names:
+            col = b.column(name)
+            out_name = name if name not in a.column_names else f"{name}_1"
+            a = a.append_column(out_name, col)
+        return Dataset([put_block(a)], [], self._executor)
+
+    def limit(self, n: int) -> "Dataset":
+        out, remaining = [], n
+        for block in self._streaming_blocks():  # early-stops the stream
+            if remaining <= 0:
+                break
+            rows = BlockAccessor(block).num_rows()
+            out.append(put_block(block.slice(0, min(rows, remaining))))
+            remaining -= rows
+        return Dataset(out, [], self._executor)
+
+    # ------------------------------------------------------ splits (Train)
+
+    def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
+        blocks = self.materialize()._blocks
+        if len(blocks) < n:
+            table = BlockAccessor.concat([resolve_block(r) for r in blocks])
+            return [
+                Dataset([put_block(t)], [], self._executor)
+                for t in _split_table(table, n)
+            ]
+        out = [[] for _ in range(n)]
+        for i, b in enumerate(blocks):
+            out[i % n].append(b)
+        return [Dataset(bs, [], self._executor) for bs in out]
+
+    def split_at_indices(self, indices: List[int]) -> List["Dataset"]:
+        table = BlockAccessor.concat(self._materialized_blocks())
+        bounds = [0] + list(indices) + [table.num_rows]
+        return [
+            Dataset([put_block(table.slice(a, b - a))], [], self._executor)
+            for a, b in zip(bounds, bounds[1:])
+        ]
+
+    def train_test_split(self, test_size: float, *, shuffle: bool = False,
+                         seed: Optional[int] = None) -> Tuple["Dataset", "Dataset"]:
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        n = ds.count()
+        cut = n - int(n * test_size) if test_size < 1 else n - int(test_size)
+        parts = ds.split_at_indices([cut])
+        return parts[0], parts[1]
+
+    def streaming_split(self, n: int, *, equal: bool = False,
+                        locality_hints=None) -> List["Dataset"]:
+        return self.split(n, equal=equal)
+
+    # ------------------------------------------------------- consumption
+
+    def iter_rows(self) -> Iterator[dict]:
+        for block in self._streaming_blocks():
+            yield from BlockAccessor(block).iter_rows()
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False,
+                     local_shuffle_buffer_size: Optional[int] = None,
+                     local_shuffle_seed: Optional[int] = None) -> Iterator[Any]:
+        """Re-batched stream across block boundaries."""
+        carry: Optional[Block] = None
+        rng = np.random.default_rng(local_shuffle_seed)
+        for block in self._streaming_blocks():
+            if local_shuffle_buffer_size:
+                idx = rng.permutation(block.num_rows)
+                block = block.take(pa.array(idx))
+            carry = block if carry is None else BlockAccessor.concat(
+                [carry, block]
+            )
+            while carry.num_rows >= batch_size:
+                acc = BlockAccessor(carry)
+                yield acc.batch(0, batch_size, batch_format)
+                carry = carry.slice(batch_size, carry.num_rows - batch_size)
+        if carry is not None and carry.num_rows > 0 and not drop_last:
+            acc = BlockAccessor(carry)
+            yield acc.batch(0, carry.num_rows, batch_format)
+
+    def iter_jax_batches(self, *, batch_size: int = 256,
+                         sharding=None, dtypes: Optional[dict] = None,
+                         drop_last: bool = True,
+                         prefetch: int = 2) -> Iterator[Dict[str, Any]]:
+        """Device-fed batches with transfer/compute overlap (TPU-first
+        feature; reference ships ``iter_torch_batches`` with GPU pinning —
+        here ``jax.device_put`` starts the host→HBM copy asynchronously and
+        we keep ``prefetch`` batches in flight so step N computes while
+        N+1 transfers).
+
+        ``sharding``: a ``jax.sharding.Sharding`` (e.g. NamedSharding over
+        the data axis) applied to every array; default = local device.
+        """
+        import collections as _c
+
+        import jax
+
+        def to_device(batch):
+            arrs = {}
+            for k, v in batch.items():
+                if dtypes and k in dtypes:
+                    v = v.astype(dtypes[k])
+                arrs[k] = (
+                    jax.device_put(v, sharding) if sharding is not None
+                    else jax.device_put(v)
+                )
+            return arrs
+
+        window: "_c.deque" = _c.deque()
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last):
+            window.append(to_device(batch))
+            if len(window) > prefetch:
+                yield window.popleft()
+        while window:
+            yield window.popleft()
+
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           drop_last: bool = False) -> Iterator[dict]:
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last):
+            yield {k: torch.as_tensor(v) for k, v in batch.items()}
+
+    # ------------------------------------------------------- aggregates
+
+    def count(self) -> int:
+        return sum(
+            BlockAccessor(b).num_rows() for b in self._streaming_blocks()
+        )
+
+    def _column_agg(self, on: str, per_block_fn, combine_fn):
+        """Single pass over the stream; None when every block is empty."""
+        vals = []
+        for b in self._streaming_blocks():
+            acc = BlockAccessor(b)
+            if acc.num_rows() > 0:
+                vals.append(per_block_fn(acc.to_numpy([on])[on]))
+        return None if not vals else float(combine_fn(np.asarray(vals)))
+
+    def sum(self, on: str):
+        return self._column_agg(on, np.sum, np.sum)
+
+    def min(self, on: str):
+        return self._column_agg(on, np.min, np.min)
+
+    def max(self, on: str):
+        return self._column_agg(on, np.max, np.max)
+
+    def mean(self, on: str):
+        total, n = 0.0, 0
+        for b in self._streaming_blocks():
+            acc = BlockAccessor(b)
+            if acc.num_rows():
+                col = acc.to_numpy([on])[on]
+                total += float(np.sum(col))
+                n += len(col)
+        return None if n == 0 else total / n
+
+    def std(self, on: str, ddof: int = 1):
+        cols = [c for c in (BlockAccessor(b).to_numpy([on])[on]
+                            for b in self._streaming_blocks()) if len(c)]
+        if not cols:
+            return None
+        return float(np.std(np.concatenate(cols), ddof=ddof))
+
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
+    def unique(self, column: str) -> List[Any]:
+        table = BlockAccessor.concat(self._materialized_blocks())
+        return pa.compute.unique(table.column(column)).to_pylist()
+
+    # ------------------------------------------------------- inspection
+
+    def take(self, n: int = 20) -> List[dict]:
+        out = []
+        for row in self.iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[dict]:
+        return [r for r in self.iter_rows()]
+
+    def take_batch(self, n: int = 20, batch_format: str = "numpy"):
+        for b in self.iter_batches(batch_size=n, batch_format=batch_format):
+            return b
+        return {}
+
+    def show(self, n: int = 20):
+        for row in self.take(n):
+            print(row)
+
+    def schema(self) -> Optional[pa.Schema]:
+        for b in self._streaming_blocks():
+            return BlockAccessor(b).schema()
+        return None
+
+    def columns(self) -> List[str]:
+        s = self.schema()
+        return list(s.names) if s else []
+
+    def num_blocks(self) -> int:
+        return len(self.materialize()._blocks)
+
+    def size_bytes(self) -> int:
+        return sum(BlockAccessor(b).size_bytes()
+                   for b in self._streaming_blocks())
+
+    def stats(self) -> str:
+        return self._executor.stats.summary()
+
+    def to_pandas(self):
+        return BlockAccessor.concat(self._materialized_blocks()).to_pandas()
+
+    def to_arrow(self) -> pa.Table:
+        return BlockAccessor.concat(self._materialized_blocks())
+
+    def __iter__(self):
+        return self.iter_rows()
+
+    def __repr__(self):
+        s = self.schema()
+        cols = ", ".join(s.names) if s else "?"
+        return f"Dataset(blocks={len(self._blocks)}, columns=[{cols}])"
+
+    # ------------------------------------------------------------ writes
+
+    def write_parquet(self, path: str, **kw):
+        from ray_tpu.data import datasource
+
+        datasource.write_parquet(self, path, **kw)
+
+    def write_csv(self, path: str, **kw):
+        from ray_tpu.data import datasource
+
+        datasource.write_csv(self, path, **kw)
+
+    def write_json(self, path: str, **kw):
+        from ray_tpu.data import datasource
+
+        datasource.write_json(self, path, **kw)
+
+
+class GroupedData:
+    """Minimal groupby (reference: ``python/ray/data/grouped_data.py``)."""
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _grouped(self):
+        table = BlockAccessor.concat(self._ds._materialized_blocks())
+        return table.group_by(self._key)
+
+    def count(self) -> Dataset:
+        out = self._grouped().aggregate([(self._key, "count")])
+        return Dataset([put_block(out)])
+
+    def sum(self, on: str) -> Dataset:
+        return Dataset([put_block(self._grouped().aggregate([(on, "sum")]))])
+
+    def min(self, on: str) -> Dataset:
+        return Dataset([put_block(self._grouped().aggregate([(on, "min")]))])
+
+    def max(self, on: str) -> Dataset:
+        return Dataset([put_block(self._grouped().aggregate([(on, "max")]))])
+
+    def mean(self, on: str) -> Dataset:
+        return Dataset([put_block(self._grouped().aggregate([(on, "mean")]))])
+
+    def map_groups(self, fn, *, batch_format: str = "numpy") -> Dataset:
+        table = BlockAccessor.concat(self._ds._materialized_blocks())
+        keys = pa.compute.unique(table.column(self._key)).to_pylist()
+        outs = []
+        for k in keys:
+            mask = pa.compute.equal(table.column(self._key), pa.scalar(k))
+            sub = table.filter(mask)
+            acc = BlockAccessor(sub)
+            outs.append(batch_to_block(
+                fn(acc.batch(0, acc.num_rows(), batch_format))
+            ))
+        return Dataset([put_block(BlockAccessor.concat(outs))])
+
+
+def _split_table(table: pa.Table, n: int) -> List[pa.Table]:
+    rows = table.num_rows
+    sizes = [rows // n + (1 if i < rows % n else 0) for i in range(n)]
+    out, start = [], 0
+    for s in sizes:
+        out.append(table.slice(start, s))
+        start += s
+    return out

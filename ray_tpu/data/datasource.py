@@ -1,0 +1,189 @@
+"""Datasources: create datasets from memory, files, and generators; writers.
+
+Reference analog: ``python/ray/data/read_api.py`` + ``datasource/`` (the
+long tail of connectors — parquet/csv/json/images/SQL/... — shares this
+file-per-block shape; the formats here are the ones a TPU training/eval
+stack actually feeds from).
+"""
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from ray_tpu.data.block import BlockAccessor, batch_to_block
+from ray_tpu.data.dataset import Dataset, _split_table
+from ray_tpu.data.executor import put_block
+
+
+DEFAULT_BLOCK_ROWS = 64 * 1024
+
+
+def _blocks_from_table(table: pa.Table, parallelism: int) -> List:
+    n = max(1, min(parallelism, max(table.num_rows, 1)))
+    return [put_block(t) for t in _split_table(table, n)]
+
+
+def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001
+    table = pa.table({"id": pa.array(np.arange(n, dtype=np.int64))})
+    return Dataset(_blocks_from_table(table, parallelism))
+
+
+def range_tensor(n: int, *, shape=(1,), parallelism: int = 8) -> Dataset:
+    data = np.broadcast_to(
+        np.arange(n, dtype=np.int64).reshape((n,) + (1,) * len(shape)),
+        (n,) + tuple(shape),
+    ).copy()
+    return from_numpy({"data": data}, parallelism=parallelism)
+
+
+def from_items(items: List[Any], *, parallelism: int = 8) -> Dataset:
+    if items and not isinstance(items[0], dict):
+        items = [{"item": x} for x in items]
+    table = pa.Table.from_pylist(items) if items else pa.table({})
+    return Dataset(_blocks_from_table(table, parallelism))
+
+
+def from_numpy(arrays, *, parallelism: int = 8) -> Dataset:
+    if isinstance(arrays, np.ndarray):
+        arrays = {"data": arrays}
+    return Dataset(_blocks_from_table(batch_to_block(arrays), parallelism))
+
+
+def from_pandas(df, *, parallelism: int = 8) -> Dataset:
+    return Dataset(_blocks_from_table(batch_to_block(df), parallelism))
+
+
+def from_arrow(table: pa.Table, *, parallelism: int = 8) -> Dataset:
+    return Dataset(_blocks_from_table(table, parallelism))
+
+
+def from_huggingface(hf_dataset, *, parallelism: int = 8) -> Dataset:
+    """An in-memory ``datasets.Dataset`` → Dataset (reference:
+    ``from_huggingface``)."""
+    return from_arrow(hf_dataset.data.table, parallelism=parallelism)
+
+
+def _expand_paths(paths) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                os.path.join(p, f) for f in os.listdir(p)
+                if not f.startswith(".")
+            ))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files match {paths}")
+    return out
+
+
+def _read_files(paths, read_one) -> Dataset:
+    """One task per file: files are the natural block boundary."""
+    files = _expand_paths(paths)
+    from ray_tpu._private import worker as worker_mod
+
+    if worker_mod.global_worker is None:
+        return Dataset([read_one(f) for f in files])
+    import ray_tpu
+
+    task = ray_tpu.remote(read_one)
+    return Dataset([task.remote(f) for f in files])
+
+
+def read_parquet(paths, **kw) -> Dataset:
+    def read_one(path: str) -> pa.Table:
+        import pyarrow.parquet as pq
+
+        return pq.read_table(path)
+
+    return _read_files(paths, read_one)
+
+
+def read_csv(paths, **kw) -> Dataset:
+    def read_one(path: str) -> pa.Table:
+        from pyarrow import csv as pacsv
+
+        return pacsv.read_csv(path)
+
+    return _read_files(paths, read_one)
+
+
+def read_json(paths, **kw) -> Dataset:
+    def read_one(path: str) -> pa.Table:
+        from pyarrow import json as pajson
+
+        return pajson.read_json(path)
+
+    return _read_files(paths, read_one)
+
+
+def read_text(paths, **kw) -> Dataset:
+    def read_one(path: str) -> pa.Table:
+        with open(path) as f:
+            lines = [ln.rstrip("\n") for ln in f]
+        return pa.table({"text": pa.array(lines)})
+
+    return _read_files(paths, read_one)
+
+
+def read_numpy(paths, **kw) -> Dataset:
+    def read_one(path: str) -> pa.Table:
+        return batch_to_block({"data": np.load(path)})
+
+    return _read_files(paths, read_one)
+
+
+def read_binary_files(paths, **kw) -> Dataset:
+    def read_one(path: str) -> pa.Table:
+        with open(path, "rb") as f:
+            return pa.table({
+                "bytes": pa.array([f.read()], type=pa.binary()),
+                "path": pa.array([path]),
+            })
+
+    return _read_files(paths, read_one)
+
+
+# ------------------------------------------------------------------ writers
+
+
+def _write_blocks(ds: Dataset, path: str, ext: str, write_one) -> List[str]:
+    os.makedirs(path, exist_ok=True)
+    out = []
+    for i, block in enumerate(ds._streaming_blocks()):
+        fp = os.path.join(path, f"part-{i:05d}.{ext}")
+        write_one(block, fp)
+        out.append(fp)
+    return out
+
+
+def write_parquet(ds: Dataset, path: str, **kw) -> List[str]:
+    import pyarrow.parquet as pq
+
+    return _write_blocks(ds, path, "parquet",
+                         lambda b, fp: pq.write_table(b, fp))
+
+
+def write_csv(ds: Dataset, path: str, **kw) -> List[str]:
+    from pyarrow import csv as pacsv
+
+    return _write_blocks(ds, path, "csv",
+                         lambda b, fp: pacsv.write_csv(b, fp))
+
+
+def write_json(ds: Dataset, path: str, **kw) -> List[str]:
+    def write_one(block, fp):
+        BlockAccessor(block).to_pandas().to_json(
+            fp, orient="records", lines=True
+        )
+
+    return _write_blocks(ds, path, "json", write_one)

@@ -97,7 +97,10 @@ class TrainContext:
         try:
             os.replace(tmp, dest)
         except OSError:
+            # Must NOT return dest on failure — that would report a
+            # checkpoint that was never persisted and corrupt later resumes.
             shutil.rmtree(tmp, ignore_errors=True)
+            raise
         return dest
 
     def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):

@@ -106,8 +106,15 @@ class TrainWorker:
         return True
 
     def poll(self) -> dict:
+        # Capture done/error BEFORE draining: the train thread enqueues its
+        # final report before setting _done (in its finally), so done=True
+        # guarantees the drain below includes the last report. The reverse
+        # order would let the final report slip between drain and the done
+        # read and be lost forever.
+        done = self._done
+        error = self._error
         reports = self._ctx.drain_reports() if self._ctx else []
-        return {"reports": reports, "done": self._done, "error": self._error}
+        return {"reports": reports, "done": done, "error": error}
 
     def request_stop(self) -> bool:
         if self._ctx:

@@ -38,6 +38,13 @@ class CollectiveCoordinator:
     def declare(self, ranks_by_actor: Dict[str, int], backend: str):
         """Record the driver-side group declaration
         (``create_collective_group``) so members can lazily self-init."""
+        if len(ranks_by_actor) != self._world:
+            raise RuntimeError(
+                f"declaring {len(ranks_by_actor)} members on a coordinator "
+                f"with world_size={self._world} — a stale coordinator from a "
+                f"previous group incarnation; destroy_collective_group() it "
+                f"first"
+            )
         self._declared = dict(ranks_by_actor)
         self._declared_backend = backend
 

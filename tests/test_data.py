@@ -1,0 +1,172 @@
+"""Data layer tests (reference test model: ``python/ray/data/tests/``)."""
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+@pytest.fixture
+def rt(rt_start):
+    yield rt_start
+
+
+def test_range_count_take(rt):
+    ds = rd.range(100, parallelism=4)
+    assert ds.count() == 100
+    assert [r["id"] for r in ds.take(3)] == [0, 1, 2]
+    assert ds.num_blocks() == 4
+
+
+def test_map_filter_flatmap_fusion(rt):
+    ds = (
+        rd.range(20, parallelism=2)
+        .map(lambda r: {"id": r["id"] * 2})
+        .filter(lambda r: r["id"] % 4 == 0)
+        .flat_map(lambda r: [{"v": r["id"]}, {"v": r["id"] + 1}])
+    )
+    vals = [r["v"] for r in ds.take_all()]
+    assert vals[:4] == [0, 1, 4, 5]
+    assert ds.count() == 20
+
+
+def test_map_batches_numpy(rt):
+    ds = rd.range(64, parallelism=2).map_batches(
+        lambda b: {"sq": b["id"] ** 2}, batch_size=16
+    )
+    out = ds.take_batch(64)
+    np.testing.assert_array_equal(out["sq"], np.arange(64) ** 2)
+
+
+def test_aggregates_and_groupby(rt):
+    ds = rd.from_items([
+        {"k": i % 3, "v": float(i)} for i in range(12)
+    ], parallelism=3)
+    assert ds.sum("v") == sum(range(12))
+    assert ds.min("v") == 0.0
+    assert ds.max("v") == 11.0
+    assert ds.mean("v") == pytest.approx(5.5)
+    counts = ds.groupby("k").count().to_pandas()
+    assert sorted(counts["k_count"]) == [4, 4, 4]
+    sums = ds.groupby("k").sum("v").to_pandas().sort_values("k")
+    assert list(sums["v_sum"]) == [18.0, 22.0, 26.0]
+
+
+def test_sort_shuffle_repartition(rt):
+    ds = rd.from_items([{"x": i} for i in [3, 1, 2, 5, 4]])
+    assert [r["x"] for r in ds.sort("x").take_all()] == [1, 2, 3, 4, 5]
+    assert [r["x"] for r in ds.sort("x", descending=True).take_all()] == [5, 4, 3, 2, 1]
+    sh = ds.random_shuffle(seed=0)
+    assert sorted(r["x"] for r in sh.take_all()) == [1, 2, 3, 4, 5]
+    rp = ds.repartition(3)
+    assert rp.num_blocks() == 3
+    assert rp.count() == 5
+
+
+def test_split_and_train_test(rt):
+    ds = rd.range(10, parallelism=2)
+    parts = ds.split(3)
+    assert sum(p.count() for p in parts) == 10
+    tr, te = ds.train_test_split(0.2)
+    assert tr.count() == 8 and te.count() == 2
+
+
+def test_zip_union_limit(rt):
+    a = rd.from_items([{"a": i} for i in range(4)])
+    b = rd.from_items([{"b": i * 10} for i in range(4)])
+    z = a.zip(b)
+    assert z.take(1)[0] == {"a": 0, "b": 0}
+    u = a.union(a)
+    assert u.count() == 8
+    assert a.limit(2).count() == 2
+
+
+def test_iter_batches_respects_batch_size(rt):
+    ds = rd.range(50, parallelism=3)
+    sizes = [len(b["id"]) for b in ds.iter_batches(batch_size=16)]
+    assert sizes == [16, 16, 16, 2]
+    sizes = [len(b["id"])
+             for b in ds.iter_batches(batch_size=16, drop_last=True)]
+    assert sizes == [16, 16, 16]
+
+
+def test_iter_jax_batches_device_and_sharding(rt):
+    import jax
+
+    ds = rd.range(32, parallelism=2)
+    batches = list(ds.iter_jax_batches(batch_size=8))
+    assert len(batches) == 4
+    assert isinstance(batches[0]["id"], jax.Array)
+    # with an explicit data-parallel sharding over 4 devices
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+    sh = NamedSharding(mesh, P("data"))
+    batches = list(ds.iter_jax_batches(batch_size=8, sharding=sh))
+    assert batches[0]["id"].sharding == sh
+
+
+def test_tensor_columns_roundtrip(rt):
+    arr = np.arange(24, dtype=np.float32).reshape(6, 4)
+    ds = rd.from_numpy({"feat": arr})
+    out = ds.take_batch(6)
+    np.testing.assert_array_equal(out["feat"], arr)
+    # >2-D tensors keep their full inner shape (images etc.)
+    img = np.arange(2 * 3 * 4 * 5, dtype=np.float32).reshape(2, 3, 4, 5)
+    out = rd.from_numpy({"img": img}).take_batch(2)
+    assert out["img"].shape == (2, 3, 4, 5)
+    np.testing.assert_array_equal(out["img"], img)
+
+
+def test_aggregates_on_empty(rt):
+    ds = rd.range(10).filter(lambda r: False)
+    assert ds.sum("id") is None
+    assert ds.min("id") is None
+    assert ds.max("id") is None
+    assert ds.mean("id") is None
+    assert ds.std("id") is None
+
+
+def test_file_roundtrip_parquet_csv_json(rt, tmp_path):
+    ds = rd.from_items([{"x": i, "y": float(i) / 2} for i in range(10)])
+    for fmt, reader in [
+        ("parquet", rd.read_parquet),
+        ("csv", rd.read_csv),
+        ("json", rd.read_json),
+    ]:
+        path = str(tmp_path / fmt)
+        getattr(ds, f"write_{fmt}")(path)
+        back = reader(path)
+        assert back.count() == 10
+        assert back.sum("x") == 45
+
+
+def test_columns_ops(rt):
+    ds = rd.from_items([{"a": 1, "b": 2}])
+    assert ds.select_columns(["a"]).columns() == ["a"]
+    assert ds.drop_columns(["a"]).columns() == ["b"]
+    assert ds.rename_columns({"a": "c"}).columns() == ["c", "b"]
+
+
+def test_dataset_feeds_trainer(rt, tmp_path):
+    """Dataset → JaxTrainer worker shards (reference: DataConfig sharding)."""
+    from ray_tpu.train import DataParallelTrainer, RunConfig, ScalingConfig
+    from ray_tpu import train as rt_train
+
+    ds = rd.range(16, parallelism=4)
+
+    def train_fn(config):
+        shard = rt_train.get_dataset_shard("train")
+        total = shard.sum("id") or 0
+        rt_train.report({"total": total,
+                         "rank": rt_train.get_context().get_world_rank()})
+
+    res = DataParallelTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="ds", storage_path=str(tmp_path)),
+        datasets={"train": ds},
+    ).fit()
+    assert res.error is None
+    # shards partition the id space: rank 0's sum + rank 1's = 0..15 total
+    assert res.metrics["total"] < sum(range(16))
