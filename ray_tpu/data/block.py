@@ -32,8 +32,9 @@ def _to_table(data: Any) -> pa.Table:
             if arr.ndim > 1:  # tensor column → fixed-shape list array; the
                 # full inner shape rides in field metadata so >2-D tensors
                 # round-trip exactly (not silently flattened to 2-D)
+                inner = int(np.prod(arr.shape[1:]))  # safe for 0-row arrays
                 fsl = pa.FixedSizeListArray.from_arrays(
-                    pa.array(arr.reshape(-1)), arr[0].size
+                    pa.array(arr.reshape(-1)), inner
                 )
                 arrays.append(fsl)
                 fields.append(pa.field(

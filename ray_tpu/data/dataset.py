@@ -221,8 +221,13 @@ class Dataset:
 
     def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
         blocks = self.materialize()._blocks
-        if len(blocks) < n:
+        if len(blocks) < n or equal:
+            # equal=True must rebalance by rows, not deal blocks round-robin:
+            # unequal shards make SPMD ranks run different step counts and
+            # hang the next collective. (equal shards drop the remainder.)
             table = BlockAccessor.concat([resolve_block(r) for r in blocks])
+            if equal:
+                table = table.slice(0, (table.num_rows // n) * n)
             return [
                 Dataset([put_block(t)], [], self._executor)
                 for t in _split_table(table, n)
@@ -400,9 +405,17 @@ class Dataset:
             print(row)
 
     def schema(self) -> Optional[pa.Schema]:
+        # Empty blocks may carry a stale pre-transform schema (a transform
+        # can't know its output schema without rows) — prefer the first
+        # block that actually has rows.
+        first = None
         for b in self._streaming_blocks():
-            return BlockAccessor(b).schema()
-        return None
+            acc = BlockAccessor(b)
+            if acc.num_rows() > 0:
+                return acc.schema()
+            if first is None:
+                first = acc.schema()
+        return first
 
     def columns(self) -> List[str]:
         s = self.schema()

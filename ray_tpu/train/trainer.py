@@ -185,7 +185,8 @@ def get_dataset_shard(name: str = "train"):
         return None
     rank, world = ctx.get_world_rank(), ctx.get_world_size()
     if hasattr(ds, "split"):  # ray_tpu.data.Dataset
-        return ds.split(world)[rank]
+        # equal shards: unequal row counts would desync SPMD step loops
+        return ds.split(world, equal=True)[rank]
     if isinstance(ds, (list, tuple)):
         return list(ds[rank::world])
     return ds

@@ -1,0 +1,76 @@
+"""ray_tpu.tune: hyperparameter tuning over trial actors.
+
+Reference analog: ``python/ray/tune``. Trials report via the same
+``report``/``get_checkpoint`` used in train_fns (the reference unified these
+too)::
+
+    from ray_tpu import tune
+
+    def objective(config):
+        for step in range(10):
+            tune.report({"loss": (config["lr"] - 0.1) ** 2 + 1 / (step + 1)})
+
+    grid = tune.Tuner(
+        objective,
+        param_space={"lr": tune.loguniform(1e-4, 1e-1)},
+        tune_config=tune.TuneConfig(metric="loss", mode="min", num_samples=8,
+                                    scheduler=tune.ASHAScheduler()),
+    ).fit()
+    best = grid.get_best_result()
+"""
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.context import get_checkpoint, get_context, report
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    Choice,
+    ConcurrencyLimiter,
+    Domain,
+    Searcher,
+    choice,
+    grid_search,
+    lograndint,
+    loguniform,
+    quniform,
+    randint,
+    randn,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.tuner import ResultGrid, Trial, TuneConfig, Tuner
+
+__all__ = [
+    "ASHAScheduler",
+    "BasicVariantGenerator",
+    "Checkpoint",
+    "Choice",
+    "ConcurrencyLimiter",
+    "Domain",
+    "FIFOScheduler",
+    "MedianStoppingRule",
+    "PopulationBasedTraining",
+    "ResultGrid",
+    "Searcher",
+    "Trial",
+    "TrialScheduler",
+    "TuneConfig",
+    "Tuner",
+    "choice",
+    "get_checkpoint",
+    "get_context",
+    "grid_search",
+    "lograndint",
+    "loguniform",
+    "quniform",
+    "randint",
+    "randn",
+    "report",
+    "sample_from",
+    "uniform",
+]

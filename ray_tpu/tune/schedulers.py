@@ -1,0 +1,207 @@
+"""Trial schedulers: FIFO, ASHA, median-stopping, PBT.
+
+Reference analogs: ``python/ray/tune/schedulers/`` — ``async_hyperband.py``
+(ASHA), ``median_stopping_rule.py``, ``pbt.py``. The scheduler sees every
+reported result and answers CONTINUE/STOP; PBT additionally mutates trial
+configs and transplants checkpoints at perturbation boundaries.
+"""
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def on_trial_start(self, trial):
+        pass
+
+    def on_result(self, trial, result: Dict[str, Any]) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial, result: Optional[dict]):
+        pass
+
+    def choose_exploit(self, trial, all_trials) -> Optional[tuple]:
+        """PBT hook: (source_trial, mutated_config) or None."""
+        return None
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class ASHAScheduler(TrialScheduler):
+    """Asynchronous Successive Halving (reference:
+    ``schedulers/async_hyperband.py AsyncHyperBandScheduler``): rungs at
+    grace_period * reduction_factor^k; a trial reaching a rung stops unless
+    it's in the top 1/reduction_factor of results recorded at that rung."""
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1, reduction_factor: int = 4,
+                 max_t: int = 100):
+        assert mode in ("min", "max")
+        self.metric, self.mode = metric, mode
+        self.time_attr = time_attr
+        self.grace = grace_period
+        self.rf = reduction_factor
+        self.max_t = max_t
+        self._rungs: Dict[int, List[float]] = {}
+        self._judged: set = set()  # (trial_id, rung): one entry per trial
+        rung = grace_period
+        while rung < max_t:
+            self._rungs[rung] = []
+            rung *= reduction_factor
+
+    def on_result(self, trial, result: Dict[str, Any]) -> str:
+        t = result.get(self.time_attr)
+        v = result.get(self.metric)
+        if t is None or v is None:
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        # Judge at the highest newly-reached rung; each trial contributes
+        # exactly one value per rung (successive-halving semantics) — a trial
+        # already promoted past a rung is not re-judged by it.
+        for rung in sorted(self._rungs, reverse=True):
+            if t >= rung:
+                if (trial.trial_id, rung) in self._judged:
+                    return CONTINUE
+                self._judged.add((trial.trial_id, rung))
+                recorded = self._rungs[rung]
+                recorded.append(float(v))
+                if len(recorded) < self.rf:
+                    return CONTINUE  # not enough data: optimistic continue
+                srt = sorted(recorded, reverse=(self.mode == "max"))
+                k = max(1, math.floor(len(srt) / self.rf))
+                cutoff = srt[k - 1]
+                good = (v <= cutoff) if self.mode == "min" else (v >= cutoff)
+                return CONTINUE if good else STOP
+        return CONTINUE
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best result so far is worse than the median of
+    other trials' running averages at the same step (reference:
+    ``schedulers/median_stopping_rule.py``)."""
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 time_attr: str = "training_iteration",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self.metric, self.mode = metric, mode
+        self.time_attr = time_attr
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        self._avgs: Dict[str, List[float]] = {}
+
+    def on_result(self, trial, result) -> str:
+        v = result.get(self.metric)
+        t = result.get(self.time_attr, 0)
+        if v is None:
+            return CONTINUE
+        self._avgs.setdefault(trial.trial_id, []).append(float(v))
+        if t < self.grace or len(self._avgs) < self.min_samples:
+            return CONTINUE
+        others = [
+            sum(h) / len(h) for tid, h in self._avgs.items()
+            if tid != trial.trial_id and h
+        ]
+        if len(others) + 1 < self.min_samples:
+            return CONTINUE
+        others.sort()
+        median = others[len(others) // 2]
+        mine = self._avgs[trial.trial_id]
+        best = min(mine) if self.mode == "min" else max(mine)
+        bad = (best > median) if self.mode == "min" else (best < median)
+        return STOP if bad else CONTINUE
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference: ``schedulers/pbt.py``): every
+    ``perturbation_interval`` iterations, bottom-quantile trials clone a
+    top-quantile trial's checkpoint and continue with a mutated config."""
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 time_attr: str = "training_iteration",
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: Optional[int] = None):
+        self.metric, self.mode = metric, mode
+        self.time_attr = time_attr
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_p = resample_probability
+        self._rng = random.Random(seed)
+        self._last: Dict[str, dict] = {}  # trial_id -> last result
+        self._perturbed_at: Dict[str, int] = {}
+
+    def on_trial_start(self, trial):
+        # A PBT clone starts with iteration = its source's progress; seed its
+        # perturbation clock there or it is "due" on its very first poll and
+        # gets re-cloned every cycle (unbounded trial churn).
+        self._perturbed_at[trial.trial_id] = getattr(trial, "iteration", 0)
+
+    def on_result(self, trial, result) -> str:
+        self._last[trial.trial_id] = dict(result)
+        return CONTINUE
+
+    def _score(self, tid: str) -> Optional[float]:
+        r = self._last.get(tid)
+        v = None if r is None else r.get(self.metric)
+        return None if v is None else float(v)
+
+    def due_for_perturbation(self, trial) -> bool:
+        r = self._last.get(trial.trial_id)
+        if r is None:
+            return False
+        t = r.get(self.time_attr, 0)
+        last = self._perturbed_at.get(trial.trial_id, 0)
+        return t - last >= self.interval
+
+    def choose_exploit(self, trial, all_trials) -> Optional[tuple]:
+        if not self.due_for_perturbation(trial):
+            return None
+        scored = [
+            (t, self._score(t.trial_id)) for t in all_trials
+            if self._score(t.trial_id) is not None
+        ]
+        if len(scored) < 2:
+            return None
+        scored.sort(key=lambda x: x[1], reverse=(self.mode == "max"))
+        k = max(1, int(len(scored) * self.quantile))
+        top = [t for t, _ in scored[:k]]
+        bottom = {t.trial_id for t, _ in scored[-k:]}
+        self._perturbed_at[trial.trial_id] = self._last[trial.trial_id].get(
+            self.time_attr, 0
+        )
+        if trial.trial_id not in bottom or trial in top:
+            return None
+        source = self._rng.choice(top)
+        return source, self._mutate(dict(source.config))
+
+    def _mutate(self, config: dict) -> dict:
+        from ray_tpu.tune.search import Domain
+
+        for key, spec in self.mutations.items():
+            if key not in config:
+                continue
+            if self._rng.random() < self.resample_p or not isinstance(
+                config[key], (int, float)
+            ):
+                if isinstance(spec, Domain):
+                    config[key] = spec.sample(self._rng)
+                elif isinstance(spec, list):
+                    config[key] = self._rng.choice(spec)
+                elif callable(spec):
+                    config[key] = spec()
+            else:
+                factor = self._rng.choice([0.8, 1.2])
+                config[key] = type(config[key])(config[key] * factor)
+        return config

@@ -1,0 +1,216 @@
+"""Search spaces and search algorithms.
+
+Reference analogs: ``python/ray/tune/search/sample.py`` (Domain objects:
+uniform/loguniform/choice/randint/...), ``search/basic_variant.py``
+(grid + random variant generation), ``search/search_algorithm.py`` +
+``ConcurrencyLimiter``. Third-party searchers (optuna/hyperopt/...) plug in
+via the same ``Searcher`` interface; only the built-ins ship here.
+"""
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Callable, Dict, List, Optional
+
+
+class Domain:
+    def sample(self, rng: random.Random):
+        raise NotImplementedError
+
+
+class Uniform(Domain):
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class QUniform(Uniform):
+    def __init__(self, low, high, q):
+        super().__init__(low, high)
+        self.q = q
+
+    def sample(self, rng):
+        return round(super().sample(rng) / self.q) * self.q
+
+
+class LogUniform(Domain):
+    def __init__(self, low: float, high: float):
+        import math
+
+        self.lo, self.hi = math.log(low), math.log(high)
+
+    def sample(self, rng):
+        import math
+
+        return math.exp(rng.uniform(self.lo, self.hi))
+
+
+class RandInt(Domain):
+    def __init__(self, low: int, high: int):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+class LogRandInt(Domain):
+    def __init__(self, low: int, high: int):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        import math
+
+        return int(round(math.exp(
+            rng.uniform(math.log(self.low), math.log(self.high - 1))
+        )))
+
+
+class Choice(Domain):
+    def __init__(self, categories: List[Any]):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class SampleFrom(Domain):
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def sample(self, rng):
+        return self.fn(None)
+
+
+class Normal(Domain):
+    def __init__(self, mean: float, sd: float):
+        self.mean, self.sd = mean, sd
+
+    def sample(self, rng):
+        return rng.gauss(self.mean, self.sd)
+
+
+def uniform(low, high) -> Uniform:
+    return Uniform(low, high)
+
+
+def quniform(low, high, q) -> QUniform:
+    return QUniform(low, high, q)
+
+
+def loguniform(low, high) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low, high) -> RandInt:
+    return RandInt(low, high)
+
+
+def lograndint(low, high) -> LogRandInt:
+    return LogRandInt(low, high)
+
+
+def choice(categories) -> Choice:
+    return Choice(categories)
+
+
+def sample_from(fn) -> SampleFrom:
+    return SampleFrom(fn)
+
+
+def randn(mean: float = 0.0, sd: float = 1.0) -> Normal:
+    return Normal(mean, sd)
+
+
+def grid_search(values: List[Any]) -> Dict[str, List[Any]]:
+    return {"grid_search": list(values)}
+
+
+class Searcher:
+    """Suggest/observe interface (reference: ``search/searcher.py``)."""
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict],
+                          error: bool = False):
+        pass
+
+    def set_search_properties(self, metric: Optional[str], mode: Optional[str],
+                              config: Dict[str, Any]) -> bool:
+        return True
+
+
+class BasicVariantGenerator(Searcher):
+    """Cross-product of every grid_search axis × num_samples random draws of
+    the Domain leaves (reference: ``search/basic_variant.py``)."""
+
+    def __init__(self, param_space: Dict[str, Any], num_samples: int = 1,
+                 seed: Optional[int] = None):
+        self._rng = random.Random(seed)
+        self._variants = list(self._expand(param_space, num_samples))
+        self._next = 0
+
+    def _expand(self, space: Dict[str, Any], num_samples: int):
+        grid_keys, grid_vals = [], []
+
+        def find_grids(prefix, node):
+            for k, v in node.items():
+                if isinstance(v, dict) and "grid_search" in v:
+                    grid_keys.append(prefix + (k,))
+                    grid_vals.append(v["grid_search"])
+                elif isinstance(v, dict):
+                    find_grids(prefix + (k,), v)
+
+        find_grids((), space)
+        combos = list(itertools.product(*grid_vals)) if grid_vals else [()]
+        for _ in range(num_samples):
+            for combo in combos:
+                yield self._materialize(space, dict(zip(grid_keys, combo)))
+
+    def _materialize(self, node, grid_assign, prefix=()):
+        out = {}
+        for k, v in node.items():
+            path = prefix + (k,)
+            if isinstance(v, dict) and "grid_search" in v:
+                out[k] = grid_assign[path]
+            elif isinstance(v, dict):
+                out[k] = self._materialize(v, grid_assign, path)
+            elif isinstance(v, Domain):
+                out[k] = v.sample(self._rng)
+            else:
+                out[k] = v
+        return out
+
+    @property
+    def total_trials(self) -> int:
+        return len(self._variants)
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._next >= len(self._variants):
+            return None
+        cfg = self._variants[self._next]
+        self._next += 1
+        return cfg
+
+
+class ConcurrencyLimiter(Searcher):
+    """Caps concurrent suggestions (reference: ``search/concurrency_limiter``)."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set = set()
+
+    def suggest(self, trial_id: str):
+        if len(self._live) >= self.max_concurrent:
+            return "PENDING"  # sentinel: ask again later
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is not None and cfg != "PENDING":
+            self._live.add(trial_id)
+        return cfg
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result, error)

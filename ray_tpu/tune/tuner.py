@@ -1,0 +1,325 @@
+"""Tuner: trial controller over the actor API.
+
+Reference analogs: ``python/ray/tune/tuner.py`` (Tuner.fit),
+``tune/execution/tune_controller.py`` (event loop managing trial actors),
+``tune/result_grid.py``. Trials reuse the Train layer's worker actor
+(``TrainWorker``) — the reference made the same unification (tune trials
+report via ``ray.train.report``).
+"""
+from __future__ import annotations
+
+import math
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import RunConfig
+from ray_tpu.train.result import Result
+from ray_tpu.tune.schedulers import CONTINUE, STOP, FIFOScheduler, TrialScheduler
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    ConcurrencyLimiter,
+    Searcher,
+)
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+
+@dataclass
+class TuneConfig:
+    """(reference: ``tune/tune_config.py``)"""
+
+    metric: Optional[str] = None
+    mode: str = "min"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    search_alg: Optional[Searcher] = None
+    scheduler: Optional[TrialScheduler] = None
+    trial_resources: Dict[str, float] = field(default_factory=lambda: {"CPU": 1.0})
+    seed: Optional[int] = None
+
+
+class Trial:
+    def __init__(self, trial_id: str, config: Dict[str, Any], trial_dir: str):
+        self.trial_id = trial_id
+        self.config = config
+        self.trial_dir = trial_dir
+        self.status = PENDING
+        self.actor = None
+        self.metrics_history: List[dict] = []
+        self.last_result: Dict[str, Any] = {}
+        self.latest_checkpoint: Optional[str] = None
+        self.error: Optional[str] = None
+        self.iteration = 0
+
+    def result(self) -> Result:
+        return Result(
+            metrics=self.last_result,
+            checkpoint=(
+                Checkpoint(self.latest_checkpoint)
+                if self.latest_checkpoint else None
+            ),
+            path=self.trial_dir,
+            error=self.error,
+            metrics_history=self.metrics_history,
+        )
+
+
+class ResultGrid:
+    """(reference: ``tune/result_grid.py``)"""
+
+    def __init__(self, trials: List[Trial], metric: Optional[str], mode: str):
+        self._trials = trials
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._trials)
+
+    def __getitem__(self, i) -> Result:
+        return self._trials[i].result()
+
+    @property
+    def num_errors(self) -> int:
+        return sum(1 for t in self._trials if t.status == ERROR)
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("metric required (set TuneConfig.metric)")
+        best, best_v = None, None
+        for t in self._trials:
+            v = t.last_result.get(metric)
+            if v is None:
+                continue
+            if best_v is None or (v < best_v if mode == "min" else v > best_v):
+                best, best_v = t, v
+        if best is None:
+            raise RuntimeError("no trial reported the target metric")
+        return best.result()
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        rows = []
+        for t in self._trials:
+            row = dict(t.last_result)
+            row["trial_id"] = t.trial_id
+            row["status"] = t.status
+            for k, v in t.config.items():
+                row[f"config/{k}"] = v
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+
+class Tuner:
+    def __init__(
+        self,
+        trainable: Callable,
+        *,
+        param_space: Optional[Dict[str, Any]] = None,
+        tune_config: Optional[TuneConfig] = None,
+        run_config: Optional[RunConfig] = None,
+    ):
+        self._trainable = trainable
+        self._param_space = param_space or {}
+        self._tune_config = tune_config or TuneConfig()
+        self._run_config = run_config or RunConfig()
+
+    def fit(self) -> ResultGrid:
+        tc = self._tune_config
+        name = self._run_config.name or f"tune_{int(time.time())}"
+        run_dir = os.path.join(self._run_config.resolved_storage_path(), name)
+        os.makedirs(run_dir, exist_ok=True)
+
+        searcher = tc.search_alg or BasicVariantGenerator(
+            self._param_space, num_samples=tc.num_samples, seed=tc.seed
+        )
+        scheduler = tc.scheduler or FIFOScheduler()
+        controller = _TrialRunner(
+            self._trainable, searcher, scheduler, tc, run_dir
+        )
+        trials = controller.run()
+        return ResultGrid(trials, tc.metric, tc.mode)
+
+
+class _TrialRunner:
+    """The trial event loop (reference: ``execution/tune_controller.py``)."""
+
+    def __init__(self, trainable, searcher, scheduler, tc: TuneConfig,
+                 run_dir: str):
+        self._trainable = trainable
+        self._searcher = searcher
+        self._scheduler = scheduler
+        self._tc = tc
+        self._run_dir = run_dir
+        self._trials: List[Trial] = []
+        self._counter = 0
+        self._fits = 1
+        self._fits_at = -10.0
+
+    # ------------------------------------------------------------ lifecycle
+
+    def _next_trial(self):
+        """Trial, "PENDING" (retry later), or None (search exhausted)."""
+        tid = f"trial_{self._counter:05d}"
+        cfg = self._searcher.suggest(tid)
+        if cfg is None or cfg == "PENDING":
+            return cfg
+        self._counter += 1
+        t = Trial(tid, cfg, os.path.join(self._run_dir, tid))
+        self._trials.append(t)
+        return t
+
+    def _max_concurrent(self) -> int:
+        cap = self._tc.max_concurrent_trials or 2 ** 30
+        # cluster_resources is a full node scan — cache it; total capacity
+        # only changes on node add/remove, not per 20ms controller tick
+        now = time.monotonic()
+        if now - self._fits_at > 1.0:
+            try:
+                import ray_tpu
+
+                avail = ray_tpu.cluster_resources()
+                per = self._tc.trial_resources
+                self._fits = int(min(
+                    (avail.get(k, 0.0) // v) for k, v in per.items() if v > 0
+                ))
+            except Exception:
+                self._fits = 4  # no cluster metadata: modest default
+            self._fits_at = now
+        return max(1, min(cap, self._fits))
+
+    def _start_trial(self, trial: Trial,
+                     checkpoint_path: Optional[str] = None):
+        import ray_tpu
+        from ray_tpu.train.worker_group import TrainWorker
+
+        res = self._tc.trial_resources
+        actor_cls = ray_tpu.remote(TrainWorker)
+        opts = {
+            "num_cpus": res.get("CPU", 1.0),
+            "resources": {k: v for k, v in res.items() if k != "CPU"},
+        }
+        trial.actor = actor_cls.options(**opts).remote()
+        ckpt = checkpoint_path or trial.latest_checkpoint
+        ray_tpu.get(
+            trial.actor.setup.remote(
+                0, 1, 0, 1, 0, trial.trial_id, trial.trial_dir, ckpt, {},
+                None, trial.iteration,
+            ),
+            timeout=60,
+        )
+        ray_tpu.get(
+            trial.actor.start.remote(self._trainable, trial.config), timeout=60
+        )
+        trial.status = RUNNING
+        self._scheduler.on_trial_start(trial)
+
+    def _stop_trial(self, trial: Trial, status: str = TERMINATED):
+        import ray_tpu
+
+        if trial.actor is not None:
+            try:
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+            trial.actor = None
+        trial.status = status
+        self._searcher.on_trial_complete(
+            trial.trial_id, trial.last_result, error=(status == ERROR)
+        )
+
+    # ------------------------------------------------------------ main loop
+
+    def run(self) -> List[Trial]:
+        import ray_tpu
+
+        exhausted = False
+        while True:
+            running = [t for t in self._trials if t.status == RUNNING]
+            # launch up to the concurrency/resource cap
+            while not exhausted and len(running) < self._max_concurrent():
+                t = self._next_trial()
+                if t is None:
+                    exhausted = True
+                    break
+                if t == "PENDING":
+                    break  # concurrency-limited: retry next loop
+                try:
+                    self._start_trial(t)
+                    running.append(t)
+                except Exception as e:
+                    t.error = f"start failed: {e}"
+                    self._stop_trial(t, ERROR)
+            if not running:
+                if exhausted and all(
+                    t.status in (TERMINATED, ERROR) for t in self._trials
+                ):
+                    return self._trials
+                time.sleep(0.05)
+                continue
+            for trial in running:
+                self._poll_trial(trial)
+            time.sleep(0.02)
+
+    def _poll_trial(self, trial: Trial):
+        import ray_tpu
+
+        try:
+            h = ray_tpu.get(trial.actor.poll.remote(), timeout=30)
+        except Exception as e:
+            trial.error = f"trial actor unreachable: {e}"
+            self._stop_trial(trial, ERROR)
+            return
+        decision = CONTINUE
+        for rep in h["reports"]:
+            trial.iteration += 1
+            result = dict(rep["metrics"])
+            result.setdefault("training_iteration", trial.iteration)
+            trial.last_result = result
+            trial.metrics_history.append(result)
+            if rep.get("checkpoint_path"):
+                trial.latest_checkpoint = rep["checkpoint_path"]
+            d = self._scheduler.on_result(trial, result)
+            if d == STOP:
+                decision = STOP
+                break  # discard reports past the stop decision
+        if decision == STOP:
+            self._stop_trial(trial, TERMINATED)
+            return
+        # PBT exploit/explore at perturbation boundaries
+        exploit = self._scheduler.choose_exploit(trial, self._trials)
+        if exploit is not None:
+            source, new_config = exploit
+            if source.latest_checkpoint:
+                self._stop_trial(trial, TERMINATED)
+                clone = Trial(
+                    f"{trial.trial_id}_pbt{trial.iteration}",
+                    new_config,
+                    os.path.join(self._run_dir,
+                                 f"{trial.trial_id}_pbt{trial.iteration}"),
+                )
+                clone.iteration = source.iteration
+                clone.metrics_history = list(trial.metrics_history)
+                self._trials.append(clone)
+                try:
+                    self._start_trial(
+                        clone, checkpoint_path=source.latest_checkpoint
+                    )
+                except Exception as e:
+                    clone.error = f"pbt restart failed: {e}"
+                    self._stop_trial(clone, ERROR)
+                return
+        if h["error"]:
+            trial.error = h["error"]
+            self._stop_trial(trial, ERROR)
+        elif h["done"]:
+            self._stop_trial(trial, TERMINATED)

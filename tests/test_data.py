@@ -118,6 +118,28 @@ def test_tensor_columns_roundtrip(rt):
     np.testing.assert_array_equal(out["img"], img)
 
 
+def test_equal_split_balances_rows(rt):
+    # pathologically skewed blocks: equal=True must rebalance by rows
+    a = rd.from_items([{"x": i} for i in range(10)], parallelism=1)
+    b = rd.from_items([{"x": i} for i in range(10, 11)], parallelism=1)
+    ds = a.union(b)  # blocks of 10 and 1 rows
+    parts = ds.split(2, equal=True)
+    counts = [p.count() for p in parts]
+    assert counts == [5, 5], counts  # 11th row dropped for equality
+
+
+def test_empty_tensor_column_ok(rt):
+    ds = rd.from_numpy({"x": np.zeros((0, 4), np.float32)})
+    assert ds.count() == 0
+
+
+def test_schema_skips_empty_blocks(rt):
+    ds = (rd.range(8, parallelism=4)
+          .filter(lambda r: r["id"] >= 6)
+          .map_batches(lambda b: {"v": b["id"]}, batch_size=8))
+    assert ds.columns() == ["v"]
+
+
 def test_aggregates_on_empty(rt):
     ds = rd.range(10).filter(lambda r: False)
     assert ds.sum("id") is None

@@ -1,0 +1,144 @@
+"""Tune layer tests (reference test model: ``python/ray/tune/tests/``)."""
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train import RunConfig
+
+
+def _rc(tmp_path, name):
+    return RunConfig(name=name, storage_path=str(tmp_path))
+
+
+def test_grid_search_runs_all_variants(rt_start, tmp_path):
+    def objective(config):
+        tune.report({"score": config["a"] * 10 + config["b"]})
+
+    grid = tune.Tuner(
+        objective,
+        param_space={"a": tune.grid_search([1, 2, 3]),
+                     "b": tune.grid_search([0, 1])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    max_concurrent_trials=3),
+        run_config=_rc(tmp_path, "grid"),
+    ).fit()
+    assert len(grid) == 6
+    assert grid.num_errors == 0
+    best = grid.get_best_result()
+    assert best.metrics["score"] == 31
+    df = grid.get_dataframe()
+    assert sorted(df["score"]) == [10, 11, 20, 21, 30, 31]
+
+
+def test_random_search_domains(rt_start, tmp_path):
+    def objective(config):
+        assert 1e-4 <= config["lr"] <= 1e-1
+        assert config["width"] in (32, 64)
+        assert 1 <= config["depth"] < 4
+        tune.report({"loss": config["lr"]})
+
+    grid = tune.Tuner(
+        objective,
+        param_space={
+            "lr": tune.loguniform(1e-4, 1e-1),
+            "width": tune.choice([32, 64]),
+            "depth": tune.randint(1, 4),
+        },
+        tune_config=tune.TuneConfig(metric="loss", mode="min", num_samples=4,
+                                    seed=7, max_concurrent_trials=2),
+        run_config=_rc(tmp_path, "rand"),
+    ).fit()
+    assert len(grid) == 4 and grid.num_errors == 0
+
+
+def test_asha_stops_bad_trials_early(rt_start, tmp_path):
+    def objective(config):
+        import time
+
+        for step in range(20):
+            # trial quality is config["q"]: lower loss is better; the sleep
+            # makes steps slow relative to controller polls (real training
+            # steps always are) so early stopping can actually interrupt
+            time.sleep(0.05)
+            tune.report({"loss": config["q"] + 1.0 / (step + 1),
+                         "training_iteration": step + 1})
+
+    grid = tune.Tuner(
+        objective,
+        param_space={"q": tune.grid_search([0.0, 0.0, 5.0, 5.0, 9.0, 9.0])},
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min", max_concurrent_trials=6,
+            scheduler=tune.ASHAScheduler(
+                metric="loss", mode="min", grace_period=2,
+                reduction_factor=2, max_t=20,
+            ),
+        ),
+        run_config=_rc(tmp_path, "asha"),
+    ).fit()
+    best = grid.get_best_result()
+    assert best.metrics["loss"] < 1.0  # a q=0 trial ran to completion
+    iters = [t.iteration for t in grid._trials]
+    # at least one bad trial was cut before 20 iterations
+    assert min(iters) < 20
+
+
+def test_trial_error_isolated(rt_start, tmp_path):
+    def objective(config):
+        if config["x"] == 1:
+            raise RuntimeError("bad trial")
+        tune.report({"ok": config["x"]})
+
+    grid = tune.Tuner(
+        objective,
+        param_space={"x": tune.grid_search([0, 1, 2])},
+        tune_config=tune.TuneConfig(metric="ok", mode="max"),
+        run_config=_rc(tmp_path, "err"),
+    ).fit()
+    assert grid.num_errors == 1
+    assert grid.get_best_result().metrics["ok"] == 2
+
+
+def test_pbt_exploits_checkpoints(rt_start, tmp_path):
+    """Bad-hyperparam trials should adopt good trials' checkpoints/configs."""
+    import tempfile
+
+    def objective(config):
+        # resume accumulated score from checkpoint (what PBT transplants)
+        score = 0.0
+        ckpt = tune.get_checkpoint()
+        if ckpt is not None:
+            with open(os.path.join(ckpt.path, "score")) as f:
+                score = float(f.read())
+        import time
+
+        for step in range(12):
+            time.sleep(0.05)  # let controller polls interleave with steps
+            score += config["rate"]  # higher rate = faster progress
+            with tempfile.TemporaryDirectory() as d:
+                with open(os.path.join(d, "score"), "w") as f:
+                    f.write(str(score))
+                tune.report(
+                    {"score": score, "rate": config["rate"]},
+                    checkpoint=tune.Checkpoint.from_directory(d),
+                )
+
+    grid = tune.Tuner(
+        objective,
+        param_space={"rate": tune.grid_search([0.1, 1.0])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", max_concurrent_trials=2,
+            scheduler=tune.PopulationBasedTraining(
+                metric="score", mode="max", perturbation_interval=4,
+                hyperparam_mutations={"rate": [0.1, 1.0]}, seed=3,
+                quantile_fraction=0.5,
+            ),
+        ),
+        run_config=_rc(tmp_path, "pbt"),
+    ).fit()
+    assert grid.num_errors == 0
+    # the exploited lineage exists (a _pbt trial was spawned)
+    assert any("_pbt" in t.trial_id for t in grid._trials)
+    best = grid.get_best_result()
+    assert best.metrics["score"] >= 12 * 1.0 - 4  # good lineage dominated
