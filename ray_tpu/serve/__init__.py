@@ -1,0 +1,203 @@
+"""ray_tpu.serve: model serving with replicas, routing, and autoscaling.
+
+Reference analog: ``python/ray/serve``::
+
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    class Model:
+        def __call__(self, request):
+            return {"answer": ...}
+
+    handle = serve.run(Model.bind(), name="app", route_prefix="/model")
+    handle.remote({"x": 1}).result()
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import cloudpickle
+
+from ray_tpu.serve.deployment import (
+    Application,
+    AutoscalingConfig,
+    Deployment,
+    DeploymentConfig,
+    deployment,
+)
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.replica import batch
+
+__all__ = [
+    "Application",
+    "AutoscalingConfig",
+    "Deployment",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "batch",
+    "delete",
+    "deployment",
+    "get_app_handle",
+    "get_deployment_handle",
+    "run",
+    "shutdown",
+    "start_http_proxy",
+    "status",
+]
+
+_proxy = None
+
+
+def _get_or_start_controller():
+    import ray_tpu
+    from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
+
+    actor_cls = ray_tpu.remote(max_concurrency=64)(ServeController)
+    return actor_cls.options(
+        name=CONTROLLER_NAME, get_if_exists=True
+    ).remote()
+
+
+def _collect_specs(app: Application, specs: Dict[str, dict],
+                   order: List[str]):
+    """DFS over the composition graph; dependency init args become handles."""
+    d = app.deployment
+    if d.name in specs:
+        return
+    init_args = []
+    for a in app.args:
+        if isinstance(a, Application):
+            _collect_specs(a, specs, order)
+            init_args.append(DeploymentHandle(a.deployment.name))
+        else:
+            init_args.append(a)
+    init_kwargs = {}
+    for k, a in app.kwargs.items():
+        if isinstance(a, Application):
+            _collect_specs(a, specs, order)
+            init_kwargs[k] = DeploymentHandle(a.deployment.name)
+        else:
+            init_kwargs[k] = a
+    cfg = d.config
+    asc = None
+    if cfg.autoscaling_config is not None:
+        a = cfg.autoscaling_config
+        asc = {
+            "min_replicas": a.min_replicas,
+            "max_replicas": a.max_replicas,
+            "target_ongoing_requests": a.target_ongoing_requests,
+            "upscale_delay_s": a.upscale_delay_s,
+            "downscale_delay_s": a.downscale_delay_s,
+        }
+    specs[d.name] = {
+        "name": d.name,
+        "serialized_target": cloudpickle.dumps(d.target),
+        "init_args": tuple(init_args),
+        "init_kwargs": init_kwargs,
+        "num_replicas": cfg.num_replicas,
+        "max_ongoing": cfg.max_ongoing_requests,
+        "actor_options": cfg.ray_actor_options,
+        "user_config": cfg.user_config,
+        "autoscaling": asc,
+        "version": cfg.version,
+        "gang_size": cfg.gang_size,
+    }
+    order.append(d.name)
+
+
+def run(app: Application, *, name: str = "default",
+        route_prefix: Optional[str] = "/",
+        _blocking_timeout: float = 60.0) -> DeploymentHandle:
+    """Deploy an application; returns a handle to its ingress deployment
+    (reference: ``serve.run`` ``api.py:869``)."""
+    import ray_tpu
+
+    controller = _get_or_start_controller()
+    specs: Dict[str, dict] = {}
+    order: List[str] = []
+    _collect_specs(app, specs, order)
+    ingress = app.deployment.name
+    ray_tpu.get(
+        controller.deploy.remote(
+            name, [specs[n] for n in order], route_prefix, ingress
+        ),
+        timeout=_blocking_timeout,
+    )
+    # block until every deployment has its replicas
+    deadline = time.time() + _blocking_timeout
+    while time.time() < deadline:
+        st = ray_tpu.get(controller.status.remote(), timeout=30)
+        if all(
+            st.get(n, {}).get("running", 0) >= min(specs[n]["num_replicas"], 1)
+            for n in order
+        ):
+            break
+        time.sleep(0.05)
+    return DeploymentHandle(ingress)
+
+
+def get_deployment_handle(deployment_name: str, app_name: str = "default"
+                          ) -> DeploymentHandle:
+    return DeploymentHandle(deployment_name)
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    import ray_tpu
+
+    controller = _get_or_start_controller()
+    routes = ray_tpu.get(controller.get_routes.remote(), timeout=30)
+    for _, dep in routes.items():
+        return DeploymentHandle(dep)
+    raise ValueError(f"app '{name}' has no routed ingress")
+
+
+def status() -> dict:
+    import ray_tpu
+
+    return ray_tpu.get(
+        _get_or_start_controller().status.remote(), timeout=30
+    )
+
+
+def delete(name: str):
+    import ray_tpu
+
+    ray_tpu.get(
+        _get_or_start_controller().delete_app.remote(name), timeout=60
+    )
+
+
+def shutdown():
+    global _proxy
+    import ray_tpu
+
+    try:
+        from ray_tpu.serve.controller import CONTROLLER_NAME
+
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        ray_tpu.get(controller.shutdown.remote(), timeout=30)
+        ray_tpu.kill(controller)
+    except Exception:
+        pass
+    if _proxy is not None:
+        try:
+            ray_tpu.get(_proxy.stop.remote(), timeout=10)
+            ray_tpu.kill(_proxy)
+        except Exception:
+            pass
+        _proxy = None
+
+
+def start_http_proxy(host: str = "127.0.0.1", port: int = 0) -> int:
+    """Start the HTTP ingress actor; returns the bound port (reference:
+    per-node ProxyActor; one proxy here — the head node's)."""
+    global _proxy
+    import ray_tpu
+    from ray_tpu.serve.http_proxy import HTTPProxy
+
+    actor_cls = ray_tpu.remote(max_concurrency=64)(HTTPProxy)
+    _proxy = actor_cls.options(name="__serve_proxy", get_if_exists=True).remote(
+        host, port
+    )
+    return ray_tpu.get(_proxy.start.remote(), timeout=30)

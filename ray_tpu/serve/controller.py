@@ -1,0 +1,238 @@
+"""Serve controller actor: deployment/replica state machines + autoscaling.
+
+Reference analogs: ``python/ray/serve/_private/controller.py:126``
+(ServeController, reconcile loop :506), ``deployment_state.py`` (replica
+state machine), ``autoscaling_policy.py`` (+ ``_private/autoscaling_state``:
+scale on ongoing-request metrics), ``_private/deployment_scheduler.py``.
+Runs as a named actor; handles query it for the live replica set.
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Dict, List, Optional
+
+CONTROLLER_NAME = "__serve_controller"
+
+
+class _DeploymentState:
+    def __init__(self, name: str, spec: dict):
+        self.name = name
+        self.spec = spec             # serialized target + config fields
+        self.replicas: List[dict] = []  # {"actor": handle, "id": str}
+        self.target_replicas = spec["num_replicas"]
+        self.counter = 0
+        self.last_scale_up = 0.0
+        self.last_scale_down = 0.0
+        self.deleted = False
+
+
+class ServeController:
+    """Async actor: one reconcile loop drives every deployment."""
+
+    def __init__(self):
+        self._deployments: Dict[str, _DeploymentState] = {}
+        self._apps: Dict[str, List[str]] = {}  # app name -> deployment names
+        self._routes: Dict[str, str] = {}      # route_prefix -> deployment
+        self._loop_task = None
+        self._running = True
+
+    def _ensure_loop(self):
+        if self._loop_task is None:
+            self._loop_task = asyncio.get_running_loop().create_task(
+                self._reconcile_loop()
+            )
+
+    # ------------------------------------------------------------ deploy API
+
+    async def deploy(self, app_name: str, deployments: List[dict],
+                     route_prefix: Optional[str], ingress: str) -> dict:
+        """deployments: [{name, serialized_target, init_args_ser,
+        num_replicas, max_ongoing, actor_options, user_config,
+        autoscaling (dict|None), version}]"""
+        self._ensure_loop()
+        names = []
+        for spec in deployments:
+            name = spec["name"]
+            names.append(name)
+            existing = self._deployments.get(name)
+            if existing is None:
+                self._deployments[name] = _DeploymentState(name, spec)
+            else:
+                old_version = existing.spec.get("version")
+                existing.spec = spec
+                existing.target_replicas = spec["num_replicas"]
+                if spec.get("version") != old_version:
+                    # rolling update: retire old-version replicas; the
+                    # reconcile loop will start fresh ones
+                    for r in existing.replicas:
+                        await self._stop_replica(r)
+                    existing.replicas = []
+                elif spec.get("user_config") is not None:
+                    for r in existing.replicas:
+                        try:
+                            await self._call(
+                                r, "reconfigure", spec["user_config"]
+                            )
+                        except Exception:
+                            pass
+        self._apps[app_name] = names
+        if route_prefix:
+            self._routes[route_prefix] = ingress
+        await self._reconcile_once()
+        return {"ok": True, "deployments": names}
+
+    async def delete_app(self, app_name: str) -> dict:
+        for name in self._apps.pop(app_name, []):
+            st = self._deployments.get(name)
+            if st:
+                st.deleted = True
+                st.target_replicas = 0
+        self._routes = {
+            k: v for k, v in self._routes.items()
+            if v in {d for ds in self._apps.values() for d in ds}
+        }
+        await self._reconcile_once()
+        return {"ok": True}
+
+    # ------------------------------------------------------------- query API
+
+    def get_replicas(self, deployment: str) -> List[str]:
+        st = self._deployments.get(deployment)
+        if st is None:
+            return []
+        return [r["id"] for r in st.replicas]
+
+    def get_handles(self, deployment: str) -> List[Any]:
+        st = self._deployments.get(deployment)
+        if st is None:
+            return []
+        return [r["actor"] for r in st.replicas]
+
+    def get_routes(self) -> Dict[str, str]:
+        return dict(self._routes)
+
+    def status(self) -> dict:
+        return {
+            name: {
+                "target": st.target_replicas,
+                "running": len(st.replicas),
+                "deleted": st.deleted,
+            }
+            for name, st in self._deployments.items()
+        }
+
+    async def shutdown(self) -> bool:
+        self._running = False
+        for st in self._deployments.values():
+            for r in st.replicas:
+                await self._stop_replica(r)
+            st.replicas = []
+        return True
+
+    # --------------------------------------------------------- reconcile
+
+    async def _reconcile_loop(self):
+        while self._running:
+            try:
+                await self._reconcile_once()
+                await self._autoscale()
+            except Exception:
+                pass
+            await asyncio.sleep(0.25)
+
+    async def _reconcile_once(self):
+        for st in list(self._deployments.values()):
+            while len(st.replicas) < st.target_replicas:
+                r = await self._start_replica(st)
+                if r is None:
+                    break
+                st.replicas.append(r)
+            while len(st.replicas) > st.target_replicas:
+                await self._stop_replica(st.replicas.pop())
+            if st.deleted and not st.replicas:
+                self._deployments.pop(st.name, None)
+        # health: drop dead replicas so the loop replaces them
+        for st in self._deployments.values():
+            alive = []
+            for r in st.replicas:
+                try:
+                    ok = await asyncio.wait_for(
+                        self._call(r, "health_check"), timeout=5
+                    )
+                    alive.append(r)
+                except Exception:
+                    pass  # dead → not re-added; reconcile restarts
+            st.replicas = alive
+
+    async def _start_replica(self, st: _DeploymentState) -> Optional[dict]:
+        import ray_tpu
+        from ray_tpu.serve.replica import Replica
+
+        spec = st.spec
+        rid = f"{st.name}#{st.counter}"
+        st.counter += 1
+        opts = dict(spec.get("actor_options") or {})
+        opts.setdefault("max_concurrency", max(spec["max_ongoing"], 2))
+        try:
+            actor_cls = ray_tpu.remote(Replica)
+            actor = actor_cls.options(**opts).remote(
+                spec["serialized_target"],
+                spec.get("init_args", ()),
+                spec.get("init_kwargs", {}),
+                spec.get("user_config"),
+            )
+            # wait for construction to finish (or raise)
+            await self._await_ref(actor.health_check.remote())
+            return {"actor": actor, "id": rid}
+        except Exception:
+            return None
+
+    async def _stop_replica(self, r: dict):
+        import ray_tpu
+
+        try:
+            ray_tpu.kill(r["actor"])
+        except Exception:
+            pass
+
+    async def _call(self, r: dict, method: str, *args):
+        ref = getattr(r["actor"], method).remote(*args)
+        return await self._await_ref(ref)
+
+    async def _await_ref(self, ref):
+        from ray_tpu._private.worker import get_global_worker
+
+        return await get_global_worker().as_asyncio_future(ref)
+
+    # --------------------------------------------------------- autoscaling
+
+    async def _autoscale(self):
+        for st in self._deployments.values():
+            asc = st.spec.get("autoscaling")
+            if not asc or st.deleted or not st.replicas:
+                continue
+            total = 0
+            for r in st.replicas:
+                try:
+                    total += await asyncio.wait_for(
+                        self._call(r, "queue_len"), timeout=5
+                    )
+                except Exception:
+                    pass
+            import math
+
+            desired = math.ceil(total / asc["target_ongoing_requests"]) or 1
+            desired = min(max(desired, asc["min_replicas"]),
+                          asc["max_replicas"])
+            now = time.monotonic()
+            if desired > st.target_replicas and (
+                now - st.last_scale_up > asc["upscale_delay_s"]
+            ):
+                st.target_replicas = desired
+                st.last_scale_up = now
+            elif desired < st.target_replicas and (
+                now - st.last_scale_down > asc["downscale_delay_s"]
+            ):
+                st.target_replicas = max(desired, asc["min_replicas"])
+                st.last_scale_down = now

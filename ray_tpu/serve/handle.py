@@ -1,0 +1,152 @@
+"""Deployment handles + power-of-two-choices routing.
+
+Reference analogs: ``python/ray/serve/handle.py`` (DeploymentHandle /
+DeploymentResponse), ``_private/router.py:516`` + ``request_router/
+pow_2_router.py:27`` (pick 2 random replicas, route to the lower queue
+length). The router tracks its *own* in-flight counts per replica (no
+per-request RPC to ask replicas their length; counts refresh lazily).
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class DeploymentResponse:
+    """Future-like result of ``handle.remote()`` (reference:
+    ``serve/handle.py DeploymentResponse``)."""
+
+    def __init__(self, ref, router, replica_key):
+        self._ref = ref
+        self._router = router
+        self._key = replica_key
+        self._done = False
+
+    def result(self, timeout: Optional[float] = None):
+        import ray_tpu
+
+        try:
+            return ray_tpu.get(self._ref, timeout=timeout)
+        finally:
+            self._settle()
+
+    def _settle(self):
+        if not self._done:
+            self._done = True
+            self._router.request_finished(self._key)
+
+    @property
+    def ref(self):
+        """Underlying ObjectRef (compose into other task submissions)."""
+        return self._ref
+
+
+class _Router:
+    def __init__(self, deployment: str, refresh_s: float = 1.0):
+        self._deployment = deployment
+        self._refresh_s = refresh_s
+        self._replicas: List[Any] = []
+        self._inflight: Dict[int, int] = {}
+        self._fetched_at = -10.0
+        self._lock = threading.Lock()
+
+    def _controller(self):
+        import ray_tpu
+        from ray_tpu.serve.controller import CONTROLLER_NAME
+
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+
+    def _refresh(self, force: bool = False):
+        now = time.monotonic()
+        if not force and now - self._fetched_at < self._refresh_s:
+            return
+        import ray_tpu
+
+        handles = ray_tpu.get(
+            self._controller().get_handles.remote(self._deployment), timeout=30
+        )
+        with self._lock:
+            self._replicas = handles
+            live = {id(h) for h in handles}
+            self._inflight = {
+                k: v for k, v in self._inflight.items() if k in live
+            }
+            for h in handles:
+                self._inflight.setdefault(id(h), 0)
+            self._fetched_at = now
+
+    def pick(self):
+        """Power-of-two-choices on locally tracked in-flight counts."""
+        self._refresh()
+        deadline = time.monotonic() + 30
+        while not self._replicas:
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no replicas for deployment '{self._deployment}'"
+                )
+            time.sleep(0.05)
+            self._refresh(force=True)
+        with self._lock:
+            if len(self._replicas) == 1:
+                chosen = self._replicas[0]
+            else:
+                a, b = random.sample(self._replicas, 2)
+                chosen = (
+                    a if self._inflight.get(id(a), 0)
+                    <= self._inflight.get(id(b), 0) else b
+                )
+            self._inflight[id(chosen)] = self._inflight.get(id(chosen), 0) + 1
+            return chosen, id(chosen)
+
+    def request_finished(self, key: int):
+        with self._lock:
+            if key in self._inflight and self._inflight[key] > 0:
+                self._inflight[key] -= 1
+
+    def evict(self, key: int):
+        """Drop a replica that failed a request; next pick refreshes."""
+        with self._lock:
+            self._replicas = [r for r in self._replicas if id(r) != key]
+            self._inflight.pop(key, None)
+        self._fetched_at = -10.0
+
+
+class _MethodCaller:
+    def __init__(self, handle: "DeploymentHandle", method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._handle._call(self._method, args, kwargs)
+
+
+class DeploymentHandle:
+    def __init__(self, deployment: str):
+        self._deployment = deployment
+        self._router = _Router(deployment)
+
+    @property
+    def deployment_name(self) -> str:
+        return self._deployment
+
+    def _call(self, method: str, args, kwargs) -> DeploymentResponse:
+        replica, key = self._router.pick()
+        try:
+            ref = replica.handle_request.remote(method, args, kwargs)
+        except Exception:
+            self._router.evict(key)
+            raise
+        return DeploymentResponse(ref, self._router, key)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return self._call("__call__", args, kwargs)
+
+    def __getattr__(self, item) -> _MethodCaller:
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return _MethodCaller(self, item)
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self._deployment,))

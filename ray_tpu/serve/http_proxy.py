@@ -1,0 +1,90 @@
+"""HTTP ingress proxy (reference: ``python/ray/serve/_private/proxy.py`` —
+per-node ProxyActor routing HTTP to replicas via the router).
+
+An aiohttp server inside an async actor. Routes come from the controller's
+route table (longest-prefix match); request bodies pass to the ingress
+deployment's ``__call__`` as a dict: ``{"body": bytes, "path": str,
+"query": dict, "headers": dict, "method": str}`` — JSON responses are
+serialized automatically.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Dict, Optional
+
+
+class HTTPProxy:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self._host = host
+        self._port = port
+        self._handles: Dict[str, object] = {}
+        self._runner = None
+        self._site = None
+
+    async def start(self) -> int:
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        self._site = web.TCPSite(self._runner, self._host, self._port)
+        await self._site.start()
+        port = self._site._server.sockets[0].getsockname()[1]
+        self._port = port
+        return port
+
+    def port(self) -> int:
+        return self._port
+
+    def _route_for(self, path: str) -> Optional[str]:
+        import ray_tpu
+        from ray_tpu.serve.controller import CONTROLLER_NAME
+
+        routes = ray_tpu.get(
+            ray_tpu.get_actor(CONTROLLER_NAME).get_routes.remote(), timeout=10
+        )
+        best = None
+        for prefix, deployment in routes.items():
+            if path.startswith(prefix) and (
+                best is None or len(prefix) > len(best[0])
+            ):
+                best = (prefix, deployment)
+        return None if best is None else best[1]
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        deployment = self._route_for(request.path)
+        if deployment is None:
+            return web.Response(status=404, text="no route")
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        handle = self._handles.get(deployment)
+        if handle is None:
+            handle = self._handles[deployment] = DeploymentHandle(deployment)
+        body = await request.read()
+        payload = {
+            "body": body,
+            "path": request.path,
+            "query": dict(request.query),
+            "headers": dict(request.headers),
+            "method": request.method,
+        }
+        loop = asyncio.get_running_loop()
+        try:
+            resp = handle.remote(payload)
+            out = await loop.run_in_executor(None, resp.result, 60)
+        except Exception as e:
+            return web.Response(status=500, text=f"{type(e).__name__}: {e}")
+        if isinstance(out, (bytes, bytearray)):
+            return web.Response(body=bytes(out))
+        if isinstance(out, str):
+            return web.Response(text=out)
+        return web.json_response(out)
+
+    async def stop(self) -> bool:
+        if self._runner is not None:
+            await self._runner.cleanup()
+        return True

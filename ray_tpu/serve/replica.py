@@ -1,0 +1,154 @@
+"""Replica actor: hosts one instance of a deployment's callable.
+
+Reference analog: ``python/ray/serve/_private/replica.py``. Tracks ongoing
+requests (the router's and autoscaler's load signal), supports async and
+sync callables, ``reconfigure`` (user_config updates without restart), and
+dynamic batching via :func:`batch`.
+"""
+from __future__ import annotations
+
+import asyncio
+import inspect
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+
+class Replica:
+    """Created via ray_tpu.remote with max_concurrency > 1 so requests
+    overlap; ``_ongoing`` is the live load metric."""
+
+    def __init__(self, serialized_target, init_args, init_kwargs,
+                 user_config=None):
+        import cloudpickle
+
+        target = cloudpickle.loads(serialized_target)
+        self._is_function = not inspect.isclass(target)
+        if self._is_function:
+            self._instance = target
+        else:
+            self._instance = target(*init_args, **init_kwargs)
+        self._ongoing = 0
+        self._total = 0
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    def reconfigure(self, user_config) -> bool:
+        if hasattr(self._instance, "reconfigure"):
+            self._instance.reconfigure(user_config)
+        return True
+
+    def health_check(self) -> bool:
+        if hasattr(self._instance, "check_health"):
+            self._instance.check_health()
+        return True
+
+    def queue_len(self) -> int:
+        return self._ongoing
+
+    def stats(self) -> dict:
+        return {"ongoing": self._ongoing, "total": self._total}
+
+    async def handle_request(self, method: str, args, kwargs):
+        self._ongoing += 1
+        self._total += 1
+        try:
+            if self._is_function:
+                fn = self._instance
+            else:
+                fn = getattr(self._instance, method)
+            if inspect.iscoroutinefunction(fn) or (
+                hasattr(fn, "_is_serve_batch")
+            ):
+                return await fn(*args, **kwargs)
+            # Sync callables run on an executor thread: they may block (e.g.
+            # a composition handle's .result()) and must not stall this
+            # replica's event loop.
+            loop = asyncio.get_running_loop()
+            out = await loop.run_in_executor(
+                None, lambda: fn(*args, **kwargs)
+            )
+            if inspect.isawaitable(out):
+                out = await out
+            return out
+        finally:
+            self._ongoing -= 1
+
+
+class _BatchQueue:
+    """Accumulates calls until max_batch_size or batch_wait_timeout_s."""
+
+    def __init__(self, fn, max_batch_size: int, timeout_s: float):
+        self._fn = fn
+        self._max = max_batch_size
+        self._timeout = timeout_s
+        self._queue: List[tuple] = []
+        self._flush_task: Optional[asyncio.Task] = None
+
+    async def submit(self, item):
+        fut = asyncio.get_running_loop().create_future()
+        self._queue.append((item, fut))
+        if len(self._queue) >= self._max:
+            await self._flush()
+        elif self._flush_task is None or self._flush_task.done():
+            self._flush_task = asyncio.get_running_loop().create_task(
+                self._delayed_flush()
+            )
+        return await fut
+
+    async def _delayed_flush(self):
+        await asyncio.sleep(self._timeout)
+        await self._flush()
+
+    async def _flush(self):
+        if not self._queue:
+            return
+        batch, self._queue = self._queue, []
+        items = [b[0] for b in batch]
+        try:
+            outs = self._fn(items)
+            if inspect.isawaitable(outs):
+                outs = await outs
+            if len(outs) != len(items):
+                raise ValueError(
+                    f"batched fn returned {len(outs)} results for "
+                    f"{len(items)} inputs"
+                )
+            for (_, fut), out in zip(batch, outs):
+                if not fut.done():
+                    fut.set_result(out)
+        except Exception as e:  # propagate to every waiter
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+
+
+def batch(fn=None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """``@serve.batch``: N concurrent single-item calls → one list call
+    (reference: ``python/ray/serve/batching.py``). Decorate an async method
+    taking a list and returning an equal-length list."""
+
+    def wrap(f):
+        queues: Dict[int, _BatchQueue] = {}
+
+        async def wrapper(self_or_item, *args):
+            # methods: (self, item); free functions: (item,)
+            if args:
+                owner, item = id(self_or_item), args[0]
+                bound = f.__get__(self_or_item)  # bind self
+            else:
+                owner, item = 0, self_or_item
+                bound = f
+            q = queues.get(owner)
+            if q is None:
+                q = queues[owner] = _BatchQueue(
+                    bound, max_batch_size, batch_wait_timeout_s
+                )
+            return await q.submit(item)
+
+        wrapper._is_serve_batch = True
+        return wrapper
+
+    if fn is not None:
+        return wrap(fn)
+    return wrap
