@@ -207,8 +207,13 @@ class HeadService:
         loop = asyncio.get_running_loop()
 
         def _spawn():
-            if not loop.is_closed():
-                loop.create_task(self._on_node_dead(node_id))
+            if loop.is_closed():
+                return
+            coro = self._on_node_dead(node_id)
+            try:
+                loop.create_task(coro)
+            except RuntimeError:
+                coro.close()  # loop torn down between check and create
 
         def _on_close(conn):
             if not loop.is_closed():

@@ -574,8 +574,14 @@ class CoreWorker:
         return asyncio.run_coroutine_threadsafe(self._get_one(ref, None), self.loop)
 
     def as_asyncio_future(self, ref: ObjectRef):
+        """Awaitable from ANY event loop. _get_one must run on the core loop:
+        its store_events Events are set() by the core loop, and a cross-loop
+        Event.wait() never wakes once the waiter's loop goes idle."""
         async def _get():
-            v = await self._get_one(ref, None)
+            cfut = asyncio.run_coroutine_threadsafe(
+                self._get_one(ref, None), self.loop
+            )
+            v = await asyncio.wrap_future(cfut)
             if isinstance(v, Exception):
                 raise v
             return v

@@ -32,6 +32,11 @@ def main(argv=None):
         format=f"[rt-worker {os.getpid()}] %(levelname)s %(name)s: %(message)s",
     )
 
+    # SIGUSR1 → dump all thread stacks to stderr (debugging stuck workers;
+    # reference analog: py-spy hooks in dashboard/modules/reporter).
+    import faulthandler
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
+
     # Workers default to CPU jax unless the node was explicitly given TPUs:
     # only one process may own the TPU chips.
     resources = json.loads(args.resources)

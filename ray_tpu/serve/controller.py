@@ -30,12 +30,18 @@ class _DeploymentState:
 class ServeController:
     """Async actor: one reconcile loop drives every deployment."""
 
+    HANDLE_METRIC_TTL_S = 3.0
+
     def __init__(self):
         self._deployments: Dict[str, _DeploymentState] = {}
         self._apps: Dict[str, List[str]] = {}  # app name -> deployment names
         self._routes: Dict[str, str] = {}      # route_prefix -> deployment
+        # deployment -> {handle_id: (ongoing, monotonic ts)}; pushed by
+        # handle routers (queued + executing requests they have issued).
+        self._handle_metrics: Dict[str, Dict[int, tuple]] = {}
         self._loop_task = None
         self._running = True
+        self._reconcile_lock = asyncio.Lock()
 
     def _ensure_loop(self):
         if self._loop_task is None:
@@ -52,30 +58,34 @@ class ServeController:
         autoscaling (dict|None), version}]"""
         self._ensure_loop()
         names = []
-        for spec in deployments:
-            name = spec["name"]
-            names.append(name)
-            existing = self._deployments.get(name)
-            if existing is None:
-                self._deployments[name] = _DeploymentState(name, spec)
-            else:
-                old_version = existing.spec.get("version")
-                existing.spec = spec
-                existing.target_replicas = spec["num_replicas"]
-                if spec.get("version") != old_version:
-                    # rolling update: retire old-version replicas; the
-                    # reconcile loop will start fresh ones
-                    for r in existing.replicas:
-                        await self._stop_replica(r)
-                    existing.replicas = []
-                elif spec.get("user_config") is not None:
-                    for r in existing.replicas:
-                        try:
-                            await self._call(
-                                r, "reconfigure", spec["user_config"]
-                            )
-                        except Exception:
-                            pass
+        # Hold the reconcile lock: an in-flight reconcile pass may be mid
+        # _start_replica and would append an old-version replica after the
+        # teardown below.
+        async with self._reconcile_lock:
+            for spec in deployments:
+                name = spec["name"]
+                names.append(name)
+                existing = self._deployments.get(name)
+                if existing is None:
+                    self._deployments[name] = _DeploymentState(name, spec)
+                else:
+                    old_version = existing.spec.get("version")
+                    existing.spec = spec
+                    existing.target_replicas = spec["num_replicas"]
+                    if spec.get("version") != old_version:
+                        # rolling update: retire old-version replicas; the
+                        # reconcile loop will start fresh ones
+                        for r in existing.replicas:
+                            await self._stop_replica(r)
+                        existing.replicas = []
+                    elif spec.get("user_config") is not None:
+                        for r in existing.replicas:
+                            try:
+                                await self._call(
+                                    r, "reconfigure", spec["user_config"]
+                                )
+                            except Exception:
+                                pass
         self._apps[app_name] = names
         if route_prefix:
             self._routes[route_prefix] = ingress
@@ -124,10 +134,11 @@ class ServeController:
 
     async def shutdown(self) -> bool:
         self._running = False
-        for st in self._deployments.values():
-            for r in st.replicas:
-                await self._stop_replica(r)
-            st.replicas = []
+        async with self._reconcile_lock:  # wait out an in-flight pass
+            for st in self._deployments.values():
+                for r in st.replicas:
+                    await self._stop_replica(r)
+                st.replicas = []
         return True
 
     # --------------------------------------------------------- reconcile
@@ -142,6 +153,12 @@ class ServeController:
             await asyncio.sleep(0.25)
 
     async def _reconcile_once(self):
+        # Serialized: deploy() also reconciles, and two interleaved passes
+        # would both see len < target and double-start replicas.
+        async with self._reconcile_lock:
+            await self._reconcile_inner()
+
+    async def _reconcile_inner(self):
         for st in list(self._deployments.values()):
             while len(st.replicas) < st.target_replicas:
                 r = await self._start_replica(st)
@@ -182,8 +199,15 @@ class ServeController:
                 spec.get("init_kwargs", {}),
                 spec.get("user_config"),
             )
-            # wait for construction to finish (or raise)
-            await self._await_ref(actor.health_check.remote())
+            # wait (bounded) for construction to finish or raise; a wedged
+            # start must not stall the reconcile loop forever
+            try:
+                await asyncio.wait_for(
+                    self._await_ref(actor.health_check.remote()), timeout=60
+                )
+            except BaseException:
+                await self._stop_replica({"actor": actor})  # don't leak it
+                raise
             return {"actor": actor, "id": rid}
         except Exception:
             return None
@@ -207,19 +231,38 @@ class ServeController:
 
     # --------------------------------------------------------- autoscaling
 
+    def record_handle_metrics(self, deployment: str, handle_id: int,
+                              ongoing: int) -> bool:
+        self._handle_metrics.setdefault(deployment, {})[handle_id] = (
+            ongoing, time.monotonic()
+        )
+        return True
+
+    def _handle_reported_total(self, deployment: str) -> int:
+        now = time.monotonic()
+        metrics = self._handle_metrics.get(deployment, {})
+        for hid in [h for h, (_, ts) in metrics.items()
+                    if now - ts > self.HANDLE_METRIC_TTL_S]:
+            metrics.pop(hid, None)
+        return sum(n for n, _ in metrics.values())
+
     async def _autoscale(self):
         for st in self._deployments.values():
             asc = st.spec.get("autoscaling")
             if not asc or st.deleted or not st.replicas:
                 continue
+            # Replica-reported executing count can undercount (queued
+            # requests are invisible in the actor mailbox), so take the max
+            # with the handle-reported in-flight totals.
             total = 0
             for r in st.replicas:
                 try:
                     total += await asyncio.wait_for(
-                        self._call(r, "queue_len"), timeout=5
+                        self._call(r, "queue_len"), timeout=2
                     )
                 except Exception:
                     pass
+            total = max(total, self._handle_reported_total(st.name))
             import math
 
             desired = math.ceil(total / asc["target_ongoing_requests"]) or 1

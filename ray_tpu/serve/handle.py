@@ -51,12 +51,76 @@ class _Router:
         self._inflight: Dict[int, int] = {}
         self._fetched_at = -10.0
         self._lock = threading.Lock()
+        # Autoscaling signal: refs of requests this handle has issued that
+        # haven't completed yet (queued + executing), pushed to the
+        # controller (reference: handle-side metrics in _private/router.py →
+        # autoscaling_state.py; replica-side polls undercount because queued
+        # requests sit invisible in the actor mailbox).
+        self._refs: Dict[int, Any] = {}
+        self._metrics_thread = None
+        self._controller_handle = None
+
+    def _ensure_metrics_thread(self):
+        with self._lock:
+            if (self._metrics_thread is not None
+                    and self._metrics_thread.is_alive()):
+                return
+            self._metrics_thread = threading.Thread(
+                target=self._metrics_loop, daemon=True,
+                name=f"serve-handle-metrics-{self._deployment}",
+            )
+            self._metrics_thread.start()
+
+    def _metrics_loop(self):
+        import ray_tpu
+
+        failures = 0
+        last_pushed = -1
+        try:
+            while failures < 8:
+                time.sleep(0.25)
+                try:
+                    with self._lock:
+                        refs = list(self._refs.items())
+                    if refs:
+                        ready, _ = ray_tpu.wait(
+                            [r for _, r in refs],
+                            num_returns=len(refs), timeout=0,
+                        )
+                        done = {id(r) for r in ready}
+                        with self._lock:
+                            for k, r in refs:
+                                if id(r) in done:
+                                    self._refs.pop(k, None)
+                    with self._lock:
+                        n = len(self._refs)
+                    if n != last_pushed or n > 0:
+                        self._controller().record_handle_metrics.remote(
+                            self._deployment, id(self), n
+                        )
+                        last_pushed = n
+                    failures = 0
+                except Exception:
+                    self._controller_handle = None  # re-resolve next time
+                    failures += 1
+        finally:
+            # A dead thread must not pin result objects; the next
+            # track_request restarts tracking.
+            with self._lock:
+                self._refs.clear()
+
+    def track_request(self, ref):
+        with self._lock:
+            self._refs[id(ref)] = ref
+        self._ensure_metrics_thread()
 
     def _controller(self):
         import ray_tpu
         from ray_tpu.serve.controller import CONTROLLER_NAME
 
-        return ray_tpu.get_actor(CONTROLLER_NAME)
+        if self._controller_handle is None:
+            self._controller_handle = ray_tpu.get_actor(CONTROLLER_NAME)
+        return self._controller_handle
 
     def _refresh(self, force: bool = False):
         now = time.monotonic()
@@ -64,9 +128,14 @@ class _Router:
             return
         import ray_tpu
 
-        handles = ray_tpu.get(
-            self._controller().get_handles.remote(self._deployment), timeout=30
-        )
+        try:
+            handles = ray_tpu.get(
+                self._controller().get_handles.remote(self._deployment),
+                timeout=30,
+            )
+        except Exception:
+            self._controller_handle = None  # stale after controller restart
+            raise
         with self._lock:
             self._replicas = handles
             live = {id(h) for h in handles}
@@ -138,6 +207,7 @@ class DeploymentHandle:
         except Exception:
             self._router.evict(key)
             raise
+        self._router.track_request(ref)
         return DeploymentResponse(ref, self._router, key)
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
