@@ -46,7 +46,7 @@ from ray_tpu._private.ids import (
     TaskID,
     WorkerID,
 )
-from ray_tpu._private.object_store import LocalShmStore
+from ray_tpu.native.arena import HybridShmStore
 from ray_tpu._private.serialization import SerializationContext
 from ray_tpu.object_ref import ObjectRef, collect_refs_during
 
@@ -178,7 +178,9 @@ class CoreWorker:
         self.peer_lock: Optional[asyncio.Lock] = None
 
         self.ctx = SerializationContext()
-        self.shm = LocalShmStore()
+        # Built lazily (see .shm): the arena name is derived from the head
+        # address, which for the in-process head is only known post-start.
+        self._shm: Optional[HybridShmStore] = None
         # object hex -> ("mem", header, frames) | ("shm", meta) | ("err", exception)
         self.memory_store: Dict[str, tuple] = {}
         self.store_events: Dict[str, asyncio.Event] = {}
@@ -199,6 +201,16 @@ class CoreWorker:
         self._stats = {"tasks_executed": 0, "tasks_submitted": 0}
         self.runtime_env: dict = {}
         self.pubsub_handlers: Dict[str, List[Any]] = {}
+
+    @property
+    def shm(self) -> HybridShmStore:
+        """Session-scoped object store: every process on this machine maps the
+        same native arena, named after the head address."""
+        if self._shm is None:
+            port = self.gcs_addr[1]
+            arena = f"/rt_arena_{port}_{os.getuid()}" if port else None
+            self._shm = HybridShmStore(arena)
+        return self._shm
 
     # ------------------------------------------------------------------ setup
 
@@ -223,6 +235,11 @@ class CoreWorker:
 
     async def _async_setup(self):
         self.peer_lock = asyncio.Lock()
+        if self.is_driver:
+            # Create the session arena now so the *driver* owns it: the driver
+            # is the one process guaranteed to run close_all at shutdown, so
+            # the /dev/shm segment gets unlinked (workers die by SIGTERM).
+            _ = self.shm
         self.task_executor = ThreadPoolExecutor(
             max_workers=max(self.num_task_slots, 4),
             thread_name_prefix="rt-task",
@@ -463,6 +480,19 @@ class CoreWorker:
         if kind == "shm":
             frames = self.shm.get_frames(hex_, entry[1])
             if frames is None:
+                # Local mapping unavailable (this process has no arena, or
+                # the segment died with its creator): fall back to pulling
+                # the bytes from the owner over RPC.
+                try:
+                    entry = await self._pull_from_owner(ref, deadline, inline=True)
+                except exc.RayTpuError as e:
+                    return e
+                if entry[0] == "err":
+                    return entry[1]
+                if entry[0] == "mem":
+                    # Cache: repeated gets must not re-transfer the payload.
+                    self.memory_store[hex_] = entry
+                    return self.ctx.deserialize_frames(entry[1])
                 return exc.ObjectLostError(hex_, "shm segment missing")
             return self.ctx.deserialize_frames(frames)
         return exc.ObjectLostError(hex_, f"bad store entry {kind}")
@@ -491,13 +521,20 @@ class CoreWorker:
         if h.get("found"):
             return ("shm", h["meta"])
         # 2) pull from the owner
+        return await self._pull_from_owner(ref, deadline)
+
+    async def _pull_from_owner(self, ref: ObjectRef, deadline, inline=False):
+        """Fetch from the owning worker. inline=True forces the owner to send
+        the bytes over the wire even for shm-backed objects (used when this
+        process cannot map the shared store)."""
+        hex_ = ref.id().hex()
         owner = tuple(ref.owner_address or ())
         if not owner:
             raise exc.ObjectLostError(hex_, "no owner address on ref")
         try:
             conn = await self.get_peer(owner)
             timeout = None if deadline is None else max(deadline - time.monotonic(), 0)
-            call = conn.call("pull_object", {"oid": hex_})
+            call = conn.call("pull_object", {"oid": hex_, "inline": inline})
             hh, frames = await (
                 asyncio.wait_for(call, timeout) if timeout is not None else call
             )
@@ -505,6 +542,8 @@ class CoreWorker:
             raise exc.GetTimeoutError(f"get() timed out pulling {hex_}")
         except (protocol.ConnectionLost, ConnectionRefusedError, OSError):
             raise exc.ObjectLostError(hex_, "owner unreachable")
+        except protocol.RpcError as e:
+            raise exc.ObjectLostError(hex_, str(e))
         if hh.get("kind") == "shm":
             return ("shm", hh["meta"])
         if hh.get("kind") == "err":
@@ -1070,6 +1109,11 @@ class CoreWorker:
         if kind == "mem":
             return {"kind": "mem"}, list(entry[1])
         if kind == "shm":
+            if h.get("inline"):
+                frames = self.shm.get_frames(hex_, entry[1])
+                if frames is None:
+                    raise protocol.RpcError(f"object {hex_} lost at owner")
+                return {"kind": "mem"}, [bytes(f) for f in frames]
             return {"kind": "shm", "meta": entry[1]}, []
         sobj = self.ctx.serialize(entry[1])
         return {"kind": "err"}, sobj.to_frames()
@@ -1093,7 +1137,8 @@ class CoreWorker:
     async def rpc_free_object(self, h, frames, conn):
         for oid in h["oids"]:
             self.memory_store.pop(oid, None)
-            self.shm.free(oid)
+            if self._shm is not None:
+                self._shm.free(oid)
         return {}, []
 
     async def _materialize_args(self, header, frames):
@@ -1385,7 +1430,8 @@ class CoreWorker:
                     await self.server.close()
             except Exception:
                 pass
-            self.shm.close_all()
+            if self._shm is not None:
+                self._shm.close_all()
             # Quiet teardown: cancel stragglers (reapers, recv loops).
             me = asyncio.current_task()
             for t in asyncio.all_tasks():

@@ -1,0 +1,102 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The shared library is built from ``src/`` on first import (g++ is part of the
+toolchain; there is no server process to deploy — the arena lives in shm and
+every process coordinates through its header). If the toolchain is missing or
+the build fails, importers fall back to the portable Python implementations.
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+logger = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "librt_native.so")
+_SRC = os.path.join(_DIR, "src", "arena_store.cc")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        res = subprocess.run(
+            ["make", "-C", _DIR],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+    except (OSError, subprocess.TimeoutExpired) as e:
+        logger.warning("native build unavailable: %s", e)
+        return False
+    if res.returncode != 0:
+        logger.warning("native build failed:\n%s", res.stderr[-2000:])
+        return False
+    return True
+
+
+def load_library():
+    """Return the ctypes lib, building it if needed; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)
+        ):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError as e:
+            logger.warning("native library load failed: %s", e)
+            return None
+
+        lib.rt_arena_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32,
+        ]
+        lib.rt_arena_create.restype = ctypes.c_int
+        lib.rt_arena_attach.argtypes = [ctypes.c_char_p]
+        lib.rt_arena_attach.restype = ctypes.c_int
+        lib.rt_arena_unlink.argtypes = [ctypes.c_char_p]
+        lib.rt_arena_unlink.restype = ctypes.c_int
+        lib.rt_arena_detach.argtypes = [ctypes.c_int]
+        lib.rt_arena_detach.restype = ctypes.c_int
+        lib.rt_arena_base.argtypes = [ctypes.c_int]
+        lib.rt_arena_base.restype = ctypes.c_void_p
+        lib.rt_arena_capacity.argtypes = [ctypes.c_int]
+        lib.rt_arena_capacity.restype = ctypes.c_uint64
+        lib.rt_obj_create.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_uint64,
+        ]
+        lib.rt_obj_create.restype = ctypes.c_int64
+        lib.rt_obj_seal.argtypes = [ctypes.c_int, ctypes.c_char_p]
+        lib.rt_obj_seal.restype = ctypes.c_int
+        lib.rt_obj_get.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.rt_obj_get.restype = ctypes.c_int64
+        lib.rt_obj_release.argtypes = [ctypes.c_int, ctypes.c_char_p]
+        lib.rt_obj_release.restype = ctypes.c_int
+        lib.rt_obj_delete.argtypes = [ctypes.c_int, ctypes.c_char_p]
+        lib.rt_obj_delete.restype = ctypes.c_int
+        lib.rt_obj_contains.argtypes = [ctypes.c_int, ctypes.c_char_p]
+        lib.rt_obj_contains.restype = ctypes.c_int
+        lib.rt_arena_stats.argtypes = [
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.rt_arena_stats.restype = None
+        _lib = lib
+        return _lib

@@ -1,0 +1,239 @@
+"""Python client for the native shm arena store (plasma-analog client).
+
+Exposes the same interface as ``_private/object_store.LocalShmStore`` so the
+worker can swap backends: ``put_frames``/``get_frames``/``contains``/``free``/
+``close_all``. Objects are stored with the identical frame layout
+([u32 nframes][u64 len]*n, 8-aligned payloads) so serialization code sees no
+difference; the payload just lives in one node-wide arena instead of one shm
+segment per object.
+
+Semantics mirrored from the reference store
+(src/ray/object_manager/plasma/store.cc): create→write→seal by the producer,
+get pins, delete defers reclamation until the last pin drops. The Python side
+tracks this process's pins and its created objects so ``free`` maps onto
+release (reader) or delete (owner).
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import struct
+import weakref
+from typing import List, Optional
+
+from ray_tpu import native as _native
+from ray_tpu._private.object_store import LocalShmStore
+
+logger = logging.getLogger(__name__)
+
+_ALIGN = 8
+_HDR_COUNT = struct.Struct("<I")
+_HDR_LEN = struct.Struct("<Q")
+
+DEFAULT_CAPACITY = int(os.environ.get("RT_ARENA_BYTES", 1 << 30))
+INDEX_SLOTS = 1 << 15
+
+
+def _align(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+def _shm_budget(requested: int) -> int:
+    """Cap the arena below what /dev/shm can actually hold."""
+    try:
+        st = os.statvfs("/dev/shm")
+        free = st.f_bavail * st.f_frsize
+        return max(min(requested, int(free * 0.4)), 1 << 24)
+    except OSError:
+        return requested
+
+
+class NativeArenaStore:
+    """ctypes client for one named arena. Raises RuntimeError if the native
+    library is unavailable or the arena cannot be created/attached."""
+
+    def __init__(self, name: str, capacity: int = DEFAULT_CAPACITY,
+                 create: bool = True):
+        lib = _native.load_library()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self.name = name
+        self.created_arena = False
+        h = lib.rt_arena_attach(name.encode())
+        if h < 0 and create:
+            cap = _shm_budget(capacity)
+            h = lib.rt_arena_create(name.encode(), cap, INDEX_SLOTS)
+            if h >= 0:
+                self.created_arena = True
+            elif h == -17:  # EEXIST: lost the creation race
+                h = lib.rt_arena_attach(name.encode())
+        if h < 0:
+            raise RuntimeError(f"arena {name}: errno {-h}")
+        self._h = h
+        self._base = lib.rt_arena_base(h)
+        # Objects this process created (free() maps to delete for these).
+        # Reader pins are owned by the buffers themselves: get_frames attaches
+        # a finalizer to the mapping window so the pin drops only when the
+        # last zero-copy view dies (plasma client-buffer semantics).
+        self._created: set = set()
+
+    # -- store interface ----------------------------------------------------
+
+    def put_frames(self, object_hex: str, frames: List[bytes]) -> Optional[dict]:
+        """Returns meta, or None when the arena is full (caller falls back)."""
+        total = _HDR_COUNT.size + _HDR_LEN.size * len(frames)
+        offsets = []
+        for f in frames:
+            total = _align(total)
+            offsets.append(total)
+            total += len(f)
+        off = self._lib.rt_obj_create(self._h, object_hex.encode(), max(total, 1))
+        if off < 0:
+            if off in (-28, -23):  # ENOSPC / ENFILE
+                return None
+            raise RuntimeError(f"obj_create({object_hex}): errno {-off}")
+        buf = self._view(off, total)
+        _HDR_COUNT.pack_into(buf, 0, len(frames))
+        pos = _HDR_COUNT.size
+        for f in frames:
+            _HDR_LEN.pack_into(buf, pos, len(f))
+            pos += _HDR_LEN.size
+        for o, f in zip(offsets, frames):
+            buf[o : o + len(f)] = f
+        rc = self._lib.rt_obj_seal(self._h, object_hex.encode())
+        if rc != 0:
+            raise RuntimeError(f"obj_seal({object_hex}): errno {-rc}")
+        self._created.add(object_hex)
+        return {"arena": self.name, "size": total}
+
+    def get_frames(self, object_hex: str, meta: dict) -> Optional[List[memoryview]]:
+        size = ctypes.c_uint64()
+        off = self._lib.rt_obj_get(self._h, object_hex.encode(), ctypes.byref(size))
+        if off < 0:
+            return None
+        arr = (ctypes.c_char * size.value).from_address(self._base + off)
+        # The pin taken by rt_obj_get is released when the last view into this
+        # window is GC'd — deserialized arrays alias arena memory, so the
+        # block must not be reused while any of them is alive. (Reference:
+        # plasma client buffers release on destruction.) atexit=False: at
+        # interpreter exit the arena is torn down wholesale anyway.
+        fin = weakref.finalize(
+            arr, self._lib.rt_obj_release, self._h, object_hex.encode()
+        )
+        fin.atexit = False
+        buf = memoryview(arr).cast("B")
+        nframes = _HDR_COUNT.unpack_from(buf, 0)[0]
+        lens = []
+        pos = _HDR_COUNT.size
+        for _ in range(nframes):
+            lens.append(_HDR_LEN.unpack_from(buf, pos)[0])
+            pos += _HDR_LEN.size
+        out = []
+        for ln in lens:
+            pos = _align(pos)
+            out.append(buf[pos : pos + ln])
+            pos += ln
+        return out
+
+    def contains(self, object_hex: str) -> bool:
+        return bool(self._lib.rt_obj_contains(self._h, object_hex.encode()))
+
+    def free(self, object_hex: str, meta: Optional[dict] = None):
+        enc = object_hex.encode()
+        if object_hex in self._created:
+            self._created.discard(object_hex)
+            self._lib.rt_obj_delete(self._h, enc)
+        elif meta is not None:
+            # Owner-side free of an object this process didn't create (e.g.
+            # the creator died and the head reassigned ownership). Drops the
+            # (possibly leaked) creator pin and marks the block deletable.
+            self._lib.rt_obj_delete(self._h, enc)
+        # Reader-side free (meta=None, not creator) is a no-op: get-pins are
+        # released by the buffer finalizers when the views die.
+
+    def close_all(self):
+        for hex_ in list(self._created):
+            self.free(hex_)
+        if self.created_arena:
+            self._lib.rt_arena_unlink(self.name.encode())
+
+    # -- helpers ------------------------------------------------------------
+
+    def _view(self, off: int, size: int) -> memoryview:
+        arr = (ctypes.c_char * size).from_address(self._base + off)
+        return memoryview(arr).cast("B")
+
+    def stats(self) -> dict:
+        used = ctypes.c_uint64()
+        nobj = ctypes.c_uint64()
+        cap = ctypes.c_uint64()
+        peak = ctypes.c_uint64()
+        self._lib.rt_arena_stats(
+            self._h, ctypes.byref(used), ctypes.byref(nobj),
+            ctypes.byref(cap), ctypes.byref(peak),
+        )
+        return {
+            "bytes_in_use": used.value,
+            "num_objects": nobj.value,
+            "capacity": cap.value,
+            "peak_bytes": peak.value,
+        }
+
+
+class HybridShmStore:
+    """Arena-first store with per-object-segment fallback.
+
+    Mirrors plasma's fallback allocation (create_request_queue falling back to
+    filesystem-backed mmap when the main arena is exhausted): puts go to the
+    native arena; on arena-full (or no native toolchain) they land in a
+    per-object POSIX shm segment via the portable store. Reads dispatch on the
+    meta descriptor ("arena" vs "seg" key).
+    """
+
+    def __init__(self, arena_name: Optional[str], prefix: str = "rt"):
+        self.fallback = LocalShmStore(prefix=prefix)
+        self.arena: Optional[NativeArenaStore] = None
+        if arena_name and os.environ.get("RT_DISABLE_NATIVE_STORE") != "1":
+            try:
+                self.arena = NativeArenaStore(arena_name)
+            except (RuntimeError, OSError) as e:
+                logger.debug("native arena unavailable (%s); portable store", e)
+
+    @property
+    def native_enabled(self) -> bool:
+        return self.arena is not None
+
+    def put_frames(self, object_hex: str, frames: List[bytes]) -> dict:
+        if self.arena is not None:
+            meta = self.arena.put_frames(object_hex, frames)
+            if meta is not None:
+                return meta
+        return self.fallback.put_frames(object_hex, frames)
+
+    def get_frames(self, object_hex: str, meta: dict) -> Optional[List[memoryview]]:
+        if "arena" in meta:
+            if self.arena is None:
+                return None
+            return self.arena.get_frames(object_hex, meta)
+        return self.fallback.get_frames(object_hex, meta)
+
+    def contains(self, object_hex: str) -> bool:
+        if self.arena is not None and self.arena.contains(object_hex):
+            return True
+        return self.fallback.contains(object_hex)
+
+    def free(self, object_hex: str, meta: Optional[dict] = None):
+        if meta is not None and "seg" in meta:
+            self.fallback.free(object_hex, meta)
+            return
+        if self.arena is not None:
+            self.arena.free(object_hex, meta)
+        if meta is None:
+            self.fallback.free(object_hex)
+
+    def close_all(self):
+        if self.arena is not None:
+            self.arena.close_all()
+        self.fallback.close_all()

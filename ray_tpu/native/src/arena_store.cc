@@ -1,0 +1,504 @@
+// Native shared-memory arena object store (plasma equivalent).
+//
+// Reference behavior being reproduced (not copied):
+//   src/ray/object_manager/plasma/{store.cc,object_store.cc,malloc.cc} — a
+//   node-local shared-memory arena in which every large object lives exactly
+//   once, written by its creator, sealed, then mapped zero-copy by readers,
+//   with pin/release lifetime and delete deferred until the last pin drops.
+//
+// TPU-era design differences: no store server process. The arena is a single
+// /dev/shm file; every process maps it MAP_SHARED and coordinates through a
+// process-shared robust mutex in the arena header. All state lives at stable
+// offsets (never raw pointers) so maps can land anywhere. The allocator is a
+// boundary-tag explicit free list (first fit, split, coalesce) — plasma uses
+// dlmalloc; we need only the create/free pattern of whole objects, where a
+// simple coalescing allocator is equally effective and auditable.
+//
+// Concurrency: one mutex for index + heap. Object payload writes happen
+// OUTSIDE the lock (the creator owns the block until seal; readers cannot see
+// it before the sealed flag is set under the lock). Robustness: if a process
+// dies holding the lock, the next locker gets EOWNERDEAD and marks the state
+// consistent — index/heap invariants hold because all mutations are applied
+// in crash-safe order (allocate fully, then publish).
+
+#include <errno.h>
+#include <fcntl.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <mutex>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x52545F4152454E41ull;  // "RT_ARENA"
+constexpr uint32_t kVersion = 1;
+constexpr uint64_t kAlign = 16;
+constexpr uint64_t kMinBlock = 48;  // hdr(8)+links(16)+ftr(8), padded to 16
+constexpr uint32_t kIdBytes = 28;   // 56 hex chars
+
+inline uint64_t align_up(uint64_t n, uint64_t a) { return (n + a - 1) & ~(a - 1); }
+
+struct Entry {
+  uint8_t id[kIdBytes];
+  uint8_t state;  // 0 empty, 1 created, 2 sealed, 3 tombstone
+  uint8_t deletable;
+  uint16_t _pad;
+  uint32_t pins;
+  uint64_t off;   // payload offset in arena
+  uint64_t size;  // payload size requested by the creator
+  uint64_t seq;   // create sequence, for LRU-ish introspection
+};
+static_assert(sizeof(Entry) == 64, "Entry must be 64 bytes");
+
+enum EntryState : uint8_t { kEmpty = 0, kCreated = 1, kSealed = 2, kTomb = 3 };
+
+struct ArenaHeader {
+  uint64_t magic;
+  uint32_t version;
+  uint32_t index_slots;
+  uint64_t capacity;
+  uint64_t index_off;
+  uint64_t heap_off;
+  uint64_t heap_end;
+  uint64_t free_head;  // offset of first free block header, 0 = none
+  uint64_t bytes_in_use;
+  uint64_t num_objects;
+  uint64_t peak_bytes;
+  uint64_t create_seq;
+  uint64_t num_evictions;
+  pthread_mutex_t mutex;
+};
+
+struct Arena {
+  uint8_t* base = nullptr;
+  uint64_t capacity = 0;
+  char name[256] = {0};
+  bool used = false;
+};
+
+constexpr int kMaxArenas = 1024;
+Arena g_arenas[kMaxArenas];
+std::mutex g_table_mutex;  // protects the process-local arena table
+
+int table_claim_slot() {
+  for (int i = 0; i < kMaxArenas; i++) {
+    if (!g_arenas[i].used) {
+      g_arenas[i].used = true;
+      return i;
+    }
+  }
+  return -1;
+}
+
+bool handle_ok(int h) {
+  return h >= 0 && h < kMaxArenas && g_arenas[h].used;
+}
+
+inline ArenaHeader* hdr(Arena& a) { return reinterpret_cast<ArenaHeader*>(a.base); }
+inline Entry* index_of(Arena& a) {
+  return reinterpret_cast<Entry*>(a.base + hdr(a)->index_off);
+}
+
+// ------------------------------- heap ---------------------------------------
+// Block: [u64 tag][payload...][u64 tag]; tag = size | alloc_bit. Free blocks
+// keep {next,prev} free-list offsets at payload start. Heap is bracketed by an
+// allocated prologue block and a size-0 allocated epilogue tag so coalescing
+// never walks out of bounds.
+
+inline uint64_t& tag_at(Arena& a, uint64_t off) {
+  return *reinterpret_cast<uint64_t*>(a.base + off);
+}
+inline uint64_t blk_size(Arena& a, uint64_t b) { return tag_at(a, b) & ~1ull; }
+inline bool blk_alloc(Arena& a, uint64_t b) { return tag_at(a, b) & 1ull; }
+inline void set_tags(Arena& a, uint64_t b, uint64_t size, bool alloc) {
+  tag_at(a, b) = size | (alloc ? 1 : 0);
+  tag_at(a, b + size - 8) = size | (alloc ? 1 : 0);
+}
+inline uint64_t& free_next(Arena& a, uint64_t b) {
+  return *reinterpret_cast<uint64_t*>(a.base + b + 8);
+}
+inline uint64_t& free_prev(Arena& a, uint64_t b) {
+  return *reinterpret_cast<uint64_t*>(a.base + b + 16);
+}
+
+void free_insert(Arena& a, uint64_t b) {
+  ArenaHeader* h = hdr(a);
+  free_next(a, b) = h->free_head;
+  free_prev(a, b) = 0;
+  if (h->free_head) free_prev(a, h->free_head) = b;
+  h->free_head = b;
+}
+
+void free_remove(Arena& a, uint64_t b) {
+  ArenaHeader* h = hdr(a);
+  uint64_t nx = free_next(a, b), pv = free_prev(a, b);
+  if (pv) free_next(a, pv) = nx; else h->free_head = nx;
+  if (nx) free_prev(a, nx) = pv;
+}
+
+void heap_init(Arena& a) {
+  ArenaHeader* h = hdr(a);
+  uint64_t p = h->heap_off;
+  set_tags(a, p, 16, true);  // prologue
+  uint64_t big = p + 16;
+  uint64_t big_size = (h->heap_end - 8) - big;  // leave 8 for epilogue tag
+  big_size &= ~(kAlign - 1);
+  set_tags(a, big, big_size, false);
+  tag_at(a, big + big_size) = 0 | 1ull;  // epilogue: size 0, allocated
+  h->free_head = 0;
+  free_insert(a, big);
+}
+
+// Returns block offset or 0 on OOM. size = total block size (already padded).
+uint64_t heap_alloc(Arena& a, uint64_t need) {
+  uint64_t b = hdr(a)->free_head;
+  while (b) {
+    uint64_t sz = blk_size(a, b);
+    if (sz >= need) {
+      free_remove(a, b);
+      if (sz - need >= kMinBlock) {
+        set_tags(a, b, need, true);
+        uint64_t rest = b + need;
+        set_tags(a, rest, sz - need, false);
+        free_insert(a, rest);
+      } else {
+        set_tags(a, b, sz, true);
+      }
+      return b;
+    }
+    b = free_next(a, b);
+  }
+  return 0;
+}
+
+void heap_free(Arena& a, uint64_t b) {
+  uint64_t sz = blk_size(a, b);
+  // coalesce right
+  uint64_t right = b + sz;
+  if (!blk_alloc(a, right)) {
+    free_remove(a, right);
+    sz += blk_size(a, right);
+  }
+  // coalesce left
+  uint64_t left_ftr = b - 8;
+  if (!(tag_at(a, left_ftr) & 1ull)) {
+    uint64_t lsz = tag_at(a, left_ftr) & ~1ull;
+    uint64_t left = b - lsz;
+    free_remove(a, left);
+    b = left;
+    sz += lsz;
+  }
+  set_tags(a, b, sz, false);
+  free_insert(a, b);
+}
+
+// ------------------------------- index --------------------------------------
+
+uint64_t fnv1a(const uint8_t* p, size_t n) {
+  uint64_t h = 1469598103934665603ull;
+  for (size_t i = 0; i < n; i++) { h ^= p[i]; h *= 1099511628211ull; }
+  return h;
+}
+
+int hex_to_id(const char* hex, uint8_t out[kIdBytes]) {
+  for (uint32_t i = 0; i < kIdBytes; i++) {
+    int v = 0;
+    for (int j = 0; j < 2; j++) {
+      char c = hex[2 * i + j];
+      int d;
+      if (c >= '0' && c <= '9') d = c - '0';
+      else if (c >= 'a' && c <= 'f') d = c - 'a' + 10;
+      else if (c >= 'A' && c <= 'F') d = c - 'A' + 10;
+      else return -1;
+      v = (v << 4) | d;
+    }
+    out[i] = (uint8_t)v;
+  }
+  return 0;
+}
+
+// Find entry for id; returns slot index or -1. If insert, returns first
+// usable slot (empty/tombstone) when the id is absent.
+int64_t index_find(Arena& a, const uint8_t id[kIdBytes], bool insert) {
+  ArenaHeader* h = hdr(a);
+  Entry* idx = index_of(a);
+  uint32_t slots = h->index_slots;
+  uint64_t start = fnv1a(id, kIdBytes) & (slots - 1);
+  int64_t first_free = -1;
+  for (uint32_t i = 0; i < slots; i++) {
+    uint32_t s = (start + i) & (slots - 1);
+    Entry& e = idx[s];
+    if (e.state == kEmpty) {
+      if (insert) return first_free >= 0 ? first_free : s;
+      return -1;
+    }
+    if (e.state == kTomb) {
+      if (first_free < 0) first_free = s;
+      continue;
+    }
+    if (memcmp(e.id, id, kIdBytes) == 0) return s;
+  }
+  return insert ? first_free : -1;
+}
+
+struct LockGuard {
+  pthread_mutex_t* m;
+  explicit LockGuard(pthread_mutex_t* mu) : m(mu) {
+    int rc = pthread_mutex_lock(m);
+    if (rc == EOWNERDEAD) pthread_mutex_consistent(m);
+  }
+  ~LockGuard() { pthread_mutex_unlock(m); }
+};
+
+}  // namespace
+
+// ------------------------------- C API --------------------------------------
+
+extern "C" {
+
+// Create the arena file (fails with -EEXIST if it already exists).
+// capacity covers header + index + heap. index_slots must be a power of two.
+int rt_arena_create(const char* name, uint64_t capacity, uint32_t index_slots) {
+  if (index_slots == 0 || (index_slots & (index_slots - 1))) return -EINVAL;
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return -errno;
+  if (ftruncate(fd, (off_t)capacity) != 0) {
+    int e = errno; close(fd); shm_unlink(name); return -e;
+  }
+  void* base = mmap(nullptr, capacity, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) { shm_unlink(name); return -errno; }
+
+  ArenaHeader* h = reinterpret_cast<ArenaHeader*>(base);
+  memset(h, 0, sizeof(ArenaHeader));
+  h->version = kVersion;
+  h->index_slots = index_slots;
+  h->capacity = capacity;
+  h->index_off = align_up(sizeof(ArenaHeader), 64);
+  uint64_t index_bytes = (uint64_t)index_slots * sizeof(Entry);
+  h->heap_off = align_up(h->index_off + index_bytes, 4096);
+  h->heap_end = capacity;
+  if (h->heap_off + (1 << 16) > h->heap_end) { munmap(base, capacity); shm_unlink(name); return -EINVAL; }
+  memset((uint8_t*)base + h->index_off, 0, index_bytes);
+
+  pthread_mutexattr_t attr;
+  pthread_mutexattr_init(&attr);
+  pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mutex, &attr);
+  pthread_mutexattr_destroy(&attr);
+
+  std::lock_guard<std::mutex> tg(g_table_mutex);
+  int slot = table_claim_slot();
+  if (slot < 0) { munmap(base, capacity); shm_unlink(name); return -ENOMEM; }
+  Arena& a = g_arenas[slot];
+  a.base = (uint8_t*)base;
+  a.capacity = capacity;
+  memset(a.name, 0, sizeof(a.name));
+  strncpy(a.name, name, sizeof(a.name) - 1);
+  heap_init(a);
+  __sync_synchronize();
+  h->magic = kMagic;  // publish: attachers spin on magic
+  return slot;
+}
+
+// Attach an existing arena; returns handle or negative errno.
+int rt_arena_attach(const char* name) {
+  {
+    std::lock_guard<std::mutex> tg(g_table_mutex);
+    for (int i = 0; i < kMaxArenas; i++) {
+      if (g_arenas[i].used && strcmp(g_arenas[i].name, name) == 0) return i;
+    }
+  }
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return -errno;
+  struct stat st;
+  if (fstat(fd, &st) != 0) { int e = errno; close(fd); return -e; }
+  void* base = mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) return -errno;
+  ArenaHeader* h = reinterpret_cast<ArenaHeader*>(base);
+  if (h->magic != kMagic || h->version != kVersion) {
+    munmap(base, st.st_size);
+    return -EPROTO;
+  }
+  std::lock_guard<std::mutex> tg(g_table_mutex);
+  int slot = table_claim_slot();
+  if (slot < 0) { munmap(base, st.st_size); return -ENOMEM; }
+  Arena& a = g_arenas[slot];
+  a.base = (uint8_t*)base;
+  a.capacity = (uint64_t)st.st_size;
+  memset(a.name, 0, sizeof(a.name));
+  strncpy(a.name, name, sizeof(a.name) - 1);
+  return slot;
+}
+
+int rt_arena_unlink(const char* name) {
+  return shm_unlink(name) == 0 ? 0 : -errno;
+}
+
+// Unmap this process's view and free the handle slot. Only safe once no
+// zero-copy views into the mapping remain in this process.
+int rt_arena_detach(int handle) {
+  std::lock_guard<std::mutex> tg(g_table_mutex);
+  if (!handle_ok(handle)) return -EBADF;
+  Arena& a = g_arenas[handle];
+  munmap(a.base, a.capacity);
+  a.base = nullptr;
+  a.capacity = 0;
+  a.name[0] = 0;
+  a.used = false;
+  return 0;
+}
+
+// Base pointer for this process's mapping (Python builds memoryviews on it).
+void* rt_arena_base(int handle) {
+  if (!handle_ok(handle)) return nullptr;
+  return g_arenas[handle].base;
+}
+
+uint64_t rt_arena_capacity(int handle) {
+  if (!handle_ok(handle)) return 0;
+  return g_arenas[handle].capacity;
+}
+
+// Allocate + register an object. Returns payload offset, or negative errno
+// (-EEXIST id taken, -ENOSPC no contiguous space, -ENFILE index full).
+int64_t rt_obj_create(int handle, const char* id_hex, uint64_t size) {
+  if (!handle_ok(handle)) return -EBADF;
+  Arena& a = g_arenas[handle];
+  uint8_t id[kIdBytes];
+  if (hex_to_id(id_hex, id) != 0) return -EINVAL;
+  ArenaHeader* h = hdr(a);
+  LockGuard g(&h->mutex);
+  int64_t s = index_find(a, id, /*insert=*/true);
+  if (s < 0) return -ENFILE;
+  Entry& e = index_of(a)[s];
+  if (e.state == kCreated || e.state == kSealed) return -EEXIST;
+  uint64_t need = align_up(size + 16, kAlign);  // +hdr/ftr tags
+  if (need < kMinBlock) need = kMinBlock;
+  uint64_t b = heap_alloc(a, need);
+  if (b == 0) return -ENOSPC;
+  memcpy(e.id, id, kIdBytes);
+  e.state = kCreated;
+  e.deletable = 0;
+  e.pins = 1;  // creator's pin; dropped by rt_obj_delete
+  e.off = b + 8;
+  e.size = size;
+  e.seq = ++h->create_seq;
+  h->bytes_in_use += blk_size(a, b);
+  h->num_objects += 1;
+  if (h->bytes_in_use > h->peak_bytes) h->peak_bytes = h->bytes_in_use;
+  return (int64_t)e.off;
+}
+
+int rt_obj_seal(int handle, const char* id_hex) {
+  if (!handle_ok(handle)) return -EBADF;
+  Arena& a = g_arenas[handle];
+  uint8_t id[kIdBytes];
+  if (hex_to_id(id_hex, id) != 0) return -EINVAL;
+  ArenaHeader* h = hdr(a);
+  LockGuard g(&h->mutex);
+  int64_t s = index_find(a, id, false);
+  if (s < 0) return -ENOENT;
+  Entry& e = index_of(a)[s];
+  if (e.state != kCreated) return -EINVAL;
+  e.state = kSealed;
+  return 0;
+}
+
+// Pin + locate a sealed object. Returns payload offset (size in *size_out),
+// -ENOENT if absent or not sealed yet.
+int64_t rt_obj_get(int handle, const char* id_hex, uint64_t* size_out) {
+  if (!handle_ok(handle)) return -EBADF;
+  Arena& a = g_arenas[handle];
+  uint8_t id[kIdBytes];
+  if (hex_to_id(id_hex, id) != 0) return -EINVAL;
+  ArenaHeader* h = hdr(a);
+  LockGuard g(&h->mutex);
+  int64_t s = index_find(a, id, false);
+  if (s < 0) return -ENOENT;
+  Entry& e = index_of(a)[s];
+  if (e.state != kSealed) return -ENOENT;
+  e.pins += 1;
+  if (size_out) *size_out = e.size;
+  return (int64_t)e.off;
+}
+
+static void entry_reclaim_locked(Arena& a, Entry& e) {
+  ArenaHeader* h = hdr(a);
+  uint64_t b = e.off - 8;
+  h->bytes_in_use -= blk_size(a, b);
+  h->num_objects -= 1;
+  heap_free(a, b);
+  e.state = kTomb;
+  e.pins = 0;
+  e.deletable = 0;
+}
+
+// Drop one pin (reader-side). Reclaims if deletable and pins hit zero.
+int rt_obj_release(int handle, const char* id_hex) {
+  if (!handle_ok(handle)) return -EBADF;
+  Arena& a = g_arenas[handle];
+  uint8_t id[kIdBytes];
+  if (hex_to_id(id_hex, id) != 0) return -EINVAL;
+  ArenaHeader* h = hdr(a);
+  LockGuard g(&h->mutex);
+  int64_t s = index_find(a, id, false);
+  if (s < 0) return -ENOENT;
+  Entry& e = index_of(a)[s];
+  if (e.pins == 0) return -EINVAL;
+  e.pins -= 1;
+  if (e.pins == 0 && e.deletable) entry_reclaim_locked(a, e);
+  return 0;
+}
+
+// Owner-side delete: drop the creator pin, mark deletable; memory returns to
+// the free list once every reader pin is released.
+int rt_obj_delete(int handle, const char* id_hex) {
+  if (!handle_ok(handle)) return -EBADF;
+  Arena& a = g_arenas[handle];
+  uint8_t id[kIdBytes];
+  if (hex_to_id(id_hex, id) != 0) return -EINVAL;
+  ArenaHeader* h = hdr(a);
+  LockGuard g(&h->mutex);
+  int64_t s = index_find(a, id, false);
+  if (s < 0) return -ENOENT;
+  Entry& e = index_of(a)[s];
+  if (e.state != kCreated && e.state != kSealed) return -ENOENT;
+  e.deletable = 1;
+  if (e.pins > 0) e.pins -= 1;
+  if (e.pins == 0) entry_reclaim_locked(a, e);
+  return 0;
+}
+
+int rt_obj_contains(int handle, const char* id_hex) {
+  if (!handle_ok(handle)) return 0;
+  Arena& a = g_arenas[handle];
+  uint8_t id[kIdBytes];
+  if (hex_to_id(id_hex, id) != 0) return 0;
+  ArenaHeader* h = hdr(a);
+  LockGuard g(&h->mutex);
+  int64_t s = index_find(a, id, false);
+  if (s < 0) return 0;
+  return index_of(a)[s].state == kSealed ? 1 : 0;
+}
+
+void rt_arena_stats(int handle, uint64_t* bytes_in_use, uint64_t* num_objects,
+                    uint64_t* capacity, uint64_t* peak_bytes) {
+  if (!handle_ok(handle)) return;
+  Arena& a = g_arenas[handle];
+  ArenaHeader* h = hdr(a);
+  LockGuard g(&h->mutex);
+  if (bytes_in_use) *bytes_in_use = h->bytes_in_use;
+  if (num_objects) *num_objects = h->num_objects;
+  if (capacity) *capacity = h->heap_end - h->heap_off;
+  if (peak_bytes) *peak_bytes = h->peak_bytes;
+}
+
+}  // extern "C"

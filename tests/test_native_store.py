@@ -1,0 +1,222 @@
+"""Native shm arena store: allocator, pin/delete lifetime, cross-process.
+
+Mirrors the reference's plasma tests
+(src/ray/object_manager/plasma/test/object_store_test.cc — create/seal/get/
+delete lifecycle) against our arena client.
+"""
+import multiprocessing as mp
+import os
+import secrets
+
+import pytest
+
+from ray_tpu.native import load_library
+from ray_tpu.native.arena import HybridShmStore, NativeArenaStore
+
+pytestmark = pytest.mark.skipif(
+    load_library() is None, reason="native toolchain unavailable"
+)
+
+
+def _hex() -> str:
+    return secrets.token_hex(28)
+
+
+@pytest.fixture
+def arena():
+    name = f"/rt_test_{os.getpid()}_{secrets.token_hex(4)}"
+    store = NativeArenaStore(name, capacity=1 << 24)
+    yield store
+    store.close_all()
+
+
+def test_roundtrip_frames(arena):
+    oid = _hex()
+    frames = [b"header-bytes", b"x" * 100_000, b""]
+    meta = arena.put_frames(oid, frames)
+    assert meta["arena"] == arena.name
+    got = arena.get_frames(oid, meta)
+    assert [bytes(f) for f in got] == frames
+    assert arena.contains(oid)
+
+
+def test_get_is_zero_copy(arena):
+    oid = _hex()
+    arena.put_frames(oid, [b"a" * 4096])
+    v1 = arena.get_frames(oid, {})[0]
+    v2 = arena.get_frames(oid, {})[0]
+    # Same underlying arena memory, not copies.
+    import ctypes
+    a1 = ctypes.addressof(ctypes.c_char.from_buffer(v1))
+    a2 = ctypes.addressof(ctypes.c_char.from_buffer(v2))
+    assert a1 == a2
+
+
+def test_missing_object(arena):
+    assert arena.get_frames(_hex(), {}) is None
+    assert not arena.contains(_hex())
+
+
+def test_delete_reclaims_memory(arena):
+    base = arena.stats()["bytes_in_use"]
+    oids = []
+    for _ in range(16):
+        oid = _hex()
+        arena.put_frames(oid, [b"y" * 50_000])
+        oids.append(oid)
+    assert arena.stats()["num_objects"] == 16
+    for oid in oids:
+        arena.free(oid)
+    st = arena.stats()
+    assert st["num_objects"] == 0
+    assert st["bytes_in_use"] == base
+
+
+def test_pinned_object_survives_delete(arena):
+    import gc
+
+    oid = _hex()
+    arena.put_frames(oid, [b"z" * 1000])
+    view = arena.get_frames(oid, {})[0]  # pin rides the view's lifetime
+    # Creator deletes while the reader view is live: memory must not be
+    # reused until the view dies (plasma pin semantics).
+    in_use = arena.stats()["bytes_in_use"]
+    arena._created.discard(oid)  # simulate owner in another process
+    arena._lib.rt_obj_delete(arena._h, oid.encode())
+    assert arena.stats()["bytes_in_use"] == in_use  # still held by pin
+    assert bytes(view) == b"z" * 1000
+    del view
+    gc.collect()
+    assert arena.stats()["bytes_in_use"] < in_use
+
+
+def test_coalescing_allows_large_realloc(arena):
+    # Fill with small objects, free them all, then allocate one block that
+    # only fits if neighbors coalesced back into a single free range.
+    cap = arena.stats()["capacity"]
+    oids = []
+    small = (cap // 64) & ~15
+    for _ in range(32):
+        oid = _hex()
+        if arena.put_frames(oid, [b"s" * small]) is None:
+            break
+        oids.append(oid)
+    for oid in oids:
+        arena.free(oid)
+    big = int(cap * 0.75)
+    oid = _hex()
+    assert arena.put_frames(oid, [b"B" * big]) is not None
+    arena.free(oid)
+
+
+def test_arena_full_returns_none(arena):
+    cap = arena.stats()["capacity"]
+    oid = _hex()
+    assert arena.put_frames(oid, [b"Q" * (cap * 2)]) is None
+
+
+def test_duplicate_create_raises(arena):
+    oid = _hex()
+    arena.put_frames(oid, [b"1"])
+    with pytest.raises(RuntimeError):
+        arena.put_frames(oid, [b"2"])
+
+
+def _child_reader(name, oid, payload_len, q):
+    try:
+        store = NativeArenaStore(name, create=False)
+        frames = store.get_frames(oid, {})
+        q.put(("ok", bytes(frames[1]) == b"p" * payload_len))
+    except Exception as e:  # pragma: no cover - diagnostic path
+        q.put(("err", repr(e)))
+
+
+def test_cross_process_read():
+    name = f"/rt_test_xp_{os.getpid()}_{secrets.token_hex(4)}"
+    store = NativeArenaStore(name, capacity=1 << 24)
+    try:
+        oid = _hex()
+        store.put_frames(oid, [b"hdr", b"p" * 10_000])
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        p = ctx.Process(target=_child_reader, args=(name, oid, 10_000, q))
+        p.start()
+        status, ok = q.get(timeout=30)
+        p.join(timeout=10)
+        assert status == "ok", ok
+        assert ok
+    finally:
+        store.close_all()
+
+
+def _child_writer(name, oid, q):
+    try:
+        store = NativeArenaStore(name, create=False)
+        store.put_frames(oid, [b"from-child" * 100])
+        q.put("ok")
+        # Exit WITHOUT delete: creator pin leaks, object must stay readable.
+    except Exception as e:  # pragma: no cover
+        q.put(repr(e))
+
+
+def test_cross_process_write_then_parent_read():
+    name = f"/rt_test_xw_{os.getpid()}_{secrets.token_hex(4)}"
+    store = NativeArenaStore(name, capacity=1 << 24)
+    try:
+        oid = _hex()
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        p = ctx.Process(target=_child_writer, args=(name, oid, q))
+        p.start()
+        assert q.get(timeout=30) == "ok"
+        p.join(timeout=10)
+        frames = store.get_frames(oid, {})
+        assert bytes(frames[0]) == b"from-child" * 100
+    finally:
+        store.close_all()
+
+
+def test_hybrid_falls_back_when_arena_full():
+    name = f"/rt_test_hy_{os.getpid()}_{secrets.token_hex(4)}"
+    store = HybridShmStore(name)
+    try:
+        if store.arena is None:
+            pytest.skip("no native arena")
+        cap = store.arena.stats()["capacity"]
+        oid = _hex()
+        meta = store.put_frames(oid, [b"W" * (cap * 2)])
+        assert "seg" in meta  # portable fallback segment
+        got = store.get_frames(oid, meta)
+        assert bytes(got[0]) == b"W" * (cap * 2)
+        store.free(oid, meta)
+    finally:
+        store.close_all()
+
+
+def test_many_alloc_free_cycles(arena):
+    """Allocator churn: interleaved sizes, no leak at the end."""
+    import random
+
+    rng = random.Random(0)
+    live = {}
+    base = arena.stats()["bytes_in_use"]
+    for i in range(400):
+        if live and (rng.random() < 0.45 or len(live) > 40):
+            oid = rng.choice(list(live))
+            n = live.pop(oid)
+            got = arena.get_frames(oid, {})
+            assert len(got[0]) == n
+            arena.free(oid)
+        else:
+            oid = _hex()
+            n = rng.randrange(10, 60_000)
+            if arena.put_frames(oid, [bytes([i % 256]) * n]) is not None:
+                live[oid] = n
+    for oid in list(live):
+        arena.free(oid)
+    del got
+    import gc
+
+    gc.collect()  # drop view pins so deletable blocks reclaim
+    assert arena.stats()["bytes_in_use"] == base
+    assert arena.stats()["num_objects"] == 0
