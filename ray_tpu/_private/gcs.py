@@ -658,6 +658,8 @@ class HeadService:
 
     async def rpc_object_free(self, h, frames, conn):
         metas = [self.object_dir.pop(oid, None) for oid in h["oids"]]
+        # Fan out so borrower processes evict cached copies/pins.
+        self.publish("object_free", {"oids": h["oids"]})
         return {"metas": [m for m in metas if m]}, []
 
     # ------------------------------------------------------------- jobs/state

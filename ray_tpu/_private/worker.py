@@ -249,6 +249,11 @@ class CoreWorker:
         self.gcs = await protocol.connect(
             self.gcs_addr, self._handle_rpc, name="gcs-client"
         )
+        # Object-free fan-out: evict borrowed copies when the owner frees.
+        self.pubsub_handlers.setdefault("object_free", []).append(
+            lambda data, frames: self._evict_freed(data.get("oids", []))
+        )
+        await self.gcs.call("subscribe", {"channel": "object_free"})
         if self.is_driver:
             await self.gcs.call("register_job", {"job_id": self.job_id.hex()})
         else:
@@ -1135,11 +1140,19 @@ class CoreWorker:
         return {}, []
 
     async def rpc_free_object(self, h, frames, conn):
-        for oid in h["oids"]:
+        self._evict_freed(h["oids"])
+        return {}, []
+
+    def _evict_freed(self, oids):
+        """Global free fan-out (via GCS pubsub): drop borrowed copies —
+        cached inline pulls, pulled shm descriptors, local segment attaches.
+        Owned entries are freed by _maybe_free, not here."""
+        for oid in oids:
+            if oid in self.owned:
+                continue
             self.memory_store.pop(oid, None)
             if self._shm is not None:
                 self._shm.free(oid)
-        return {}, []
 
     async def _materialize_args(self, header, frames):
         arg_slots, plain, kwargs = self.ctx.deserialize_frames(frames)

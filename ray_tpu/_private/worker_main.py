@@ -16,6 +16,64 @@ import signal
 import sys
 
 
+def _install_jax_platform_pin():
+    """Re-assert JAX_PLATFORMS via jax.config the moment jax is imported.
+
+    If jax is already loaded, pin now; otherwise install a meta-path hook
+    that fires once after the real jax module executes.
+    """
+    plat = os.environ.get("JAX_PLATFORMS")
+    if not plat:
+        return
+
+    def pin(jax_mod):
+        try:
+            jax_mod.config.update("jax_platforms", plat)
+        except Exception:
+            pass
+
+    if "jax" in sys.modules:
+        pin(sys.modules["jax"])
+        return
+
+    import importlib.abc
+    import importlib.machinery
+
+    class _PinningLoader(importlib.abc.Loader):
+        def __init__(self, inner):
+            self._inner = inner
+
+        def create_module(self, spec):
+            return self._inner.create_module(spec)
+
+        def exec_module(self, module):
+            self._inner.exec_module(module)
+            pin(module)
+            try:
+                sys.meta_path.remove(finder)
+            except ValueError:
+                pass
+
+    class _Finder(importlib.abc.MetaPathFinder):
+        def find_spec(self, name, path, target=None):
+            if name != "jax":
+                return None
+            sys.meta_path.remove(finder)  # avoid recursion
+            try:
+                spec = importlib.util.find_spec(name)
+            finally:
+                sys.meta_path.insert(0, finder)
+            if spec is None or spec.loader is None:
+                return None
+            spec.loader = _PinningLoader(spec.loader)
+            return spec
+
+    import importlib.util
+
+    finder = _Finder()
+    sys.meta_path.insert(0, finder)
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--gcs-host", required=True)
@@ -42,6 +100,13 @@ def main(argv=None):
     resources = json.loads(args.resources)
     if resources.get("TPU", 0) <= 0:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # The env var alone is not enough: site hooks (e.g. a PJRT plugin
+    # registered from sitecustomize) can programmatically force a platform at
+    # interpreter start, silently overriding the inherited env and pointing
+    # CPU-resource workers at the TPU. Backends initialize lazily, so
+    # re-asserting the config right after jax's import wins — hooked lazily
+    # so non-jax workloads don't pay the multi-second jax import at spawn.
+    _install_jax_platform_pin()
 
     from ray_tpu._private import worker as worker_mod
     from ray_tpu._private.ids import JobID

@@ -24,14 +24,30 @@ _lib = None
 _tried = False
 
 
+def _needs_build() -> bool:
+    return not os.path.exists(_LIB_PATH) or (
+        os.path.exists(_SRC)
+        and os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)
+    )
+
+
 def _build() -> bool:
+    """Build under an exclusive file lock: N workers can start concurrently
+    and must not relink the .so while another process dlopens it (the link
+    itself is also atomic — temp output + rename, see Makefile)."""
+    import fcntl
+
     try:
-        res = subprocess.run(
-            ["make", "-C", _DIR],
-            capture_output=True,
-            text=True,
-            timeout=120,
-        )
+        with open(os.path.join(_DIR, ".build.lock"), "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            if not _needs_build():  # another process built while we waited
+                return True
+            res = subprocess.run(
+                ["make", "-C", _DIR],
+                capture_output=True,
+                text=True,
+                timeout=120,
+            )
     except (OSError, subprocess.TimeoutExpired) as e:
         logger.warning("native build unavailable: %s", e)
         return False
@@ -48,10 +64,7 @@ def load_library():
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_LIB_PATH) or (
-            os.path.exists(_SRC)
-            and os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)
-        ):
+        if _needs_build():
             if not _build():
                 return None
         try:
@@ -98,5 +111,11 @@ def load_library():
             ctypes.POINTER(ctypes.c_uint64),
         ]
         lib.rt_arena_stats.restype = None
+        lib.rt_test_hold_lock.argtypes = [ctypes.c_int]
+        lib.rt_test_hold_lock.restype = ctypes.c_int
+        lib.rt_arena_num_tombs.argtypes = [ctypes.c_int]
+        lib.rt_arena_num_tombs.restype = ctypes.c_uint64
+        lib.rt_arena_scrub.argtypes = [ctypes.c_int]
+        lib.rt_arena_scrub.restype = ctypes.c_int
         _lib = lib
         return _lib

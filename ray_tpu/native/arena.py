@@ -19,6 +19,7 @@ import ctypes
 import logging
 import os
 import struct
+import time
 import weakref
 from typing import List, Optional
 
@@ -54,7 +55,7 @@ class NativeArenaStore:
     library is unavailable or the arena cannot be created/attached."""
 
     def __init__(self, name: str, capacity: int = DEFAULT_CAPACITY,
-                 create: bool = True):
+                 create: bool = True, index_slots: int = INDEX_SLOTS):
         lib = _native.load_library()
         if lib is None:
             raise RuntimeError("native library unavailable")
@@ -64,11 +65,18 @@ class NativeArenaStore:
         h = lib.rt_arena_attach(name.encode())
         if h < 0 and create:
             cap = _shm_budget(capacity)
-            h = lib.rt_arena_create(name.encode(), cap, INDEX_SLOTS)
+            h = lib.rt_arena_create(name.encode(), cap, index_slots)
             if h >= 0:
                 self.created_arena = True
             elif h == -17:  # EEXIST: lost the creation race
                 h = lib.rt_arena_attach(name.encode())
+        # The creator publishes the header magic last; an attach landing in
+        # its init window (file exists, magic unset → EPROTO/EINVAL) must
+        # wait it out, not fall back for the process's whole lifetime.
+        deadline = time.monotonic() + 5.0
+        while h < 0 and h != -2 and time.monotonic() < deadline:  # -2=ENOENT
+            time.sleep(0.02)
+            h = lib.rt_arena_attach(name.encode())
         if h < 0:
             raise RuntimeError(f"arena {name}: errno {-h}")
         self._h = h

@@ -24,7 +24,10 @@
 #include <errno.h>
 #include <fcntl.h>
 #include <pthread.h>
+#include <signal.h>
+#include <stdio.h>
 #include <stdint.h>
+#include <stdlib.h>
 #include <string.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
@@ -36,7 +39,7 @@
 namespace {
 
 constexpr uint64_t kMagic = 0x52545F4152454E41ull;  // "RT_ARENA"
-constexpr uint32_t kVersion = 1;
+constexpr uint32_t kVersion = 3;
 constexpr uint64_t kAlign = 16;
 constexpr uint64_t kMinBlock = 48;  // hdr(8)+links(16)+ftr(8), padded to 16
 constexpr uint32_t kIdBytes = 28;   // 56 hex chars
@@ -57,12 +60,36 @@ static_assert(sizeof(Entry) == 64, "Entry must be 64 bytes");
 
 enum EntryState : uint8_t { kEmpty = 0, kCreated = 1, kSealed = 2, kTomb = 3 };
 
+// Per-process pin accounting. Every process that maps the arena claims a
+// ClientSlot; every pin it takes (creator pin at create, reader pin at get)
+// is mirrored into its pin ledger. If the process dies without releasing
+// (SIGKILL, actor kill at scale-down), a scrub detects the dead pid and
+// subtracts its ledger from the entries — the serverless stand-in for
+// plasma's client-disconnect cleanup (reference: plasma store releases all
+// of a client's objects when its socket closes).
+constexpr uint32_t kMaxClients = 32;
+
+struct ClientSlot {
+  uint32_t state;  // 0 free, 1 live
+  uint32_t pid;
+  uint64_t starttime;  // /proc/<pid>/stat field 22 (guards pid reuse)
+};
+static_assert(sizeof(ClientSlot) == 16, "ClientSlot must be 16 bytes");
+
+struct PinRec {
+  uint8_t id[kIdBytes];
+  uint32_t count;  // 0 + zero id = empty; 0 + id = tombstone
+};
+static_assert(sizeof(PinRec) == 32, "PinRec must be 32 bytes");
+
 struct ArenaHeader {
   uint64_t magic;
   uint32_t version;
   uint32_t index_slots;
   uint64_t capacity;
-  uint64_t index_off;
+  uint64_t index_off;     // two index regions live here, back to back
+  uint32_t active_index;  // 0/1: which region is live (flipped atomically)
+  uint32_t _pad0;
   uint64_t heap_off;
   uint64_t heap_end;
   uint64_t free_head;  // offset of first free block header, 0 = none
@@ -71,6 +98,11 @@ struct ArenaHeader {
   uint64_t peak_bytes;
   uint64_t create_seq;
   uint64_t num_evictions;
+  uint64_t num_tombs;
+  uint64_t epilogue_off;  // position of the size-0 terminator tag
+  uint64_t client_off;    // ClientSlot[kMaxClients] then the pin ledgers
+  uint32_t pin_slots;     // ledger slots per client (power of two)
+  uint32_t _pad1;
   pthread_mutex_t mutex;
 };
 
@@ -79,6 +111,7 @@ struct Arena {
   uint64_t capacity = 0;
   char name[256] = {0};
   bool used = false;
+  int client = -1;  // this process's ClientSlot for this arena
 };
 
 constexpr int kMaxArenas = 1024;
@@ -100,8 +133,110 @@ bool handle_ok(int h) {
 }
 
 inline ArenaHeader* hdr(Arena& a) { return reinterpret_cast<ArenaHeader*>(a.base); }
+inline uint64_t index_region_bytes(ArenaHeader* h) {
+  return (uint64_t)h->index_slots * sizeof(Entry);
+}
 inline Entry* index_of(Arena& a) {
-  return reinterpret_cast<Entry*>(a.base + hdr(a)->index_off);
+  ArenaHeader* h = hdr(a);
+  return reinterpret_cast<Entry*>(
+      a.base + h->index_off + (h->active_index ? index_region_bytes(h) : 0));
+}
+inline Entry* index_inactive(Arena& a) {
+  ArenaHeader* h = hdr(a);
+  return reinterpret_cast<Entry*>(
+      a.base + h->index_off + (h->active_index ? 0 : index_region_bytes(h)));
+}
+
+inline ClientSlot* clients_of(Arena& a) {
+  return reinterpret_cast<ClientSlot*>(a.base + hdr(a)->client_off);
+}
+inline PinRec* pin_ledger(Arena& a, uint32_t client) {
+  ArenaHeader* h = hdr(a);
+  uint64_t base = h->client_off + kMaxClients * sizeof(ClientSlot);
+  return reinterpret_cast<PinRec*>(
+      a.base + base + (uint64_t)client * h->pin_slots * sizeof(PinRec));
+}
+
+// starttime from /proc/<pid>/stat (field 22, counted after the comm field,
+// which may itself contain spaces/parens — parse from the last ')').
+uint64_t read_starttime(uint32_t pid) {
+  char path[64];
+  snprintf(path, sizeof(path), "/proc/%u/stat", pid);
+  FILE* f = fopen(path, "r");
+  if (!f) return 0;
+  char buf[1024];
+  size_t n = fread(buf, 1, sizeof(buf) - 1, f);
+  fclose(f);
+  buf[n] = 0;
+  char* p = strrchr(buf, ')');
+  if (!p) return 0;
+  p++;
+  // after ')': state is field 3; starttime is field 22 → 20th token
+  uint64_t val = 0;
+  for (int field = 3; field <= 22; field++) {
+    while (*p == ' ') p++;
+    if (field == 22) {
+      val = strtoull(p, nullptr, 10);
+      break;
+    }
+    while (*p && *p != ' ') p++;
+  }
+  return val;
+}
+
+bool process_alive(uint32_t pid, uint64_t starttime) {
+  if (kill((pid_t)pid, 0) != 0 && errno == ESRCH) return false;
+  if (starttime != 0) {
+    uint64_t now = read_starttime(pid);
+    if (now != 0 && now != starttime) return false;  // pid was reused
+  }
+  return true;
+}
+
+uint64_t fnv1a(const uint8_t* p, size_t n);  // fwd decl (defined below)
+
+// Ledger add/sub for one id. delta=+1 inserts or increments; delta=-1
+// decrements and tombstone-clears (with backward empty-conversion when the
+// probe successor is empty, bounding tombstone buildup).
+void pin_log_add(Arena& a, int client, const uint8_t* id, int delta) {
+  if (client < 0) return;
+  ArenaHeader* h = hdr(a);
+  PinRec* tab = pin_ledger(a, (uint32_t)client);
+  uint32_t slots = h->pin_slots;
+  uint64_t start = fnv1a(id, kIdBytes) & (slots - 1);
+  int64_t first_tomb = -1;
+  for (uint32_t i = 0; i < slots; i++) {
+    uint32_t sidx = (start + i) & (slots - 1);
+    PinRec& r = tab[sidx];
+    bool id_zero = r.id[0] == 0 && memcmp(r.id, r.id + 1, kIdBytes - 1) == 0;
+    if (r.count == 0 && id_zero) {
+      // empty terminator
+      if (delta > 0) {
+        uint32_t use = first_tomb >= 0 ? (uint32_t)first_tomb : sidx;
+        memcpy(tab[use].id, id, kIdBytes);
+        tab[use].count = (uint32_t)delta;
+      }
+      return;
+    }
+    if (memcmp(r.id, id, kIdBytes) == 0) {
+      if (delta > 0) {
+        r.count += (uint32_t)delta;
+      } else if (r.count > 0) {
+        r.count -= 1;
+        if (r.count == 0) {
+          // tombstone; convert to empty if the successor is empty
+          uint32_t nxt = (sidx + 1) & (slots - 1);
+          PinRec& rn = tab[nxt];
+          bool nxt_empty = rn.count == 0 && rn.id[0] == 0 &&
+                           memcmp(rn.id, rn.id + 1, kIdBytes - 1) == 0;
+          if (nxt_empty) memset(r.id, 0, kIdBytes);
+        }
+      }
+      return;
+    }
+    if (r.count == 0 && first_tomb < 0) first_tomb = sidx;
+  }
+  // ledger full: pin goes unlogged (unscrubbable but functionally correct)
 }
 
 // ------------------------------- heap ---------------------------------------
@@ -150,6 +285,7 @@ void heap_init(Arena& a) {
   big_size &= ~(kAlign - 1);
   set_tags(a, big, big_size, false);
   tag_at(a, big + big_size) = 0 | 1ull;  // epilogue: size 0, allocated
+  h->epilogue_off = big + big_size;
   h->free_head = 0;
   free_insert(a, big);
 }
@@ -246,14 +382,202 @@ int64_t index_find(Arena& a, const uint8_t id[kIdBytes], bool insert) {
   return insert ? first_free : -1;
 }
 
+// Crash recovery after EOWNERDEAD: a process died while mutating the heap
+// free list or index. Header tags are the authority — rebuild the free list
+// (coalescing adjacent free blocks), recompute stats, and tomb index entries
+// whose block no longer looks like a live allocation. If the tags themselves
+// are torn, freeze the allocator (free_head = 0): existing sealed objects
+// stay readable and new puts fall back to the portable store.
+void crash_recover(Arena& a) {
+  ArenaHeader* h = hdr(a);
+  uint64_t heap_lo = h->heap_off + 16;  // past prologue
+  // pass 1: validate the block walk and merge runs of free blocks
+  uint64_t b = heap_lo, run = 0;
+  bool valid = true;
+  while (true) {
+    if (b + 8 > h->heap_end) { valid = false; break; }
+    uint64_t t = tag_at(a, b);
+    uint64_t sz = t & ~1ull;
+    if (sz == 0) {
+      // Only the true terminator may read zero: a torn split in heap_alloc
+      // leaves a zero tag mid-heap, which must freeze, not truncate.
+      valid = (b == h->epilogue_off);
+      break;
+    }
+    if (sz < 16 || (sz & 7) || b + sz + 8 > h->heap_end) { valid = false; break; }
+    if (t & 1ull) {
+      if (run) { set_tags(a, run, b - run, false); run = 0; }
+    } else if (!run) {
+      run = b;
+    }
+    b += sz;
+  }
+  if (run && valid) set_tags(a, run, b - run, false);
+  if (!valid) { h->free_head = 0; return; }
+  // pass 2: rebuild the free list and bytes_in_use from the merged walk
+  h->free_head = 0;
+  uint64_t in_use = 0;
+  for (b = heap_lo;;) {
+    uint64_t t = tag_at(a, b);
+    uint64_t sz = t & ~1ull;
+    if (sz == 0) break;
+    if (t & 1ull) in_use += sz; else free_insert(a, b);
+    b += sz;
+  }
+  h->bytes_in_use = in_use;
+  // pass 3: index entries must point at live allocated blocks
+  Entry* idx = index_of(a);
+  uint64_t nobj = 0, ntomb = 0;
+  for (uint32_t sl = 0; sl < h->index_slots; sl++) {
+    Entry& e = idx[sl];
+    if (e.state == kCreated || e.state == kSealed) {
+      // Guard against a torn create (state written, off still 0): the
+      // subtraction below must not wrap.
+      if (e.off < heap_lo + 8 || e.off >= h->heap_end) {
+        e.state = kTomb; e.pins = 0; e.deletable = 0;
+        ntomb++;
+        continue;
+      }
+      uint64_t bb = e.off - 8;
+      bool ok = blk_alloc(a, bb) && blk_size(a, bb) >= e.size + 16 &&
+                bb + blk_size(a, bb) <= h->heap_end;
+      if (!ok) { e.state = kTomb; e.pins = 0; e.deletable = 0; }
+      else nobj++;
+    }
+    if (e.state == kTomb) ntomb++;
+  }
+  h->num_objects = nobj;
+  h->num_tombs = ntomb;
+}
+
 struct LockGuard {
   pthread_mutex_t* m;
-  explicit LockGuard(pthread_mutex_t* mu) : m(mu) {
+  explicit LockGuard(Arena& a) : m(&hdr(a)->mutex) {
     int rc = pthread_mutex_lock(m);
-    if (rc == EOWNERDEAD) pthread_mutex_consistent(m);
+    if (rc == EOWNERDEAD) {
+      crash_recover(a);
+      pthread_mutex_consistent(m);
+    }
   }
   ~LockGuard() { pthread_mutex_unlock(m); }
 };
+
+// Linear-probe tombstones are only reusable for inserts, not terminators:
+// once most slots are tombs every miss scans the whole index under the
+// mutex. Rebuild in place once tombs pass 1/4 of slots.
+void maybe_rehash(Arena& a) {
+  ArenaHeader* h = hdr(a);
+  uint32_t slots = h->index_slots;
+  if (h->num_tombs * 4 < slots) return;
+  // Crash safety: rebuild into the inactive region, then flip active_index
+  // with one aligned store. A process dying mid-rebuild leaves the active
+  // region untouched.
+  Entry* idx = index_of(a);
+  Entry* fresh = index_inactive(a);
+  memset(fresh, 0, (size_t)slots * sizeof(Entry));
+  for (uint32_t sl = 0; sl < slots; sl++) {
+    Entry& e = idx[sl];
+    if (e.state != kCreated && e.state != kSealed) continue;
+    uint64_t start = fnv1a(e.id, kIdBytes) & (slots - 1);
+    for (uint32_t j = 0; j < slots; j++) {
+      uint32_t t = (start + j) & (slots - 1);
+      if (fresh[t].state == kEmpty) { fresh[t] = e; break; }
+    }
+  }
+  __sync_synchronize();
+  h->active_index ^= 1;  // atomic publish
+  h->num_tombs = 0;
+}
+
+void entry_reclaim_locked(Arena& a, Entry& e) {
+  ArenaHeader* h = hdr(a);
+  uint64_t b = e.off - 8;
+  h->bytes_in_use -= blk_size(a, b);
+  h->num_objects -= 1;
+  heap_free(a, b);
+  e.state = kTomb;
+  e.pins = 0;
+  e.deletable = 0;
+  h->num_tombs += 1;
+  maybe_rehash(a);
+}
+
+// Subtract a dead client's ledger from the entries and free its slot.
+// Caller holds the arena mutex.
+void scrub_client_locked(Arena& a, uint32_t c) {
+  ArenaHeader* h = hdr(a);
+  PinRec* tab = pin_ledger(a, c);
+  for (uint32_t i = 0; i < h->pin_slots; i++) {
+    PinRec& r = tab[i];
+    if (r.count == 0) continue;
+    int64_t sl = index_find(a, r.id, false);
+    if (sl >= 0) {
+      Entry& e = index_of(a)[sl];
+      if (e.state == kCreated || e.state == kSealed) {
+        uint32_t sub = r.count < e.pins ? r.count : e.pins;
+        e.pins -= sub;
+        if (e.state == kCreated) {
+          // creator died before seal: the object can never be read
+          e.deletable = 1;
+        }
+        if (e.pins == 0 && e.deletable) entry_reclaim_locked(a, e);
+      }
+    }
+    r.count = 0;
+  }
+  memset(tab, 0, (size_t)h->pin_slots * sizeof(PinRec));
+  ClientSlot& cs = clients_of(a)[c];
+  cs.state = 0;
+  cs.pid = 0;
+  cs.starttime = 0;
+}
+
+// Reclaim pins owned by processes that no longer exist.
+void scrub_dead_clients_locked(Arena& a, int self_client) {
+  ClientSlot* cs = clients_of(a);
+  for (uint32_t c = 0; c < kMaxClients; c++) {
+    if ((int)c == self_client || cs[c].state != 1) continue;
+    if (!process_alive(cs[c].pid, cs[c].starttime)) {
+      scrub_client_locked(a, c);
+    }
+  }
+}
+
+// Claim a ClientSlot for this process (reusing dead slots). Caller holds
+// the arena mutex. Returns slot or -1 (table full of live processes).
+int claim_client_locked(Arena& a) {
+  ClientSlot* cs = clients_of(a);
+  uint32_t mypid = (uint32_t)getpid();
+  for (uint32_t c = 0; c < kMaxClients; c++) {
+    if (cs[c].state == 1 && cs[c].pid == mypid &&
+        cs[c].starttime == read_starttime(mypid)) {
+      return (int)c;  // re-attach from the same process
+    }
+  }
+  for (uint32_t c = 0; c < kMaxClients; c++) {
+    if (cs[c].state == 0) {
+      cs[c].state = 1;
+      cs[c].pid = mypid;
+      cs[c].starttime = read_starttime(mypid);
+      memset(pin_ledger(a, c), 0,
+             (size_t)hdr(a)->pin_slots * sizeof(PinRec));
+      return (int)c;
+    }
+  }
+  // all slots claimed: scrub the dead and retry once
+  scrub_dead_clients_locked(a, -1);
+  for (uint32_t c = 0; c < kMaxClients; c++) {
+    if (cs[c].state == 0) {
+      cs[c].state = 1;
+      cs[c].pid = mypid;
+      cs[c].starttime = read_starttime(mypid);
+      memset(pin_ledger(a, c), 0,
+             (size_t)hdr(a)->pin_slots * sizeof(PinRec));
+      return (int)c;
+    }
+  }
+  return -1;
+}
 
 }  // namespace
 
@@ -280,11 +604,18 @@ int rt_arena_create(const char* name, uint64_t capacity, uint32_t index_slots) {
   h->index_slots = index_slots;
   h->capacity = capacity;
   h->index_off = align_up(sizeof(ArenaHeader), 64);
-  uint64_t index_bytes = (uint64_t)index_slots * sizeof(Entry);
-  h->heap_off = align_up(h->index_off + index_bytes, 4096);
+  uint64_t index_bytes = 2 * (uint64_t)index_slots * sizeof(Entry);  // A/B
+  h->client_off = align_up(h->index_off + index_bytes, 64);
+  uint32_t pin_slots = index_slots / 16;
+  if (pin_slots < 256) pin_slots = 256;
+  h->pin_slots = pin_slots;
+  uint64_t client_bytes = kMaxClients * sizeof(ClientSlot)
+      + (uint64_t)kMaxClients * pin_slots * sizeof(PinRec);
+  h->heap_off = align_up(h->client_off + client_bytes, 4096);
   h->heap_end = capacity;
   if (h->heap_off + (1 << 16) > h->heap_end) { munmap(base, capacity); shm_unlink(name); return -EINVAL; }
   memset((uint8_t*)base + h->index_off, 0, index_bytes);
+  memset((uint8_t*)base + h->client_off, 0, client_bytes);
 
   pthread_mutexattr_t attr;
   pthread_mutexattr_init(&attr);
@@ -302,6 +633,7 @@ int rt_arena_create(const char* name, uint64_t capacity, uint32_t index_slots) {
   memset(a.name, 0, sizeof(a.name));
   strncpy(a.name, name, sizeof(a.name) - 1);
   heap_init(a);
+  a.client = claim_client_locked(a);
   __sync_synchronize();
   h->magic = kMagic;  // publish: attachers spin on magic
   return slot;
@@ -335,6 +667,10 @@ int rt_arena_attach(const char* name) {
   a.capacity = (uint64_t)st.st_size;
   memset(a.name, 0, sizeof(a.name));
   strncpy(a.name, name, sizeof(a.name) - 1);
+  {
+    LockGuard g(a);
+    a.client = claim_client_locked(a);
+  }
   return slot;
 }
 
@@ -348,6 +684,11 @@ int rt_arena_detach(int handle) {
   std::lock_guard<std::mutex> tg(g_table_mutex);
   if (!handle_ok(handle)) return -EBADF;
   Arena& a = g_arenas[handle];
+  if (a.client >= 0) {
+    LockGuard g(a);
+    scrub_client_locked(a, (uint32_t)a.client);
+    a.client = -1;
+  }
   munmap(a.base, a.capacity);
   a.base = nullptr;
   a.capacity = 0;
@@ -375,7 +716,7 @@ int64_t rt_obj_create(int handle, const char* id_hex, uint64_t size) {
   uint8_t id[kIdBytes];
   if (hex_to_id(id_hex, id) != 0) return -EINVAL;
   ArenaHeader* h = hdr(a);
-  LockGuard g(&h->mutex);
+  LockGuard g(a);
   int64_t s = index_find(a, id, /*insert=*/true);
   if (s < 0) return -ENFILE;
   Entry& e = index_of(a)[s];
@@ -383,18 +724,29 @@ int64_t rt_obj_create(int handle, const char* id_hex, uint64_t size) {
   uint64_t need = align_up(size + 16, kAlign);  // +hdr/ftr tags
   if (need < kMinBlock) need = kMinBlock;
   uint64_t b = heap_alloc(a, need);
-  if (b == 0) return -ENOSPC;
-  memcpy(e.id, id, kIdBytes);
-  e.state = kCreated;
-  e.deletable = 0;
-  e.pins = 1;  // creator's pin; dropped by rt_obj_delete
-  e.off = b + 8;
-  e.size = size;
-  e.seq = ++h->create_seq;
+  if (b == 0) {
+    // Space pressure: reclaim pins leaked by dead processes, then retry.
+    scrub_dead_clients_locked(a, a.client);
+    b = heap_alloc(a, need);
+    if (b == 0) return -ENOSPC;
+    // the scrub may have tombed/moved entries — re-resolve the slot
+    s = index_find(a, id, /*insert=*/true);
+    if (s < 0) { heap_free(a, b); return -ENFILE; }
+  }
+  Entry& e2 = index_of(a)[s];
+  if (e2.state == kTomb && h->num_tombs > 0) h->num_tombs -= 1;
+  memcpy(e2.id, id, kIdBytes);
+  e2.state = kCreated;
+  e2.deletable = 0;
+  e2.pins = 1;  // creator's pin; dropped by rt_obj_delete
+  e2.off = b + 8;
+  e2.size = size;
+  e2.seq = ++h->create_seq;
   h->bytes_in_use += blk_size(a, b);
   h->num_objects += 1;
   if (h->bytes_in_use > h->peak_bytes) h->peak_bytes = h->bytes_in_use;
-  return (int64_t)e.off;
+  pin_log_add(a, a.client, id, +1);  // creator pin in this process's ledger
+  return (int64_t)e2.off;
 }
 
 int rt_obj_seal(int handle, const char* id_hex) {
@@ -402,8 +754,7 @@ int rt_obj_seal(int handle, const char* id_hex) {
   Arena& a = g_arenas[handle];
   uint8_t id[kIdBytes];
   if (hex_to_id(id_hex, id) != 0) return -EINVAL;
-  ArenaHeader* h = hdr(a);
-  LockGuard g(&h->mutex);
+  LockGuard g(a);
   int64_t s = index_find(a, id, false);
   if (s < 0) return -ENOENT;
   Entry& e = index_of(a)[s];
@@ -419,26 +770,15 @@ int64_t rt_obj_get(int handle, const char* id_hex, uint64_t* size_out) {
   Arena& a = g_arenas[handle];
   uint8_t id[kIdBytes];
   if (hex_to_id(id_hex, id) != 0) return -EINVAL;
-  ArenaHeader* h = hdr(a);
-  LockGuard g(&h->mutex);
+  LockGuard g(a);
   int64_t s = index_find(a, id, false);
   if (s < 0) return -ENOENT;
   Entry& e = index_of(a)[s];
   if (e.state != kSealed) return -ENOENT;
   e.pins += 1;
+  pin_log_add(a, a.client, id, +1);
   if (size_out) *size_out = e.size;
   return (int64_t)e.off;
-}
-
-static void entry_reclaim_locked(Arena& a, Entry& e) {
-  ArenaHeader* h = hdr(a);
-  uint64_t b = e.off - 8;
-  h->bytes_in_use -= blk_size(a, b);
-  h->num_objects -= 1;
-  heap_free(a, b);
-  e.state = kTomb;
-  e.pins = 0;
-  e.deletable = 0;
 }
 
 // Drop one pin (reader-side). Reclaims if deletable and pins hit zero.
@@ -447,13 +787,13 @@ int rt_obj_release(int handle, const char* id_hex) {
   Arena& a = g_arenas[handle];
   uint8_t id[kIdBytes];
   if (hex_to_id(id_hex, id) != 0) return -EINVAL;
-  ArenaHeader* h = hdr(a);
-  LockGuard g(&h->mutex);
+  LockGuard g(a);
   int64_t s = index_find(a, id, false);
   if (s < 0) return -ENOENT;
   Entry& e = index_of(a)[s];
   if (e.pins == 0) return -EINVAL;
   e.pins -= 1;
+  pin_log_add(a, a.client, id, -1);
   if (e.pins == 0 && e.deletable) entry_reclaim_locked(a, e);
   return 0;
 }
@@ -465,14 +805,14 @@ int rt_obj_delete(int handle, const char* id_hex) {
   Arena& a = g_arenas[handle];
   uint8_t id[kIdBytes];
   if (hex_to_id(id_hex, id) != 0) return -EINVAL;
-  ArenaHeader* h = hdr(a);
-  LockGuard g(&h->mutex);
+  LockGuard g(a);
   int64_t s = index_find(a, id, false);
   if (s < 0) return -ENOENT;
   Entry& e = index_of(a)[s];
   if (e.state != kCreated && e.state != kSealed) return -ENOENT;
   e.deletable = 1;
   if (e.pins > 0) e.pins -= 1;
+  pin_log_add(a, a.client, id, -1);
   if (e.pins == 0) entry_reclaim_locked(a, e);
   return 0;
 }
@@ -482,11 +822,40 @@ int rt_obj_contains(int handle, const char* id_hex) {
   Arena& a = g_arenas[handle];
   uint8_t id[kIdBytes];
   if (hex_to_id(id_hex, id) != 0) return 0;
-  ArenaHeader* h = hdr(a);
-  LockGuard g(&h->mutex);
+  LockGuard g(a);
   int64_t s = index_find(a, id, false);
   if (s < 0) return 0;
   return index_of(a)[s].state == kSealed ? 1 : 0;
+}
+
+// Test-only: grab the arena mutex and never release it. A test child calls
+// this and _exits to simulate a crash inside the critical section, so the
+// parent's next lock sees EOWNERDEAD and runs crash_recover.
+int rt_test_hold_lock(int handle) {
+  if (!handle_ok(handle)) return -EBADF;
+  int rc = pthread_mutex_lock(&hdr(g_arenas[handle])->mutex);
+  if (rc == EOWNERDEAD) pthread_mutex_consistent(&hdr(g_arenas[handle])->mutex);
+  return 0;
+}
+
+// Reclaim pins held by dead processes (also runs automatically when a
+// create hits ENOSPC). Returns number of live clients after the scrub.
+int rt_arena_scrub(int handle) {
+  if (!handle_ok(handle)) return -EBADF;
+  Arena& a = g_arenas[handle];
+  LockGuard g(a);
+  scrub_dead_clients_locked(a, a.client);
+  int live = 0;
+  ClientSlot* cs = clients_of(a);
+  for (uint32_t c = 0; c < kMaxClients; c++) live += cs[c].state == 1;
+  return live;
+}
+
+uint64_t rt_arena_num_tombs(int handle) {
+  if (!handle_ok(handle)) return 0;
+  Arena& a = g_arenas[handle];
+  LockGuard g(a);
+  return hdr(a)->num_tombs;
 }
 
 void rt_arena_stats(int handle, uint64_t* bytes_in_use, uint64_t* num_objects,
@@ -494,7 +863,7 @@ void rt_arena_stats(int handle, uint64_t* bytes_in_use, uint64_t* num_objects,
   if (!handle_ok(handle)) return;
   Arena& a = g_arenas[handle];
   ArenaHeader* h = hdr(a);
-  LockGuard g(&h->mutex);
+  LockGuard g(a);
   if (bytes_in_use) *bytes_in_use = h->bytes_in_use;
   if (num_objects) *num_objects = h->num_objects;
   if (capacity) *capacity = h->heap_end - h->heap_off;

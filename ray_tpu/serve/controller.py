@@ -38,10 +38,16 @@ class ServeController:
         self._routes: Dict[str, str] = {}      # route_prefix -> deployment
         # deployment -> {handle_id: (ongoing, monotonic ts)}; pushed by
         # handle routers (queued + executing requests they have issued).
-        self._handle_metrics: Dict[str, Dict[int, tuple]] = {}
+        self._handle_metrics: Dict[str, Dict[str, tuple]] = {}
         self._loop_task = None
         self._running = True
         self._reconcile_lock = asyncio.Lock()
+        # Serializes whole deploy() calls (incl. the post-reconcile-lock
+        # reconfigure fan-out) so two concurrent deploys of one deployment
+        # can't interleave reconfigure RPCs (last-deploy-wins, not
+        # last-RPC-wins). Separate from _reconcile_lock on purpose: holding
+        # THAT across the bounded 30s gather would stall health checks.
+        self._deploy_lock = asyncio.Lock()
 
     def _ensure_loop(self):
         if self._loop_task is None:
@@ -57,10 +63,20 @@ class ServeController:
         num_replicas, max_ongoing, actor_options, user_config,
         autoscaling (dict|None), version}]"""
         self._ensure_loop()
+        async with self._deploy_lock:
+            return await self._deploy_inner(
+                app_name, deployments, route_prefix, ingress
+            )
+
+    async def _deploy_inner(self, app_name: str, deployments: List[dict],
+                            route_prefix: Optional[str], ingress: str) -> dict:
         names = []
+        to_reconfigure = []
         # Hold the reconcile lock: an in-flight reconcile pass may be mid
         # _start_replica and would append an old-version replica after the
-        # teardown below.
+        # teardown below. Replica reconfigure RPCs run AFTER release — they
+        # can queue behind saturated replicas, and holding the lock across
+        # that await would wedge the whole controller.
         async with self._reconcile_lock:
             for spec in deployments:
                 name = spec["name"]
@@ -79,13 +95,22 @@ class ServeController:
                             await self._stop_replica(r)
                         existing.replicas = []
                     elif spec.get("user_config") is not None:
-                        for r in existing.replicas:
-                            try:
-                                await self._call(
-                                    r, "reconfigure", spec["user_config"]
-                                )
-                            except Exception:
-                                pass
+                        to_reconfigure.extend(
+                            (r, spec["user_config"])
+                            for r in existing.replicas
+                        )
+        if to_reconfigure:
+            async def _one(r, user_config):
+                try:
+                    await asyncio.wait_for(
+                        self._call(r, "reconfigure", user_config), timeout=30
+                    )
+                except Exception:
+                    pass
+
+            await asyncio.gather(
+                *(_one(r, cfg) for r, cfg in to_reconfigure)
+            )
         self._apps[app_name] = names
         if route_prefix:
             self._routes[route_prefix] = ingress
@@ -159,6 +184,10 @@ class ServeController:
             await self._reconcile_inner()
 
     async def _reconcile_inner(self):
+        if not self._running:
+            # A pass queued behind shutdown() must not resurrect replicas
+            # that shutdown just killed.
+            return
         for st in list(self._deployments.values()):
             while len(st.replicas) < st.target_replicas:
                 r = await self._start_replica(st)
@@ -231,12 +260,22 @@ class ServeController:
 
     # --------------------------------------------------------- autoscaling
 
-    def record_handle_metrics(self, deployment: str, handle_id: int,
-                              ongoing: int) -> bool:
+    def record_handle_metrics(self, deployment: str, handle_id: str,
+                              ongoing: int) -> int:
+        """Ack codes: 1 = stored; 0 = deployment unknown (transient — e.g.
+        mid-redeploy or controller restart; keep pushing); -1 = deployment
+        doesn't autoscale (permanent — the handle stops pushing; nothing is
+        stored, since unbounded handle-id churn would grow the map forever)."""
+        st = self._deployments.get(deployment)
+        if st is None:
+            return 0
+        if not st.spec.get("autoscaling"):
+            self._handle_metrics.pop(deployment, None)
+            return -1
         self._handle_metrics.setdefault(deployment, {})[handle_id] = (
             ongoing, time.monotonic()
         )
-        return True
+        return 1
 
     def _handle_reported_total(self, deployment: str) -> int:
         now = time.monotonic()

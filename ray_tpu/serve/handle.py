@@ -11,6 +11,7 @@ from __future__ import annotations
 import random
 import threading
 import time
+import uuid
 from typing import Any, Dict, List, Optional
 
 
@@ -46,6 +47,9 @@ class DeploymentResponse:
 class _Router:
     def __init__(self, deployment: str, refresh_s: float = 1.0):
         self._deployment = deployment
+        # Globally unique: routers are recreated on every handle unpickle and
+        # live in many processes; id(self) would collide across them.
+        self._router_id = uuid.uuid4().hex
         self._refresh_s = refresh_s
         self._replicas: List[Any] = []
         self._inflight: Dict[int, int] = {}
@@ -58,10 +62,20 @@ class _Router:
         # requests sit invisible in the actor mailbox).
         self._refs: Dict[int, Any] = {}
         self._metrics_thread = None
+        # Set when the controller acks "does not autoscale"; soft latch — a
+        # redeploy can enable autoscaling later, so retry after a while.
+        self._metrics_disabled_at: Optional[float] = None
         self._controller_handle = None
+
+    METRICS_RETRY_S = 60.0
 
     def _ensure_metrics_thread(self):
         with self._lock:
+            if (self._metrics_disabled_at is not None
+                    and time.monotonic() - self._metrics_disabled_at
+                    < self.METRICS_RETRY_S):
+                return
+            self._metrics_disabled_at = None
             if (self._metrics_thread is not None
                     and self._metrics_thread.is_alive()):
                 return
@@ -76,6 +90,7 @@ class _Router:
 
         failures = 0
         last_pushed = -1
+        pushes = 0
         try:
             while failures < 8:
                 time.sleep(0.25)
@@ -95,9 +110,20 @@ class _Router:
                     with self._lock:
                         n = len(self._refs)
                     if n != last_pushed or n > 0:
-                        self._controller().record_handle_metrics.remote(
-                            self._deployment, id(self), n
+                        ref = self._controller().record_handle_metrics.remote(
+                            self._deployment, self._router_id, n
                         )
+                        # Periodically read the ack: -1 means the deployment
+                        # doesn't autoscale, so this thread is pure overhead
+                        # — stop pushing for good (the latch also stops
+                        # track_request from respawning us). 0 is transient
+                        # (mid-redeploy / controller restart): keep pushing.
+                        if pushes % 20 == 0:
+                            if ray_tpu.get(ref, timeout=5) == -1:
+                                with self._lock:
+                                    self._metrics_disabled_at = time.monotonic()
+                                return
+                        pushes += 1
                         last_pushed = n
                     failures = 0
                 except Exception:

@@ -220,3 +220,131 @@ def test_many_alloc_free_cycles(arena):
     gc.collect()  # drop view pins so deletable blocks reclaim
     assert arena.stats()["bytes_in_use"] == base
     assert arena.stats()["num_objects"] == 0
+
+
+def test_tombstone_rehash_bounded():
+    """Churn far more objects than index slots: tombstones must rehash away
+    and lookups keep working."""
+    name = f"/rt_test_tb_{os.getpid()}_{secrets.token_hex(4)}"
+    store = NativeArenaStore(name, capacity=1 << 24, index_slots=256)
+    try:
+        for i in range(2000):
+            oid = _hex()
+            assert store.put_frames(oid, [b"t" * 64]) is not None
+            assert store.contains(oid)
+            store.free(oid)
+        tombs = store._lib.rt_arena_num_tombs(store._h)
+        assert tombs <= 64, f"tombstones not rehashed: {tombs}"
+        assert not store.contains(_hex())  # miss lookups still terminate
+        st = store.stats()
+        assert st["num_objects"] == 0
+    finally:
+        store.close_all()
+
+
+def _child_crash_in_lock(name, q):
+    import time as _time
+
+    try:
+        store = NativeArenaStore(name, create=False)
+        store.put_frames(secrets.token_hex(28), [b"pre-crash" * 10])
+        store._lib.rt_test_hold_lock(store._h)
+        q.put("locked")
+        # Let the queue feeder thread flush, then die holding the mutex.
+        # (The parent blocks on the robust mutex until this process dies,
+        # then wakes with EOWNERDEAD.)
+        _time.sleep(0.5)
+        os._exit(9)
+    except Exception as e:  # pragma: no cover
+        q.put(repr(e))
+
+
+def test_crash_recovery_eownerdead():
+    """A process dying inside the critical section must not wedge or corrupt
+    the arena: the next locker recovers and normal operation continues."""
+    name = f"/rt_test_cr_{os.getpid()}_{secrets.token_hex(4)}"
+    store = NativeArenaStore(name, capacity=1 << 24)
+    try:
+        survivor = _hex()
+        store.put_frames(survivor, [b"S" * 5000])
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        p = ctx.Process(target=_child_crash_in_lock, args=(name, q))
+        p.start()
+        assert q.get(timeout=30) == "locked"
+        p.join(timeout=10)
+        # Next operation takes the robust mutex, recovers, and proceeds.
+        assert store.contains(survivor)
+        got = store.get_frames(survivor, {})
+        assert bytes(got[0]) == b"S" * 5000
+        # Allocator still sane after recovery: alloc/free cycles work.
+        for _ in range(50):
+            oid = _hex()
+            assert store.put_frames(oid, [b"x" * 10_000]) is not None
+            store.free(oid)
+    finally:
+        store.close_all()
+
+
+def _child_pin_and_die(name, oid, q):
+    try:
+        store = NativeArenaStore(name, create=False)
+        frames = store.get_frames(oid, {})
+        assert frames is not None
+        q.put("pinned")
+        import time as _t
+        _t.sleep(0.5)  # let the queue flush
+        os._exit(9)  # die holding the reader pin (no release)
+    except Exception as e:  # pragma: no cover
+        q.put(repr(e))
+
+
+def test_dead_process_pins_are_scrubbed():
+    """A reader killed while holding pins must not leak its blocks: the
+    scrub (also triggered on allocation pressure) subtracts the dead
+    process's pin ledger and reclaims (plasma client-disconnect analog)."""
+    name = f"/rt_test_sc_{os.getpid()}_{secrets.token_hex(4)}"
+    store = NativeArenaStore(name, capacity=1 << 24)
+    try:
+        oid = _hex()
+        store.put_frames(oid, [b"L" * 100_000])
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        p = ctx.Process(target=_child_pin_and_die, args=(name, oid, q))
+        p.start()
+        assert q.get(timeout=30) == "pinned"
+        p.join(timeout=10)
+        base = store.stats()["bytes_in_use"]
+        store.free(oid)  # owner delete: dead reader's pin still blocks it
+        assert store.stats()["bytes_in_use"] == base
+        live = store._lib.rt_arena_scrub(store._h)
+        assert live >= 1  # this process
+        assert store.stats()["bytes_in_use"] < base
+        assert store.stats()["num_objects"] == 0
+    finally:
+        store.close_all()
+
+
+def test_scrub_triggers_on_allocation_pressure():
+    """When the arena fills, create() scrubs dead clients automatically and
+    retries before reporting ENOSPC."""
+    name = f"/rt_test_sp_{os.getpid()}_{secrets.token_hex(4)}"
+    store = NativeArenaStore(name, capacity=1 << 24)
+    try:
+        cap = store.stats()["capacity"]
+        big = int(cap * 0.6)
+        oid = _hex()
+        store.put_frames(oid, [b"X" * big])
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        p = ctx.Process(target=_child_pin_and_die, args=(name, oid, q))
+        p.start()
+        assert q.get(timeout=30) == "pinned"
+        p.join(timeout=10)
+        store.free(oid)  # deletable, but dead reader pin holds it
+        # This put only fits if the dead client's pin got scrubbed inline.
+        oid2 = _hex()
+        assert store.put_frames(oid2, [b"Y" * big]) is not None
+        store.free(oid2)
+    finally:
+        store.close_all()
