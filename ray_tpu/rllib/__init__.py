@@ -1,0 +1,16 @@
+"""RLlib-equivalent: RL training on the TPU-native stack.
+
+Reference analog: the ``rllib/`` tree (new API stack: EnvRunnerGroup +
+RLModule + Learner/LearnerGroup + Algorithm/AlgorithmConfig).
+"""
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, make_trainable
+from ray_tpu.rllib.algorithms import IMPALA, IMPALAConfig, PPO, PPOConfig
+from ray_tpu.rllib.env_runner import EnvRunnerGroup, SingleAgentEnvRunner
+from ray_tpu.rllib.learner import Learner, LearnerHyperparams
+
+__all__ = [
+    "Algorithm", "AlgorithmConfig", "make_trainable",
+    "PPO", "PPOConfig", "IMPALA", "IMPALAConfig",
+    "EnvRunnerGroup", "SingleAgentEnvRunner",
+    "Learner", "LearnerHyperparams",
+]

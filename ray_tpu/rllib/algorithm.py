@@ -1,0 +1,245 @@
+"""Algorithm: the RL training driver (config builder + train loop).
+
+Reference analogs: ``rllib/algorithms/algorithm.py:208`` (Algorithm as a Tune
+Trainable; ``step`` :1168, default ``training_step`` :2289) and
+``algorithm_config.py`` (builder pattern: .environment/.env_runners/
+.training). One Algorithm = an EnvRunnerGroup of sampling actors + a local
+SPMD Learner; training_step = parallel sample → learner update → weight
+broadcast, the same loop shape as the reference's new API stack.
+"""
+from __future__ import annotations
+
+import copy
+import os
+import pickle
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib import module as rl_module
+from ray_tpu.rllib.env_runner import EnvRunnerGroup
+from ray_tpu.rllib.learner import Learner, LearnerHyperparams
+
+
+def _default_env_creator(env_name: str) -> Callable[[], Any]:
+    def create():
+        import gymnasium as gym
+
+        return gym.make(env_name)
+
+    return create
+
+
+class AlgorithmConfig:
+    """Builder (reference: ``rllib/algorithms/algorithm_config.py``)."""
+
+    algo_name = "base"
+
+    def __init__(self):
+        self.env: Optional[str] = None
+        self.env_creator: Optional[Callable[[], Any]] = None
+        self.num_env_runners = 2
+        self.num_envs_per_runner = 4
+        self.rollout_fragment_length = 64
+        self.seed = 0
+        self.mesh = None  # jax Mesh for the learner SPMD step (data axis)
+        self.hp = LearnerHyperparams()
+
+    # builder sections -----------------------------------------------------
+
+    def environment(self, env: Optional[str] = None, *,
+                    env_creator: Optional[Callable[[], Any]] = None):
+        self.env = env
+        if env_creator is not None:
+            self.env_creator = env_creator
+        return self
+
+    def env_runners(self, *, num_env_runners: Optional[int] = None,
+                    num_envs_per_env_runner: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None):
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs):
+        hp = self.hp.__dict__ | kwargs
+        unknown = set(hp) - set(LearnerHyperparams().__dict__)
+        if unknown:
+            raise ValueError(f"unknown training params: {sorted(unknown)}")
+        self.hp = LearnerHyperparams(**hp)
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None):
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def learners(self, *, mesh=None):
+        self.mesh = mesh
+        return self
+
+    def copy(self) -> "AlgorithmConfig":
+        c = copy.copy(self)
+        return c
+
+    def build_algo(self) -> "Algorithm":
+        return Algorithm(self)
+
+    def get_env_creator(self) -> Callable[[], Any]:
+        if self.env_creator is not None:
+            return self.env_creator
+        if self.env is None:
+            raise ValueError("config.environment(env=...) not set")
+        return _default_env_creator(self.env)
+
+
+class Algorithm:
+    """Train loop driver; Tune-compatible via ``as_trainable``."""
+
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        creator = config.get_env_creator()
+        probe_env = creator()
+        self.module_config = rl_module.module_config_for_env(probe_env)
+        probe_env.close()
+        self.learner = Learner(
+            config.algo_name, self.module_config, config.hp,
+            seed=config.seed, mesh=config.mesh,
+        )
+        self.runner_group = EnvRunnerGroup(
+            creator, config.num_env_runners, config.num_envs_per_runner,
+            config.rollout_fragment_length, self.module_config,
+            seed=config.seed, gamma=config.hp.gamma,
+        )
+        self.runner_group.sync_weights(self.learner.get_weights())
+        self.iteration = 0
+        self._total_env_steps = 0
+        self._recent_returns: List[float] = []
+
+    # ---------------------------------------------------------------- train
+
+    def training_step(self) -> Dict[str, float]:
+        fragments = self.runner_group.sample()
+        if not fragments:
+            return {"num_healthy_runners": 0}
+        # Concat along the env axis: [T, N_total, ...] — one static-shaped
+        # learner batch per step.
+        batch = {
+            k: np.concatenate([f[k] for f in fragments], axis=-1)
+            if fragments[0][k].ndim == 1
+            else np.concatenate([f[k] for f in fragments], axis=1)
+            for k in fragments[0]
+        }
+        metrics = self.learner.update(batch)
+        self.runner_group.sync_weights(self.learner.get_weights())
+        self._total_env_steps += (
+            batch["rewards"].shape[0] * batch["rewards"].shape[1]
+        )
+        return metrics
+
+    def train(self) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        metrics = self.training_step()
+        self.iteration += 1
+        ep_returns: List[float] = []
+        num_episodes = 0
+        for m in self.runner_group.metrics():
+            ep_returns.extend(m["episode_returns"])
+            num_episodes += m["num_episodes"]
+        self._recent_returns.extend(ep_returns)
+        self._recent_returns = self._recent_returns[-100:]
+        mean_ret = (
+            float(np.mean(self._recent_returns))
+            if self._recent_returns else float("nan")
+        )
+        dt = time.perf_counter() - t0
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": mean_ret,
+            "num_episodes": num_episodes,
+            "num_env_steps_sampled_lifetime": self._total_env_steps,
+            "env_steps_per_sec": batch_steps_per_sec(dt, self.config),
+            **metrics,
+        }
+
+    # ------------------------------------------------------------ lifecycle
+
+    def save(self, path: str) -> str:
+        os.makedirs(path, exist_ok=True)
+        state = {
+            "learner": self.learner.state(),
+            "iteration": self.iteration,
+            "total_env_steps": self._total_env_steps,
+            "module_config": self.module_config.__dict__,
+            "algo": self.config.algo_name,
+        }
+        with open(os.path.join(path, "algorithm_state.pkl"), "wb") as f:
+            pickle.dump(state, f)
+        return path
+
+    def restore(self, path: str):
+        with open(os.path.join(path, "algorithm_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        self.learner.restore(state["learner"])
+        self.iteration = state["iteration"]
+        self._total_env_steps = state["total_env_steps"]
+        self.runner_group.sync_weights(self.learner.get_weights())
+
+    def stop(self):
+        self.runner_group.stop()
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    # ------------------------------------------------------------- tune glue
+
+    @classmethod
+    def from_config_dict(cls, config_cls, overrides: Dict[str, Any]):
+        cfg = config_cls()
+        if "env" in overrides:
+            cfg.environment(overrides["env"])
+        hp_keys = set(LearnerHyperparams().__dict__)
+        cfg.training(**{k: v for k, v in overrides.items() if k in hp_keys})
+        return cfg.build_algo()
+
+
+def batch_steps_per_sec(dt, config: AlgorithmConfig) -> float:
+    steps = (
+        config.rollout_fragment_length
+        * config.num_envs_per_runner
+        * config.num_env_runners
+    )
+    return steps / max(dt, 1e-9)
+
+
+def make_trainable(config: AlgorithmConfig, stop_iters: int = 10,
+                   stop_reward: Optional[float] = None):
+    """Wrap an AlgorithmConfig for the Tune layer: a train_fn that builds the
+    algo from a trial's hyperparams and reports per-iteration metrics
+    (reference: Algorithm registered as a Tune Trainable)."""
+
+    def trainable(trial_config: Dict[str, Any]):
+        from ray_tpu import train as rt_train
+
+        cfg = config.copy()
+        hp_keys = set(LearnerHyperparams().__dict__)
+        overrides = {k: v for k, v in trial_config.items() if k in hp_keys}
+        if overrides:
+            cfg.training(**overrides)
+        algo = cfg.build_algo()
+        try:
+            for _ in range(stop_iters):
+                result = algo.train()
+                rt_train.report(result)
+                if (stop_reward is not None
+                        and result["episode_return_mean"] >= stop_reward):
+                    break
+        finally:
+            algo.stop()
+
+    return trainable

@@ -1,0 +1,26 @@
+"""PPO: clipped-surrogate policy optimization.
+
+Reference analog: ``rllib/algorithms/ppo/ppo.py:365`` (PPOConfig + PPO
+Algorithm on the new API stack). The loss lives in the jitted learner update
+(``ray_tpu/rllib/learner.py make_ppo_update``): GAE advantages, ratio clip,
+value MSE, entropy bonus, minibatched SGD epochs — all one XLA program.
+"""
+from __future__ import annotations
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+
+
+class PPOConfig(AlgorithmConfig):
+    algo_name = "ppo"
+
+    def __init__(self):
+        super().__init__()
+        self.training(
+            lr=3e-4, clip_param=0.2, vf_coeff=0.5, entropy_coeff=0.01,
+            num_sgd_epochs=4, minibatch_count=4, gae_lambda=0.95,
+        )
+
+
+class PPO(Algorithm):
+    def __init__(self, config: PPOConfig):
+        super().__init__(config)

@@ -1,0 +1,215 @@
+"""Env runners: parallel episode collection actors.
+
+Reference analog: ``rllib/env/single_agent_env_runner.py`` (gymnasium vector
+envs sampled with the current module weights) + ``env_runner_group.py:70``
+(actor group with healthy-only foreach and restarts).
+
+Design: runners are plain actors that hold N independent gymnasium envs and a
+jitted CPU policy forward; they return fixed-length rollout fragments as
+numpy struct-of-arrays with a bootstrap value per env — exactly what the
+jitted learner consumes with static shapes (no ragged episodes on device).
+Vectorization is manual (reset-on-done per env) rather than gymnasium's
+vector autoreset: the 1.x "reset happens on next step" semantics silently
+corrupts fragment boundaries, and N small envs stepped in a loop is not the
+bottleneck (policy inference is batched across envs).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib import module as rl_module
+
+
+class SingleAgentEnvRunner:
+    """Collects rollout fragments from num_envs copies of one env."""
+
+    def __init__(self, env_creator: Callable[[], Any], num_envs: int,
+                 fragment_len: int, module_config: dict, seed: int = 0,
+                 gamma: float = 0.99):
+        import jax
+
+        self.envs = [env_creator() for _ in range(num_envs)]
+        self.num_envs = num_envs
+        self.fragment_len = fragment_len
+        self.gamma = gamma
+        self.config = rl_module.RLModuleConfig(**module_config)
+        self.params = None
+        self.rng = jax.random.PRNGKey(seed)
+        self._sample_fn = jax.jit(
+            lambda p, obs, rng: rl_module.sample_action(p, self.config, obs, rng)
+        )
+        self._value_fn = jax.jit(
+            lambda p, obs: rl_module.forward_value(p, self.config, obs)
+        )
+        self.obs = np.stack([
+            np.asarray(env.reset(seed=seed * 10_000 + i)[0], np.float32).ravel()
+            for i, env in enumerate(self.envs)
+        ])
+        # episode-return bookkeeping for metrics
+        self._ep_return = np.zeros(num_envs)
+        self._ep_len = np.zeros(num_envs, np.int64)
+        self._completed: List[tuple] = []
+        self._total_steps = 0
+
+    def set_weights(self, params):
+        self.params = params
+
+    def get_weights(self):
+        return self.params
+
+    def sample(self) -> Dict[str, np.ndarray]:
+        """One fragment: arrays of shape [T, N, ...] plus bootstrap values.
+
+        Fragments cut across episode boundaries (dones mark the cuts); the
+        learner computes GAE/V-trace with per-step done masks and the [N]
+        bootstrap value of the final observation.
+        """
+        import jax
+
+        assert self.params is not None, "set_weights before sample"
+        T, N = self.fragment_len, self.num_envs
+        obs_buf = np.empty((T, N, self.obs.shape[1]), np.float32)
+        act_dtype = np.int32 if self.config.discrete else np.float32
+        act_shape = (T, N) if self.config.discrete else (T, N, self.config.action_dim)
+        act_buf = np.empty(act_shape, act_dtype)
+        rew_buf = np.empty((T, N), np.float32)
+        done_buf = np.empty((T, N), np.float32)
+        logp_buf = np.empty((T, N), np.float32)
+        val_buf = np.empty((T, N), np.float32)
+
+        for t in range(T):
+            self.rng, k = jax.random.split(self.rng)
+            action, logp, value = self._sample_fn(self.params, self.obs, k)
+            action = np.asarray(action)
+            obs_buf[t] = self.obs
+            act_buf[t] = action
+            logp_buf[t] = np.asarray(logp)
+            val_buf[t] = np.asarray(value)
+            for i, env in enumerate(self.envs):
+                a = action[i]
+                if not self.config.discrete:
+                    a = np.clip(
+                        a, env.action_space.low, env.action_space.high
+                    )
+                nobs, rew, term, trunc, _ = env.step(
+                    a if not self.config.discrete else int(a)
+                )
+                self._ep_return[i] += float(rew)
+                self._ep_len[i] += 1
+                rew_buf[t, i] = rew
+                done = term or trunc
+                done_buf[t, i] = float(done)
+                if trunc and not term:
+                    # Time-limit truncation is not a true terminal: fold the
+                    # tail value into the reward (partial bootstrap), then
+                    # treat the step as done for advantage estimation.
+                    fv = self._value_fn(
+                        self.params,
+                        np.asarray(nobs, np.float32).ravel()[None, :],
+                    )
+                    rew_buf[t, i] += self.gamma * float(np.asarray(fv)[0])
+                if done:
+                    self._completed.append(
+                        (self._ep_return[i], int(self._ep_len[i]))
+                    )
+                    self._ep_return[i] = 0.0
+                    self._ep_len[i] = 0
+                    nobs = env.reset()[0]
+                self.obs[i] = np.asarray(nobs, np.float32).ravel()
+        bootstrap = np.asarray(self._value_fn(self.params, self.obs))
+        self._total_steps += T * N
+        return {
+            "obs": obs_buf, "actions": act_buf, "rewards": rew_buf,
+            "dones": done_buf, "logp": logp_buf, "values": val_buf,
+            "bootstrap_value": bootstrap,
+        }
+
+    def metrics(self) -> Dict[str, Any]:
+        completed, self._completed = self._completed, []
+        returns = [r for r, _ in completed]
+        lengths = [l for _, l in completed]
+        return {
+            "num_episodes": len(completed),
+            "episode_returns": returns,
+            "episode_lengths": lengths,
+            "total_steps": self._total_steps,
+        }
+
+    def ping(self) -> bool:
+        return True
+
+
+class EnvRunnerGroup:
+    """Actor group of env runners with healthy-only foreach + restart.
+
+    Reference analog: ``rllib/env/env_runner_group.py`` (foreach_env_runner
+    with healthy filtering; ``restore_env_runners`` respawns lost actors).
+    """
+
+    def __init__(self, env_creator, num_runners: int, num_envs_per_runner: int,
+                 fragment_len: int, module_config: rl_module.RLModuleConfig,
+                 seed: int = 0, gamma: float = 0.99):
+        import ray_tpu
+
+        self._make = lambda idx: ray_tpu.remote(SingleAgentEnvRunner).options(
+            name=f"env_runner_{idx}_{time.monotonic_ns()}", num_cpus=1
+        ).remote(
+            env_creator, num_envs_per_runner, fragment_len,
+            dict(module_config.__dict__), seed + 1000 * idx, gamma,
+        )
+        self.runners = [self._make(i) for i in range(num_runners)]
+        self._weights = None
+
+    def sync_weights(self, params):
+        import ray_tpu
+
+        self._weights = params
+        ray_tpu.get([r.set_weights.remote(params) for r in self.runners])
+
+    def sample(self) -> List[Dict[str, np.ndarray]]:
+        """Parallel fragment collection; dead runners are respawned (with the
+        last-synced weights) and skipped this round."""
+        import ray_tpu
+
+        refs = [(i, r.sample.remote()) for i, r in enumerate(self.runners)]
+        out = []
+        dead = []
+        for i, ref in refs:
+            try:
+                out.append(ray_tpu.get(ref, timeout=120))
+            except Exception:
+                dead.append(i)
+        for i in dead:
+            self.runners[i] = self._make(i)
+            if self._weights is not None:
+                try:
+                    ray_tpu.get(
+                        self.runners[i].set_weights.remote(self._weights),
+                        timeout=60,
+                    )
+                except Exception:
+                    pass
+        return out
+
+    def metrics(self) -> List[Dict[str, Any]]:
+        import ray_tpu
+
+        out = []
+        for r in self.runners:
+            try:
+                out.append(ray_tpu.get(r.metrics.remote(), timeout=30))
+            except Exception:
+                pass
+        return out
+
+    def stop(self):
+        import ray_tpu
+
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass

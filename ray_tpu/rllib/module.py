@@ -1,0 +1,130 @@
+"""RLModule: policy/value networks in pure functional JAX.
+
+Reference analog: ``rllib/core/rl_module/`` (RLModule abstraction; the
+default PPO torch module is an MLP encoder with policy and value heads).
+TPU-first choices: params are a plain pytree (same idiom as
+``ray_tpu/models/gpt2.py``) so learner steps jit/shard them directly; action
+distributions are computed inside jit (categorical for discrete spaces,
+diagonal gaussian for box spaces).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class RLModuleConfig:
+    obs_dim: int
+    action_dim: int
+    discrete: bool = True
+    hidden: Sequence[int] = (64, 64)
+    dtype: Any = jnp.float32
+    # Initial log-stddev for gaussian policies.
+    init_logstd: float = 0.0
+
+
+def _init_mlp(rng, sizes, dtype):
+    layers = []
+    for i, (fan_in, fan_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        rng, k = jax.random.split(rng)
+        scale = np.sqrt(2.0 / fan_in)
+        # final layer: small init stabilizes early policy/value outputs
+        if i == len(sizes) - 2:
+            scale = 0.01
+        layers.append({
+            "w": (jax.random.normal(k, (fan_in, fan_out)) * scale).astype(dtype),
+            "b": jnp.zeros((fan_out,), dtype),
+        })
+    return layers
+
+
+def init_params(config: RLModuleConfig, rng) -> Dict[str, Any]:
+    k_pi, k_vf = jax.random.split(rng)
+    sizes = [config.obs_dim, *config.hidden]
+    params = {
+        "pi": _init_mlp(k_pi, sizes + [config.action_dim], config.dtype),
+        "vf": _init_mlp(k_vf, sizes + [1], config.dtype),
+    }
+    if not config.discrete:
+        params["logstd"] = jnp.full(
+            (config.action_dim,), config.init_logstd, config.dtype
+        )
+    return params
+
+
+def _mlp(layers, x):
+    for i, lyr in enumerate(layers):
+        x = x @ lyr["w"] + lyr["b"]
+        if i < len(layers) - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+def forward_policy(params, config: RLModuleConfig, obs):
+    """Returns distribution inputs: logits (discrete) or mean (box)."""
+    return _mlp(params["pi"], obs)
+
+
+def forward_value(params, config: RLModuleConfig, obs):
+    return _mlp(params["vf"], obs)[..., 0]
+
+
+def sample_action(params, config: RLModuleConfig, obs, rng):
+    """(action, logp, value) for rollout collection — one fused jit."""
+    out = forward_policy(params, config, obs)
+    value = forward_value(params, config, obs)
+    if config.discrete:
+        logits = jax.nn.log_softmax(out)
+        action = jax.random.categorical(rng, out)
+        logp = jnp.take_along_axis(logits, action[..., None], -1)[..., 0]
+    else:
+        std = jnp.exp(params["logstd"])
+        noise = jax.random.normal(rng, out.shape)
+        action = out + std * noise
+        logp = _gaussian_logp(action, out, params["logstd"])
+    return action, logp, value
+
+
+def _gaussian_logp(x, mean, logstd):
+    var = jnp.exp(2 * logstd)
+    return jnp.sum(
+        -0.5 * ((x - mean) ** 2 / var + 2 * logstd + jnp.log(2 * jnp.pi)),
+        axis=-1,
+    )
+
+
+def logp_entropy_value(params, config: RLModuleConfig, obs, actions):
+    """(logp, entropy, value) of given actions — the learner-side forward."""
+    out = forward_policy(params, config, obs)
+    value = forward_value(params, config, obs)
+    if config.discrete:
+        logits = jax.nn.log_softmax(out)
+        logp = jnp.take_along_axis(
+            logits, actions.astype(jnp.int32)[..., None], -1
+        )[..., 0]
+        probs = jnp.exp(logits)
+        entropy = -jnp.sum(probs * logits, axis=-1)
+    else:
+        logp = _gaussian_logp(actions, out, params["logstd"])
+        entropy = jnp.sum(params["logstd"] + 0.5 * jnp.log(2 * jnp.pi * jnp.e))
+        entropy = jnp.broadcast_to(entropy, logp.shape)
+    return logp, entropy, value
+
+
+def module_config_for_env(env) -> RLModuleConfig:
+    """Infer obs/action dims from a gymnasium env."""
+    import gymnasium as gym
+
+    obs_dim = int(np.prod(env.observation_space.shape))
+    if isinstance(env.action_space, gym.spaces.Discrete):
+        return RLModuleConfig(obs_dim=obs_dim, action_dim=int(env.action_space.n),
+                              discrete=True)
+    return RLModuleConfig(
+        obs_dim=obs_dim, action_dim=int(np.prod(env.action_space.shape)),
+        discrete=False,
+    )
