@@ -432,6 +432,16 @@ class CoreWorker:
         self._register_owned(hex_, nested=nested)
         return ObjectRef(oid, tuple(self.addr))
 
+    def put_serialized(self, frames: List[bytes], total_bytes: int) -> ObjectRef:
+        """Store pre-serialized frames as a new owned object (skips the
+        second serialization a put(value) would do). Caller guarantees the
+        value holds no nested ObjectRefs (no borrow pinning happens here)."""
+        oid = self._next_put_id()
+        hex_ = oid.hex()
+        self.run_sync(self._store_object(hex_, frames, total_bytes))
+        self._register_owned(hex_)
+        return ObjectRef(oid, tuple(self.addr))
+
     async def _store_object(self, hex_: str, frames: List[bytes], size: int):
         if size <= INLINE_OBJECT_MAX:
             self.memory_store[hex_] = ("mem", frames)
@@ -1331,7 +1341,15 @@ class CoreWorker:
         await self._admit_in_order(inst, caller, seq)
         loop = asyncio.get_running_loop()
         try:
-            method = getattr(inst.instance, h["method"], None)
+            if h["method"] == "__rt_apply__":
+                # Generic dispatch: run fn(instance, *args) on this actor.
+                # Used by compiled graphs to install per-actor exec loops
+                # (reference analog: compiled_dag_node.py:185 exec loop tasks
+                # submitted onto the DAG's actors).
+                def method(fn, *a, **kw):
+                    return fn(inst.instance, *a, **kw)
+            else:
+                method = getattr(inst.instance, h["method"], None)
             if method is None:
                 raise protocol.RpcError(
                     f"TaskError: actor has no method '{h['method']}'"

@@ -40,6 +40,18 @@ class ActorMethod:
     def options(self, num_returns: int = 1, **_):
         return ActorMethod(self._handle, self._method_name, num_returns)
 
+    def bind(self, *args, **kwargs):
+        """Build a DAG node instead of submitting (reference:
+        ``dag/class_node.py`` — ``actor.method.bind``)."""
+        if self._num_returns != 1:
+            raise NotImplementedError(
+                "bind() does not support num_returns != 1; return a tuple "
+                "and split downstream"
+            )
+        from ray_tpu.dag.nodes import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._method_name, args, kwargs)
+
     def remote(self, *args, **kwargs):
         worker = get_global_worker()
         refs = worker.submit_actor_task(

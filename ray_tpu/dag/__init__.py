@@ -1,0 +1,18 @@
+"""Compiled graphs (aDAG-equivalent).
+
+Reference analog: ``python/ray/dag/`` + ``python/ray/experimental/channel/``.
+"""
+from ray_tpu.dag.channel import Channel, ChannelClosedError, ChannelTimeoutError
+from ray_tpu.dag.compiled import CompiledDAG, CompiledDAGRef
+from ray_tpu.dag.nodes import (
+    ClassMethodNode,
+    DAGNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+__all__ = [
+    "Channel", "ChannelClosedError", "ChannelTimeoutError",
+    "CompiledDAG", "CompiledDAGRef",
+    "ClassMethodNode", "DAGNode", "InputNode", "MultiOutputNode",
+]

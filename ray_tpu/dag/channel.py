@@ -1,0 +1,195 @@
+"""Compiled-graph channels: single-writer single-reader shm mailboxes.
+
+Reference analog: ``python/ray/experimental/channel/shared_memory_channel.py``
+backed by C++ mutable plasma objects (``experimental_mutable_object_manager.h:44``
+— versioned, reader/writer-synced shm buffers). Same design, serverless: a
+POSIX shm segment holding {write_seq, read_seq, stop, payload}; the writer
+blocks until the previous value is consumed (1-slot backpressure — exactly
+the per-edge buffering a pipeline-parallel microbatch loop needs), the reader
+blocks until a new version is published. Values too big for the segment
+spill to the object store and the channel carries the ObjectRef.
+"""
+from __future__ import annotations
+
+import pickle
+import struct
+import time
+from multiprocessing import resource_tracker, shared_memory
+from typing import Any, List, Optional
+
+_MAGIC = 0x52544348  # "RTCH"
+_HDR = struct.Struct("<IIQQQBB6x")  # magic, cap, wseq, rseq, nbytes, kind, stop
+_FRAME_COUNT = struct.Struct("<I")
+_FRAME_LEN = struct.Struct("<Q")
+KIND_INLINE = 0
+KIND_REF = 1
+
+DEFAULT_CAPACITY = 1 << 20
+
+
+class ChannelTimeoutError(TimeoutError):
+    pass
+
+
+class ChannelClosedError(RuntimeError):
+    pass
+
+
+def _pack_frames(frames: List[bytes]) -> bytes:
+    out = bytearray()
+    out += _FRAME_COUNT.pack(len(frames))
+    for f in frames:
+        out += _FRAME_LEN.pack(len(f))
+    for f in frames:
+        out += bytes(f)
+    return bytes(out)
+
+
+def _unpack_frames(buf: memoryview) -> List[bytes]:
+    n = _FRAME_COUNT.unpack_from(buf, 0)[0]
+    pos = _FRAME_COUNT.size
+    lens = []
+    for _ in range(n):
+        lens.append(_FRAME_LEN.unpack_from(buf, pos)[0])
+        pos += _FRAME_LEN.size
+    frames = []
+    for ln in lens:
+        # copy: the segment is overwritten by the next write
+        frames.append(bytes(buf[pos:pos + ln]))
+        pos += ln
+    return frames
+
+
+class Channel:
+    """One direction, one writer process, one reader process."""
+
+    def __init__(self, name: str, capacity: int = DEFAULT_CAPACITY,
+                 create: bool = False):
+        self.name = name
+        if create:
+            self._shm = shared_memory.SharedMemory(
+                name=name, create=True, size=capacity + _HDR.size
+            )
+            _HDR.pack_into(self._shm.buf, 0, _MAGIC, capacity, 0, 0, 0, 0, 0)
+        else:
+            self._shm = shared_memory.SharedMemory(name=name, create=False)
+        try:
+            resource_tracker.unregister(self._shm._name, "shared_memory")  # noqa: SLF001
+        except Exception:
+            pass
+        self.capacity = _HDR.unpack_from(self._shm.buf, 0)[1]
+        self.created = create
+        # Spilled-value refs pinned by the WRITER until the reader consumes
+        # that seq: the ObjectRef inside the channel is just bytes — without
+        # this, the only live ref dies when write() returns and the store
+        # frees the object before the reader can get() it.
+        self._spills: List[tuple] = []
+
+    # -- raw header ops ------------------------------------------------------
+    # Fields are written individually: writer owns {wseq, nbytes, kind},
+    # reader owns {rseq}, the tearing-down driver owns {stop}. No op may
+    # rewrite another owner's field or a concurrent update would be lost
+    # (e.g. a mid-write actor clobbering the stop flag during teardown).
+
+    _OFF_WSEQ, _OFF_RSEQ, _OFF_NBYTES, _OFF_KIND, _OFF_STOP = 8, 16, 24, 32, 33
+    _U64 = struct.Struct("<Q")
+
+    def _hdr(self):
+        return _HDR.unpack_from(self._shm.buf, 0)
+
+    def set_stop(self):
+        self._shm.buf[self._OFF_STOP] = 1
+
+    @property
+    def stopped(self) -> bool:
+        return self._shm.buf[self._OFF_STOP] == 1
+
+    def _wait(self, cond, timeout: Optional[float]):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        delay = 0.0001
+        while True:
+            hdr = self._hdr()
+            if hdr[6]:
+                raise ChannelClosedError(f"channel {self.name} torn down")
+            if cond(hdr):
+                return hdr
+            if deadline is not None and time.monotonic() > deadline:
+                raise ChannelTimeoutError(f"channel {self.name} wait timed out")
+            time.sleep(delay)
+            delay = min(delay * 2, 0.002)
+
+    # -- value ops -----------------------------------------------------------
+
+    def write(self, value: Any, ctx=None, timeout: Optional[float] = None):
+        """Serialize and publish; blocks while the previous value is
+        unconsumed (backpressure)."""
+        if ctx is None:
+            from ray_tpu._private.worker import get_global_worker
+
+            ctx = get_global_worker().ctx
+        sobj = ctx.serialize(value)
+        frames = sobj.to_frames()
+        kind = KIND_INLINE
+        ref = None
+        total = sum(len(f) for f in frames)
+        overhead = _FRAME_COUNT.size + _FRAME_LEN.size * len(frames)
+        if total + overhead <= self.capacity:
+            blob = _pack_frames(frames)
+        else:
+            # Spill the already-serialized frames (no second serialization)
+            # and carry the ref. Channel values must not contain nested
+            # ObjectRefs (no borrow registration on this path).
+            from ray_tpu._private.worker import get_global_worker
+
+            ref = get_global_worker().put_serialized(
+                [bytes(f) for f in frames], total
+            )
+            blob = pickle.dumps(ref)
+            kind = KIND_REF
+            if len(blob) > self.capacity:
+                raise ValueError("spilled ref larger than channel capacity")
+        hdr = self._wait(lambda h: h[2] == h[3], timeout)  # consumed
+        w = hdr[2]
+        # this wait proves seqs <= w are consumed: drop their spill pins
+        self._spills = [(sq, r) for sq, r in self._spills if sq > w]
+        self._shm.buf[_HDR.size:_HDR.size + len(blob)] = blob
+        # publish LAST: nbytes/kind first, then the seq bump readers spin on
+        self._U64.pack_into(self._shm.buf, self._OFF_NBYTES, len(blob))
+        self._shm.buf[self._OFF_KIND] = kind
+        self._U64.pack_into(self._shm.buf, self._OFF_WSEQ, w + 1)
+        if ref is not None:
+            self._spills.append((w + 1, ref))
+
+    def read(self, ctx=None, timeout: Optional[float] = None) -> Any:
+        """Block for the next value, consume it, return it."""
+        if ctx is None:
+            from ray_tpu._private.worker import get_global_worker
+
+            ctx = get_global_worker().ctx
+        hdr = self._wait(lambda h: h[2] > h[3], timeout)  # unread value
+        w, nbytes, kind = hdr[2], hdr[4], hdr[5]
+        buf = memoryview(self._shm.buf)[_HDR.size:_HDR.size + nbytes]
+        if kind == KIND_REF:
+            import ray_tpu
+
+            ref = pickle.loads(bytes(buf))
+            value = ray_tpu.get(ref)
+        else:
+            frames = _unpack_frames(buf)
+            value = ctx.deserialize_frames(frames)
+        del buf
+        self._U64.pack_into(self._shm.buf, self._OFF_RSEQ, w)  # consume
+        return value
+
+    def close(self):
+        try:
+            self.set_stop()
+        except Exception:
+            pass
+        self._spills.clear()
+        if self.created:
+            try:
+                self._shm.unlink()
+            except FileNotFoundError:
+                pass
+        # keep the mapping (readers may be mid-read); dies with the process

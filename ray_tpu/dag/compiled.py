@@ -1,0 +1,279 @@
+"""Compiled DAG: static actor graphs with pre-allocated shm channels.
+
+Reference analog: ``python/ray/dag/compiled_dag_node.py`` (``CompiledDAG``
+:804, per-actor executable tasks :477, exec loop :185). The graph is
+compiled ONCE into per-actor execution loops connected by 1-slot shm
+channels (``channel.py``); per-step scheduler/RPC overhead disappears, and
+the per-edge backpressure gives pipeline-parallel microbatch semantics for
+free: actor A can run step t+1 while actor B runs step t.
+
+TPU story: each actor's task list is normal Python — when the methods are
+jitted jax programs the loop becomes "read host buffer → device_put → run
+compiled XLA → host → write", i.e. the per-stage body of a PP schedule. On a
+mesh, stages use jax transfer collectives inside one program instead
+(parallel/pipeline.py); the channel path is the host/DCN fallback.
+"""
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.dag.channel import (
+    Channel,
+    ChannelClosedError,
+    ChannelTimeoutError,
+    DEFAULT_CAPACITY,
+)
+from ray_tpu.dag.nodes import (
+    ClassMethodNode,
+    DAGNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+
+def _dag_actor_loop(instance, plan: dict):
+    """Runs ON the actor (via __rt_apply__): the compiled exec loop."""
+    from ray_tpu._private.worker import get_global_worker
+
+    ctx = get_global_worker().ctx
+    chans = {name: Channel(name) for name in plan["channels"]}
+    try:
+        while True:
+            for task in plan["tasks"]:
+                if task.get("trigger"):
+                    chans[task["trigger"]].read(ctx)  # step gate; value unused
+                args = [
+                    chans[spec[1]].read(ctx) if spec[0] == "ch" else spec[1]
+                    for spec in task["args"]
+                ]
+                kwargs = {
+                    k: chans[spec[1]].read(ctx) if spec[0] == "ch" else spec[1]
+                    for k, spec in task["kwargs"].items()
+                }
+                result = getattr(instance, task["method"])(*args, **kwargs)
+                for out in task["out"]:
+                    chans[out].write(result, ctx)
+    except ChannelClosedError:
+        return "torn_down"
+
+
+class CompiledDAGRef:
+    """Future for one execute() (reference: ``CompiledDAGRef``)."""
+
+    def __init__(self, dag: "CompiledDAG", seq: int):
+        self._dag = dag
+        self._seq = seq
+        self._taken = False
+
+    def get(self, timeout: Optional[float] = 60.0):
+        if self._taken:
+            raise ValueError("CompiledDAGRef.get() may only be called once")
+        self._taken = True
+        return self._dag._fetch(self._seq, timeout)
+
+
+class CompiledDAG:
+    def __init__(self, root: DAGNode, channel_capacity: int = DEFAULT_CAPACITY,
+                 submit_timeout: float = 60.0):
+        self._capacity = channel_capacity
+        self._timeout = submit_timeout
+        self._torn_down = False
+
+        # ---- walk the graph: topo order, single InputNode ----
+        order: List[DAGNode] = []
+        seen: Dict[int, bool] = {}
+
+        def visit(n: DAGNode):
+            if id(n) in seen:
+                if not seen[id(n)]:
+                    raise ValueError("cycle in DAG")
+                return
+            seen[id(n)] = False
+            for c in n._dag_children():
+                visit(c)
+            seen[id(n)] = True
+            order.append(n)
+
+        visit(root)
+        self._input_node = next(
+            (n for n in order if isinstance(n, InputNode)), None
+        )
+        inputs = [n for n in order if isinstance(n, InputNode)]
+        if len(inputs) > 1:
+            raise ValueError("a DAG may have at most one InputNode")
+        self._outputs: List[DAGNode] = (
+            list(root.args) if isinstance(root, MultiOutputNode) else [root]
+        )
+        for out in self._outputs:
+            if not isinstance(out, ClassMethodNode):
+                raise ValueError("DAG outputs must be actor method nodes")
+
+        # ---- allocate channels: one per (producer → consumer) edge ----
+        self._channels: Dict[str, Channel] = {}
+        # producer node id -> list of channel names it must write
+        out_chs: Dict[int, List[str]] = {}
+        # (consumer node id, position) -> channel name
+        in_ch: Dict[Tuple[int, Any], str] = {}
+        self._input_chs: List[str] = []
+
+        def new_channel() -> str:
+            name = f"/rt_ch_{uuid.uuid4().hex[:16]}"
+            self._channels[name] = Channel(
+                name, capacity=self._capacity, create=True
+            )
+            return name
+
+        trigger_ch: Dict[int, str] = {}
+        for n in order:
+            if not isinstance(n, ClassMethodNode):
+                continue
+            has_upstream = False
+            for pos, a in enumerate(n.args):
+                if isinstance(a, InputNode):
+                    ch = new_channel()
+                    self._input_chs.append(ch)
+                    in_ch[(id(n), pos)] = ch
+                    has_upstream = True
+                elif isinstance(a, ClassMethodNode):
+                    ch = new_channel()
+                    out_chs.setdefault(id(a), []).append(ch)
+                    in_ch[(id(n), pos)] = ch
+                    has_upstream = True
+            for k, v in n.kwargs.items():
+                if isinstance(v, InputNode):
+                    ch = new_channel()
+                    self._input_chs.append(ch)
+                    in_ch[(id(n), k)] = ch
+                    has_upstream = True
+                elif isinstance(v, ClassMethodNode):
+                    ch = new_channel()
+                    out_chs.setdefault(id(v), []).append(ch)
+                    in_ch[(id(n), k)] = ch
+                    has_upstream = True
+            if not has_upstream:
+                # Constant-only task: without an upstream edge its exec loop
+                # would free-run ahead of execute() (side effects firing with
+                # no submit). Gate every iteration on a driver trigger.
+                ch = new_channel()
+                self._input_chs.append(ch)
+                trigger_ch[id(n)] = ch
+        self._output_chs: List[str] = []
+        for out in self._outputs:
+            ch = new_channel()
+            out_chs.setdefault(id(out), []).append(ch)
+            self._output_chs.append(ch)
+
+        # ---- per-actor plans (tasks stay in global topo order) ----
+        plans: Dict[str, dict] = {}
+        actors: Dict[str, Any] = {}
+        for n in order:
+            if not isinstance(n, ClassMethodNode):
+                continue
+            aid = n.actor._actor_id
+            actors[aid] = n.actor
+            plan = plans.setdefault(aid, {"tasks": [], "channels": set()})
+            arg_specs = []
+            for pos, a in enumerate(n.args):
+                if isinstance(a, DAGNode):
+                    ch = in_ch[(id(n), pos)]
+                    arg_specs.append(("ch", ch))
+                    plan["channels"].add(ch)
+                else:
+                    arg_specs.append(("val", a))
+            kwarg_specs = {}
+            for k, v in n.kwargs.items():
+                if isinstance(v, DAGNode):
+                    ch = in_ch[(id(n), k)]
+                    kwarg_specs[k] = ("ch", ch)
+                    plan["channels"].add(ch)
+                else:
+                    kwarg_specs[k] = ("val", v)
+            outs = out_chs.get(id(n), [])
+            plan["channels"].update(outs)
+            trig = trigger_ch.get(id(n))
+            if trig is not None:
+                plan["channels"].add(trig)
+            plan["tasks"].append({
+                "method": n.method_name,
+                "args": arg_specs,
+                "kwargs": kwarg_specs,
+                "out": outs,
+                "trigger": trig,
+            })
+
+        # ---- install exec loops ----
+        from ray_tpu.actor import ActorMethod
+
+        self._loop_refs = []
+        for aid, plan in plans.items():
+            plan["channels"] = sorted(plan["channels"])
+            self._loop_refs.append(
+                ActorMethod(actors[aid], "__rt_apply__").remote(
+                    _dag_actor_loop, plan
+                )
+            )
+        self._next_submit = 0
+        self._next_fetch = 0
+        self._buffered: Dict[int, Any] = {}
+        self._partial: List[Any] = []  # outputs read so far for the step
+
+    # ------------------------------------------------------------------ API
+
+    def execute(self, *input_values) -> CompiledDAGRef:
+        if self._torn_down:
+            raise RuntimeError("compiled DAG torn down")
+        value = input_values[0] if input_values else None
+        for ch in self._input_chs:
+            self._channels[ch].write(value, timeout=self._timeout)
+        seq = self._next_submit
+        self._next_submit += 1
+        return CompiledDAGRef(self, seq)
+
+    def _fetch(self, seq: int, timeout: Optional[float]):
+        if seq in self._buffered:
+            return self._buffered.pop(seq)
+        if seq < self._next_fetch:
+            raise ValueError(f"result {seq} already consumed")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            # Resume a partially-read step: a timeout mid-step must not drop
+            # consumed outputs or the channels would go off-by-one forever.
+            while len(self._partial) < len(self._output_chs):
+                ch = self._output_chs[len(self._partial)]
+                t = None if deadline is None else max(
+                    deadline - time.monotonic(), 0
+                )
+                self._partial.append(self._channels[ch].read(timeout=t))
+            outs, self._partial = self._partial, []
+            got = self._next_fetch
+            self._next_fetch += 1
+            value = outs if len(outs) > 1 else outs[0]
+            if got == seq:
+                return value
+            self._buffered[got] = value
+            if deadline is not None and time.monotonic() > deadline:
+                raise ChannelTimeoutError(f"result {seq} not produced in time")
+
+    def teardown(self):
+        if self._torn_down:
+            return
+        self._torn_down = True
+        for ch in self._channels.values():
+            ch.set_stop()
+        import ray_tpu
+
+        for ref in self._loop_refs:
+            try:
+                ray_tpu.get(ref, timeout=30)
+            except Exception:
+                pass
+        for ch in self._channels.values():
+            ch.close()
+
+    def __del__(self):
+        try:
+            self.teardown()
+        except Exception:
+            pass

@@ -1,0 +1,101 @@
+"""DAG node types + bind API.
+
+Reference analog: ``python/ray/dag/`` — ``InputNode`` (with-block),
+``ClassMethodNode`` produced by ``actor.method.bind(...)``,
+``MultiOutputNode``. Nodes form a static graph over actors that either
+executes eagerly (``execute``) or compiles to channel-connected per-actor
+exec loops (``experimental_compile`` → ``compiled.py``).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class DAGNode:
+    def __init__(self, upstream_args: Tuple, upstream_kwargs: Dict[str, Any]):
+        self.args = upstream_args
+        self.kwargs = upstream_kwargs
+
+    def _dag_children(self) -> List["DAGNode"]:
+        out = [a for a in self.args if isinstance(a, DAGNode)]
+        out += [v for v in self.kwargs.values() if isinstance(v, DAGNode)]
+        return out
+
+    # -- eager execution (uncompiled path) ----------------------------------
+
+    def execute(self, *input_values):
+        """Run the DAG once via normal actor calls (reference:
+        ``DAGNode.execute`` interpreted path)."""
+        cache: Dict[int, Any] = {}
+        return _exec_eager(self, input_values[0] if input_values else None,
+                           cache)
+
+    def experimental_compile(self, **kwargs):
+        from ray_tpu.dag.compiled import CompiledDAG
+
+        return CompiledDAG(self, **kwargs)
+
+
+def _exec_eager(node: DAGNode, input_value, cache: Dict[int, Any]):
+    if id(node) in cache:
+        return cache[id(node)]
+    if isinstance(node, InputNode):
+        result = input_value
+    elif isinstance(node, MultiOutputNode):
+        import ray_tpu
+        from ray_tpu.object_ref import ObjectRef
+
+        refs = [_exec_eager(a, input_value, cache) for a in node.args]
+        result = [
+            ray_tpu.get(r) if isinstance(r, ObjectRef) else r for r in refs
+        ]
+    elif isinstance(node, ClassMethodNode):
+        import ray_tpu
+        from ray_tpu.object_ref import ObjectRef
+
+        args = [
+            _exec_eager(a, input_value, cache) if isinstance(a, DAGNode) else a
+            for a in node.args
+        ]
+        kwargs = {
+            k: _exec_eager(v, input_value, cache) if isinstance(v, DAGNode) else v
+            for k, v in node.kwargs.items()
+        }
+        # upstream eager results are ObjectRefs; resolve before the call so
+        # actor methods see values (constants pass through untouched)
+        args = [ray_tpu.get(a) if isinstance(a, ObjectRef) else a for a in args]
+        result = getattr(node.actor, node.method_name).remote(*args, **kwargs)
+    else:
+        raise TypeError(f"unknown node {node}")
+    cache[id(node)] = result
+    return result
+
+
+class InputNode(DAGNode):
+    """The DAG's input placeholder; used as a with-block (reference:
+    ``dag/input_node.py``)."""
+
+    def __init__(self):
+        super().__init__((), {})
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+class ClassMethodNode(DAGNode):
+    def __init__(self, actor, method_name: str, args: Tuple,
+                 kwargs: Dict[str, Any]):
+        super().__init__(args, kwargs)
+        self.actor = actor
+        self.method_name = method_name
+
+    def __repr__(self):
+        return f"ClassMethodNode({self.method_name} on {self.actor})"
+
+
+class MultiOutputNode(DAGNode):
+    def __init__(self, outputs: List[DAGNode]):
+        super().__init__(tuple(outputs), {})

@@ -119,28 +119,35 @@ class Algorithm:
         self.runner_group.sync_weights(self.learner.get_weights())
         self.iteration = 0
         self._total_env_steps = 0
+        self._last_step_count = 0
         self._recent_returns: List[float] = []
 
     # ---------------------------------------------------------------- train
 
-    def training_step(self) -> Dict[str, float]:
-        fragments = self.runner_group.sample()
-        if not fragments:
-            return {"num_healthy_runners": 0}
-        # Concat along the env axis: [T, N_total, ...] — one static-shaped
-        # learner batch per step.
-        batch = {
+    def _build_batch(self, fragments) -> Dict[str, np.ndarray]:
+        """Concat fragments along the env axis: [T, N_total, ...] — one
+        static-shaped learner batch per step. bootstrap_value is [N]."""
+        return {
             k: np.concatenate([f[k] for f in fragments], axis=-1)
             if fragments[0][k].ndim == 1
             else np.concatenate([f[k] for f in fragments], axis=1)
             for k in fragments[0]
         }
+
+    def training_step(self) -> Dict[str, float]:
+        fragments = self.runner_group.sample()
+        if not fragments:
+            return {"num_healthy_runners": 0}
+        batch = self._build_batch(fragments)
         metrics = self.learner.update(batch)
         self.runner_group.sync_weights(self.learner.get_weights())
-        self._total_env_steps += (
-            batch["rewards"].shape[0] * batch["rewards"].shape[1]
-        )
+        self._record_env_steps(batch)
         return metrics
+
+    def _record_env_steps(self, batch):
+        steps = batch["rewards"].shape[0] * batch["rewards"].shape[1]
+        self._total_env_steps += steps
+        self._last_step_count = steps
 
     def train(self) -> Dict[str, Any]:
         t0 = time.perf_counter()
@@ -163,7 +170,8 @@ class Algorithm:
             "episode_return_mean": mean_ret,
             "num_episodes": num_episodes,
             "num_env_steps_sampled_lifetime": self._total_env_steps,
-            "env_steps_per_sec": batch_steps_per_sec(dt, self.config),
+            # actually-sampled steps this iteration (dead runners excluded)
+            "env_steps_per_sec": self._last_step_count / max(dt, 1e-9),
             **metrics,
         }
 
@@ -206,15 +214,6 @@ class Algorithm:
         hp_keys = set(LearnerHyperparams().__dict__)
         cfg.training(**{k: v for k, v in overrides.items() if k in hp_keys})
         return cfg.build_algo()
-
-
-def batch_steps_per_sec(dt, config: AlgorithmConfig) -> float:
-    steps = (
-        config.rollout_fragment_length
-        * config.num_envs_per_runner
-        * config.num_env_runners
-    )
-    return steps / max(dt, 1e-9)
 
 
 def make_trainable(config: AlgorithmConfig, stop_iters: int = 10,

@@ -28,9 +28,6 @@ class IMPALAConfig(AlgorithmConfig):
         )
         self.broadcast_interval = 2  # learner updates between weight syncs
 
-    def env_runners(self, **kwargs):
-        return super().env_runners(**kwargs)
-
 
 class IMPALA(Algorithm):
     def __init__(self, config: IMPALAConfig):
@@ -41,16 +38,9 @@ class IMPALA(Algorithm):
         fragments = self.runner_group.sample()
         if not fragments:
             return {"num_healthy_runners": 0}
-        batch = {
-            k: np.concatenate([f[k] for f in fragments], axis=-1)
-            if fragments[0][k].ndim == 1
-            else np.concatenate([f[k] for f in fragments], axis=1)
-            for k in fragments[0]
-        }
+        batch = self._build_batch(fragments)
         metrics = self.learner.update(batch)
-        self._total_env_steps += (
-            batch["rewards"].shape[0] * batch["rewards"].shape[1]
-        )
+        self._record_env_steps(batch)
         self._since_broadcast += 1
         interval = getattr(self.config, "broadcast_interval", 1)
         if self._since_broadcast >= interval:

@@ -1,0 +1,239 @@
+"""Compiled graphs: channels, eager DAGs, compiled exec loops.
+
+Reference analog: ``python/ray/dag/tests`` (bind/execute/compile semantics,
+channel buffering).
+"""
+import threading
+import time
+import uuid
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import (
+    Channel,
+    ChannelClosedError,
+    CompiledDAGRef,
+    InputNode,
+    MultiOutputNode,
+)
+
+
+# --------------------------------------------------------------- channels
+
+
+class _Ctx:
+    """Standalone serializer for channel unit tests (no cluster)."""
+
+    def __init__(self):
+        from ray_tpu._private.serialization import SerializationContext
+
+        self._ctx = SerializationContext()
+
+    def serialize(self, v):
+        return self._ctx.serialize(v)
+
+    def deserialize_frames(self, frames):
+        return self._ctx.deserialize_frames(frames)
+
+
+def _chname():
+    return f"/rt_cht_{uuid.uuid4().hex[:12]}"
+
+
+def test_channel_roundtrip():
+    ctx = _Ctx()
+    ch = Channel(_chname(), capacity=1 << 16, create=True)
+    try:
+        ch.write({"a": np.arange(100)}, ctx)
+        out = ch.read(ctx)
+        assert list(out) == ["a"]
+        np.testing.assert_array_equal(out["a"], np.arange(100))
+    finally:
+        ch.close()
+
+
+def test_channel_backpressure_and_order():
+    ctx = _Ctx()
+    ch = Channel(_chname(), capacity=1 << 14, create=True)
+    got = []
+
+    def reader():
+        for _ in range(10):
+            time.sleep(0.01)
+            got.append(ch.read(ctx))
+
+    t = threading.Thread(target=reader)
+    t.start()
+    try:
+        for i in range(10):  # writer must block on the 1-slot buffer
+            ch.write(i, ctx, timeout=10)
+        t.join(timeout=10)
+        assert got == list(range(10))
+    finally:
+        ch.close()
+
+
+def test_channel_stop_unblocks_reader():
+    ctx = _Ctx()
+    ch = Channel(_chname(), capacity=1 << 14, create=True)
+    err = []
+
+    def reader():
+        try:
+            ch.read(ctx, timeout=30)
+        except ChannelClosedError as e:
+            err.append(e)
+
+    t = threading.Thread(target=reader)
+    t.start()
+    time.sleep(0.1)
+    ch.set_stop()
+    t.join(timeout=10)
+    assert err, "reader not unblocked by stop"
+
+
+# ------------------------------------------------------------ dag fixtures
+
+
+@pytest.fixture
+def dag_cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Adder:
+    def __init__(self, inc):
+        self.inc = inc
+        self.calls = 0
+
+    def add(self, x):
+        self.calls += 1
+        return x + self.inc
+
+    def combine(self, a, b):
+        return a + b
+
+    def num_calls(self):
+        return self.calls
+
+
+def test_eager_execute(dag_cluster):
+    a = Adder.remote(1)
+    b = Adder.remote(10)
+    with InputNode() as inp:
+        dag = b.add.bind(a.add.bind(inp))
+    ref = dag.execute(5)
+    assert ray_tpu.get(ref) == 16
+
+
+def test_compiled_linear_pipeline(dag_cluster):
+    a = Adder.remote(1)
+    b = Adder.remote(10)
+    with InputNode() as inp:
+        dag = b.add.bind(a.add.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        for i in range(5):
+            ref = compiled.execute(i)
+            assert isinstance(ref, CompiledDAGRef)
+            assert ref.get() == i + 11
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_pipelining_overlap(dag_cluster):
+    """Submit several inputs before collecting: per-edge backpressure allows
+    stage overlap (the PP microbatch property)."""
+    a = Adder.remote(100)
+    with InputNode() as inp:
+        dag = a.add.bind(inp)
+    compiled = dag.experimental_compile()
+    try:
+        refs = [compiled.execute(i) for i in range(2)]
+        assert [r.get() for r in refs] == [100, 101]
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_fan_in(dag_cluster):
+    a = Adder.remote(1)
+    b = Adder.remote(2)
+    c = Adder.remote(0)
+    with InputNode() as inp:
+        dag = c.combine.bind(a.add.bind(inp), b.add.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute(10).get() == 23  # (10+1) + (10+2)
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_multi_output(dag_cluster):
+    a = Adder.remote(1)
+    b = Adder.remote(2)
+    with InputNode() as inp:
+        dag = MultiOutputNode([a.add.bind(inp), b.add.bind(inp)])
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute(5).get() == [6, 7]
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_large_payload_spills(dag_cluster):
+    a = Adder.remote(0.0)
+    with InputNode() as inp:
+        dag = a.add.bind(inp)
+    compiled = dag.experimental_compile(channel_capacity=1 << 12)
+    try:
+        big = np.ones(100_000)  # ~800KB >> 4KB channel
+        out = compiled.execute(big).get()
+        np.testing.assert_array_equal(out, big)
+    finally:
+        compiled.teardown()
+
+
+def test_teardown_then_execute_raises(dag_cluster):
+    a = Adder.remote(1)
+    with InputNode() as inp:
+        dag = a.add.bind(inp)
+    compiled = dag.experimental_compile()
+    assert compiled.execute(1).get() == 2
+    compiled.teardown()
+    with pytest.raises(RuntimeError):
+        compiled.execute(2)
+    # actor survives teardown and serves normal calls again
+    assert ray_tpu.get(a.num_calls.remote()) >= 1
+
+
+def test_constant_only_task_gated_per_execute(dag_cluster):
+    """A task with no upstream edges must run exactly once per execute(),
+    not free-run ahead (side effects gated by a driver trigger channel)."""
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def tick(self):
+            self.n += 1
+            return self.n
+
+        def count(self):
+            return self.n
+
+    c = Counter.remote()
+    dag = c.tick.bind()
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute().get() == 1
+        assert compiled.execute().get() == 2
+        time.sleep(0.5)  # free-running loop would keep ticking here
+    finally:
+        compiled.teardown()
+    # (queried post-teardown: the exec loop holds the actor's only
+    # concurrency slot while compiled)
+    assert ray_tpu.get(c.count.remote(), timeout=30) == 2
