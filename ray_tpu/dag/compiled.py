@@ -35,6 +35,8 @@ from ray_tpu.dag.nodes import (
 
 def _dag_actor_loop(instance, plan: dict):
     """Runs ON the actor (via __rt_apply__): the compiled exec loop."""
+    import traceback
+
     from ray_tpu._private.worker import get_global_worker
 
     ctx = get_global_worker().ctx
@@ -57,6 +59,17 @@ def _dag_actor_loop(instance, plan: dict):
                     chans[out].write(result, ctx)
     except ChannelClosedError:
         return "torn_down"
+    except Exception as e:
+        # A user-method error must reach the driver, not hang it: stop every
+        # channel this actor touches (readers/writers unblock with
+        # ChannelClosedError) and return the traceback for _raise_loop_error.
+        for ch in chans.values():
+            try:
+                ch.set_stop()
+            except Exception:
+                pass
+        return {"error": f"{type(e).__name__}: {e}",
+                "traceback": traceback.format_exc()}
 
 
 class CompiledDAGRef:
@@ -103,8 +116,9 @@ class CompiledDAG:
         inputs = [n for n in order if isinstance(n, InputNode)]
         if len(inputs) > 1:
             raise ValueError("a DAG may have at most one InputNode")
+        self._is_multi = isinstance(root, MultiOutputNode)
         self._outputs: List[DAGNode] = (
-            list(root.args) if isinstance(root, MultiOutputNode) else [root]
+            list(root.args) if self._is_multi else [root]
         )
         for out in self._outputs:
             if not isinstance(out, ClassMethodNode):
@@ -218,6 +232,7 @@ class CompiledDAG:
         self._next_fetch = 0
         self._buffered: Dict[int, Any] = {}
         self._partial: List[Any] = []  # outputs read so far for the step
+        self._loop_results: List[Any] = []
 
     # ------------------------------------------------------------------ API
 
@@ -245,16 +260,34 @@ class CompiledDAG:
                 t = None if deadline is None else max(
                     deadline - time.monotonic(), 0
                 )
-                self._partial.append(self._channels[ch].read(timeout=t))
+                try:
+                    self._partial.append(self._channels[ch].read(timeout=t))
+                except ChannelClosedError:
+                    self._raise_loop_error()
+                    raise
             outs, self._partial = self._partial, []
             got = self._next_fetch
             self._next_fetch += 1
-            value = outs if len(outs) > 1 else outs[0]
+            # list iff the user built a MultiOutputNode (matches eager path,
+            # including the single-output case)
+            value = outs if self._is_multi else outs[0]
             if got == seq:
                 return value
             self._buffered[got] = value
             if deadline is not None and time.monotonic() > deadline:
                 raise ChannelTimeoutError(f"result {seq} not produced in time")
+
+    def _raise_loop_error(self):
+        """A stopped channel outside teardown usually means an exec loop
+        died on a user exception — tear down, then surface the actor-side
+        traceback collected from the loop results."""
+        self.teardown()
+        for res in self._loop_results:
+            if isinstance(res, dict) and "error" in res:
+                raise RuntimeError(
+                    f"compiled DAG task failed: {res['error']}\n"
+                    f"{res.get('traceback', '')}"
+                )
 
     def teardown(self):
         if self._torn_down:
@@ -264,9 +297,10 @@ class CompiledDAG:
             ch.set_stop()
         import ray_tpu
 
+        self._loop_results = []
         for ref in self._loop_refs:
             try:
-                ray_tpu.get(ref, timeout=30)
+                self._loop_results.append(ray_tpu.get(ref, timeout=30))
             except Exception:
                 pass
         for ch in self._channels.values():

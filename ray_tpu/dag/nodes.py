@@ -64,6 +64,10 @@ def _exec_eager(node: DAGNode, input_value, cache: Dict[int, Any]):
         # upstream eager results are ObjectRefs; resolve before the call so
         # actor methods see values (constants pass through untouched)
         args = [ray_tpu.get(a) if isinstance(a, ObjectRef) else a for a in args]
+        kwargs = {
+            k: ray_tpu.get(v) if isinstance(v, ObjectRef) else v
+            for k, v in kwargs.items()
+        }
         result = getattr(node.actor, node.method_name).remote(*args, **kwargs)
     else:
         raise TypeError(f"unknown node {node}")

@@ -1,0 +1,16 @@
+"""LLM layer: serving + batch inference on the in-framework JAX engine.
+
+Reference analog: ``python/ray/llm/`` (serve integration, vLLM engine
+delegation, ``ray.data.llm`` batch processors).
+"""
+from ray_tpu.llm.batch import Processor, build_llm_processor
+from ray_tpu.llm.config import ByteTokenizer, LLMConfig, load_tokenizer
+from ray_tpu.llm.engine import DecodeEngine, SamplingParams
+from ray_tpu.llm.serving import LLMServer, build_openai_app, serve_llm
+
+__all__ = [
+    "LLMConfig", "ByteTokenizer", "load_tokenizer",
+    "DecodeEngine", "SamplingParams",
+    "LLMServer", "build_openai_app", "serve_llm",
+    "Processor", "build_llm_processor",
+]

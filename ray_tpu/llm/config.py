@@ -1,0 +1,93 @@
+"""LLM layer configuration.
+
+Reference analog: ``python/ray/llm/_internal/common/models.py`` /
+``serve/engines/vllm/vllm_models.py`` — ``LLMConfig`` carrying model id,
+engine kwargs (tensor_parallel_size etc.), and serving knobs. The reference
+delegates the engine to vLLM; here the engine is in-framework
+(``ray_tpu/llm/engine.py`` — jitted JAX prefill/decode on the flagship
+model), so engine kwargs map onto GPT2Config + mesh axes instead of vLLM
+arguments.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Sequence
+
+
+@dataclass
+class LLMConfig:
+    model_id: str = "gpt2-scratch"
+    # Model: either explicit architecture numbers (fresh weights) or a path
+    # to a pickled {"config": GPT2Config kwargs, "params": pytree} bundle.
+    model_source: Optional[str] = None
+    vocab_size: int = 512
+    max_seq_len: int = 1024
+    num_layers: int = 4
+    num_heads: int = 4
+    embed_dim: int = 256
+    dtype: str = "bfloat16"
+
+    # Engine knobs (reference: engine_kwargs tensor_parallel_size etc.)
+    max_batch_slots: int = 8
+    prefill_buckets: Sequence[int] = (64, 128, 256)
+    tensor_parallel_size: int = 1  # reserved: mesh "tensor" axis size
+
+    # Serving
+    max_new_tokens_default: int = 64
+    tokenizer: str = "byte"  # "byte" | local HF tokenizer dir
+
+    accelerator_type: Optional[str] = None
+    deployment_config: Dict[str, Any] = field(default_factory=dict)
+
+    def model_config(self):
+        import jax.numpy as jnp
+
+        from ray_tpu.models.gpt2 import GPT2Config
+
+        return GPT2Config(
+            vocab_size=self.vocab_size,
+            max_seq_len=self.max_seq_len,
+            num_layers=self.num_layers,
+            num_heads=self.num_heads,
+            embed_dim=self.embed_dim,
+            dtype=jnp.bfloat16 if self.dtype == "bfloat16" else jnp.float32,
+            attention_impl="xla",
+        )
+
+    def to_dict(self) -> dict:
+        d = dict(self.__dict__)
+        d["prefill_buckets"] = list(self.prefill_buckets)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "LLMConfig":
+        return cls(**d)
+
+
+class ByteTokenizer:
+    """Self-contained UTF-8 byte tokenizer (ids = byte + 2; 0=pad, 1=eos).
+
+    Stands in for a model tokenizer in environments with no downloadable
+    vocab; real checkpoints bring their own tokenizer dir (``tokenizer``
+    config field pointing at local HF files).
+    """
+
+    pad_id = 0
+    eos_id = 1
+    vocab_floor = 258
+
+    def encode(self, text: str):
+        return [b + 2 for b in text.encode("utf-8")]
+
+    def decode(self, ids) -> str:
+        return bytes(
+            i - 2 for i in ids if i >= 2
+        ).decode("utf-8", errors="replace")
+
+
+def load_tokenizer(config: LLMConfig):
+    if config.tokenizer == "byte":
+        return ByteTokenizer()
+    from transformers import AutoTokenizer
+
+    return AutoTokenizer.from_pretrained(config.tokenizer)

@@ -1,0 +1,292 @@
+"""JAX decode engine: slot-based continuous batching on static shapes.
+
+Reference analog: the vLLM engine behind ``ray/llm`` serving
+(``_internal/serve/engines/vllm/``) — continuous batching, prefill/decode
+split, KV cache management. TPU-first redesign instead of a port:
+
+- The KV cache is one static [L, B, S, H, D] pytree; every decode tick is a
+  single compiled XLA program over ALL active slots (MXU-batched), not a
+  per-request loop.
+- Prompts prefill at bucketed lengths (few compile variants) into a
+  batch=1 cache, then a jitted insert writes the slot row — requests join
+  and leave the running batch without recompiling (the "continuous" part).
+- Sampling happens host-side on the [B, V] logits of the tick (greedy /
+  temperature / top-k), which keeps the compiled program sampling-agnostic.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.llm.config import LLMConfig, load_tokenizer
+
+
+@dataclass
+class SamplingParams:
+    max_new_tokens: int = 64
+    temperature: float = 0.0  # 0 = greedy
+    top_k: int = 0            # 0 = no top-k cut
+    stop_token_ids: Sequence[int] = field(default_factory=tuple)
+
+
+@dataclass
+class _Slot:
+    active: bool = False
+    token_ids: List[int] = field(default_factory=list)
+    prompt_len: int = 0
+    produced: int = 0
+    params: SamplingParams = field(default_factory=SamplingParams)
+    future: Optional[Future] = None
+    last_token: int = 0
+    length: int = 0  # current absolute position (== tokens in cache)
+
+
+class DecodeEngine:
+    def __init__(self, config: LLMConfig, params=None, seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.models import gpt2
+
+        self.config = config
+        self.model_config = config.model_config()
+        if params is None and config.model_source:
+            import pickle
+
+            from ray_tpu.models.gpt2 import GPT2Config
+
+            with open(config.model_source, "rb") as f:
+                bundle = pickle.load(f)
+            params = jax.tree.map(jnp.asarray, bundle["params"])
+            if "config" in bundle:
+                # checkpoint architecture wins over LLMConfig defaults — a
+                # mismatch would allocate a KV cache with the wrong layout
+                self.model_config = GPT2Config(**bundle["config"])
+        if self.model_config.moe is not None:
+            raise NotImplementedError("decode engine: dense models only")
+        self.tokenizer = load_tokenizer(config)
+        if params is None:
+            params = gpt2.init_params(
+                self.model_config, jax.random.PRNGKey(seed)
+            )
+        self.params = params
+        B, S = config.max_batch_slots, config.max_seq_len
+        self._cache = gpt2.init_kv_cache(self.model_config, B, S)
+        self._rng = np.random.RandomState(seed)
+
+        cfg = self.model_config
+
+        def prefill(params, tokens, cache1):
+            logits, cache1 = gpt2.forward_cached(
+                params, tokens, cache1, jnp.zeros((1,), jnp.int32), cfg
+            )
+            return logits, cache1
+
+        def insert(batch_cache, slot_cache, b):
+            return jax.tree.map(
+                lambda c, s1: jax.lax.dynamic_update_slice(
+                    c, s1.astype(c.dtype), (0, b, 0, 0, 0)
+                ),
+                batch_cache, slot_cache,
+            )
+
+        def decode(params, tokens, cache, lens):
+            logits, cache = gpt2.forward_cached(params, tokens, cache, lens, cfg)
+            return logits[:, -1], cache
+
+        self._prefill = jax.jit(prefill)
+        self._insert = jax.jit(insert, donate_argnums=(0,))
+        self._decode = jax.jit(decode, donate_argnums=(2,))
+        self._empty_slot_cache = lambda: gpt2.init_kv_cache(cfg, 1, S)
+
+        self._slots = [_Slot() for _ in range(B)]
+        self._pending: "queue.Queue" = queue.Queue()
+        self._loop_thread: Optional[threading.Thread] = None
+        self._stopped = False
+        self._lock = threading.Lock()
+        self.stats = {"requests": 0, "tokens_generated": 0, "ticks": 0}
+
+    # ------------------------------------------------------------- sampling
+
+    def _sample(self, logits_row: np.ndarray, p: SamplingParams) -> int:
+        if p.temperature <= 0:
+            return int(np.argmax(logits_row))
+        logits = logits_row / max(p.temperature, 1e-5)
+        k = min(p.top_k, logits.shape[0])  # request-controlled: clamp
+        if k > 0:
+            kth = np.partition(logits, -k)[-k]
+            logits = np.where(logits < kth, -np.inf, logits)
+        logits = logits - logits.max()
+        probs = np.exp(logits)
+        probs /= probs.sum()
+        return int(self._rng.choice(len(probs), p=probs))
+
+    # ------------------------------------------------------------ lifecycle
+
+    def _bucket(self, n: int) -> int:
+        for b in self.config.prefill_buckets:
+            if n <= b:
+                return b
+        raise ValueError(
+            f"prompt length {n} exceeds largest prefill bucket "
+            f"{max(self.config.prefill_buckets)}"
+        )
+
+    def _admit_locked(self):
+        import jax.numpy as jnp
+
+        free = [i for i, s in enumerate(self._slots) if not s.active]
+        while free and not self._pending.empty():
+            try:
+                prompt_ids, params, fut = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            b = free.pop(0)
+            try:
+                Tpad = self._bucket(len(prompt_ids))
+            except ValueError as e:
+                # admission failure surfaces on the caller's future, never
+                # kills the scheduler loop
+                fut.set_exception(e)
+                free.insert(0, b)
+                continue
+            toks = np.zeros((1, Tpad), np.int32)
+            toks[0, : len(prompt_ids)] = prompt_ids
+            logits, cache1 = self._prefill(
+                self.params, jnp.asarray(toks), self._empty_slot_cache()
+            )
+            self._cache = self._insert(self._cache, cache1, b)
+            first = self._sample(
+                np.asarray(logits)[0, len(prompt_ids) - 1], params
+            )
+            slot = self._slots[b]
+            slot.active = True
+            slot.token_ids = [first]
+            slot.prompt_len = len(prompt_ids)
+            slot.produced = 1
+            slot.params = params
+            slot.future = fut
+            slot.last_token = first
+            slot.length = len(prompt_ids)
+            self.stats["requests"] += 1
+            self._finish_if_done_locked(b)
+
+    def _finish_if_done_locked(self, b: int):
+        slot = self._slots[b]
+        stop = set(slot.params.stop_token_ids) | {self.tokenizer.eos_id}
+        done = (
+            slot.produced >= slot.params.max_new_tokens
+            or slot.last_token in stop
+            or slot.length + 1 >= self.config.max_seq_len
+        )
+        if done:
+            out = slot.token_ids
+            if out and out[-1] in stop:
+                out = out[:-1]
+            if slot.future is not None:
+                slot.future.set_result(out)
+            slot.active = False
+            slot.future = None
+
+    def _tick_locked(self) -> bool:
+        import jax.numpy as jnp
+
+        active = [i for i, s in enumerate(self._slots) if s.active]
+        if not active:
+            return False
+        B = len(self._slots)
+        toks = np.zeros((B, 1), np.int32)
+        lens = np.zeros((B,), np.int32)
+        for i in active:
+            toks[i, 0] = self._slots[i].last_token
+            lens[i] = self._slots[i].length
+        logits, self._cache = self._decode(
+            self.params, jnp.asarray(toks), self._cache, jnp.asarray(lens)
+        )
+        logits = np.asarray(logits)
+        for i in active:
+            slot = self._slots[i]
+            nxt = self._sample(logits[i], slot.params)
+            slot.token_ids.append(nxt)
+            slot.last_token = nxt
+            slot.produced += 1
+            slot.length += 1
+            self.stats["tokens_generated"] += 1
+            self._finish_if_done_locked(i)
+        self.stats["ticks"] += 1
+        return True
+
+    # ------------------------------------------------------------- public
+
+    def submit(self, prompt_ids: List[int],
+               params: Optional[SamplingParams] = None) -> Future:
+        """Continuous-batching entry: returns a Future of generated ids."""
+        fut: Future = Future()
+        self._pending.put((list(prompt_ids), params or SamplingParams(), fut))
+        self._ensure_loop()
+        return fut
+
+    def generate(self, prompt_ids: List[int],
+                 params: Optional[SamplingParams] = None) -> List[int]:
+        """Synchronous single-request generation (batch path)."""
+        return self.submit(prompt_ids, params).result(timeout=600)
+
+    def generate_text(self, prompt: str,
+                      params: Optional[SamplingParams] = None) -> str:
+        ids = self.tokenizer.encode(prompt)
+        out = self.generate(ids, params)
+        return self.tokenizer.decode(out)
+
+    def _ensure_loop(self):
+        with self._lock:
+            if self._loop_thread is not None and self._loop_thread.is_alive():
+                return
+            self._stopped = False
+            self._loop_thread = threading.Thread(
+                target=self._loop, daemon=True, name="rt-llm-engine"
+            )
+            self._loop_thread.start()
+
+    def _loop(self):
+        idle_since = None
+        while not self._stopped:
+            try:
+                with self._lock:
+                    self._admit_locked()
+                    busy = self._tick_locked()
+            except Exception as e:
+                # Never die holding unresolved futures: fail every in-flight
+                # request, clear the slots, keep serving.
+                with self._lock:
+                    for slot in self._slots:
+                        if slot.active and slot.future is not None:
+                            slot.future.set_exception(e)
+                        slot.active = False
+                        slot.future = None
+                busy = False
+            if busy or not self._pending.empty():
+                idle_since = None
+                continue
+            if idle_since is None:
+                idle_since = time.monotonic()
+            elif time.monotonic() - idle_since > 30:
+                # Park. The pending re-check + handoff under the lock closes
+                # the race with a submit() that saw this thread still alive.
+                with self._lock:
+                    if self._pending.empty():
+                        self._loop_thread = None
+                        return
+                idle_since = None
+            time.sleep(0.002)
+
+    def shutdown(self):
+        self._stopped = True
+        t = self._loop_thread
+        if t is not None:
+            t.join(timeout=5)

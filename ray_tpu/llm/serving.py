@@ -1,0 +1,125 @@
+"""OpenAI-compatible serving app over the serve layer.
+
+Reference analog: ``ray.serve.llm build_openai_app`` / ``LLMServer``
+(``python/ray/llm/_internal/serve/``): an ingress deployment exposing
+/v1/completions and /v1/chat/completions, backed by engine replicas. Here
+the engine is the in-framework JAX decode engine; TP passthrough maps to
+engine mesh config rather than vLLM kwargs.
+"""
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.llm.config import LLMConfig
+from ray_tpu.llm.engine import DecodeEngine, SamplingParams
+
+
+class LLMServer:
+    """Serve deployment target wrapping one engine replica."""
+
+    def __init__(self, config_dict: dict, params=None):
+        self.config = LLMConfig.from_dict(config_dict)
+        self.engine = DecodeEngine(self.config, params=params)
+
+    # serve ingress entry: HTTP payloads from the proxy, or direct dicts
+    # from DeploymentHandle calls.
+    def __call__(self, request: dict) -> dict:
+        if "body" in request:  # HTTP proxy envelope
+            path = request.get("path", "")
+            try:
+                payload = json.loads(request["body"] or b"{}")
+            except json.JSONDecodeError:
+                return {"error": {"message": "invalid JSON body"}}
+            if path.endswith("/chat/completions"):
+                return self.chat_completions(payload)
+            return self.completions(payload)
+        if "messages" in request:
+            return self.chat_completions(request)
+        return self.completions(request)
+
+    # ----------------------------------------------------------- endpoints
+
+    def _sampling(self, payload: dict) -> SamplingParams:
+        return SamplingParams(
+            max_new_tokens=int(
+                payload.get("max_tokens", self.config.max_new_tokens_default)
+            ),
+            temperature=float(payload.get("temperature", 0.0)),
+            top_k=int(payload.get("top_k", 0)),
+        )
+
+    def completions(self, payload: dict) -> dict:
+        prompt = payload.get("prompt", "")
+        ids = self.engine.tokenizer.encode(prompt)
+        out = self.engine.submit(ids, self._sampling(payload)).result(600)
+        text = self.engine.tokenizer.decode(out)
+        return {
+            "id": f"cmpl-{uuid.uuid4().hex[:24]}",
+            "object": "text_completion",
+            "created": int(time.time()),
+            "model": self.config.model_id,
+            "choices": [{
+                "index": 0, "text": text, "finish_reason": "stop",
+            }],
+            "usage": {
+                "prompt_tokens": len(ids),
+                "completion_tokens": len(out),
+                "total_tokens": len(ids) + len(out),
+            },
+        }
+
+    def chat_completions(self, payload: dict) -> dict:
+        messages: List[Dict[str, str]] = payload.get("messages", [])
+        prompt = "".join(
+            f"<{m.get('role', 'user')}>{m.get('content', '')}\n"
+            for m in messages
+        ) + "<assistant>"
+        ids = self.engine.tokenizer.encode(prompt)
+        out = self.engine.submit(ids, self._sampling(payload)).result(600)
+        text = self.engine.tokenizer.decode(out)
+        return {
+            "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
+            "object": "chat.completion",
+            "created": int(time.time()),
+            "model": self.config.model_id,
+            "choices": [{
+                "index": 0,
+                "message": {"role": "assistant", "content": text},
+                "finish_reason": "stop",
+            }],
+            "usage": {
+                "prompt_tokens": len(ids),
+                "completion_tokens": len(out),
+                "total_tokens": len(ids) + len(out),
+            },
+        }
+
+    def health_check(self) -> bool:
+        return True
+
+
+def build_openai_app(config: LLMConfig, *, num_replicas: int = 1,
+                     params=None):
+    """Application for ``serve.run(...)`` exposing the OpenAI surface at
+    /v1 (reference: ``ray.serve.llm.build_openai_app``)."""
+    from ray_tpu import serve
+
+    deployment = serve.deployment(
+        num_replicas=num_replicas,
+        max_ongoing_requests=config.max_batch_slots,
+        **config.deployment_config,
+    )(LLMServer)
+    return deployment.bind(config.to_dict(), params)
+
+
+def serve_llm(config: LLMConfig, *, name: str = "llm", params=None,
+              route_prefix: str = "/v1"):
+    """Deploy and return (handle, app_name)."""
+    from ray_tpu import serve
+
+    app = build_openai_app(config, params=params)
+    handle = serve.run(app, name=name, route_prefix=route_prefix)
+    return handle

@@ -173,16 +173,21 @@ def _attention_dispatch(config: GPT2Config, q, k, v, mesh: Optional[Mesh]):
     return attention(q, k, v, causal=True, impl=impl)
 
 
-def _block(config: GPT2Config, mesh: Optional[Mesh], x, layer, rng=None):
-    """One transformer block. x: [B, T, E] (dtype), layer: one slice of the
-    stacked block params. ``rng`` (optional) feeds MoE router jitter."""
-    h = _layer_norm(x, layer["ln1_g"], layer["ln1_b"])
+def _qkv(layer, h):
+    """[B, T, E] → (q, k, v) each [B, T, H, D]."""
     qkv = jnp.einsum("bte,eshd->btshd", h, layer["qkv_w"].astype(h.dtype))
     qkv = qkv + layer["qkv_b"].astype(h.dtype)
-    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-    attn = _attention_dispatch(config, q, k, v, mesh)
-    attn = jnp.einsum("bthd,hde->bte", attn, layer["proj_w"].astype(h.dtype))
-    x = x + attn + layer["proj_b"].astype(h.dtype)
+    return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+
+def _attn_residual(layer, x, attn):
+    """Output projection + residual add."""
+    attn = jnp.einsum("bthd,hde->bte", attn, layer["proj_w"].astype(x.dtype))
+    return x + attn + layer["proj_b"].astype(x.dtype)
+
+
+def _mlp_residual(config: GPT2Config, layer, x, rng=None):
+    """ln2 + MLP (or MoE) + residual. Returns (x, aux_loss)."""
     h = _layer_norm(x, layer["ln2_g"], layer["ln2_b"])
     if config.moe is not None:
         h, aux = moe_layer(layer["moe"], h, config.moe, rng=rng)
@@ -191,6 +196,16 @@ def _block(config: GPT2Config, mesh: Optional[Mesh], x, layer, rng=None):
     h = jax.nn.gelu(h + layer["fc_b"].astype(h.dtype))
     h = jnp.einsum("btm,me->bte", h, layer["out_w"].astype(h.dtype))
     return x + h + layer["out_b"].astype(h.dtype), jnp.float32(0.0)
+
+
+def _block(config: GPT2Config, mesh: Optional[Mesh], x, layer, rng=None):
+    """One transformer block. x: [B, T, E] (dtype), layer: one slice of the
+    stacked block params. ``rng`` (optional) feeds MoE router jitter."""
+    h = _layer_norm(x, layer["ln1_g"], layer["ln1_b"])
+    q, k, v = _qkv(layer, h)
+    attn = _attention_dispatch(config, q, k, v, mesh)
+    x = _attn_residual(layer, x, attn)
+    return _mlp_residual(config, layer, x, rng=rng)
 
 
 def forward(
@@ -235,6 +250,71 @@ def forward(
     x = _layer_norm(x, params["ln_f_g"], params["ln_f_b"])
     logits = jnp.einsum("bte,ve->btv", x, params["wte"].astype(x.dtype))
     return logits.astype(jnp.float32), aux
+
+
+def init_kv_cache(config: GPT2Config, batch: int, max_len: int,
+                  dtype=None) -> Dict[str, jax.Array]:
+    """Static-shape KV cache for incremental decoding: [L, B, S, H, D].
+    (Reference capability analog: the vLLM engine Ray LLM delegates to —
+    ``llm/_internal/serve/engines/vllm``; here the cache is a jax pytree so
+    the whole decode step stays one XLA program.)"""
+    dtype = dtype or config.dtype
+    L, H, D = config.num_layers, config.num_heads, config.head_dim
+    shape = (L, batch, max_len, H, D)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def forward_cached(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    cache: Dict[str, jax.Array],
+    start: jax.Array,
+    config: GPT2Config,
+) -> tuple:
+    """Incremental forward: attend over the KV cache, append new K/V.
+
+    tokens [B, T] — a prompt chunk (prefill, start=0) or one decode step
+    (T=1, start=seq_len). start [B] int32: absolute position of tokens[:, 0]
+    per sequence. Returns (logits [B, T, V] f32, updated cache). All shapes
+    static; per-sequence offsets go through vmapped dynamic_update_slice so
+    slot-based continuous batching is one compiled program.
+    """
+    B, T = tokens.shape
+    S = cache["k"].shape[2]
+    pos = start[:, None] + jnp.arange(T)[None, :]          # [B, T] absolute
+    x = params["wte"][tokens].astype(config.dtype)
+    x = x + params["wpe"][pos].astype(config.dtype)
+
+    key_pos = jnp.arange(S)[None, None, :]                  # [1, 1, S]
+    # causal vs cache: key visible iff key_pos <= query absolute position
+    mask = key_pos <= pos[:, :, None]                       # [B, T, S]
+
+    def block(carry, layer_and_cache):
+        x = carry
+        layer, ck, cv = layer_and_cache
+        h = _layer_norm(x, layer["ln1_g"], layer["ln1_b"])
+        q, k_new, v_new = _qkv(layer, h)
+        upd = jax.vmap(
+            lambda c, n, s: jax.lax.dynamic_update_slice(c, n, (s, 0, 0))
+        )
+        ck = upd(ck, k_new.astype(ck.dtype), start)         # [B, S, H, D]
+        cv = upd(cv, v_new.astype(cv.dtype), start)
+        # attention core differs from _block: queries attend the cache
+        scores = jnp.einsum("bthd,bshd->bhts", q, ck).astype(jnp.float32)
+        scores = scores / jnp.sqrt(jnp.float32(q.shape[-1]))
+        scores = jnp.where(mask[:, None, :, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        attn = jnp.einsum("bhts,bshd->bthd", probs, cv)
+        x = _attn_residual(layer, x, attn)
+        x, _ = _mlp_residual(config, layer, x)
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        block, x, (params["blocks"], cache["k"], cache["v"])
+    )
+    x = _layer_norm(x, params["ln_f_g"], params["ln_f_b"])
+    logits = jnp.einsum("bte,ve->btv", x, params["wte"].astype(x.dtype))
+    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
 
 
 def loss_fn(

@@ -137,6 +137,7 @@ class Algorithm:
     def training_step(self) -> Dict[str, float]:
         fragments = self.runner_group.sample()
         if not fragments:
+            self._last_step_count = 0  # nothing sampled this iteration
             return {"num_healthy_runners": 0}
         batch = self._build_batch(fragments)
         metrics = self.learner.update(batch)

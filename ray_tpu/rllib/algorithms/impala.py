@@ -37,6 +37,7 @@ class IMPALA(Algorithm):
     def training_step(self) -> Dict[str, float]:
         fragments = self.runner_group.sample()
         if not fragments:
+            self._last_step_count = 0
             return {"num_healthy_runners": 0}
         batch = self._build_batch(fragments)
         metrics = self.learner.update(batch)

@@ -237,3 +237,43 @@ def test_constant_only_task_gated_per_execute(dag_cluster):
     # (queried post-teardown: the exec loop holds the actor's only
     # concurrency slot while compiled)
     assert ray_tpu.get(c.count.remote(), timeout=30) == 2
+
+
+def test_compiled_user_error_surfaces(dag_cluster):
+    """An exception in a compiled task must reach the driver with the
+    actor-side traceback, not a generic timeout."""
+    @ray_tpu.remote
+    class Boom:
+        def go(self, x):
+            if x == 2:
+                raise ValueError("kaboom at 2")
+            return x
+
+    b = Boom.remote()
+    with InputNode() as inp:
+        dag = b.go.bind(inp)
+    compiled = dag.experimental_compile()
+    assert compiled.execute(1).get() == 1
+    with pytest.raises(RuntimeError, match="kaboom at 2"):
+        compiled.execute(2).get(timeout=30)
+
+
+def test_eager_kwarg_upstream_resolved(dag_cluster):
+    a = Adder.remote(1)
+    b = Adder.remote(0)
+
+    with InputNode() as inp:
+        dag = b.combine.bind(0, b=a.add.bind(inp))
+    assert ray_tpu.get(dag.execute(5)) == 6
+
+
+def test_single_element_multioutput_consistency(dag_cluster):
+    a = Adder.remote(1)
+    with InputNode() as inp:
+        dag = MultiOutputNode([a.add.bind(inp)])
+    assert dag.execute(5) == [6]  # eager: list
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute(5).get() == [6]  # compiled: also list
+    finally:
+        compiled.teardown()
