@@ -136,9 +136,28 @@ def init(
             n_cpus = num_cpus if num_cpus is not None else (os.cpu_count() or 1)
             node_res = dict(resources or {})
             node_res["CPU"] = float(n_cpus)
-            for _ in range(num_nodes):
+            # Accelerator autodetection (reference: _private/accelerators/):
+            # explicit resources always win; detection fills the gaps — and
+            # only on ONE simulated node, since all num_nodes processes share
+            # this machine's physical chips.
+            from ray_tpu._private.accelerators import (
+                detect_node_accelerators,
+                detect_node_labels,
+            )
+
+            accel_res = {
+                k: v for k, v in detect_node_accelerators().items()
+                if k not in node_res
+            }
+            accel_labels = detect_node_labels()
+            for i in range(num_nodes):
+                res_i = dict(node_res)
+                labels_i = dict(labels or {})
+                if i == 0:
+                    res_i.update(accel_res)
+                    labels_i = {**accel_labels, **labels_i}
                 _cluster.add_node(
-                    dict(node_res), labels=labels, env=_node_env, wait=False
+                    res_i, labels=labels_i, env=_node_env, wait=False
                 )
             _cluster.wait_for_nodes(num_nodes)
         else:

@@ -1,0 +1,17 @@
+from ray_tpu._private.accelerators.accelerator import (
+    AcceleratorManager,
+    all_accelerator_managers,
+    detect_node_accelerators,
+    detect_node_labels,
+    register_accelerator_manager,
+)
+from ray_tpu._private.accelerators.tpu import TPUAcceleratorManager
+
+__all__ = [
+    "AcceleratorManager",
+    "TPUAcceleratorManager",
+    "all_accelerator_managers",
+    "detect_node_accelerators",
+    "detect_node_labels",
+    "register_accelerator_manager",
+]

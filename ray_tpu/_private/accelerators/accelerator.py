@@ -1,0 +1,80 @@
+"""Accelerator manager interface.
+
+Reference analog: ``python/ray/_private/accelerators/accelerator.py``
+(AcceleratorManager ABC: autodetection, visibility env vars, extra
+resources/labels per node). Managers are consulted at node start to fill in
+resource counts and at worker launch to scope device visibility.
+"""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Dict, List, Optional
+
+
+class AcceleratorManager(ABC):
+    @staticmethod
+    @abstractmethod
+    def get_resource_name() -> str:
+        """Scheduler resource name, e.g. "TPU"."""
+
+    @staticmethod
+    @abstractmethod
+    def get_current_node_num_accelerators() -> int:
+        """How many accelerator chips this node exposes (0 if none)."""
+
+    @staticmethod
+    def get_current_node_accelerator_type() -> Optional[str]:
+        return None
+
+    @staticmethod
+    def get_current_node_additional_resources() -> Dict[str, float]:
+        """Extra resources to advertise (e.g. slice-head markers)."""
+        return {}
+
+    @staticmethod
+    def get_current_node_labels() -> Dict[str, str]:
+        return {}
+
+    @staticmethod
+    def get_visible_accelerator_ids_env_var() -> Optional[str]:
+        """Env var that scopes chip visibility for a worker process."""
+        return None
+
+    @staticmethod
+    def set_visible_accelerators(ids: List[str], env: Dict[str, str]):
+        """Write the visibility env var into ``env`` (in place)."""
+
+
+_REGISTRY: List[type] = []
+
+
+def register_accelerator_manager(cls: type):
+    if cls not in _REGISTRY:
+        _REGISTRY.append(cls)
+    return cls
+
+
+def all_accelerator_managers() -> List[type]:
+    # populate defaults lazily to avoid import cycles
+    from ray_tpu._private.accelerators import tpu  # noqa: F401
+
+    return list(_REGISTRY)
+
+
+def detect_node_accelerators() -> Dict[str, float]:
+    """Aggregate resources contributed by every detected accelerator."""
+    out: Dict[str, float] = {}
+    for mgr in all_accelerator_managers():
+        n = mgr.get_current_node_num_accelerators()
+        if n > 0:
+            out[mgr.get_resource_name()] = float(n)
+            out.update(mgr.get_current_node_additional_resources())
+    return out
+
+
+def detect_node_labels() -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for mgr in all_accelerator_managers():
+        if mgr.get_current_node_num_accelerators() > 0:
+            out.update(mgr.get_current_node_labels())
+    return out

@@ -1,0 +1,149 @@
+"""TPU accelerator manager + slice placement groups.
+
+Reference analog: ``python/ray/tests/accelerators/test_tpu.py`` (metadata
+lookups patched) and ``python/ray/tests/test_tpu.py`` slice-PG coverage.
+"""
+import pytest
+
+import ray_tpu
+from ray_tpu._private.accelerators import (
+    TPUAcceleratorManager,
+    detect_node_accelerators,
+    detect_node_labels,
+)
+from ray_tpu._private.accelerators import tpu as tpu_mod
+from ray_tpu.util.tpu import (
+    get_tpu_coordinator_env_vars,
+    slice_placement_group,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_gce(monkeypatch):
+    monkeypatch.setattr(tpu_mod, "_fetch_metadata", lambda *a, **k: None)
+    for var in ("TPU_ACCELERATOR_TYPE", "ACCELERATOR_TYPE", "TPU_WORKER_ID",
+                "TPU_CHIPS_PER_HOST_BOUNDS", "TPU_NAME", "TPU_TOPOLOGY"):
+        monkeypatch.delenv(var, raising=False)
+
+
+def test_no_tpu_detected():
+    assert TPUAcceleratorManager.get_current_node_num_accelerators() == 0
+    assert detect_node_accelerators() == {}
+    assert detect_node_labels() == {}
+
+
+def test_detection_from_env(monkeypatch):
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5e-16")
+    monkeypatch.setenv("TPU_CHIPS_PER_HOST_BOUNDS", "2,2,1")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    monkeypatch.setenv("TPU_NAME", "my-slice")
+    assert TPUAcceleratorManager.get_current_node_num_accelerators() == 4
+    res = detect_node_accelerators()
+    assert res["TPU"] == 4.0
+    assert res["TPU-v5e-16-head"] == 1.0  # worker 0 carries the head token
+    labels = detect_node_labels()
+    assert labels["ray_tpu.accelerator_type"] == "v5e-16"
+    assert labels["ray_tpu.slice_name"] == "my-slice"
+
+
+def test_non_head_worker_has_no_head_resource(monkeypatch):
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5e-16")
+    monkeypatch.setenv("TPU_CHIPS_PER_HOST_BOUNDS", "2,2,1")
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    res = detect_node_accelerators()
+    assert res["TPU"] == 4.0
+    assert "TPU-v5e-16-head" not in res
+
+
+def test_single_host_slice_from_type(monkeypatch):
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5e-8")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    # 8 chips, v5e packs 8/host -> single host owns the whole slice
+    assert TPUAcceleratorManager.get_current_node_num_accelerators() == 8
+
+
+def test_visibility_env():
+    env = {}
+    TPUAcceleratorManager.set_visible_accelerators(["0", "1"], env)
+    assert env["TPU_VISIBLE_CHIPS"] == "0,1"
+    # multi-chip grants keep default bounds (physical grid must win)
+    assert "TPU_CHIPS_PER_PROCESS_BOUNDS" not in env
+    solo = {}
+    TPUAcceleratorManager.set_visible_accelerators(["2"], solo)
+    assert solo["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "1,1,1"
+
+
+def test_coordinator_env_vars():
+    env = get_tpu_coordinator_env_vars("10.0.0.1:8080", 4, 2)
+    assert env == {
+        "MEGASCALE_COORDINATOR_ADDRESS": "10.0.0.1:8080",
+        "MEGASCALE_NUM_SLICES": "4",
+        "MEGASCALE_SLICE_ID": "2",
+    }
+
+
+def test_slice_placement_group_reserves_hosts():
+    """v5e-16 = 2 hosts x 8 chips; the PG lands only when both hosts exist
+    and the head token pins host 0."""
+    ray_tpu.init(num_cpus=2)
+    try:
+        cluster = ray_tpu._internal_cluster()
+        cluster.add_node({"CPU": 1, "TPU": 8, "TPU-v5e-16-head": 1})
+        cluster.add_node({"CPU": 1, "TPU": 8})
+        cluster.wait_for_nodes(3)
+        spg = slice_placement_group("v5e-16")
+        assert spg.ready(timeout=30)
+        assert spg.num_workers == 2
+        assert spg.chips_per_host == 8
+        r0 = spg.worker_resources(0)
+        assert r0["TPU"] == 8.0 and "TPU-v5e-16-head" in r0
+        r1 = spg.worker_resources(1)
+        assert r1 == {"TPU": 8.0}
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_slice_placement_group_unsatisfiable():
+    ray_tpu.init(num_cpus=2)
+    try:
+        spg = slice_placement_group("v5e-16", timeout=2)
+        assert not spg.ready(timeout=2)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_slice_placement_group_bad_type():
+    with pytest.raises(ValueError, match="v5e-16"):
+        slice_placement_group("v5e")
+
+
+def test_chips_per_host_from_live_nodes():
+    """A 4-host x 4-chip v5e-16 (differs from the generation table's 8)
+    must be reserved with the observed per-host chip count."""
+    ray_tpu.init(num_cpus=2)
+    try:
+        cluster = ray_tpu._internal_cluster()
+        cluster.add_node({"CPU": 1, "TPU": 4, "TPU-v5e-16-head": 1},
+                         labels={"ray_tpu.accelerator_type": "v5e-16"})
+        for _ in range(3):
+            cluster.add_node({"CPU": 1, "TPU": 4},
+                             labels={"ray_tpu.accelerator_type": "v5e-16"})
+        cluster.wait_for_nodes(5)
+        spg = slice_placement_group("v5e-16")
+        assert spg.chips_per_host == 4
+        assert spg.num_workers == 4
+        assert spg.ready(timeout=30)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_init_autodetects_tpu_resources(monkeypatch):
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5e-8")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    ray_tpu.init(num_cpus=2)
+    try:
+        total = ray_tpu.cluster_resources()
+        assert total.get("TPU") == 8.0
+        assert total.get("TPU-v5e-8-head") == 1.0
+    finally:
+        ray_tpu.shutdown()
