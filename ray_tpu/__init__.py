@@ -88,6 +88,20 @@ def init(
             if ignore_reinit_error:
                 return ClientContext(_worker_mod.global_worker)
             raise RuntimeError("ray_tpu.init() called twice")
+        # Resolve the head address like the reference's RAY_ADDRESS/"auto":
+        # env var (set for submitted jobs), then the head's address file.
+        if address is None:
+            address = os.environ.get("RAY_TPU_ADDRESS")
+        if address == "auto":
+            from ray_tpu._private.head_main import read_address_file
+
+            info = read_address_file()
+            if info is None:
+                raise ConnectionError(
+                    "address='auto' but no running head found "
+                    "(start one with `raytpu start --head`)"
+                )
+            address = info["address"]
         job_id = JobID.from_random()
         if address is None:
             head = HeadService()

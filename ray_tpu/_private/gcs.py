@@ -141,6 +141,11 @@ class HeadService:
         self.task_events: List[dict] = []  # bounded task-event buffer for state API
         self.jobs: Dict[str, dict] = {}
         self._schedule_rr = 0  # round-robin cursor
+        # Unsatisfied lease demands, keyed by waiter id — the autoscaler's
+        # scale-up signal (reference: GcsAutoscalerStateManager feeding
+        # autoscaler v2 with pending resource demands).
+        self.pending_demands: Dict[int, dict] = {}
+        self.job_procs: Dict[str, object] = {}  # submission_id -> Popen
 
     # ------------------------------------------------------------------ setup
 
@@ -323,10 +328,17 @@ class HeadService:
                 break
             fut = asyncio.get_running_loop().create_future()
             self._pending_waiters.append(fut)
+            self.pending_demands[id(fut)] = {
+                "resources": dict(need),
+                "count": count - len(grants),  # bundles still unsatisfied
+                "since": time.time(),
+            }
             try:
                 await asyncio.wait_for(fut, timeout=min(remaining, 1.0))
             except asyncio.TimeoutError:
                 pass
+            finally:
+                self.pending_demands.pop(id(fut), None)
         return {"grants": grants, "resources": need}, []
 
     async def rpc_release_lease(self, h, frames, conn):
@@ -670,16 +682,159 @@ class HeadService:
         }
         return {}, []
 
+    async def rpc_list_jobs(self, h, frames, conn):
+        return {"jobs": list(self.jobs.values())}, []
+
+    async def rpc_list_objects(self, h, frames, conn):
+        out = [
+            {"object_id": oid, "meta": meta}
+            for oid, meta in list(self.object_dir.items())[: h.get("limit", 1000)]
+        ]
+        return {"objects": out}, []
+
+    async def rpc_cluster_load(self, h, frames, conn):
+        """Autoscaler feed: unsatisfied demands + pending PG bundles + the
+        per-node resource view (reference: gcs_autoscaler_state_manager.cc)."""
+        pending_pgs = [
+            {"pg_id": pg.pg_id, "bundles": pg.bundles, "strategy": pg.strategy}
+            for pg in self.pgs.values() if pg.state == "PENDING"
+        ]
+        return {
+            "pending": list(self.pending_demands.values()),
+            "pending_pgs": pending_pgs,
+            "nodes": [n.to_public() for n in self.nodes.values()],
+        }, []
+
     async def rpc_task_event(self, h, frames, conn):
+        return await self.rpc_task_events(
+            {"events": [h["event"]]}, frames, conn
+        )
+
+    async def rpc_task_events(self, h, frames, conn):
         """Task-event sink (reference: GcsTaskManager fed by the per-worker
-        ``task_event_buffer.h``); bounded ring for the state API."""
-        self.task_events.append(h["event"])
+        ``task_event_buffer.h`` in 4Hz batches); bounded ring for the state
+        API."""
+        self.task_events.extend(h.get("events", []))
         if len(self.task_events) > 10000:
             del self.task_events[: len(self.task_events) - 10000]
         return {}, []
 
     async def rpc_list_task_events(self, h, frames, conn):
         return {"events": self.task_events[-h.get("limit", 1000):]}, []
+
+    # ------------------------------------------------------ job submission
+    # Reference analog: dashboard/modules/job/job_manager.py:58 — submitted
+    # entrypoints run as supervised subprocesses with captured logs and a
+    # PENDING→RUNNING→SUCCEEDED/FAILED/STOPPED lifecycle. The head owns them
+    # here (round-1 single head process).
+
+    def _job_log_path(self, sub_id: str) -> str:
+        import os
+        import tempfile
+
+        d = os.path.join(tempfile.gettempdir(), "ray_tpu", "jobs")
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, f"{sub_id}.log")
+
+    async def rpc_submit_job(self, h, frames, conn):
+        import os
+        import subprocess
+        import uuid
+
+        sub_id = h.get("submission_id") or f"raysubmit_{uuid.uuid4().hex[:16]}"
+        if sub_id in self.job_procs:
+            raise protocol.RpcError(f"job {sub_id} already exists")
+        env = dict(os.environ)
+        runtime_env = h.get("runtime_env") or {}
+        env.update(runtime_env.get("env_vars") or {})
+        env["RAY_TPU_ADDRESS"] = f"{self.addr[0]}:{self.addr[1]}"
+        # The entrypoint must be able to import the framework regardless of
+        # its cwd (python puts the script dir, not cwd, on sys.path).
+        import ray_tpu
+
+        pkg_parent = os.path.dirname(os.path.dirname(ray_tpu.__file__))
+        existing = env.get("PYTHONPATH")
+        env["PYTHONPATH"] = (
+            pkg_parent + os.pathsep + existing if existing else pkg_parent
+        )
+        log_path = self._job_log_path(sub_id)
+        logf = open(log_path, "wb")
+        try:
+            proc = subprocess.Popen(
+                h["entrypoint"], shell=True, stdout=logf,
+                stderr=subprocess.STDOUT, env=env,
+                cwd=runtime_env.get("working_dir") or None,
+            )
+        except OSError as e:
+            logf.close()
+            raise protocol.RpcError(f"spawn failed: {e}")
+        logf.close()
+        self.job_procs[sub_id] = proc
+        self.jobs[sub_id] = {
+            "job_id": sub_id, "submission_id": sub_id, "type": "SUBMISSION",
+            "entrypoint": h["entrypoint"], "status": "RUNNING",
+            "start_time": time.time(), "end_time": None, "log_path": log_path,
+            "metadata": h.get("metadata") or {},
+        }
+        asyncio.get_running_loop().create_task(self._watch_job(sub_id, proc))
+        return {"submission_id": sub_id}, []
+
+    async def _watch_job(self, sub_id: str, proc):
+        while proc.poll() is None:
+            await asyncio.sleep(0.1)
+        info = self.jobs.get(sub_id)
+        if info is not None and info["status"] in ("RUNNING", "STOPPING"):
+            if info.get("stop_requested"):
+                info["status"] = "STOPPED"
+            else:
+                info["status"] = (
+                    "SUCCEEDED" if proc.returncode == 0 else "FAILED"
+                )
+            info["end_time"] = time.time()
+
+    async def rpc_job_status(self, h, frames, conn):
+        info = self.jobs.get(h["submission_id"])
+        if info is None:
+            return {"found": False}, []
+        return {"found": True, "job": info}, []
+
+    async def rpc_job_logs(self, h, frames, conn):
+        info = self.jobs.get(h["submission_id"])
+        if info is None or "log_path" not in info:
+            return {"found": False}, []
+        try:
+            with open(info["log_path"], "rb") as f:
+                data = f.read()
+        except OSError:
+            data = b""
+        return {"found": True}, [data]
+
+    async def rpc_stop_job(self, h, frames, conn):
+        proc = self.job_procs.get(h["submission_id"])
+        info = self.jobs.get(h["submission_id"])
+        if proc is None or info is None:
+            return {"stopped": False}, []
+        if proc.poll() is None:
+            # SIGTERM with SIGKILL escalation; STOPPED is reported only once
+            # the process actually exits (_watch_job), so a trap-and-ignore
+            # entrypoint can't look terminal while holding resources.
+            info["stop_requested"] = True
+            info["status"] = "STOPPING"
+            proc.terminate()
+            loop = asyncio.get_running_loop()
+            loop.create_task(self._escalate_stop(proc))
+        return {"stopped": True}, []
+
+    async def _escalate_stop(self, proc, grace_s: float = 3.0):
+        deadline = time.monotonic() + grace_s
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                return
+            await asyncio.sleep(0.1)
+        try:
+            proc.kill()
+        except ProcessLookupError:
+            pass
 
     async def rpc_ping(self, h, frames, conn):
         return {"t": time.time()}, []

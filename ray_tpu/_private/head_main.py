@@ -1,0 +1,108 @@
+"""Standalone head process entrypoint (``raytpu start --head``).
+
+Reference analog: ``gcs_server_main.cc`` + the head-node pieces of
+``ray start --head`` (``scripts/scripts.py:799``): the head service, an
+optional local worker node, and the dashboard, in one process tree. The
+head address is published to a well-known file so drivers can
+``init(address="auto")`` (reference: the bootstrap address file in the
+session dir).
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import sys
+import tempfile
+
+
+def address_file_path() -> str:
+    d = os.path.join(tempfile.gettempdir(), "ray_tpu")
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, "head_address")
+
+
+def read_address_file():
+    try:
+        with open(address_file_path()) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--num-cpus", type=int, default=0,
+                        help="CPUs for the colocated worker node (0 = none)")
+    parser.add_argument("--resources", default="{}")
+    parser.add_argument("--dashboard-port", type=int, default=-1,
+                        help="-1 disables the dashboard; 0 picks a port")
+    parser.add_argument("--log-level", default="WARNING")
+    args = parser.parse_args(argv)
+
+    import logging
+
+    logging.basicConfig(level=getattr(logging, args.log_level.upper(), 30))
+
+    from ray_tpu._private.gcs import HeadService
+    from ray_tpu._private.ids import JobID
+    from ray_tpu._private.node import spawn_node
+
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    head = HeadService()
+    addr = loop.run_until_complete(head.start(args.host, args.port))
+
+    dash_port = None
+    dashboard = None
+    if args.dashboard_port >= 0:
+        from ray_tpu.dashboard import DashboardApp
+
+        dashboard = DashboardApp(head, args.host, args.dashboard_port)
+        dash_port = loop.run_until_complete(dashboard.start())
+
+    node = None
+    if args.num_cpus > 0:
+        resources = {"CPU": float(args.num_cpus)}
+        resources.update(json.loads(args.resources))
+        node = spawn_node(addr, JobID.from_random(), resources, {}, None)
+
+    info = {
+        "address": f"{addr[0]}:{addr[1]}",
+        "dashboard_port": dash_port,
+        "head_pid": os.getpid(),
+        "node_pids": [node.proc.pid] if node else [],
+    }
+    with open(address_file_path(), "w") as f:
+        json.dump(info, f)
+    # parseable by the CLI parent
+    print(json.dumps(info), flush=True)
+
+    def term(*_):
+        loop.stop()
+
+    signal.signal(signal.SIGTERM, term)
+    signal.signal(signal.SIGINT, term)
+    try:
+        loop.run_forever()
+    finally:
+        if node is not None:
+            node.terminate()
+        for coro in ([dashboard.stop()] if dashboard else []) + [head.close()]:
+            try:
+                loop.run_until_complete(asyncio.wait_for(coro, timeout=3))
+            except Exception:
+                pass
+        try:
+            os.remove(address_file_path())
+        except OSError:
+            pass
+        os._exit(0)  # no lingering non-daemon threads may block exit
+
+
+if __name__ == "__main__":
+    main()

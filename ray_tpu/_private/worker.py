@@ -199,6 +199,7 @@ class CoreWorker:
         self.num_task_slots = int(self.node_resources.get("CPU", 1)) or 1
         self._shutdown = False
         self._stats = {"tasks_executed": 0, "tasks_submitted": 0}
+        self._task_events_buf: List[dict] = []
         self.runtime_env: dict = {}
         self.pubsub_handlers: Dict[str, List[Any]] = {}
 
@@ -254,6 +255,7 @@ class CoreWorker:
             lambda data, frames: self._evict_freed(data.get("oids", []))
         )
         await self.gcs.call("subscribe", {"channel": "object_free"})
+        self.loop.create_task(self._task_event_flusher())
         if self.is_driver:
             await self.gcs.call("register_job", {"job_id": self.job_id.hex()})
         else:
@@ -1194,6 +1196,22 @@ class CoreWorker:
             else:
                 os.environ[k] = v
 
+    def _record_task_event(self, event: dict):
+        """Buffered task events for the state API (reference:
+        ``core_worker/task_event_buffer.h`` batching to GcsTaskManager)."""
+        self._task_events_buf.append(event)
+
+    async def _task_event_flusher(self):
+        while not self._shutdown:
+            await asyncio.sleep(0.25)
+            if not self._task_events_buf:
+                continue
+            batch, self._task_events_buf = self._task_events_buf, []
+            try:
+                self.gcs.notify("task_events", {"events": batch})
+            except protocol.ConnectionLost:
+                return
+
     async def rpc_push_task(self, h, frames, conn):
         """Execute a normal task (reference: ``CoreWorker::HandlePushTask``
         ``core_worker.cc:3341`` → ExecuteTask)."""
@@ -1214,8 +1232,16 @@ class CoreWorker:
             finally:
                 self._restore_env(old)
 
+        t0 = time.time()
         ok, result = await loop.run_in_executor(self.task_executor, run)
         self._stats["tasks_executed"] += 1
+        self._record_task_event({
+            "task_id": h["tid"], "name": h.get("name") or h["fkey"],
+            "type": "NORMAL_TASK",
+            "state": "FINISHED" if ok else "FAILED",
+            "start_time": t0, "end_time": time.time(),
+            "node_id": self.node_id,
+        })
         return await self._package_result(h, ok, result)
 
     async def _package_result(self, h, ok, result):
@@ -1340,6 +1366,7 @@ class CoreWorker:
         caller, seq = h.get("caller", ""), h.get("seq", 0)
         await self._admit_in_order(inst, caller, seq)
         loop = asyncio.get_running_loop()
+        ev_start = time.time()
         try:
             if h["method"] == "__rt_apply__":
                 # Generic dispatch: run fn(instance, *args) on this actor.
@@ -1391,6 +1418,13 @@ class CoreWorker:
         finally:
             self._advance_seq(inst, caller, seq)
         inst.num_executed += 1
+        self._record_task_event({
+            "task_id": h["tid"], "name": h["method"], "type": "ACTOR_TASK",
+            "actor_id": h["aid"],
+            "state": "FINISHED" if ok else "FAILED",
+            "start_time": ev_start, "end_time": time.time(),
+            "node_id": self.node_id,
+        })
         if not ok:
             e, tb = result if isinstance(result, tuple) else (result, "")
             if isinstance(e, SystemExit):

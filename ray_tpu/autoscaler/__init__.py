@@ -1,0 +1,12 @@
+from ray_tpu.autoscaler.autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+    AutoscalerMonitor,
+    NodeTypeConfig,
+)
+from ray_tpu.autoscaler.node_provider import LocalNodeProvider, NodeProvider
+
+__all__ = [
+    "Autoscaler", "AutoscalerConfig", "AutoscalerMonitor", "NodeTypeConfig",
+    "NodeProvider", "LocalNodeProvider",
+]

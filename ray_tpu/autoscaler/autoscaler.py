@@ -1,0 +1,192 @@
+"""Autoscaler v2: demand-driven reconciliation of cluster nodes.
+
+Reference analog: ``python/ray/autoscaler/v2/autoscaler.py:51`` —
+``update_autoscaling_state`` (:181) reads pending demand from GCS
+(``gcs_autoscaler_state_manager.cc``), bin-packs it against node types
+(``scheduler.py:476 try_schedule``), and drives an instance-manager
+reconciler over cloud nodes. Same loop here, sized for the process-per-host
+model: demand = unsatisfied lease waits + pending PG bundles; supply =
+per-node available resources; delta = nodes to launch / idle nodes to drain.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class NodeTypeConfig:
+    resources: Dict[str, float]
+    min_workers: int = 0
+    max_workers: int = 10
+    labels: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class AutoscalerConfig:
+    node_types: Dict[str, NodeTypeConfig]
+    idle_timeout_s: float = 60.0
+    upscaling_speed: int = 100  # max nodes launched per update
+
+
+def _fits(avail: Dict[str, float], need: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) >= v for k, v in need.items())
+
+
+def _sub(avail: Dict[str, float], need: Dict[str, float]):
+    for k, v in need.items():
+        avail[k] = avail.get(k, 0.0) - v
+
+
+class Autoscaler:
+    def __init__(self, head_address: str, config: AutoscalerConfig,
+                 provider: NodeProvider):
+        from ray_tpu._private.sync_client import SyncHeadClient
+
+        self.config = config
+        self.provider = provider
+        self._client = SyncHeadClient(head_address)
+        self._idle_since: Dict[str, float] = {}  # cluster node_id -> ts
+
+    # ---------------------------------------------------------------- update
+
+    def update(self) -> dict:
+        """One reconcile pass; returns {launched: {type: n}, terminated: [..]}."""
+        load, _ = self._client.call("cluster_load", {})
+        demands: List[Dict[str, float]] = []
+        for d in load["pending"]:
+            # one waiter may represent many unsatisfied bundles
+            demands.extend([d["resources"]] * max(int(d.get("count", 1)), 1))
+        for pg in load["pending_pgs"]:
+            demands.extend(pg["bundles"])
+
+        # simulated free capacity: live nodes' available + launching nodes
+        sim: List[Dict[str, float]] = [
+            dict(n["available"]) for n in load["nodes"] if n.get("alive")
+        ]
+        provider_nodes = self.provider.non_terminated_nodes()
+        by_type: Dict[str, int] = {}
+        for n in provider_nodes:
+            by_type[n["node_type"]] = by_type.get(n["node_type"], 0) + 1
+
+        launched: Dict[str, int] = {}
+        budget = self.config.upscaling_speed
+
+        # min_workers floor
+        for tname, tcfg in self.config.node_types.items():
+            while by_type.get(tname, 0) < tcfg.min_workers and budget > 0:
+                self._launch(tname, tcfg, launched, by_type, sim)
+                budget -= 1
+
+        # bin-pack demands: fit into simulated capacity, else launch the
+        # smallest node type that can hold the bundle
+        for need in demands:
+            placed = False
+            for avail in sim:
+                if _fits(avail, need):
+                    _sub(avail, need)
+                    placed = True
+                    break
+            if placed or budget <= 0:
+                continue
+            candidates = sorted(
+                (
+                    (tname, tcfg)
+                    for tname, tcfg in self.config.node_types.items()
+                    if _fits(tcfg.resources, need)
+                    and by_type.get(tname, 0) < tcfg.max_workers
+                ),
+                key=lambda tc: sum(tc[1].resources.values()),
+            )
+            if not candidates:
+                logger.warning("autoscaler: demand %s fits no node type", need)
+                continue
+            tname, tcfg = candidates[0]
+            avail = self._launch(tname, tcfg, launched, by_type, sim)
+            _sub(avail, need)
+            budget -= 1
+
+        terminated = self._scale_down(load, provider_nodes)
+        return {"launched": launched, "terminated": terminated}
+
+    def _launch(self, tname, tcfg, launched, by_type, sim):
+        self.provider.create_node(tname, tcfg.resources, tcfg.labels)
+        launched[tname] = launched.get(tname, 0) + 1
+        by_type[tname] = by_type.get(tname, 0) + 1
+        avail = dict(tcfg.resources)
+        sim.append(avail)
+        return avail
+
+    def _scale_down(self, load, provider_nodes) -> List[str]:
+        """Terminate provider-owned nodes idle past the timeout (never below
+        min_workers)."""
+        now = time.monotonic()
+        alive = {n["node_id"]: n for n in load["nodes"] if n.get("alive")}
+        by_type: Dict[str, int] = {}
+        for n in provider_nodes:
+            by_type[n["node_type"]] = by_type.get(n["node_type"], 0) + 1
+        terminated = []
+        for pn in provider_nodes:
+            info = alive.get(pn["node_id"])
+            if info is None:
+                continue
+            idle = info["available"] == info["resources"]
+            if not idle:
+                self._idle_since.pop(pn["node_id"], None)
+                continue
+            since = self._idle_since.setdefault(pn["node_id"], now)
+            tcfg = self.config.node_types.get(pn["node_type"])
+            floor = tcfg.min_workers if tcfg else 0
+            if (now - since > self.config.idle_timeout_s
+                    and by_type.get(pn["node_type"], 0) > floor):
+                try:
+                    self._client.call(
+                        "drain_node", {"node_id": pn["node_id"]}
+                    )
+                except Exception:
+                    pass
+                self.provider.terminate_node(pn["provider_node_id"])
+                by_type[pn["node_type"]] -= 1
+                terminated.append(pn["provider_node_id"])
+                self._idle_since.pop(pn["node_id"], None)
+        return terminated
+
+    def close(self):
+        self._client.close()
+
+
+class AutoscalerMonitor:
+    """Background loop driving Autoscaler.update (reference:
+    ``autoscaler/v2/monitor.py``)."""
+
+    def __init__(self, autoscaler: Autoscaler, interval_s: float = 5.0):
+        self.autoscaler = autoscaler
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="rt-autoscaler"
+        )
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self.autoscaler.update()
+            except Exception:
+                logger.exception("autoscaler update failed")
+            self._stop.wait(self.interval_s)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)

@@ -1,0 +1,69 @@
+"""Node providers: the autoscaler's cloud abstraction.
+
+Reference analog: ``python/ray/autoscaler/node_provider.py`` (NodeProvider
+ABC) + v2's instance manager cloud interface. ``LocalNodeProvider`` spawns
+worker-node processes on this machine — the test/single-host provider the
+reference implements as ``autoscaler/_private/fake_multi_node``; a GKE/TPU
+provider implements the same three methods with cloud instance calls.
+"""
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Dict, List, Optional
+
+
+class NodeProvider(ABC):
+    @abstractmethod
+    def create_node(self, node_type: str, resources: Dict[str, float],
+                    labels: Optional[Dict[str, str]] = None) -> str:
+        """Launch one node; returns a provider node id."""
+
+    @abstractmethod
+    def terminate_node(self, provider_node_id: str):
+        ...
+
+    @abstractmethod
+    def non_terminated_nodes(self) -> List[dict]:
+        """[{provider_node_id, node_type, node_id (cluster id, may be None)}]"""
+
+
+class LocalNodeProvider(NodeProvider):
+    """Spawns worker_main processes against a head address."""
+
+    def __init__(self, head_address: str):
+        host, _, port = head_address.rpartition(":")
+        self._gcs_addr = (host or "127.0.0.1", int(port))
+        self._nodes: Dict[str, dict] = {}
+        self._counter = 0
+
+    def create_node(self, node_type, resources, labels=None) -> str:
+        from ray_tpu._private.ids import JobID
+        from ray_tpu._private.node import spawn_node
+
+        handle = spawn_node(
+            self._gcs_addr, JobID.from_random(), dict(resources), labels
+        )
+        pid = f"local-{self._counter}"
+        self._counter += 1
+        self._nodes[pid] = {
+            "provider_node_id": pid,
+            "node_type": node_type,
+            "node_id": handle.node_id,
+            "handle": handle,
+        }
+        return pid
+
+    def terminate_node(self, provider_node_id: str):
+        info = self._nodes.pop(provider_node_id, None)
+        if info is not None:
+            info["handle"].terminate()
+
+    def non_terminated_nodes(self) -> List[dict]:
+        out = []
+        for pid, info in list(self._nodes.items()):
+            if info["handle"].alive():
+                out.append({k: info[k] for k in
+                            ("provider_node_id", "node_type", "node_id")})
+            else:
+                self._nodes.pop(pid, None)
+        return out

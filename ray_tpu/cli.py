@@ -1,0 +1,241 @@
+"""Command-line interface: ``python -m ray_tpu.cli`` (alias: raytpu).
+
+Reference analog: ``python/ray/scripts/scripts.py`` — ``ray start`` (:799),
+``ray stop`` (:1346), ``ray status``, ``ray job submit/logs/stop``,
+``ray summary``, ``ray timeline``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _resolve_address(args) -> str:
+    addr = getattr(args, "address", None) or os.environ.get("RAY_TPU_ADDRESS")
+    if addr and addr != "auto":
+        return addr
+    from ray_tpu._private.head_main import read_address_file
+
+    info = read_address_file()
+    if info is None:
+        print("error: no running head found (raytpu start --head)",
+              file=sys.stderr)
+        sys.exit(1)
+    return info["address"]
+
+
+def cmd_start(args):
+    if args.head:
+        cmd = [
+            sys.executable, "-m", "ray_tpu._private.head_main",
+            "--host", args.host, "--port", str(args.port),
+            "--num-cpus", str(args.num_cpus or os.cpu_count() or 1),
+            "--resources", args.resources,
+            "--dashboard-port", str(args.dashboard_port),
+        ]
+        if args.block:
+            os.execv(sys.executable, cmd)
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
+        line = proc.stdout.readline().strip()
+        try:
+            info = json.loads(line)
+        except json.JSONDecodeError:
+            print(f"head failed to start: {line}", file=sys.stderr)
+            sys.exit(1)
+        print(f"head started at {info['address']} (pid {info['head_pid']})")
+        if info.get("dashboard_port"):
+            print(f"dashboard: http://{args.host}:{info['dashboard_port']}")
+        print(f"connect with: ray_tpu.init(address='{info['address']}')")
+    else:
+        address = _resolve_address(args)
+        host, _, port = address.rpartition(":")
+        from ray_tpu._private.ids import JobID
+        from ray_tpu._private.node import spawn_node
+
+        resources = {"CPU": float(args.num_cpus or os.cpu_count() or 1)}
+        resources.update(json.loads(args.resources))
+        node = spawn_node((host, int(port)), JobID.from_random(), resources)
+        print(f"node started (pid {node.proc.pid}) -> {address}")
+
+
+def cmd_stop(args):
+    from ray_tpu._private.head_main import address_file_path, read_address_file
+
+    info = read_address_file()
+    if info is None:
+        print("no running head")
+        return
+    pids = [info.get("head_pid")] + list(info.get("node_pids", []))
+    for pid in [p for p in pids if p]:
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+    try:
+        os.remove(address_file_path())
+    except OSError:
+        pass
+    print(f"stopped head (pid {info.get('head_pid')})")
+
+
+def cmd_status(args):
+    from ray_tpu.util import state
+
+    address = _resolve_address(args)
+    status = state.cluster_status(address)
+    print(json.dumps(status, indent=2, default=str))
+
+
+def cmd_job_submit(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    import shlex
+
+    client = JobSubmissionClient(_resolve_address(args))
+    parts = args.entrypoint
+    if parts and parts[0] == "--":  # argparse.REMAINDER keeps the separator
+        parts = parts[1:]
+    entrypoint = shlex.join(parts)
+    sub_id = client.submit_job(entrypoint=entrypoint)
+    print(f"submitted: {sub_id}")
+    if args.wait:
+        status = client.wait_until_status(sub_id, timeout=args.timeout)
+        print(f"status: {status.value}")
+        print(client.get_job_logs(sub_id), end="")
+        sys.exit(0 if status.value == "SUCCEEDED" else 1)
+
+
+def cmd_job_status(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(_resolve_address(args))
+    print(json.dumps(client.get_job_info(args.submission_id), indent=2,
+                     default=str))
+
+
+def cmd_job_logs(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(_resolve_address(args))
+    print(client.get_job_logs(args.submission_id), end="")
+
+
+def cmd_job_stop(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(_resolve_address(args))
+    ok = client.stop_job(args.submission_id)
+    print("stopped" if ok else "not found")
+
+
+def cmd_job_list(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(_resolve_address(args))
+    print(json.dumps(client.list_jobs(), indent=2, default=str))
+
+
+def cmd_summary(args):
+    from ray_tpu.util import state
+
+    address = _resolve_address(args)
+    if args.what == "tasks":
+        print(json.dumps(state.summarize_tasks(address), indent=2))
+    elif args.what == "actors":
+        actors = state.list_actors(address)
+        by_state = {}
+        for a in actors:
+            by_state[a.get("state", "?")] = by_state.get(a.get("state", "?"), 0) + 1
+        print(json.dumps({"actors": by_state, "total": len(actors)}, indent=2))
+    else:
+        nodes = state.list_nodes(address)
+        print(json.dumps({"nodes": len(nodes)}, indent=2))
+
+
+def cmd_timeline(args):
+    """Dump task events as chrome://tracing JSON (reference: ray timeline)."""
+    from ray_tpu.util import state
+
+    events = state.list_tasks(_resolve_address(args), limit=100_000)
+    trace = []
+    for e in events:
+        if "start_time" not in e:
+            continue
+        trace.append({
+            "name": e.get("name", "task"),
+            "cat": e.get("type", "task"),
+            "ph": "X",
+            "ts": e["start_time"] * 1e6,
+            "dur": (e.get("end_time", e["start_time"]) - e["start_time"]) * 1e6,
+            "pid": e.get("node_id", "node")[:8],
+            "tid": e.get("worker_id", e.get("actor_id", "worker"))[:8],
+        })
+    with open(args.output, "w") as f:
+        json.dump(trace, f)
+    print(f"wrote {len(trace)} events to {args.output}")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="raytpu")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("start", help="start a head or worker node")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=0)
+    sp.add_argument("--num-cpus", type=int, default=0)
+    sp.add_argument("--resources", default="{}")
+    sp.add_argument("--dashboard-port", type=int, default=0)
+    sp.add_argument("--block", action="store_true")
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop the local head + nodes")
+    sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("status", help="cluster resource status")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_status)
+
+    jp = sub.add_parser("job", help="job submission")
+    jsub = jp.add_subparsers(dest="job_command", required=True)
+    sp = jsub.add_parser("submit")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--wait", action="store_true")
+    sp.add_argument("--timeout", type=float, default=600.0)
+    sp.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    sp.set_defaults(fn=cmd_job_submit)
+    for name, fn in (("status", cmd_job_status), ("logs", cmd_job_logs),
+                     ("stop", cmd_job_stop)):
+        sp = jsub.add_parser(name)
+        sp.add_argument("--address", default=None)
+        sp.add_argument("submission_id")
+        sp.set_defaults(fn=fn)
+    sp = jsub.add_parser("list")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_job_list)
+
+    sp = sub.add_parser("summary")
+    sp.add_argument("what", choices=["tasks", "actors", "nodes"])
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser("timeline")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--output", default="timeline.json")
+    sp.set_defaults(fn=cmd_timeline)
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

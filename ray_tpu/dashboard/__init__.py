@@ -1,0 +1,3 @@
+from ray_tpu.dashboard.app import DashboardApp
+
+__all__ = ["DashboardApp"]

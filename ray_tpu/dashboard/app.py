@@ -1,0 +1,141 @@
+"""Dashboard-lite: HTTP observability + job REST endpoints on the head.
+
+Reference analog: ``python/ray/dashboard/`` (head.py aiohttp app + modules:
+node, actor, job, state). Round-1 scope: the JSON API surface (no web UI) —
+enough for operators and the CLI/SDK to inspect nodes, actors, placement
+groups, jobs, tasks, and autoscaler-relevant load, plus REST job
+submit/stop (``dashboard/modules/job/job_head.py`` analog).
+"""
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+
+class DashboardApp:
+    """Runs inside the head process; calls HeadService handlers directly."""
+
+    def __init__(self, head, host: str = "127.0.0.1", port: int = 0):
+        self.head = head
+        self._host = host
+        self._port = port
+        self._runner = None
+
+    async def start(self) -> int:
+        from aiohttp import web
+
+        app = web.Application()
+        r = app.router
+        r.add_get("/api/version", self._version)
+        r.add_get("/api/nodes", self._nodes)
+        r.add_get("/api/actors", self._actors)
+        r.add_get("/api/placement_groups", self._pgs)
+        r.add_get("/api/jobs", self._jobs)
+        r.add_post("/api/jobs", self._submit_job)
+        r.add_get("/api/jobs/{submission_id}", self._job_status)
+        r.add_get("/api/jobs/{submission_id}/logs", self._job_logs)
+        r.add_post("/api/jobs/{submission_id}/stop", self._stop_job)
+        r.add_get("/api/tasks", self._tasks)
+        r.add_get("/api/cluster_status", self._cluster_status)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self._host, self._port)
+        await site.start()
+        self._port = site._server.sockets[0].getsockname()[1]
+        return self._port
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    async def stop(self):
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    # ------------------------------------------------------------ handlers
+
+    async def _head(self, method: str, header: dict):
+        h, frames = await getattr(self.head, "rpc_" + method)(header, [], None)
+        return h, frames
+
+    async def _version(self, request):
+        from aiohttp import web
+
+        return web.json_response({"ray_tpu": "0.1", "api": "v1"})
+
+    async def _nodes(self, request):
+        from aiohttp import web
+
+        h, _ = await self._head("get_nodes", {})
+        return web.json_response(h)
+
+    async def _actors(self, request):
+        from aiohttp import web
+
+        h, _ = await self._head("list_actors", {})
+        return web.json_response(h)
+
+    async def _pgs(self, request):
+        from aiohttp import web
+
+        h, _ = await self._head("list_pgs", {})
+        return web.json_response(h)
+
+    async def _jobs(self, request):
+        from aiohttp import web
+
+        h, _ = await self._head("list_jobs", {})
+        return web.json_response(h)
+
+    async def _submit_job(self, request):
+        from aiohttp import web
+
+        try:
+            payload = json.loads(await request.read())
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid JSON"}, status=400)
+        if "entrypoint" not in payload:
+            return web.json_response(
+                {"error": "entrypoint required"}, status=400
+            )
+        h, _ = await self._head("submit_job", payload)
+        return web.json_response(h)
+
+    async def _job_status(self, request):
+        from aiohttp import web
+
+        sid = request.match_info["submission_id"]
+        h, _ = await self._head("job_status", {"submission_id": sid})
+        if not h.get("found"):
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response(h["job"])
+
+    async def _job_logs(self, request):
+        from aiohttp import web
+
+        sid = request.match_info["submission_id"]
+        h, frames = await self._head("job_logs", {"submission_id": sid})
+        if not h.get("found"):
+            return web.json_response({"error": "not found"}, status=404)
+        text = bytes(frames[0]).decode(errors="replace") if frames else ""
+        return web.json_response({"logs": text})
+
+    async def _stop_job(self, request):
+        from aiohttp import web
+
+        sid = request.match_info["submission_id"]
+        h, _ = await self._head("stop_job", {"submission_id": sid})
+        return web.json_response(h)
+
+    async def _tasks(self, request):
+        from aiohttp import web
+
+        limit = int(request.query.get("limit", 1000))
+        h, _ = await self._head("list_task_events", {"limit": limit})
+        return web.json_response(h)
+
+    async def _cluster_status(self, request):
+        from aiohttp import web
+
+        h, _ = await self._head("cluster_load", {})
+        return web.json_response(h)

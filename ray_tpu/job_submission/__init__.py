@@ -1,0 +1,93 @@
+"""Job submission SDK.
+
+Reference analog: ``python/ray/job_submission/`` +
+``dashboard/modules/job/sdk.py:132 submit_job`` — REST+SDK job lifecycle
+(submit/status/logs/stop). Transport here is the head's RPC protocol
+directly (the dashboard-lite HTTP app exposes the same surface over REST).
+"""
+from __future__ import annotations
+
+import time
+from enum import Enum
+from typing import Any, Dict, Optional
+
+
+class JobStatus(str, Enum):
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+    def is_terminal(self) -> bool:
+        return self in (
+            JobStatus.SUCCEEDED, JobStatus.FAILED, JobStatus.STOPPED
+        )
+
+
+class JobSubmissionClient:
+    def __init__(self, address: str):
+        from ray_tpu._private.sync_client import SyncHeadClient
+
+        self._client = SyncHeadClient(address)
+
+    def submit_job(
+        self,
+        *,
+        entrypoint: str,
+        submission_id: Optional[str] = None,
+        runtime_env: Optional[Dict[str, Any]] = None,
+        metadata: Optional[Dict[str, str]] = None,
+    ) -> str:
+        h, _ = self._client.call("submit_job", {
+            "entrypoint": entrypoint,
+            "submission_id": submission_id,
+            "runtime_env": runtime_env,
+            "metadata": metadata,
+        })
+        return h["submission_id"]
+
+    def get_job_status(self, submission_id: str) -> JobStatus:
+        h, _ = self._client.call("job_status", {"submission_id": submission_id})
+        if not h.get("found"):
+            raise RuntimeError(f"job {submission_id} not found")
+        return JobStatus(h["job"]["status"])
+
+    def get_job_info(self, submission_id: str) -> dict:
+        h, _ = self._client.call("job_status", {"submission_id": submission_id})
+        if not h.get("found"):
+            raise RuntimeError(f"job {submission_id} not found")
+        return h["job"]
+
+    def get_job_logs(self, submission_id: str) -> str:
+        h, frames = self._client.call(
+            "job_logs", {"submission_id": submission_id}
+        )
+        if not h.get("found"):
+            raise RuntimeError(f"job {submission_id} not found")
+        return bytes(frames[0]).decode(errors="replace") if frames else ""
+
+    def stop_job(self, submission_id: str) -> bool:
+        h, _ = self._client.call("stop_job", {"submission_id": submission_id})
+        return h.get("stopped", False)
+
+    def list_jobs(self) -> list:
+        h, _ = self._client.call("list_jobs", {})
+        return h["jobs"]
+
+    def wait_until_status(self, submission_id: str, timeout: float = 120.0,
+                          target: Optional[JobStatus] = None) -> JobStatus:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            status = self.get_job_status(submission_id)
+            if (target is not None and status == target) or (
+                target is None and status.is_terminal()
+            ):
+                return status
+            time.sleep(0.2)
+        raise TimeoutError(
+            f"job {submission_id} not terminal within {timeout}s"
+        )
+
+    def close(self):
+        self._client.close()

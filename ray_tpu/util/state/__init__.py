@@ -1,0 +1,113 @@
+"""State API: cluster-wide listings and summaries.
+
+Reference analog: ``python/ray/util/state/api.py`` — ``list_actors`` (:793),
+``list_nodes`` (:885), ``list_tasks`` (:1020), ``list_objects`` (:1065),
+``summarize_tasks`` (:1376), backed by GCS tables + the task-event store.
+"""
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any, Dict, List, Optional
+
+
+def _call(method: str, header: dict, address: Optional[str] = None):
+    if address is not None:
+        from ray_tpu._private.sync_client import SyncHeadClient
+
+        client = SyncHeadClient(address)
+        try:
+            return client.call(method, header)[0]
+        finally:
+            client.close()
+    from ray_tpu._private.worker import get_global_worker
+
+    w = get_global_worker()
+    return w.run_sync(w.gcs.call(method, header))[0]
+
+
+def _apply_filters(rows: List[dict], filters) -> List[dict]:
+    """filters: [(key, op, value)] with op in ("=", "!=")."""
+    for key, op, value in filters or ():
+        if op == "=":
+            rows = [r for r in rows if str(r.get(key)) == str(value)]
+        elif op == "!=":
+            rows = [r for r in rows if str(r.get(key)) != str(value)]
+        else:
+            raise ValueError(f"unsupported filter op {op}")
+    return rows
+
+
+def list_nodes(address: Optional[str] = None, filters=None,
+               limit: int = 1000) -> List[dict]:
+    rows = _call("get_nodes", {}, address)["nodes"]
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_actors(address: Optional[str] = None, filters=None,
+                limit: int = 1000) -> List[dict]:
+    rows = _call("list_actors", {}, address)["actors"]
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_placement_groups(address: Optional[str] = None, filters=None,
+                          limit: int = 1000) -> List[dict]:
+    rows = _call("list_pgs", {}, address)["pgs"]
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_jobs(address: Optional[str] = None, filters=None,
+              limit: int = 1000) -> List[dict]:
+    rows = _call("list_jobs", {}, address)["jobs"]
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_objects(address: Optional[str] = None, filters=None,
+                 limit: int = 1000) -> List[dict]:
+    rows = _call("list_objects", {"limit": limit}, address)["objects"]
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_tasks(address: Optional[str] = None, filters=None,
+               limit: int = 1000) -> List[dict]:
+    rows = _call("list_task_events", {"limit": limit}, address)["events"]
+    return _apply_filters(rows, filters)[:limit]
+
+
+def summarize_tasks(address: Optional[str] = None) -> Dict[str, Any]:
+    """Counts by (name, state) (reference: ``api.py:1376``)."""
+    events = list_tasks(address, limit=100_000)
+    by_name: Dict[str, Counter] = {}
+    for e in events:
+        name = e.get("name", "unknown")
+        by_name.setdefault(name, Counter())[e.get("state", "UNKNOWN")] += 1
+    return {
+        "cluster": {
+            "summary": {
+                name: {"state_counts": dict(c)} for name, c in by_name.items()
+            },
+            "total_tasks": len(events),
+        }
+    }
+
+
+def cluster_status(address: Optional[str] = None) -> Dict[str, Any]:
+    """Autoscaler-style status: totals, availability, pending demand."""
+    load = _call("cluster_load", {}, address)
+    total: Dict[str, float] = {}
+    avail: Dict[str, float] = {}
+    alive = 0
+    for n in load["nodes"]:
+        if not n.get("alive"):
+            continue
+        alive += 1
+        for k, v in n.get("resources", {}).items():
+            total[k] = total.get(k, 0) + v
+        for k, v in n.get("available", {}).items():
+            avail[k] = avail.get(k, 0) + v
+    return {
+        "nodes_alive": alive,
+        "resources_total": total,
+        "resources_available": avail,
+        "pending_demands": load["pending"],
+        "pending_placement_groups": load["pending_pgs"],
+    }
