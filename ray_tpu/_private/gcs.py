@@ -146,6 +146,7 @@ class HeadService:
         # autoscaler v2 with pending resource demands).
         self.pending_demands: Dict[int, dict] = {}
         self.job_procs: Dict[str, object] = {}  # submission_id -> Popen
+        self.worker_metrics: Dict[str, list] = {}  # worker -> metric snapshot
 
     # ------------------------------------------------------------------ setup
 
@@ -244,6 +245,11 @@ class HeadService:
             for i, nid in enumerate(pg.bundle_nodes):
                 if nid == node_id:
                     pg.bundle_nodes[i] = None
+        # Drop the dead node's metric series.
+        self.worker_metrics = {
+            wid: rec for wid, rec in self.worker_metrics.items()
+            if rec.get("node_id") != node_id
+        }
 
     async def rpc_drain_node(self, h, frames, conn):
         await self._on_node_dead(h["node_id"], "drained")
@@ -419,10 +425,17 @@ class HeadService:
             if node is None:
                 fut = asyncio.get_running_loop().create_future()
                 self._pending_waiters.append(fut)
+                # actors are the third demand source next to leases and PGs
+                self.pending_demands[id(fut)] = {
+                    "resources": dict(info.resources), "count": 1,
+                    "since": time.time(),
+                }
                 try:
                     await asyncio.wait_for(fut, timeout=1.0)
                 except asyncio.TimeoutError:
                     pass
+                finally:
+                    self.pending_demands.pop(id(fut), None)
                 continue
             if not strategy.get("pg_id"):
                 _acquire(node.available, info.resources)
@@ -703,6 +716,23 @@ class HeadService:
             "pending": list(self.pending_demands.values()),
             "pending_pgs": pending_pgs,
             "nodes": [n.to_public() for n in self.nodes.values()],
+        }, []
+
+    async def rpc_metrics_push(self, h, frames, conn):
+        """Latest metric snapshot per worker (reference: per-node metrics
+        agent collecting for the Prometheus scrape). node_id rides along so
+        node death can drop the worker's series (stale gauges poison
+        Prometheus aggregates)."""
+        self.worker_metrics[h["worker_id"]] = {
+            "node_id": h.get("node_id"), "metrics": h["metrics"],
+        }
+        return {}, []
+
+    async def rpc_metrics_snapshot(self, h, frames, conn):
+        return {
+            "snapshots": {
+                wid: rec["metrics"] for wid, rec in self.worker_metrics.items()
+            }
         }, []
 
     async def rpc_task_event(self, h, frames, conn):

@@ -87,8 +87,12 @@ def main(argv=None):
 
     signal.signal(signal.SIGTERM, term)
     signal.signal(signal.SIGINT, term)
+    exit_code = 0
     try:
         loop.run_forever()
+    except BaseException:  # crash must not report success to supervisors
+        exit_code = 1
+        raise
     finally:
         if node is not None:
             node.terminate()
@@ -101,7 +105,7 @@ def main(argv=None):
             os.remove(address_file_path())
         except OSError:
             pass
-        os._exit(0)  # no lingering non-daemon threads may block exit
+        os._exit(exit_code)  # no lingering non-daemon threads may block exit
 
 
 if __name__ == "__main__":

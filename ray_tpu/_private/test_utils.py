@@ -1,0 +1,69 @@
+"""Chaos/test helpers.
+
+Reference analog: ``python/ray/_private/test_utils.py`` —
+``ResourceKillerActor`` (:1278), ``RayletKiller`` (:1407): background
+killers that take out cluster components mid-workload so fault-tolerance
+paths get exercised for real.
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import List, Optional
+
+
+class NodeKiller:
+    """Kills random worker nodes of a LocalCluster on an interval.
+
+    Spares the last ``min_alive`` nodes so the workload can finish. Runs in
+    a thread in the driver (our cluster handle lives there; the reference
+    runs its killer as an actor for remote clusters).
+    """
+
+    def __init__(self, cluster, interval_s: float = 1.0, min_alive: int = 1,
+                 max_kills: int = 1_000_000, seed: int = 0):
+        self.cluster = cluster
+        self.interval_s = interval_s
+        self.min_alive = min_alive
+        self.max_kills = max_kills
+        self.killed: List[str] = []
+        self._rng = random.Random(seed)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="rt-node-killer"
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.is_set() and len(self.killed) < self.max_kills:
+            if self._stop.wait(self.interval_s):
+                return
+            alive = [n for n in self.cluster.nodes if n.alive()]
+            if len(alive) <= self.min_alive:
+                continue
+            victim = self._rng.choice(alive)
+            try:
+                self.cluster.kill_node(victim)
+                self.killed.append(victim.node_id)
+            except Exception:
+                pass
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+
+def wait_for_condition(fn, timeout: float = 30.0, interval: float = 0.1,
+                       message: str = "condition not met"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return
+        time.sleep(interval)
+    raise TimeoutError(message)

@@ -695,6 +695,10 @@ class CoreWorker:
             "name": name or getattr(fn, "__name__", "task"),
             "renv": runtime_env or {},
         }
+        from ray_tpu.util.tracing import tracing_helper
+
+        if tracing_helper.enabled():
+            header["trace"] = tracing_helper.inject_context()
         refs = []
         for i in range(num_returns):
             oid = ObjectID.for_return(task_id, i)
@@ -1181,16 +1185,52 @@ class CoreWorker:
             args.append(fetched[idx] if kind == "ref" else plain[idx])
         return args, kwargs
 
+    _warned_renv_plugins: set = set()
+    # Serializes tasks that use working_dir: cwd is process-global, so two
+    # concurrent chdir'ing tasks would corrupt each other's view (and the
+    # restore). Tasks without working_dir never touch cwd and skip the lock.
+    _cwd_lock = threading.Lock()
+
     def _apply_runtime_env(self, renv: dict):
-        envs = (renv or {}).get("env_vars") or {}
+        """Per-task environment (reference: _private/runtime_env/ plugins).
+        Supported: env_vars, working_dir (chdir for the task — NOTE: cwd is
+        process-global, so tasks from different working_dirs must not share
+        a worker concurrently). pip/uv/conda/container isolation needs
+        worker-pool-per-env support and is declined loudly, not silently."""
+        renv = renv or {}
+        for plugin in ("pip", "uv", "conda", "container", "py_modules"):
+            if renv.get(plugin) and plugin not in self._warned_renv_plugins:
+                self._warned_renv_plugins.add(plugin)
+                logger.warning(
+                    "runtime_env[%r] is not supported yet; ignoring", plugin
+                )
+        envs = renv.get("env_vars") or {}
         old = {}
         for k, v in envs.items():
             old[k] = os.environ.get(k)
             os.environ[k] = str(v)
-        return old
+        cwd = None
+        locked = False
+        if renv.get("working_dir"):
+            self._cwd_lock.acquire()
+            locked = True
+            cwd = os.getcwd()
+            try:
+                os.chdir(renv["working_dir"])
+            except OSError as e:
+                logger.warning("working_dir %r: %s", renv["working_dir"], e)
+                cwd = None
+        return {"env": old, "cwd": cwd, "locked": locked}
 
-    def _restore_env(self, old: dict):
-        for k, v in old.items():
+    def _restore_env(self, old):
+        if old.get("cwd") is not None:
+            try:
+                os.chdir(old["cwd"])
+            except OSError:
+                pass
+        if old.get("locked"):
+            self._cwd_lock.release()
+        for k, v in old.get("env", {}).items():
             if v is None:
                 os.environ.pop(k, None)
             else:
@@ -1202,15 +1242,32 @@ class CoreWorker:
         self._task_events_buf.append(event)
 
     async def _task_event_flusher(self):
+        last_metrics = 0.0
         while not self._shutdown:
             await asyncio.sleep(0.25)
-            if not self._task_events_buf:
-                continue
-            batch, self._task_events_buf = self._task_events_buf, []
-            try:
-                self.gcs.notify("task_events", {"events": batch})
-            except protocol.ConnectionLost:
-                return
+            if self._task_events_buf:
+                batch, self._task_events_buf = self._task_events_buf, []
+                try:
+                    self.gcs.notify("task_events", {"events": batch})
+                except protocol.ConnectionLost:
+                    return
+            now = time.monotonic()
+            if now - last_metrics >= 2.0:
+                last_metrics = now
+                try:
+                    from ray_tpu.util.metrics import registry
+
+                    snap = registry().snapshot()
+                    if snap:
+                        self.gcs.notify("metrics_push", {
+                            "worker_id": self.worker_id.hex(),
+                            "node_id": self.node_id,
+                            "metrics": snap,
+                        })
+                except protocol.ConnectionLost:
+                    return
+                except Exception:
+                    pass
 
     async def rpc_push_task(self, h, frames, conn):
         """Execute a normal task (reference: ``CoreWorker::HandlePushTask``
@@ -1220,13 +1277,19 @@ class CoreWorker:
         loop = asyncio.get_running_loop()
 
         def run():
+            from ray_tpu.util.tracing import tracing_helper
+
             old = self._apply_runtime_env(h.get("renv"))
             tid = TaskID.from_hex(h["tid"])
             self.current_task_id.value = tid
             self.current_actor_id.value = None
             self.put_counter.value = 0
             try:
-                return True, fn(*args, **kwargs)
+                with tracing_helper.span(
+                    f"task::{h.get('name', 'task')}", h.get("trace"),
+                    {"task_id": h["tid"], "node_id": self.node_id},
+                ):
+                    return True, fn(*args, **kwargs)
             except Exception as e:
                 return False, (e, traceback.format_exc())
             finally:

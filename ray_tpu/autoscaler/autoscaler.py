@@ -36,8 +36,15 @@ class AutoscalerConfig:
     upscaling_speed: int = 100  # max nodes launched per update
 
 
+# Same epsilon as the head's scheduler (_fits in _private/gcs.py): float
+# residue from fractional acquire/release must not diverge the two views.
 def _fits(avail: Dict[str, float], need: Dict[str, float]) -> bool:
-    return all(avail.get(k, 0.0) >= v for k, v in need.items())
+    return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in need.items())
+
+
+def _is_idle(node: dict) -> bool:
+    res, avail = node["resources"], node["available"]
+    return all(abs(avail.get(k, 0.0) - v) < 1e-6 for k, v in res.items())
 
 
 def _sub(avail: Dict[str, float], need: Dict[str, float]):
@@ -67,7 +74,13 @@ class Autoscaler:
         for pg in load["pending_pgs"]:
             demands.extend(pg["bundles"])
 
-        # simulated free capacity: live nodes' available + launching nodes
+        # simulated free capacity: live registered nodes' available, PLUS the
+        # full resources of provider nodes still booting (launched earlier,
+        # not yet in the head's view) — without that credit every reconcile
+        # pass would re-launch for the same demand until registration.
+        alive_ids = {
+            n["node_id"] for n in load["nodes"] if n.get("alive")
+        }
         sim: List[Dict[str, float]] = [
             dict(n["available"]) for n in load["nodes"] if n.get("alive")
         ]
@@ -75,6 +88,10 @@ class Autoscaler:
         by_type: Dict[str, int] = {}
         for n in provider_nodes:
             by_type[n["node_type"]] = by_type.get(n["node_type"], 0) + 1
+            if n.get("node_id") not in alive_ids:
+                tcfg = self.config.node_types.get(n["node_type"])
+                if tcfg is not None:
+                    sim.append(dict(tcfg.resources))
 
         launched: Dict[str, int] = {}
         budget = self.config.upscaling_speed
@@ -137,7 +154,7 @@ class Autoscaler:
             info = alive.get(pn["node_id"])
             if info is None:
                 continue
-            idle = info["available"] == info["resources"]
+            idle = _is_idle(info)
             if not idle:
                 self._idle_since.pop(pn["node_id"], None)
                 continue

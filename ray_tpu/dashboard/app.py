@@ -37,6 +37,7 @@ class DashboardApp:
         r.add_post("/api/jobs/{submission_id}/stop", self._stop_job)
         r.add_get("/api/tasks", self._tasks)
         r.add_get("/api/cluster_status", self._cluster_status)
+        r.add_get("/metrics", self._metrics)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self._host, self._port)
@@ -139,3 +140,15 @@ class DashboardApp:
 
         h, _ = await self._head("cluster_load", {})
         return web.json_response(h)
+
+    async def _metrics(self, request):
+        """Prometheus exposition (reference: metrics agent scrape target)."""
+        from aiohttp import web
+
+        from ray_tpu.util.metrics import render_prometheus
+
+        h, _ = await self._head("metrics_snapshot", {})
+        return web.Response(
+            text=render_prometheus(h["snapshots"]),
+            content_type="text/plain",
+        )

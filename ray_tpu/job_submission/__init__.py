@@ -15,6 +15,7 @@ from typing import Any, Dict, Optional
 class JobStatus(str, Enum):
     PENDING = "PENDING"
     RUNNING = "RUNNING"
+    STOPPING = "STOPPING"
     SUCCEEDED = "SUCCEEDED"
     FAILED = "FAILED"
     STOPPED = "STOPPED"

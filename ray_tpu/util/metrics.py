@@ -1,0 +1,199 @@
+"""User + internal metrics: Counter/Gauge/Histogram with Prometheus export.
+
+Reference analog: ``ray.util.metrics`` (Counter/Gauge/Histogram backed by
+``src/ray/stats/metric.h`` via ``includes/metric.pxi``) and the per-node
+metrics agent → Prometheus scrape pipeline. Here every process keeps a
+registry; workers push snapshots to the head with their telemetry batch, and
+the dashboard exposes ``/metrics`` in Prometheus text format (one sample per
+(metric, tags, worker)).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_DEFAULT_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+class _Registry:
+    def __init__(self):
+        self._metrics: Dict[str, "Metric"] = {}
+        self._lock = threading.Lock()
+
+    def register(self, metric: "Metric"):
+        with self._lock:
+            existing = self._metrics.get(metric.name)
+            if existing is not None and type(existing) is not type(metric):
+                raise ValueError(
+                    f"metric {metric.name} already registered with a "
+                    f"different type"
+                )
+            self._metrics[metric.name] = metric
+
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            return [m._snapshot() for m in self._metrics.values()]
+
+    def clear(self):
+        with self._lock:
+            self._metrics.clear()
+
+
+_registry = _Registry()
+
+
+def registry() -> _Registry:
+    return _registry
+
+
+def _tags_key(tags: Optional[Dict[str, str]]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((tags or {}).items()))
+
+
+class Metric:
+    metric_type = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        if not name:
+            raise ValueError("metric name required")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        self._values: Dict[Tuple, float] = {}
+        _registry.register(self)
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _merged(self, tags):
+        if self._default_tags:
+            return {**self._default_tags, **(tags or {})}
+        return tags or {}
+
+    def _snapshot(self) -> dict:
+        with self._lock:
+            samples = [
+                {"tags": dict(k), "value": v} for k, v in self._values.items()
+            ]
+        return {
+            "name": self.name, "type": self.metric_type,
+            "help": self.description, "samples": samples,
+        }
+
+
+class Counter(Metric):
+    metric_type = "counter"
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None):
+        if value < 0:
+            raise ValueError("counters only increase")
+        key = _tags_key(self._merged(tags))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+
+class Gauge(Metric):
+    metric_type = "gauge"
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        with self._lock:
+            self._values[_tags_key(self._merged(tags))] = float(value)
+
+
+class Histogram(Metric):
+    metric_type = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Sequence[float] = _DEFAULT_BUCKETS,
+                 tag_keys: Sequence[str] = ()):
+        self.boundaries = tuple(sorted(boundaries))
+        # per tags: (bucket counts [len+1], sum, count)
+        super().__init__(name, description, tag_keys)
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = _tags_key(self._merged(tags))
+        with self._lock:
+            rec = self._values.get(key)
+            if rec is None:
+                rec = [[0] * (len(self.boundaries) + 1), 0.0, 0]
+                self._values[key] = rec
+            idx = len(self.boundaries)
+            for i, b in enumerate(self.boundaries):
+                if value <= b:
+                    idx = i
+                    break
+            rec[0][idx] += 1
+            rec[1] += value
+            rec[2] += 1
+
+    def _snapshot(self) -> dict:
+        with self._lock:
+            samples = [
+                {
+                    "tags": dict(k),
+                    "buckets": list(rec[0]),
+                    "sum": rec[1],
+                    "count": rec[2],
+                }
+                for k, rec in self._values.items()
+            ]
+        return {
+            "name": self.name, "type": "histogram",
+            "help": self.description,
+            "boundaries": list(self.boundaries), "samples": samples,
+        }
+
+
+def render_prometheus(snapshots: Dict[str, List[dict]]) -> str:
+    """snapshots: {worker_id: [metric snapshot dicts]} → exposition text."""
+
+    def fmt_tags(tags: Dict[str, str]) -> str:
+        if not tags:
+            return ""
+        inner = ",".join(
+            f'{k}="{str(v).replace(chr(34), chr(39))}"'
+            for k, v in sorted(tags.items())
+        )
+        return "{" + inner + "}"
+
+    lines: List[str] = []
+    seen_headers = set()
+    for worker_id, metrics in snapshots.items():
+        for m in metrics:
+            if m["name"] not in seen_headers:
+                seen_headers.add(m["name"])
+                if m.get("help"):
+                    lines.append(f"# HELP {m['name']} {m['help']}")
+                lines.append(f"# TYPE {m['name']} {m['type']}")
+            for s in m["samples"]:
+                tags = {**s.get("tags", {}), "worker_id": worker_id[:12]}
+                if m["type"] == "histogram":
+                    cum = 0
+                    for b, n in zip(m["boundaries"], s["buckets"]):
+                        cum += n
+                        lines.append(
+                            f"{m['name']}_bucket"
+                            f"{fmt_tags({**tags, 'le': str(b)})} {cum}"
+                        )
+                    cum += s["buckets"][-1]
+                    lines.append(
+                        f"{m['name']}_bucket"
+                        f"{fmt_tags({**tags, 'le': '+Inf'})} {cum}"
+                    )
+                    lines.append(
+                        f"{m['name']}_sum{fmt_tags(tags)} {s['sum']}"
+                    )
+                    lines.append(
+                        f"{m['name']}_count{fmt_tags(tags)} {s['count']}"
+                    )
+                else:
+                    lines.append(
+                        f"{m['name']}{fmt_tags(tags)} {s['value']}"
+                    )
+    return "\n".join(lines) + "\n"

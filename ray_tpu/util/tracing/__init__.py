@@ -1,0 +1,11 @@
+from ray_tpu.util.tracing.tracing_helper import (
+    enabled,
+    inject_context,
+    setup_tracing,
+    span,
+    teardown_tracing,
+)
+
+__all__ = [
+    "enabled", "inject_context", "setup_tracing", "span", "teardown_tracing",
+]

@@ -1,0 +1,89 @@
+"""OpenTelemetry tracing around task submission/execution.
+
+Reference analog: ``python/ray/util/tracing/tracing_helper.py`` —
+import-guarded (:36-40) span wrappers applied around submit/execute, with
+trace context propagated inside task metadata. Disabled (no-op, near-zero
+cost) until ``setup_tracing`` runs; the worker hot path only pays a None
+check.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+_tracer = None
+_propagator = None
+
+
+def enabled() -> bool:
+    return _tracer is not None
+
+
+def setup_tracing(service_name: str = "ray_tpu",
+                  exporter: Optional[object] = None,
+                  in_memory: bool = False):
+    """Enable tracing in THIS process. exporter: any OTel SpanExporter;
+    in_memory=True installs an InMemorySpanExporter and returns it (tests).
+    """
+    global _tracer, _propagator
+    try:
+        from opentelemetry import trace
+        from opentelemetry.propagate import get_global_textmap
+        from opentelemetry.sdk.trace import TracerProvider
+        from opentelemetry.sdk.trace.export import SimpleSpanProcessor
+    except ImportError:  # tracing stays off without the SDK
+        return None
+
+    provider = TracerProvider()
+    memory_exporter = None
+    if in_memory:
+        from opentelemetry.sdk.trace.export.in_memory_span_exporter import (
+            InMemorySpanExporter,
+        )
+
+        memory_exporter = InMemorySpanExporter()
+        exporter = memory_exporter
+    if exporter is not None:
+        provider.add_span_processor(SimpleSpanProcessor(exporter))
+    trace.set_tracer_provider(provider)
+    _tracer = trace.get_tracer(service_name)
+    _propagator = get_global_textmap()
+    return memory_exporter
+
+
+def teardown_tracing():
+    global _tracer, _propagator
+    _tracer = None
+    _propagator = None
+
+
+def inject_context() -> Optional[Dict[str, str]]:
+    """Trace headers for a task being submitted (None when disabled)."""
+    if _tracer is None:
+        return None
+    carrier: Dict[str, str] = {}
+    _propagator.inject(carrier)
+    return carrier or None
+
+
+@contextmanager
+def span(name: str, carrier: Optional[Dict[str, str]] = None,
+         attributes: Optional[Dict[str, str]] = None):
+    """Span around submit/execute; no-op when disabled."""
+    if _tracer is None:
+        yield None
+        return
+    from opentelemetry import context as otel_context
+
+    token = None
+    if carrier:
+        ctx = _propagator.extract(carrier)
+        token = otel_context.attach(ctx)
+    try:
+        with _tracer.start_as_current_span(name) as sp:
+            for k, v in (attributes or {}).items():
+                sp.set_attribute(k, v)
+            yield sp
+    finally:
+        if token is not None:
+            otel_context.detach(token)

@@ -134,9 +134,9 @@ def test_jax_trainer_end_to_end(rt_start, tmp_path):
                 "num_heads": 2, "embed_dim": 32, "dtype": "float32",
                 "attention_impl": "xla",
             },
-            "mesh": {"data": 1},
+            "mesh": {"data": -1},  # all local devices (8 on the test mesh)
             "num_steps": 3,
-            "batch_size": 4,
+            "batch_size": 8,
             "seq_len": 16,
             "checkpoint_every": 0,
             "optimizer": {"warmup_steps": 1, "total_steps": 3},
