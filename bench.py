@@ -78,10 +78,19 @@ def main():
     parser.add_argument("--no-train", action="store_true")
     args = parser.parse_args()
 
+    import os
+
     import ray_tpu
     from ray_tpu._private.perf import run_core_benchmarks
 
-    ray_tpu.init(num_cpus=4, num_nodes=1)
+    # Scale worker processes to the machine: task execution is GIL-bound per
+    # process, so on many-core hosts (TPU VMs have ~100 vCPUs) throughput
+    # comes from multiple node processes. On tiny CI hosts stay small.
+    cores = os.cpu_count() or 1
+    if cores >= 8:
+        ray_tpu.init(num_cpus=4, num_nodes=min(cores // 4, 8))
+    else:
+        ray_tpu.init(num_cpus=max(cores, 2), num_nodes=1)
     try:
         core = run_core_benchmarks(quick=args.quick)
     finally:

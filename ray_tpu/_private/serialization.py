@@ -38,9 +38,20 @@ class SerializedObject:
             n += b.raw().nbytes
         return n
 
-    def to_frames(self) -> List[bytes]:
-        """Flatten to a frame list: [metadata, inband, buf0, buf1, ...]."""
-        return [self.metadata, self.inband] + [bytes(b.raw()) for b in self.buffers]
+    def to_frames(self, copy: bool = True) -> List[bytes]:
+        """Flatten to a frame list: [metadata, inband, buf0, buf1, ...].
+
+        The default COPIES out-of-band buffers: frames routinely outlive the
+        call while the caller still owns (and may mutate) the source — e.g.
+        task args queued for dispatch must be a snapshot from .remote() time.
+        Pass copy=False only where the frames are consumed immediately and
+        exactly once (the large-object put path writing straight into shm),
+        which is where the zero-copy win lives.
+        """
+        bufs = [
+            bytes(b.raw()) if copy else b.raw() for b in self.buffers
+        ]
+        return [self.metadata, self.inband] + bufs
 
 
 METADATA_PICKLE5 = b"pickle5"

@@ -427,10 +427,16 @@ class CoreWorker:
         nested = [
             (r.id().hex(), list(r.owner_address or ())) for r in nested_refs
         ]
-        frames = sobj.to_frames()
+        size = sobj.total_bytes()
+        # Large values go straight into shm inside this call (one memcpy
+        # from the raw buffer views — zero-copy is safe because the write
+        # happens before put() returns); small inline values keep the
+        # default copy since the memory store holds the frames while the
+        # caller may mutate the source.
+        frames = sobj.to_frames(copy=size <= INLINE_OBJECT_MAX)
         hex_ = oid.hex()
         self._add_borrows(nested)  # pinned until this object is freed
-        self.run_sync(self._store_object(hex_, frames, sobj.total_bytes()))
+        self.run_sync(self._store_object(hex_, frames, size))
         self._register_owned(hex_, nested=nested)
         return ObjectRef(oid, tuple(self.addr))
 
@@ -758,18 +764,31 @@ class CoreWorker:
             self._store_error(ObjectID.for_return(tid, i).hex(), err)
         self._release_borrows(header.get("borrows", []))
 
+    # In-flight pushes per leased slot: depth 2 keeps the next task on the
+    # wire while the current one executes (the worker's executor queues it),
+    # hiding the push RPC latency. Depth 1 caps throughput at
+    # slots/round-trip; real parallelism stays bounded by the worker's own
+    # task slots (reference: pipelined task submission on leased workers).
+    _PUSH_PIPELINE = 2
+
     def _pump_leases(self, key, lease_set: _LeaseSet):
         lease_set.last_active = time.monotonic()
-        # dispatch pending onto free slots
-        while lease_set.pending:
-            slot = next((s for s in lease_set.slots if s.busy == 0), None)
-            if slot is None:
+        # Spawn long-lived pushers (≤ _PUSH_PIPELINE per slot), each draining
+        # the pending queue — per-task create_task churn would dominate the
+        # driver loop at high rates.
+        # Spawn at most one new pusher per queued item this pass — but never
+        # count busy pushers as capacity for NEW work: each is committed to
+        # its in-flight task for that task's whole runtime, and treating it
+        # as available would strand queued tasks while other slots idle
+        # (deadlock for producer/consumer task patterns).
+        spawn_budget = len(lease_set.pending)
+        while spawn_budget > 0 and lease_set.slots:
+            slot = min(lease_set.slots, key=lambda s: s.busy)
+            if slot.busy >= self._PUSH_PIPELINE:
                 break
-            header, frames, fut = lease_set.pending.pop(0)
-            slot.busy = 1
-            self.loop.create_task(
-                self._push_to_slot(key, lease_set, slot, header, frames, fut)
-            )
+            slot.busy += 1
+            spawn_budget -= 1
+            self.loop.create_task(self._slot_pusher(key, lease_set, slot))
         need = len(lease_set.pending)
         if need > 0 and not lease_set.requesting:
             lease_set.requesting = True
@@ -809,29 +828,40 @@ class CoreWorker:
             lease_set.requesting = False
             self._pump_leases(key, lease_set)
 
-    async def _push_to_slot(self, key, lease_set, slot, header, frames, fut):
+    async def _slot_pusher(self, key, lease_set, slot):
+        """Drains pending tasks onto one leased slot until the queue (or the
+        slot) is gone; many tasks amortize one coroutine."""
         try:
-            conn = await self.get_peer(slot.addr)
-            h, rframes = await conn.call("push_task", header, frames)
-            self._handle_task_reply(header, h, rframes)
-            if not fut.done():
-                fut.set_result(None)
-        except (protocol.ConnectionLost, ConnectionRefusedError, OSError):
-            # node died: drop its slots, retry via the future
-            lease_set.slots = [s for s in lease_set.slots if s.node_id != slot.node_id]
-            if not fut.done():
-                fut.set_exception(
-                    exc.WorkerCrashedError(f"node {slot.node_id[:8]} lost")
-                )
-            self._pump_leases(key, lease_set)
-            return
-        except protocol.RpcError as e:
-            if not fut.done():
-                fut.set_exception(exc.RayTpuError(str(e)))
+            while lease_set.pending and slot in lease_set.slots:
+                header, frames, fut = lease_set.pending.pop(0)
+                try:
+                    conn = await self.get_peer(slot.addr)
+                    h, rframes = await conn.call("push_task", header, frames)
+                    self._handle_task_reply(header, h, rframes)
+                    if not fut.done():
+                        fut.set_result(None)
+                except (protocol.ConnectionLost, ConnectionRefusedError,
+                        OSError):
+                    # node died: drop its slots, retry via the future
+                    lease_set.slots = [
+                        s for s in lease_set.slots
+                        if s.node_id != slot.node_id
+                    ]
+                    if not fut.done():
+                        fut.set_exception(
+                            exc.WorkerCrashedError(
+                                f"node {slot.node_id[:8]} lost"
+                            )
+                        )
+                    return
+                except protocol.RpcError as e:
+                    if not fut.done():
+                        fut.set_exception(exc.RayTpuError(str(e)))
         finally:
-            slot.busy = 0
+            slot.busy = max(slot.busy - 1, 0)
             lease_set.last_active = time.monotonic()
-            self._pump_leases(key, lease_set)
+            if lease_set.pending:
+                self._pump_leases(key, lease_set)
 
     async def _lease_reaper(self, key, lease_set: _LeaseSet):
         """Return idle leases to the head (reference: lease idle timeout in
@@ -1346,7 +1376,8 @@ class CoreWorker:
                 out_frames.extend(fr)
             else:
                 oid = ObjectID.for_return(tid, i).hex()
-                meta = self.shm.put_frames(oid, sobj.to_frames())
+                # written into shm before this call returns: zero-copy safe
+                meta = self.shm.put_frames(oid, sobj.to_frames(copy=False))
                 await self.gcs.call("object_register", {"oid": oid, "meta": meta})
                 rets.append({"kind": "shm", "meta": meta})
         return {"rets": rets}, out_frames

@@ -81,14 +81,24 @@ class Autoscaler:
         alive_ids = {
             n["node_id"] for n in load["nodes"] if n.get("alive")
         }
+        dead_ids = {
+            n["node_id"] for n in load["nodes"] if not n.get("alive")
+        }
         sim: List[Dict[str, float]] = [
             dict(n["available"]) for n in load["nodes"] if n.get("alive")
         ]
         provider_nodes = self.provider.non_terminated_nodes()
         by_type: Dict[str, int] = {}
         for n in provider_nodes:
+            node_id = n.get("node_id")
+            if node_id in dead_ids:
+                # registered then died: phantom — reclaim, never credit
+                self.provider.terminate_node(n["provider_node_id"])
+                continue
             by_type[n["node_type"]] = by_type.get(n["node_type"], 0) + 1
-            if n.get("node_id") not in alive_ids:
+            if node_id not in alive_ids:
+                # launched, not yet registered: credit full resources so the
+                # same demand doesn't trigger a duplicate launch
                 tcfg = self.config.node_types.get(n["node_type"])
                 if tcfg is not None:
                     sim.append(dict(tcfg.resources))

@@ -22,15 +22,28 @@ class _Registry:
         self._metrics: Dict[str, "Metric"] = {}
         self._lock = threading.Lock()
 
-    def register(self, metric: "Metric"):
+    def register(self, metric: "Metric") -> "Metric":
+        """Returns the canonical instance for this name: re-constructing a
+        metric (e.g. inside a task body run many times) must accumulate into
+        the existing series, not reset it."""
         with self._lock:
             existing = self._metrics.get(metric.name)
-            if existing is not None and type(existing) is not type(metric):
-                raise ValueError(
-                    f"metric {metric.name} already registered with a "
-                    f"different type"
-                )
+            if existing is not None:
+                if type(existing) is not type(metric):
+                    raise ValueError(
+                        f"metric {metric.name} already registered with a "
+                        f"different type"
+                    )
+                if getattr(existing, "boundaries", None) != getattr(
+                    metric, "boundaries", None
+                ):
+                    raise ValueError(
+                        f"histogram {metric.name} re-registered with "
+                        f"different boundaries"
+                    )
+                return existing
             self._metrics[metric.name] = metric
+            return metric
 
     def snapshot(self) -> List[dict]:
         with self._lock:
@@ -65,7 +78,11 @@ class Metric:
         self._default_tags: Dict[str, str] = {}
         self._lock = threading.Lock()
         self._values: Dict[Tuple, float] = {}
-        _registry.register(self)
+        canonical = _registry.register(self)
+        if canonical is not self:
+            # share storage with the already-registered series
+            self._values = canonical._values
+            self._lock = canonical._lock
 
     def set_default_tags(self, tags: Dict[str, str]):
         self._default_tags = dict(tags)

@@ -213,3 +213,20 @@ def test_tasks_survive_node_killer():
         assert killer.killed, "chaos killer never fired"
     finally:
         ray_tpu.shutdown()
+
+
+def test_metric_reregistration_accumulates():
+    """Re-constructing a metric with the same name must keep accumulating
+    into the same series (task bodies re-run on the same worker)."""
+    c1 = Counter("rt_reuse_total")
+    c1.inc(2)
+    c2 = Counter("rt_reuse_total")
+    c2.inc(3)
+    snap = {m["name"]: m for m in metrics_mod.registry().snapshot()}
+    assert snap["rt_reuse_total"]["samples"][0]["value"] == 5.0
+    with pytest.raises(ValueError):
+        Gauge("rt_reuse_total")  # type change is an error
+    h1 = Histogram("rt_reuse_hist", boundaries=(1.0,))
+    h1.observe(0.5)
+    with pytest.raises(ValueError):
+        Histogram("rt_reuse_hist", boundaries=(2.0,))
