@@ -27,7 +27,7 @@ from ray_tpu.serve.deployment import (
     deployment,
 )
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
-from ray_tpu.serve.replica import batch
+from ray_tpu.serve.replica import GangContext, batch, get_gang_context
 
 __all__ = [
     "Application",
@@ -35,7 +35,9 @@ __all__ = [
     "Deployment",
     "DeploymentHandle",
     "DeploymentResponse",
+    "GangContext",
     "batch",
+    "get_gang_context",
     "delete",
     "deployment",
     "get_app_handle",
@@ -102,6 +104,7 @@ def _collect_specs(app: Application, specs: Dict[str, dict],
         "autoscaling": asc,
         "version": cfg.version,
         "gang_size": cfg.gang_size,
+        "gang_strategy": cfg.gang_strategy,
     }
     order.append(d.name)
 

@@ -198,17 +198,25 @@ class ServeController:
                 await self._stop_replica(st.replicas.pop())
             if st.deleted and not st.replicas:
                 self._deployments.pop(st.name, None)
-        # health: drop dead replicas so the loop replaces them
+        # health: drop dead replicas so the loop replaces them. A gang
+        # replica is healthy only if EVERY member answers (scale-as-a-unit);
+        # a failed gang is torn down whole so its surviving members and the
+        # placement group's reservations don't leak.
         for st in self._deployments.values():
             alive = []
             for r in st.replicas:
+                members = r.get("members") or [r["actor"]]
                 try:
-                    ok = await asyncio.wait_for(
-                        self._call(r, "health_check"), timeout=5
-                    )
+                    await asyncio.gather(*(
+                        asyncio.wait_for(
+                            self._await_ref(m.health_check.remote()),
+                            timeout=5,
+                        )
+                        for m in members
+                    ))
                     alive.append(r)
                 except Exception:
-                    pass  # dead → not re-added; reconcile restarts
+                    await self._stop_replica(r)  # reconcile restarts it
             st.replicas = alive
 
     async def _start_replica(self, st: _DeploymentState) -> Optional[dict]:
@@ -220,6 +228,9 @@ class ServeController:
         st.counter += 1
         opts = dict(spec.get("actor_options") or {})
         opts.setdefault("max_concurrency", max(spec["max_ongoing"], 2))
+        gang = int(spec.get("gang_size") or 1)
+        if gang > 1:
+            return await self._start_gang_replica(st, rid, opts, gang)
         try:
             actor_cls = ray_tpu.remote(Replica)
             actor = actor_cls.options(**opts).remote(
@@ -241,13 +252,102 @@ class ServeController:
         except Exception:
             return None
 
+    async def _start_gang_replica(self, st, rid, opts, gang):
+        """One replica = a gang of actors co-reserved via a placement group
+        (reference: ``serve/gang.py:9 GangContext`` + gang autoscaling — a
+        multi-host model replica, e.g. one ICI slice, scales as a unit).
+        Rank 0 serves requests; every member gets a GangContext."""
+        import ray_tpu
+        from ray_tpu.serve.replica import Replica
+        from ray_tpu.util.placement_group import (
+            placement_group,
+            remove_placement_group,
+        )
+        from ray_tpu.util.scheduling_strategies import (
+            PlacementGroupSchedulingStrategy,
+        )
+
+        from ray_tpu.remote_function import _build_resources
+
+        spec = st.spec
+        # Bundles must reserve EXACTLY what the member actors will request
+        # (num_tpus/num_gpus included), or the in-pg lease can never fit.
+        bundle = _build_resources(opts)
+        pg = None
+        actors = []
+        loop = asyncio.get_running_loop()
+        try:
+            # PACK by default (works single-host); multi-host slice gangs
+            # pass gang_strategy="STRICT_SPREAD" to force one host per rank.
+            # Both pg calls block in run_sync — keep them off this shared
+            # async-actor loop.
+            pg = await loop.run_in_executor(
+                None,
+                lambda: placement_group(
+                    [dict(bundle) for _ in range(gang)],
+                    strategy=spec.get("gang_strategy") or "PACK",
+                ),
+            )
+            if not await loop.run_in_executor(None, pg.ready, 60.0):
+                raise RuntimeError(f"gang pg for {rid} not placeable")
+            actor_cls = ray_tpu.remote(Replica)
+            for rank in range(gang):
+                a_opts = dict(opts)
+                a_opts["scheduling_strategy"] = PlacementGroupSchedulingStrategy(
+                    placement_group=pg, placement_group_bundle_index=rank
+                )
+                actors.append(actor_cls.options(**a_opts).remote(
+                    spec["serialized_target"],
+                    spec.get("init_args", ()),
+                    spec.get("init_kwargs", {}),
+                    spec.get("user_config"),
+                    gang_ctx={
+                        "rank": rank, "world_size": gang,
+                        "replica_id": rid, "pg_id": pg.id,
+                    },
+                ))
+            await asyncio.gather(*(
+                asyncio.wait_for(
+                    self._await_ref(a.health_check.remote()), timeout=60
+                )
+                for a in actors
+            ))
+            return {"actor": actors[0], "id": rid, "members": actors,
+                    "pg": pg}
+        except BaseException:
+            for a in actors:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
+            if pg is not None:
+                try:
+                    await loop.run_in_executor(
+                        None, remove_placement_group, pg
+                    )
+                except Exception:
+                    pass
+            return None
+
     async def _stop_replica(self, r: dict):
         import ray_tpu
 
-        try:
-            ray_tpu.kill(r["actor"])
-        except Exception:
-            pass
+        for actor in r.get("members") or [r["actor"]]:
+            try:
+                ray_tpu.kill(actor)
+            except Exception:
+                pass
+        if r.get("pg") is not None:
+            try:
+                from ray_tpu.util.placement_group import (
+                    remove_placement_group,
+                )
+
+                await asyncio.get_running_loop().run_in_executor(
+                    None, remove_placement_group, r["pg"]
+                )
+            except Exception:
+                pass
 
     async def _call(self, r: dict, method: str, *args):
         ref = getattr(r["actor"], method).remote(*args)

@@ -32,6 +32,7 @@ class DeploymentConfig:
     health_check_period_s: float = 2.0
     version: Optional[str] = None
     gang_size: int = 1  # multi-host replica groups (reference: serve/gang.py)
+    gang_strategy: Optional[str] = None  # PACK (default) | STRICT_SPREAD
 
 
 class Deployment:
@@ -98,6 +99,7 @@ def deployment(cls_or_fn=None, *, name: Optional[str] = None,
                autoscaling_config: Optional[AutoscalingConfig] = None,
                version: Optional[str] = None,
                gang_size: int = 1,
+               gang_strategy: Optional[str] = None,
                health_check_period_s: float = 2.0):
     """``@serve.deployment`` decorator (reference: ``serve/api.py``)."""
 
@@ -114,6 +116,7 @@ def deployment(cls_or_fn=None, *, name: Optional[str] = None,
             autoscaling_config=asc,
             version=version,
             gang_size=gang_size,
+            gang_strategy=gang_strategy,
             health_check_period_s=health_check_period_s,
         )
         return Deployment(target, name or target.__name__, cfg)

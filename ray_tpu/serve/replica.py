@@ -13,20 +13,61 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 
+class GangContext:
+    """Rank/world view for one member of a gang replica (reference:
+    ``serve/gang.py:9 GangContext``)."""
+
+    def __init__(self, rank: int, world_size: int, replica_id: str,
+                 pg_id: str):
+        self.rank = rank
+        self.world_size = world_size
+        self.replica_id = replica_id
+        self.placement_group_id = pg_id
+
+
+# Gang members can share one host process (actors are threads there), so the
+# context must never be a bare module global: requests carry it in a
+# ContextVar set per handle_request, and constructions serialize under a
+# lock with a scoped slot.
+import contextvars as _contextvars
+import threading as _threading
+
+_gang_ctx_var: "_contextvars.ContextVar[Optional[GangContext]]" = (
+    _contextvars.ContextVar("rt_gang_ctx", default=None)
+)
+_ctor_lock = _threading.Lock()
+_ctor_ctx: Optional[GangContext] = None
+
+
+def get_gang_context() -> Optional[GangContext]:
+    """Inside a gang replica member: its GangContext (None otherwise)."""
+    ctx = _gang_ctx_var.get()
+    if ctx is not None:
+        return ctx
+    return _ctor_ctx
+
+
 class Replica:
     """Created via ray_tpu.remote with max_concurrency > 1 so requests
     overlap; ``_ongoing`` is the live load metric."""
 
     def __init__(self, serialized_target, init_args, init_kwargs,
-                 user_config=None):
+                 user_config=None, gang_ctx: Optional[dict] = None):
         import cloudpickle
 
+        self._gang_ctx = GangContext(**gang_ctx) if gang_ctx else None
         target = cloudpickle.loads(serialized_target)
         self._is_function = not inspect.isclass(target)
         if self._is_function:
             self._instance = target
         else:
-            self._instance = target(*init_args, **init_kwargs)
+            with _ctor_lock:
+                global _ctor_ctx
+                _ctor_ctx = self._gang_ctx
+                try:
+                    self._instance = target(*init_args, **init_kwargs)
+                finally:
+                    _ctor_ctx = None
         self._ongoing = 0
         self._total = 0
         if user_config is not None:
@@ -49,6 +90,8 @@ class Replica:
         return {"ongoing": self._ongoing, "total": self._total}
 
     async def handle_request(self, method: str, args, kwargs):
+        if self._gang_ctx is not None:
+            _gang_ctx_var.set(self._gang_ctx)
         self._ongoing += 1
         self._total += 1
         try:

@@ -1,0 +1,9 @@
+"""Tombstone: durable workflows were removed from the reference
+(``python/ray/workflow`` is a 4-line tombstone in Ray 2.55); kept here so the
+import path fails with the same guidance."""
+
+raise ImportError(
+    "ray_tpu.workflow has been removed (matching the reference's removal of "
+    "ray.workflow); compose tasks/actors or use the compiled graph API "
+    "(ray_tpu.dag) instead."
+)

@@ -170,3 +170,84 @@ def test_http_proxy(srv):
             f"http://127.0.0.1:{port}/nope", timeout=30
         )
     assert ei.value.code == 404
+
+
+def test_gang_scheduled_deployment(srv):
+    """gang_size>1: one replica = a placement-group gang of actors; rank 0
+    serves, every member gets a GangContext (reference: serve/gang.py)."""
+    import ray_tpu
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=1, gang_size=2,
+                      ray_actor_options={"num_cpus": 1})
+    class GangModel:
+        def __init__(self):
+            from ray_tpu.serve import get_gang_context
+
+            self.ctx = get_gang_context()
+
+        def __call__(self, x):
+            return {
+                "rank": self.ctx.rank,
+                "world_size": self.ctx.world_size,
+                "value": x * 2,
+            }
+
+    h = serve.run(GangModel.bind(), name="gang_app")
+    try:
+        out = h.remote(21).result(timeout=60)
+        assert out == {"rank": 0, "world_size": 2, "value": 42}
+        # both gang members exist as replica actors under one pg
+        from ray_tpu._private.worker import get_global_worker
+
+        w = get_global_worker()
+        pgs = w.run_sync(w.gcs.call("list_pgs", {}))[0]["pgs"]
+        created = [p for p in pgs if p["state"] == "CREATED"]
+        assert any(len(p["bundles"]) == 2 for p in created)
+    finally:
+        serve.shutdown()
+
+
+def test_gang_member_death_recycles_whole_gang(srv):
+    """Death of ANY gang member must tear down and replace the whole gang
+    (scale-as-a-unit; reference: gang autoscaling semantics)."""
+    import time
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=1, gang_size=2,
+                      ray_actor_options={"num_cpus": 1})
+    class G:
+        def __call__(self, x):
+            return x
+
+    h = serve.run(G.bind(), name="gang_ft")
+    try:
+        assert h.remote(1).result(timeout=60) == 1
+        from ray_tpu.serve.controller import CONTROLLER_NAME
+
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        st = ray_tpu.get(controller.status.remote(), timeout=30)
+        assert st["G"]["running"] == 1
+        handles = ray_tpu.get(controller.get_handles.remote("G"), timeout=30)
+        # kill the rank-1 member behind the controller's back: fetch the
+        # full member list via replica state
+        reps = ray_tpu.get(controller.get_replicas.remote("G"), timeout=30)
+        assert len(reps) == 1
+        # rank-0 handle is what get_handles returns; kill it to simulate
+        # member death (any member death must recycle the gang)
+        ray_tpu.kill(handles[0])
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            st = ray_tpu.get(controller.status.remote(), timeout=30)
+            if st.get("G", {}).get("running", 0) >= 1:
+                try:
+                    if h.remote(2).result(timeout=10) == 2:
+                        break
+                except Exception:
+                    pass
+            time.sleep(0.3)
+        assert h.remote(3).result(timeout=30) == 3
+    finally:
+        serve.shutdown()
