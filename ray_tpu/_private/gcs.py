@@ -183,6 +183,15 @@ class HeadService:
         existed = self.kv[h.get("ns", "")].pop(h["key"], None) is not None
         return {"deleted": existed}, []
 
+    async def rpc_kv_del_prefix(self, h, frames, conn):
+        ns = self.kv[h.get("ns", "")]
+        doomed = [k for k in ns if k.startswith(h.get("prefix", ""))]
+        for k in doomed:
+            ns.pop(k, None)
+        if not ns:
+            self.kv.pop(h.get("ns", ""), None)
+        return {"deleted": len(doomed)}, []
+
     async def rpc_kv_keys(self, h, frames, conn):
         prefix = h.get("prefix", "")
         keys = [k for k in self.kv[h.get("ns", "")] if k.startswith(prefix)]

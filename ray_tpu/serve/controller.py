@@ -314,7 +314,7 @@ class ServeController:
             ))
             return {"actor": actors[0], "id": rid, "members": actors,
                     "pg": pg}
-        except BaseException:
+        except BaseException as e:
             for a in actors:
                 try:
                     ray_tpu.kill(a)
@@ -327,6 +327,8 @@ class ServeController:
                     )
                 except Exception:
                     pass
+            if not isinstance(e, Exception):
+                raise  # CancelledError etc. must propagate after cleanup
             return None
 
     async def _stop_replica(self, r: dict):

@@ -26,25 +26,22 @@ class GangContext:
 
 
 # Gang members can share one host process (actors are threads there), so the
-# context must never be a bare module global: requests carry it in a
-# ContextVar set per handle_request, and constructions serialize under a
-# lock with a scoped slot.
+# context must never be a bare module global: it rides a ContextVar — set in
+# the constructing thread around target construction (contextvars are
+# per-thread for raw threads, and each actor has its own pool) and set again
+# per request in handle_request (copied into executor threads). No lock:
+# serializing constructions would deadlock PACK gangs whose constructors
+# rendezvous with each other.
 import contextvars as _contextvars
-import threading as _threading
 
 _gang_ctx_var: "_contextvars.ContextVar[Optional[GangContext]]" = (
     _contextvars.ContextVar("rt_gang_ctx", default=None)
 )
-_ctor_lock = _threading.Lock()
-_ctor_ctx: Optional[GangContext] = None
 
 
 def get_gang_context() -> Optional[GangContext]:
     """Inside a gang replica member: its GangContext (None otherwise)."""
-    ctx = _gang_ctx_var.get()
-    if ctx is not None:
-        return ctx
-    return _ctor_ctx
+    return _gang_ctx_var.get()
 
 
 class Replica:
@@ -61,13 +58,11 @@ class Replica:
         if self._is_function:
             self._instance = target
         else:
-            with _ctor_lock:
-                global _ctor_ctx
-                _ctor_ctx = self._gang_ctx
-                try:
-                    self._instance = target(*init_args, **init_kwargs)
-                finally:
-                    _ctor_ctx = None
+            token = _gang_ctx_var.set(self._gang_ctx)
+            try:
+                self._instance = target(*init_args, **init_kwargs)
+            finally:
+                _gang_ctx_var.reset(token)
         self._ongoing = 0
         self._total = 0
         if user_config is not None:
@@ -105,10 +100,14 @@ class Replica:
                 return await fn(*args, **kwargs)
             # Sync callables run on an executor thread: they may block (e.g.
             # a composition handle's .result()) and must not stall this
-            # replica's event loop.
+            # replica's event loop. copy_context carries the GangContext var
+            # into the thread (run_in_executor alone would not).
+            import contextvars
+
             loop = asyncio.get_running_loop()
+            call_ctx = contextvars.copy_context()
             out = await loop.run_in_executor(
-                None, lambda: fn(*args, **kwargs)
+                None, lambda: call_ctx.run(fn, *args, **kwargs)
             )
             if inspect.isawaitable(out):
                 out = await out

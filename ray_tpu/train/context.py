@@ -38,8 +38,10 @@ class TrainContext:
         latest_checkpoint: Optional[Checkpoint] = None,
         checkpoint_upload_rank: Optional[int] = 0,
         attempt: int = 0,
+        run_nonce: str = "",
     ):
         self._attempt = attempt
+        self._run_nonce = run_nonce
         self._world_rank = world_rank
         self._world_size = world_size
         self._local_rank = local_rank

@@ -1,0 +1,188 @@
+"""TorchTrainer: torch.distributed data-parallel training on the framework.
+
+Reference analog: ``python/ray/train/torch/`` — ``TorchConfig`` →
+``_TorchBackend`` (config.py:256: sets MASTER_ADDR/PORT, calls
+``dist.init_process_group``) and ``prepare_model`` / ``prepare_data_loader``
+(``train/v2/torch/train_loop_utils.py``: DDP wrap + DistributedSampler).
+
+On this framework torch is the CPU/host-side trainer family (gloo); the TPU
+path is ``JaxTrainer``. Rendezvous rides the train control-plane collectives
+(``broadcast_from_rank_zero``) instead of a backend-managed env handshake.
+"""
+from __future__ import annotations
+
+import socket
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.trainer import DataParallelTrainer
+
+
+@dataclass
+class TorchConfig:
+    backend: str = "gloo"
+    init_timeout_s: float = 120.0
+    env_vars: Dict[str, str] = field(default_factory=dict)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+# torch.distributed state is PER-PROCESS; two ranks co-hosted in one node
+# process can never form a group. Guarded explicitly because the failure mode
+# otherwise is a silent TCPStore hang. (Module-level helpers: the wrapped
+# train_fn is cloudpickled, and a lock captured in its globals would not
+# pickle — these resolve by module reference instead.)
+import threading as _threading
+
+_dist_lock = _threading.Lock()
+_dist_owner: Optional[int] = None
+
+
+def _acquire_dist_slot(rank: int):
+    global _dist_owner
+    with _dist_lock:
+        if _dist_owner is not None:
+            raise RuntimeError(
+                "two train workers share one host process — "
+                "torch.distributed can hold only one rank per process. "
+                "Spread workers across hosts: "
+                "ScalingConfig(placement_strategy='SPREAD') (the "
+                "process-per-host model gives one worker per TPU/CPU host "
+                "in real clusters)."
+            )
+        _dist_owner = rank
+
+
+def _release_dist_slot(rank: int):
+    global _dist_owner
+    with _dist_lock:
+        if _dist_owner == rank:
+            _dist_owner = None
+
+
+def _torch_wrapped(user_fn: Callable, torch_config: TorchConfig) -> Callable:
+    def wrapped(config):
+        import os
+
+        import torch.distributed as dist
+
+        from ray_tpu.train.collective import broadcast_from_rank_zero
+        from ray_tpu.train.context import get_context
+
+        ctx = get_context()
+        world = ctx.get_world_size()
+        inited = False
+        if world > 1:
+            # slot held from here; released in the finally below even when
+            # rendezvous/init fails (a leak would poison this long-lived
+            # host process for every later Torch run)
+            from ray_tpu.train.torch import _acquire_dist_slot
+
+            _acquire_dist_slot(ctx.get_world_rank())
+        try:
+            if world > 1:
+                if ctx.get_world_rank() == 0:
+                    # the address this worker's RPC server bound — routable
+                    # by the cluster (loopback in local test clusters)
+                    from ray_tpu._private.worker import get_global_worker
+
+                    host = get_global_worker().addr[0]
+                    master = (host, _free_port())
+                else:
+                    master = None
+                master = broadcast_from_rank_zero(master, name="torch_master")
+                os.environ.setdefault("MASTER_ADDR", master[0])
+                os.environ.setdefault("MASTER_PORT", str(master[1]))
+                if master[0].startswith("127."):
+                    # single-machine rendezvous: gloo's interface
+                    # autodetection hangs in hostname-less containers
+                    os.environ.setdefault("GLOO_SOCKET_IFNAME", "lo")
+                for k, v in torch_config.env_vars.items():
+                    os.environ[k] = v
+                dist.init_process_group(
+                    backend=torch_config.backend,
+                    init_method=f"tcp://{master[0]}:{master[1]}",
+                    rank=ctx.get_world_rank(),
+                    world_size=world,
+                )
+                inited = True
+            takes_arg = True
+            try:
+                import inspect
+
+                takes_arg = len(
+                    inspect.signature(user_fn).parameters
+                ) > 0
+            except (TypeError, ValueError):
+                pass
+            return user_fn(config) if takes_arg else user_fn()
+        finally:
+            if inited:
+                dist.destroy_process_group()
+            if world > 1:
+                from ray_tpu.train.torch import _release_dist_slot
+
+                _release_dist_slot(ctx.get_world_rank())
+
+    return wrapped
+
+
+class TorchTrainer(DataParallelTrainer):
+    """DDP trainer (reference: ``ray.train.torch.TorchTrainer``)."""
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        torch_config: Optional[TorchConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+    ):
+        super().__init__(
+            _torch_wrapped(train_loop_per_worker,
+                           torch_config or TorchConfig()),
+            train_loop_config=train_loop_config,
+            scaling_config=scaling_config,
+            run_config=run_config,
+            datasets=datasets,
+        )
+
+
+def prepare_model(model):
+    """Wrap in DDP when distributed (reference:
+    ``train_loop_utils.py prepare_model``); pass-through single-worker."""
+    import torch.distributed as dist
+
+    if dist.is_available() and dist.is_initialized():
+        from torch.nn.parallel import DistributedDataParallel
+
+        return DistributedDataParallel(model)
+    return model
+
+
+def prepare_data_loader(data_loader):
+    """Re-build with a DistributedSampler when distributed (reference:
+    ``prepare_data_loader``)."""
+    import torch.distributed as dist
+
+    if not (dist.is_available() and dist.is_initialized()):
+        return data_loader
+    from torch.utils.data import DataLoader
+    from torch.utils.data.distributed import DistributedSampler
+
+    sampler = DistributedSampler(data_loader.dataset)
+    return DataLoader(
+        data_loader.dataset,
+        batch_size=data_loader.batch_size,
+        sampler=sampler,
+        num_workers=0,
+        collate_fn=data_loader.collate_fn,
+        drop_last=data_loader.drop_last,
+    )

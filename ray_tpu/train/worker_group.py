@@ -44,6 +44,7 @@ class TrainWorker:
         env_vars: Dict[str, str],
         jax_distributed: Optional[dict] = None,
         attempt: int = 0,
+        run_nonce: str = "",
     ) -> dict:
         for k, v in env_vars.items():
             os.environ[k] = v
@@ -68,6 +69,7 @@ class TrainWorker:
             run_dir=run_dir,
             latest_checkpoint=ckpt,
             attempt=attempt,
+            run_nonce=run_nonce,
         )
         import socket
 
@@ -214,6 +216,12 @@ class WorkerGroup:
                 "num_processes": world_size,
             }
 
+        # Per-start nonce: scopes control-plane collectives so a re-run (or
+        # elastic restart) can never read a previous group's rendezvous keys.
+        import uuid as _uuid
+
+        run_nonce = _uuid.uuid4().hex[:12]
+        self._last_nonce = run_nonce
         # Deterministic ranks: worker i = rank i. Node-locality metadata from
         # setup() feeds local_rank; round-1 treats each worker as its own node
         # slot (process-per-host model).
@@ -230,6 +238,7 @@ class WorkerGroup:
                 env_vars,
                 jax_dist,
                 attempt,
+                run_nonce,
             )
             for i, w in enumerate(self.workers)
         ]
@@ -273,3 +282,19 @@ class WorkerGroup:
                 pass
         self.workers = []
         self.world_size = 0
+        # reclaim this group's control-plane rendezvous keys
+        nonce = getattr(self, "_last_nonce", None)
+        if nonce:
+            try:
+                from ray_tpu._private.worker import get_global_worker
+
+                w = get_global_worker()
+                w.run_sync(w.gcs.call("kv_del_prefix", {
+                    "ns": (
+                        f"__train_collective:{self._experiment_name}:"
+                        f"{nonce}:"
+                    ),
+                    "prefix": "",
+                }))
+            except Exception:
+                pass
