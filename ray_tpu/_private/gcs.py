@@ -160,6 +160,74 @@ class HeadService:
         if self.server:
             await self.server.close()
 
+    # -------------------------------------------------------- persistence
+    # Reference analog: GCS fault tolerance via Redis-backed store +
+    # GcsInitData replay (``gcs/store_client/redis_store_client.cc``,
+    # ``gcs_init_data.cc``): durable metadata survives a head restart.
+    # Round-1 scope: the durable tables are the KV (function table, train
+    # rendezvous, user data) and job records; live process state (nodes,
+    # actors) re-registers on reconnect.
+
+    def snapshot(self) -> bytes:
+        import pickle
+
+        jobs = {
+            jid: {k: v for k, v in info.items()}
+            for jid, info in self.jobs.items()
+        }
+        return pickle.dumps({
+            "version": 1,
+            "kv": {ns: dict(kvs) for ns, kvs in self.kv.items()},
+            "jobs": jobs,
+        })
+
+    def restore(self, blob: bytes):
+        import pickle
+
+        state = pickle.loads(blob)
+        for ns, kvs in state.get("kv", {}).items():
+            self.kv[ns].update(kvs)
+        for jid, info in state.get("jobs", {}).items():
+            info = dict(info)
+            # processes did not survive the head: running jobs are FAILED
+            if info.get("status") in ("RUNNING", "STOPPING", "PENDING"):
+                info["status"] = "FAILED"
+                info.setdefault("end_time", time.time())
+            self.jobs.setdefault(jid, info)
+
+    def save_to_file(self, path: str):
+        import os
+        import tempfile
+
+        blob = self.snapshot()
+        d = os.path.dirname(os.path.abspath(path))
+        fd, tmp = tempfile.mkstemp(dir=d, prefix=".head_state_")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())  # replace() must publish complete bytes
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+
+    def load_from_file(self, path: str) -> bool:
+        try:
+            with open(path, "rb") as f:
+                self.restore(f.read())
+            return True
+        except FileNotFoundError:
+            return False
+        except Exception:
+            # A corrupt/truncated snapshot must not crash-loop the head —
+            # starting empty beats never starting.
+            logger.exception("head state %s unreadable; starting fresh", path)
+            return False
+
     # ------------------------------------------------------------- dispatcher
 
     async def _handle(self, method, header, frames, conn):
@@ -281,9 +349,12 @@ class HeadService:
             out.append(n)
         return out
 
-    def _pick_node(self, need: Dict[str, float], strategy: dict) -> Optional[NodeInfo]:
+    def _pick_node(self, need: Dict[str, float], strategy: dict,
+                   avoid=None) -> Optional[NodeInfo]:
         """Hybrid policy (reference: ``scheduling/policy/hybrid_scheduling_policy.cc``):
-        pack onto earliest nodes with room, spread when strategy requests it."""
+        pack onto earliest nodes with room, spread when strategy requests it.
+        ``avoid``: soft blocklist (e.g. memory-pressured nodes) — used only
+        when an alternative fits."""
         pg_id = strategy.get("pg_id")
         if pg_id:
             return self._pick_pg_node(need, pg_id, strategy.get("bundle_index", -1))
@@ -291,6 +362,10 @@ class HeadService:
             need, strategy.get("labels"), strategy.get("node_id")
         )
         fitting = [n for n in cands if _fits(n.available, need)]
+        if avoid:
+            preferred = [n for n in fitting if n.node_id not in avoid]
+            if preferred:
+                fitting = preferred
         if not fitting:
             return None
         if strategy.get("spread"):
@@ -327,10 +402,11 @@ class HeadService:
         strategy = h.get("strategy", {})
         count = h.get("count", 1)
         timeout = h.get("timeout", 30.0)
+        avoid = set(h.get("avoid") or ())
         grants = []
         deadline = time.monotonic() + timeout
         while len(grants) < count:
-            node = self._pick_node(need, strategy)
+            node = self._pick_node(need, strategy, avoid)
             if node is not None:
                 if not strategy.get("pg_id"):
                     _acquire(node.available, need)

@@ -41,6 +41,10 @@ def main(argv=None):
     parser.add_argument("--resources", default="{}")
     parser.add_argument("--dashboard-port", type=int, default=-1,
                         help="-1 disables the dashboard; 0 picks a port")
+    parser.add_argument("--state-file", default=None,
+                        help="persist durable head state (KV, jobs) here; "
+                             "restored on restart (GCS fault tolerance)")
+    parser.add_argument("--state-save-interval", type=float, default=5.0)
     parser.add_argument("--log-level", default="WARNING")
     args = parser.parse_args(argv)
 
@@ -55,7 +59,20 @@ def main(argv=None):
     loop = asyncio.new_event_loop()
     asyncio.set_event_loop(loop)
     head = HeadService()
+    if args.state_file:
+        head.load_from_file(args.state_file)
     addr = loop.run_until_complete(head.start(args.host, args.port))
+
+    if args.state_file:
+        async def _persist_loop():
+            while True:
+                await asyncio.sleep(args.state_save_interval)
+                try:
+                    head.save_to_file(args.state_file)
+                except OSError:
+                    pass
+
+        loop.create_task(_persist_loop())
 
     dash_port = None
     dashboard = None
@@ -94,6 +111,11 @@ def main(argv=None):
         exit_code = 1
         raise
     finally:
+        if args.state_file:
+            try:
+                head.save_to_file(args.state_file)
+            except OSError:
+                pass
         if node is not None:
             node.terminate()
         for coro in ([dashboard.stop()] if dashboard else []) + [head.close()]:

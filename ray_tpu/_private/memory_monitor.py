@@ -1,0 +1,87 @@
+"""Memory pressure monitoring + OOM task rejection.
+
+Reference analog: ``src/ray/common/threshold_memory_monitor.cc`` /
+``pressure_memory_monitor.cc`` feeding the raylet's worker-killing policies
+(``raylet/worker_killing_policy_*.h``): when a node crosses its memory
+threshold, retriable tasks are killed/rejected so the node survives and the
+owner retries elsewhere. Here the check runs at task admission in the worker
+(process-per-host: the worker process IS the node).
+
+cgroup v2 limits are honored when present (containers), else /proc/meminfo.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional, Tuple
+
+DEFAULT_THRESHOLD = 0.95
+_CACHE_S = 0.5
+
+
+def _read_int(path: str) -> Optional[int]:
+    try:
+        with open(path) as f:
+            v = f.read().strip()
+        return None if v == "max" else int(v)
+    except (OSError, ValueError):
+        return None
+
+
+def get_memory_usage() -> Tuple[int, int]:
+    """(used_bytes, total_bytes) for this node's memory budget."""
+    # cgroup v2 (container limit) first
+    cur = _read_int("/sys/fs/cgroup/memory.current")
+    lim = _read_int("/sys/fs/cgroup/memory.max")
+    if cur is not None and lim is not None:
+        return cur, lim
+    # cgroup v1
+    cur = _read_int("/sys/fs/cgroup/memory/memory.usage_in_bytes")
+    lim = _read_int("/sys/fs/cgroup/memory/memory.limit_in_bytes")
+    if cur is not None and lim is not None and lim < (1 << 60):
+        return cur, lim
+    # host meminfo
+    total = avail = None
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    total = int(line.split()[1]) * 1024
+                elif line.startswith("MemAvailable:"):
+                    avail = int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    if total is None:
+        return 0, 1  # unknown: never report pressure
+    used = total - (avail if avail is not None else total)
+    return used, total
+
+
+class MemoryMonitor:
+    """Threshold monitor with a short result cache (admission is hot)."""
+
+    def __init__(self, threshold: Optional[float] = None):
+        if threshold is None:
+            threshold = float(
+                os.environ.get("RT_MEMORY_THRESHOLD", DEFAULT_THRESHOLD)
+            )
+        self.threshold = threshold
+        self._last_check = 0.0
+        self._last_result = False
+
+    def is_pressing(self) -> bool:
+        now = time.monotonic()
+        if now - self._last_check < _CACHE_S:
+            return self._last_result
+        self._last_check = now
+        used, total = get_memory_usage()
+        self._last_result = total > 0 and used / total > self.threshold
+        return self._last_result
+
+    def usage_string(self) -> str:
+        used, total = get_memory_usage()
+        return (
+            f"{used / (1 << 30):.2f}/{total / (1 << 30):.2f} GiB "
+            f"({used / max(total, 1):.0%}, threshold "
+            f"{self.threshold:.0%})"
+        )

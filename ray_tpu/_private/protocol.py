@@ -49,7 +49,13 @@ async def read_message(reader: asyncio.StreamReader) -> Tuple[dict, List[bytes]]
 
 
 class RpcError(Exception):
-    pass
+    """Remote handler failure. ``code`` is an optional machine-readable
+    class (e.g. "oom") carried on the wire — callers branch on it, never on
+    message substrings."""
+
+    def __init__(self, message: str = "", code=None):
+        super().__init__(message)
+        self.code = code
 
 
 class ConnectionLost(RpcError):
@@ -95,7 +101,9 @@ class Connection:
                     fut = self._pending.pop(header["i"], None)
                     if fut is not None and not fut.done():
                         if header.get("e") is not None:
-                            fut.set_exception(RpcError(header["e"]))
+                            fut.set_exception(
+                                RpcError(header["e"], code=header.get("ec"))
+                            )
                         else:
                             fut.set_result((header, frames))
                 else:
@@ -138,6 +146,9 @@ class Connection:
         except Exception as e:
             logger.debug("handler error for %s: %s", header.get("m"), e, exc_info=True)
             reply_header["e"] = f"{type(e).__name__}: {e}"
+            code = getattr(e, "code", None)
+            if code is not None:
+                reply_header["ec"] = code
             reply_frames = []
         if header.get("oneway"):
             return

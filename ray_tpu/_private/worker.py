@@ -101,6 +101,7 @@ class _LeaseSlot:
     node_id: str
     addr: Tuple[str, int]
     busy: int = 0
+    draining: bool = False  # evicted (e.g. OOM); release once in-flight done
 
 
 class _LeaseSet:
@@ -112,6 +113,8 @@ class _LeaseSet:
         self.slots: List[_LeaseSlot] = []
         self.pending: List[Tuple[dict, List[bytes], asyncio.Future]] = []
         self.requesting = False
+        # node_id -> monotonic deadline: avoid leasing there (OOM backoff)
+        self.avoid: Dict[str, float] = {}
         self.last_active = time.monotonic()
         self.reaper_running = False
 
@@ -200,6 +203,9 @@ class CoreWorker:
         self._shutdown = False
         self._stats = {"tasks_executed": 0, "tasks_submitted": 0}
         self._task_events_buf: List[dict] = []
+        from ray_tpu._private.memory_monitor import MemoryMonitor
+
+        self._memory_monitor = MemoryMonitor()
         self.runtime_env: dict = {}
         self.pubsub_handlers: Dict[str, List[Any]] = {}
 
@@ -750,6 +756,10 @@ class CoreWorker:
                 err = e
                 if attempt >= retries:
                     break
+                if isinstance(e, exc.OutOfMemoryError):
+                    # give memory pressure a chance to clear before burning
+                    # the retry budget (admission caches pressure ~0.5s)
+                    await asyncio.sleep(min(0.5 * 2 ** attempt, 5.0))
                 fut = asyncio.get_running_loop().create_future()
                 lease_set.pending.append((header, frames, fut))
                 self._pump_leases(key, lease_set)
@@ -783,7 +793,10 @@ class CoreWorker:
         # (deadlock for producer/consumer task patterns).
         spawn_budget = len(lease_set.pending)
         while spawn_budget > 0 and lease_set.slots:
-            slot = min(lease_set.slots, key=lambda s: s.busy)
+            usable = [s for s in lease_set.slots if not s.draining]
+            if not usable:
+                break
+            slot = min(usable, key=lambda s: s.busy)
             if slot.busy >= self._PUSH_PIPELINE:
                 break
             slot.busy += 1
@@ -801,6 +814,10 @@ class CoreWorker:
 
     async def _request_leases(self, key, lease_set: _LeaseSet, count):
         try:
+            now = time.monotonic()
+            lease_set.avoid = {
+                n: t for n, t in lease_set.avoid.items() if t > now
+            }
             h, _ = await self.gcs.call(
                 "lease",
                 {
@@ -808,6 +825,7 @@ class CoreWorker:
                     "strategy": lease_set.strategy,
                     "count": count,
                     "timeout": 30.0,
+                    "avoid": list(lease_set.avoid),
                 },
             )
             for g in h.get("grants", []):
@@ -856,9 +874,35 @@ class CoreWorker:
                     return
                 except protocol.RpcError as e:
                     if not fut.done():
+                        if getattr(e, "code", None) == "oom":
+                            # Memory-pressure rejection: retriable, and this
+                            # node's slots are RETURNED to the head (the node
+                            # is alive — dropping them silently would leak
+                            # its resource accounting). Idle slots release
+                            # now; in-flight ones drain first (releasing a
+                            # busy slot would double-book the node).
+                            lease_set.avoid[slot.node_id] = (
+                                time.monotonic() + 10.0
+                            )
+                            keep = []
+                            for s in lease_set.slots:
+                                if s.node_id != slot.node_id:
+                                    keep.append(s)
+                                elif s.busy > 0:
+                                    s.draining = True
+                                    keep.append(s)
+                                else:
+                                    self._release_slot(lease_set, s)
+                            lease_set.slots = keep
+                            fut.set_exception(exc.OutOfMemoryError(str(e)))
+                            return
                         fut.set_exception(exc.RayTpuError(str(e)))
         finally:
             slot.busy = max(slot.busy - 1, 0)
+            if slot.draining and slot.busy == 0:
+                if slot in lease_set.slots:
+                    lease_set.slots.remove(slot)
+                    self._release_slot(lease_set, slot)
             lease_set.last_active = time.monotonic()
             if lease_set.pending:
                 self._pump_leases(key, lease_set)
@@ -880,20 +924,23 @@ class CoreWorker:
                     continue
                 slots, lease_set.slots = lease_set.slots, []
                 for s in slots:
-                    try:
-                        self.gcs.notify(
-                            "release_lease",
-                            {
-                                "node_id": s.node_id,
-                                "resources": lease_set.resources,
-                                "strategy": lease_set.strategy,
-                            },
-                        )
-                    except protocol.ConnectionLost:
-                        pass
+                    self._release_slot(lease_set, s)
                 return
         finally:
             lease_set.reaper_running = False
+
+    def _release_slot(self, lease_set: _LeaseSet, slot: _LeaseSlot):
+        try:
+            self.gcs.notify(
+                "release_lease",
+                {
+                    "node_id": slot.node_id,
+                    "resources": lease_set.resources,
+                    "strategy": lease_set.strategy,
+                },
+            )
+        except protocol.ConnectionLost:
+            pass
 
     def _handle_task_reply(self, header, h, rframes):
         """Process a push_task reply: inline values, shm descriptors, errors."""
@@ -1302,6 +1349,14 @@ class CoreWorker:
     async def rpc_push_task(self, h, frames, conn):
         """Execute a normal task (reference: ``CoreWorker::HandlePushTask``
         ``core_worker.cc:3341`` → ExecuteTask)."""
+        if self._memory_monitor.is_pressing():
+            # Reject at admission so this node survives; the owner retries
+            # (reference: worker-killing policies under the memory monitor).
+            raise protocol.RpcError(
+                f"node {self.node_id[:8]} over memory threshold "
+                f"({self._memory_monitor.usage_string()})",
+                code="oom",
+            )
         fn = await self._load_function(h["fkey"])
         args, kwargs = await self._materialize_args(h, frames)
         loop = asyncio.get_running_loop()

@@ -59,6 +59,12 @@ class NodeDiedError(RayTpuError):
     """A node was marked dead by the head's health checker."""
 
 
+class OutOfMemoryError(WorkerCrashedError):
+    """A node rejected/killed the task under memory pressure (reference:
+    memory-monitor-driven worker killing; subclasses WorkerCrashedError so
+    the submitter's retry path treats it as retriable)."""
+
+
 class RuntimeEnvSetupError(RayTpuError):
     """Failed to materialize the runtime environment for a task/actor."""
 

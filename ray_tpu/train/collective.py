@@ -27,13 +27,18 @@ def _seq(ctx, name: str) -> int:
     return n
 
 
+def namespace(experiment_name: str, run_nonce: str) -> str:
+    """KV namespace for one worker-group start (shutdown reclaims it)."""
+    return f"__train_collective:{experiment_name}:{run_nonce}:"
+
+
 def _ns(ctx) -> str:
     # run_nonce is fresh per worker-group start: re-runs and elastic
     # restarts can never observe a previous group's rendezvous keys. The
     # attempt lives in the key prefix (one namespace per group start, so
     # shutdown can reclaim it wholesale).
     nonce = getattr(ctx, "_run_nonce", "")
-    return f"__train_collective:{ctx.get_experiment_name()}:{nonce}:"
+    return namespace(ctx.get_experiment_name(), nonce)
 
 
 def _key(ctx, rest: str) -> str:

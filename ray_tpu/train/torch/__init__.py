@@ -169,20 +169,26 @@ def prepare_model(model):
 
 def prepare_data_loader(data_loader):
     """Re-build with a DistributedSampler when distributed (reference:
-    ``prepare_data_loader``)."""
+    ``prepare_data_loader``). Shuffling follows the original loader's
+    sampler (a RandomSampler means the user asked for shuffle=True), and
+    loader construction kwargs carry over."""
     import torch.distributed as dist
 
     if not (dist.is_available() and dist.is_initialized()):
         return data_loader
-    from torch.utils.data import DataLoader
+    from torch.utils.data import DataLoader, RandomSampler
     from torch.utils.data.distributed import DistributedSampler
 
-    sampler = DistributedSampler(data_loader.dataset)
+    shuffle = isinstance(data_loader.sampler, RandomSampler)
+    sampler = DistributedSampler(data_loader.dataset, shuffle=shuffle)
     return DataLoader(
         data_loader.dataset,
         batch_size=data_loader.batch_size,
         sampler=sampler,
-        num_workers=0,
+        num_workers=data_loader.num_workers,
+        pin_memory=data_loader.pin_memory,
+        worker_init_fn=data_loader.worker_init_fn,
         collate_fn=data_loader.collate_fn,
         drop_last=data_loader.drop_last,
+        timeout=data_loader.timeout,
     )

@@ -288,12 +288,11 @@ class WorkerGroup:
             try:
                 from ray_tpu._private.worker import get_global_worker
 
+                from ray_tpu.train.collective import namespace
+
                 w = get_global_worker()
                 w.run_sync(w.gcs.call("kv_del_prefix", {
-                    "ns": (
-                        f"__train_collective:{self._experiment_name}:"
-                        f"{nonce}:"
-                    ),
+                    "ns": namespace(self._experiment_name, nonce),
                     "prefix": "",
                 }))
             except Exception:

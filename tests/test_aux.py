@@ -230,3 +230,51 @@ def test_metric_reregistration_accumulates():
     h1.observe(0.5)
     with pytest.raises(ValueError):
         Histogram("rt_reuse_hist", boundaries=(2.0,))
+
+
+# ------------------------------------------------------------ memory/OOM
+
+
+def test_memory_monitor_reads_usage():
+    from ray_tpu._private.memory_monitor import MemoryMonitor, get_memory_usage
+
+    used, total = get_memory_usage()
+    assert total > 0 and 0 <= used <= total
+    assert not MemoryMonitor(threshold=1.0).is_pressing()
+    assert MemoryMonitor(threshold=0.0).is_pressing()
+
+
+def test_oom_rejection_is_retriable_and_surfaces():
+    """A node over its memory threshold rejects tasks; the submitter
+    retries and finally surfaces OutOfMemoryError (reference: memory
+    monitor + worker-killing policy + task retries)."""
+    ray_tpu.init(num_cpus=2, _node_env={"RT_MEMORY_THRESHOLD": "0.0"})
+    try:
+        @ray_tpu.remote(max_retries=1)
+        def f():
+            return 1
+
+        with pytest.raises(ray_tpu.exceptions.OutOfMemoryError):
+            ray_tpu.get(f.remote(), timeout=60)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_oom_retry_lands_on_healthy_node():
+    """With one pressured node and one healthy node, retries land the task
+    (slot eviction + fresh lease)."""
+    ray_tpu.init(num_cpus=2)
+    try:
+        cluster = ray_tpu._internal_cluster()
+        cluster.add_node({"CPU": 2}, env={"RT_MEMORY_THRESHOLD": "0.0"})
+        cluster.wait_for_nodes(2)
+
+        @ray_tpu.remote(max_retries=8)
+        def f(i):
+            return i + 1
+
+        assert ray_tpu.get([f.remote(i) for i in range(20)], timeout=120) == [
+            i + 1 for i in range(20)
+        ]
+    finally:
+        ray_tpu.shutdown()
