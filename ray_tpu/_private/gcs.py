@@ -189,9 +189,13 @@ class HeadService:
             self.kv[ns].update(kvs)
         for jid, info in state.get("jobs", {}).items():
             info = dict(info)
-            # processes did not survive the head: running jobs are FAILED
+            # processes did not survive the head: running work is terminal.
+            # Submission jobs track "status"; driver-registered jobs "state".
             if info.get("status") in ("RUNNING", "STOPPING", "PENDING"):
                 info["status"] = "FAILED"
+                info.setdefault("end_time", time.time())
+            if info.get("state") == "RUNNING":
+                info["state"] = "DEAD"
                 info.setdefault("end_time", time.time())
             self.jobs.setdefault(jid, info)
 

@@ -68,9 +68,15 @@ def main(argv=None):
             while True:
                 await asyncio.sleep(args.state_save_interval)
                 try:
-                    head.save_to_file(args.state_file)
-                except OSError:
-                    pass
+                    # snapshot+fsync off the RPC loop: a big KV must not
+                    # stall lease grants for the write's duration
+                    await loop.run_in_executor(
+                        None, head.save_to_file, args.state_file
+                    )
+                except Exception:
+                    logging.getLogger(__name__).exception(
+                        "head state persistence failed; will retry"
+                    )
 
         loop.create_task(_persist_loop())
 

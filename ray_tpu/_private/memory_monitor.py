@@ -28,18 +28,33 @@ def _read_int(path: str) -> Optional[int]:
         return None
 
 
+def _cgroup_reclaimable(stat_path: str) -> int:
+    """inactive_file from memory.stat: page cache the kernel can drop —
+    counting it as used would flag I/O-heavy nodes as OOM."""
+    try:
+        with open(stat_path) as f:
+            for line in f:
+                if line.startswith("inactive_file "):
+                    return int(line.split()[1])
+    except (OSError, ValueError):
+        pass
+    return 0
+
+
 def get_memory_usage() -> Tuple[int, int]:
     """(used_bytes, total_bytes) for this node's memory budget."""
     # cgroup v2 (container limit) first
     cur = _read_int("/sys/fs/cgroup/memory.current")
     lim = _read_int("/sys/fs/cgroup/memory.max")
     if cur is not None and lim is not None:
-        return cur, lim
+        cur -= _cgroup_reclaimable("/sys/fs/cgroup/memory.stat")
+        return max(cur, 0), lim
     # cgroup v1
     cur = _read_int("/sys/fs/cgroup/memory/memory.usage_in_bytes")
     lim = _read_int("/sys/fs/cgroup/memory/memory.limit_in_bytes")
     if cur is not None and lim is not None and lim < (1 << 60):
-        return cur, lim
+        cur -= _cgroup_reclaimable("/sys/fs/cgroup/memory/memory.stat")
+        return max(cur, 0), lim
     # host meminfo
     total = avail = None
     try:

@@ -850,7 +850,8 @@ class CoreWorker:
         """Drains pending tasks onto one leased slot until the queue (or the
         slot) is gone; many tasks amortize one coroutine."""
         try:
-            while lease_set.pending and slot in lease_set.slots:
+            while (lease_set.pending and slot in lease_set.slots
+                   and not slot.draining):
                 header, frames, fut = lease_set.pending.pop(0)
                 try:
                     conn = await self.get_peer(slot.addr)

@@ -181,8 +181,7 @@ def prepare_data_loader(data_loader):
 
     shuffle = isinstance(data_loader.sampler, RandomSampler)
     sampler = DistributedSampler(data_loader.dataset, shuffle=shuffle)
-    return DataLoader(
-        data_loader.dataset,
+    kwargs = dict(
         batch_size=data_loader.batch_size,
         sampler=sampler,
         num_workers=data_loader.num_workers,
@@ -191,4 +190,9 @@ def prepare_data_loader(data_loader):
         collate_fn=data_loader.collate_fn,
         drop_last=data_loader.drop_last,
         timeout=data_loader.timeout,
+        generator=data_loader.generator,
     )
+    if data_loader.num_workers > 0:
+        kwargs["persistent_workers"] = data_loader.persistent_workers
+        kwargs["prefetch_factor"] = data_loader.prefetch_factor
+    return DataLoader(data_loader.dataset, **kwargs)
