@@ -199,11 +199,13 @@ class HeadService:
                 info.setdefault("end_time", time.time())
             self.jobs.setdefault(jid, info)
 
-    def save_to_file(self, path: str):
+    @staticmethod
+    def write_snapshot(path: str, blob: bytes):
+        """Atomic fsync'd write; safe to run off the event loop (the blob
+        was produced on-loop, so no handler races the tables)."""
         import os
         import tempfile
 
-        blob = self.snapshot()
         d = os.path.dirname(os.path.abspath(path))
         fd, tmp = tempfile.mkstemp(dir=d, prefix=".head_state_")
         try:
@@ -218,6 +220,9 @@ class HeadService:
             except OSError:
                 pass
             raise
+
+    def save_to_file(self, path: str):
+        self.write_snapshot(path, self.snapshot())
 
     def load_from_file(self, path: str) -> bool:
         try:

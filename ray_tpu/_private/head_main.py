@@ -68,10 +68,11 @@ def main(argv=None):
             while True:
                 await asyncio.sleep(args.state_save_interval)
                 try:
-                    # snapshot+fsync off the RPC loop: a big KV must not
-                    # stall lease grants for the write's duration
+                    # Snapshot ON the loop (handlers mutate the tables
+                    # between awaits only), write+fsync OFF it.
+                    blob = head.snapshot()
                     await loop.run_in_executor(
-                        None, head.save_to_file, args.state_file
+                        None, head.write_snapshot, args.state_file, blob
                     )
                 except Exception:
                     logging.getLogger(__name__).exception(

@@ -29,16 +29,22 @@ def _read_int(path: str) -> Optional[int]:
 
 
 def _cgroup_reclaimable(stat_path: str) -> int:
-    """inactive_file from memory.stat: page cache the kernel can drop —
-    counting it as used would flag I/O-heavy nodes as OOM."""
+    """Reclaimable page cache from memory.stat — counting it as used would
+    flag I/O-heavy nodes as OOM. v1 usage is hierarchical, so prefer
+    total_inactive_file (sums child cgroups) over the local counter."""
+    local = total = None
     try:
         with open(stat_path) as f:
             for line in f:
-                if line.startswith("inactive_file "):
-                    return int(line.split()[1])
+                if line.startswith("total_inactive_file "):
+                    total = int(line.split()[1])
+                elif line.startswith("inactive_file "):
+                    local = int(line.split()[1])
     except (OSError, ValueError):
         pass
-    return 0
+    if total is not None:
+        return total
+    return local or 0
 
 
 def get_memory_usage() -> Tuple[int, int]:

@@ -7,10 +7,15 @@ from ray_tpu.llm.batch import Processor, build_llm_processor
 from ray_tpu.llm.config import ByteTokenizer, LLMConfig, load_tokenizer
 from ray_tpu.llm.engine import DecodeEngine, SamplingParams
 from ray_tpu.llm.serving import LLMServer, build_openai_app, serve_llm
+from ray_tpu.llm.serving_patterns import (
+    build_dp_openai_app,
+    build_pd_openai_app,
+)
 
 __all__ = [
     "LLMConfig", "ByteTokenizer", "load_tokenizer",
     "DecodeEngine", "SamplingParams",
     "LLMServer", "build_openai_app", "serve_llm",
+    "build_dp_openai_app", "build_pd_openai_app",
     "Processor", "build_llm_processor",
 ]

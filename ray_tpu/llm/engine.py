@@ -138,44 +138,73 @@ class DecodeEngine:
             f"{max(self.config.prefill_buckets)}"
         )
 
+    def _prefill_locked(self, prompt_ids, params):
+        """(slot_cache jax pytree, first_token). Caller holds the lock."""
+        import jax.numpy as jnp
+
+        Tpad = self._bucket(len(prompt_ids))
+        toks = np.zeros((1, Tpad), np.int32)
+        toks[0, : len(prompt_ids)] = prompt_ids
+        logits, cache1 = self._prefill(
+            self.params, jnp.asarray(toks), self._empty_slot_cache()
+        )
+        first = self._sample(
+            np.asarray(logits)[0, len(prompt_ids) - 1], params
+        )
+        return cache1, first
+
+    def _activate_slot_locked(self, b, cache1, first, prompt_len, params,
+                              fut):
+        self._cache = self._insert(self._cache, cache1, b)
+        slot = self._slots[b]
+        slot.active = True
+        slot.token_ids = [first]
+        slot.prompt_len = prompt_len
+        slot.produced = 1
+        slot.params = params
+        slot.future = fut
+        slot.last_token = first
+        slot.length = prompt_len
+        self.stats["requests"] += 1
+        self._finish_if_done_locked(b)
+
     def _admit_locked(self):
         import jax.numpy as jnp
 
         free = [i for i, s in enumerate(self._slots) if not s.active]
         while free and not self._pending.empty():
             try:
-                prompt_ids, params, fut = self._pending.get_nowait()
+                item = self._pending.get_nowait()
             except queue.Empty:
                 break
             b = free.pop(0)
             try:
-                Tpad = self._bucket(len(prompt_ids))
-            except ValueError as e:
-                # admission failure surfaces on the caller's future, never
-                # kills the scheduler loop
+                if item[0] == "prefilled":
+                    # PD disaggregation: the prompt's KV was computed by a
+                    # prefill server; insert its transferred cache directly.
+                    _, prefilled, params, fut = item
+                    cache1 = {
+                        k: jnp.asarray(v)
+                        for k, v in prefilled["cache"].items()
+                    }
+                    first = int(prefilled["first_token"])
+                    prompt_len = int(prefilled["prompt_len"])
+                else:
+                    _, prompt_ids, params, fut = item
+                    cache1, first = self._prefill_locked(prompt_ids, params)
+                    prompt_len = len(prompt_ids)
+                if prompt_len <= 0:
+                    raise ValueError("prompt must be non-empty")
+                self._activate_slot_locked(
+                    b, cache1, first, prompt_len, params, fut
+                )
+            except Exception as e:
+                # Admission failure (bad bucket, mismatched transferred
+                # cache shapes, ...) surfaces on the caller's future, never
+                # on other slots or the scheduler loop.
                 fut.set_exception(e)
                 free.insert(0, b)
                 continue
-            toks = np.zeros((1, Tpad), np.int32)
-            toks[0, : len(prompt_ids)] = prompt_ids
-            logits, cache1 = self._prefill(
-                self.params, jnp.asarray(toks), self._empty_slot_cache()
-            )
-            self._cache = self._insert(self._cache, cache1, b)
-            first = self._sample(
-                np.asarray(logits)[0, len(prompt_ids) - 1], params
-            )
-            slot = self._slots[b]
-            slot.active = True
-            slot.token_ids = [first]
-            slot.prompt_len = len(prompt_ids)
-            slot.produced = 1
-            slot.params = params
-            slot.future = fut
-            slot.last_token = first
-            slot.length = len(prompt_ids)
-            self.stats["requests"] += 1
-            self._finish_if_done_locked(b)
 
     def _finish_if_done_locked(self, b: int):
         slot = self._slots[b]
@@ -227,8 +256,40 @@ class DecodeEngine:
     def submit(self, prompt_ids: List[int],
                params: Optional[SamplingParams] = None) -> Future:
         """Continuous-batching entry: returns a Future of generated ids."""
+        if not prompt_ids:
+            raise ValueError("prompt must be non-empty")
         fut: Future = Future()
-        self._pending.put((list(prompt_ids), params or SamplingParams(), fut))
+        self._pending.put(
+            ("prompt", list(prompt_ids), params or SamplingParams(), fut)
+        )
+        self._ensure_loop()
+        return fut
+
+    def prefill_only(self, prompt_ids: List[int],
+                     params: Optional[SamplingParams] = None) -> dict:
+        """Prefill-server half of PD disaggregation (reference:
+        ``serving_patterns/prefill_decode/builder.py``): compute the
+        prompt's KV cache + first token WITHOUT occupying a decode slot.
+        Returns a transferable dict a decode engine resumes from."""
+        if not prompt_ids:
+            raise ValueError("prompt must be non-empty")
+        params = params or SamplingParams()
+        with self._lock:
+            cache1, first = self._prefill_locked(list(prompt_ids), params)
+            return {
+                "cache": {k: np.asarray(v) for k, v in cache1.items()},
+                "first_token": first,
+                "prompt_len": len(prompt_ids),
+            }
+
+    def submit_prefilled(self, prefilled: dict,
+                         params: Optional[SamplingParams] = None) -> Future:
+        """Decode-server half of PD disaggregation: continue generation from
+        a transferred prefill state."""
+        fut: Future = Future()
+        self._pending.put(
+            ("prefilled", prefilled, params or SamplingParams(), fut)
+        )
         self._ensure_loop()
         return fut
 

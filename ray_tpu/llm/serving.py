@@ -17,6 +17,37 @@ from ray_tpu.llm.config import LLMConfig
 from ray_tpu.llm.engine import DecodeEngine, SamplingParams
 
 
+def extract_sampling(payload: dict, config: LLMConfig) -> SamplingParams:
+    """OpenAI request fields → SamplingParams (shared by every ingress)."""
+    return SamplingParams(
+        max_new_tokens=int(
+            payload.get("max_tokens", config.max_new_tokens_default)
+        ),
+        temperature=float(payload.get("temperature", 0.0)),
+        top_k=int(payload.get("top_k", 0)),
+    )
+
+
+def completion_response(config: LLMConfig, prompt_tokens: int,
+                        completion_ids, text: str, **extra) -> dict:
+    """OpenAI text_completion envelope (shared by every ingress)."""
+    return {
+        "id": f"cmpl-{uuid.uuid4().hex[:24]}",
+        "object": "text_completion",
+        "created": int(time.time()),
+        "model": config.model_id,
+        "choices": [{
+            "index": 0, "text": text, "finish_reason": "stop",
+        }],
+        "usage": {
+            "prompt_tokens": prompt_tokens,
+            "completion_tokens": len(completion_ids),
+            "total_tokens": prompt_tokens + len(completion_ids),
+        },
+        **extra,
+    }
+
+
 class LLMServer:
     """Serve deployment target wrapping one engine replica."""
 
@@ -43,33 +74,14 @@ class LLMServer:
     # ----------------------------------------------------------- endpoints
 
     def _sampling(self, payload: dict) -> SamplingParams:
-        return SamplingParams(
-            max_new_tokens=int(
-                payload.get("max_tokens", self.config.max_new_tokens_default)
-            ),
-            temperature=float(payload.get("temperature", 0.0)),
-            top_k=int(payload.get("top_k", 0)),
-        )
+        return extract_sampling(payload, self.config)
 
     def completions(self, payload: dict) -> dict:
         prompt = payload.get("prompt", "")
         ids = self.engine.tokenizer.encode(prompt)
         out = self.engine.submit(ids, self._sampling(payload)).result(600)
         text = self.engine.tokenizer.decode(out)
-        return {
-            "id": f"cmpl-{uuid.uuid4().hex[:24]}",
-            "object": "text_completion",
-            "created": int(time.time()),
-            "model": self.config.model_id,
-            "choices": [{
-                "index": 0, "text": text, "finish_reason": "stop",
-            }],
-            "usage": {
-                "prompt_tokens": len(ids),
-                "completion_tokens": len(out),
-                "total_tokens": len(ids) + len(out),
-            },
-        }
+        return completion_response(self.config, len(ids), out, text)
 
     def chat_completions(self, payload: dict) -> dict:
         messages: List[Dict[str, str]] = payload.get("messages", [])

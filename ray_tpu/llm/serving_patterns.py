@@ -1,0 +1,127 @@
+"""LLM serving patterns: data-parallel and prefill/decode disaggregation.
+
+Reference analog: ``python/ray/llm/_internal/serve/serving_patterns/`` —
+``data_parallel/dp_server.py:221`` (N identical engine replicas behind the
+router) and ``prefill_decode/builder.py:184`` (separate prefill and decode
+replica pools; the prompt's KV state transfers between them).
+
+TPU-first shape: prefill is compute-bound (big matmuls, loves the MXU) and
+decode is latency/HBM-bound — disaggregation sizes the two pools
+independently. The transferred prefill state is a numpy KV pytree that rides
+the zero-copy object path between replicas.
+"""
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from ray_tpu.llm.config import LLMConfig
+from ray_tpu.llm.engine import DecodeEngine, SamplingParams
+from ray_tpu.llm.serving import (
+    LLMServer,
+    completion_response,
+    extract_sampling,
+)
+
+
+def build_dp_openai_app(config: LLMConfig, *, num_replicas: int = 2,
+                        params=None):
+    """Data-parallel serving: N engine replicas, power-of-two routed
+    (reference: dp_server.py)."""
+    from ray_tpu.llm.serving import build_openai_app
+
+    return build_openai_app(config, num_replicas=num_replicas, params=params)
+
+
+class PrefillServer:
+    """Prefill pool replica: prompts in, transferable KV states out."""
+
+    def __init__(self, config_dict: dict, params=None):
+        self.config = LLMConfig.from_dict(config_dict)
+        self.engine = DecodeEngine(self.config, params=params)
+
+    def prefill(self, prompt_ids, sampling: dict) -> dict:
+        return self.engine.prefill_only(
+            list(prompt_ids), SamplingParams(**sampling)
+        )
+
+    def health_check(self) -> bool:
+        return True
+
+
+class DecodeServer:
+    """Decode pool replica: continues generation from transferred states."""
+
+    def __init__(self, config_dict: dict, params=None):
+        self.config = LLMConfig.from_dict(config_dict)
+        self.engine = DecodeEngine(self.config, params=params)
+
+    def decode(self, prefilled: dict, sampling: dict):
+        return self.engine.submit_prefilled(
+            prefilled, SamplingParams(**sampling)
+        ).result(600)
+
+    def health_check(self) -> bool:
+        return True
+
+
+class PDIngress:
+    """OpenAI-surface ingress routing prompt->prefill pool->decode pool."""
+
+    def __init__(self, config_dict: dict, prefill_handle, decode_handle):
+        self.config = LLMConfig.from_dict(config_dict)
+        from ray_tpu.llm.config import load_tokenizer
+
+        self.tokenizer = load_tokenizer(self.config)
+        self._prefill = prefill_handle
+        self._decode = decode_handle
+
+    def __call__(self, request: dict) -> dict:
+        if "body" in request:  # HTTP proxy envelope
+            try:
+                payload = json.loads(request["body"] or b"{}")
+            except json.JSONDecodeError:
+                return {"error": {"message": "invalid JSON body"}}
+        else:
+            payload = request
+        prompt = payload.get("prompt", "")
+        sampling = dict(extract_sampling(payload, self.config).__dict__)
+        ids = self.tokenizer.encode(prompt)
+        if not ids:
+            return {"error": {"message": "prompt must be non-empty"}}
+        prefilled = self._prefill.prefill.remote(ids, sampling).result(600)
+        out = self._decode.decode.remote(prefilled, sampling).result(600)
+        text = self.tokenizer.decode(out)
+        return completion_response(
+            self.config, len(ids), out, text, disaggregated=True
+        )
+
+    def health_check(self) -> bool:
+        return True
+
+
+def build_pd_openai_app(config: LLMConfig, *, num_prefill: int = 1,
+                        num_decode: int = 1, params=None):
+    """Prefill/decode-disaggregated app for ``serve.run`` (reference:
+    prefill_decode/builder.py:184). Weights must be shared: pass ``params``
+    (or a config.model_source checkpoint) so both pools load identical
+    models."""
+    from ray_tpu import serve
+
+    prefill_dep = serve.deployment(
+        name="pd_prefill", num_replicas=num_prefill,
+        max_ongoing_requests=config.max_batch_slots,
+    )(PrefillServer)
+    decode_dep = serve.deployment(
+        name="pd_decode", num_replicas=num_decode,
+        max_ongoing_requests=config.max_batch_slots,
+    )(DecodeServer)
+    ingress = serve.deployment(
+        name="pd_ingress", max_ongoing_requests=64,
+    )(PDIngress)
+    cfg = config.to_dict()
+    return ingress.bind(
+        cfg,
+        prefill_dep.bind(cfg, params),
+        decode_dep.bind(cfg, params),
+    )
