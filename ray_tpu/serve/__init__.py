@@ -111,9 +111,16 @@ def _collect_specs(app: Application, specs: Dict[str, dict],
 
 def run(app: Application, *, name: str = "default",
         route_prefix: Optional[str] = "/",
+        local_testing_mode: bool = False,
         _blocking_timeout: float = 60.0) -> DeploymentHandle:
     """Deploy an application; returns a handle to its ingress deployment
-    (reference: ``serve.run`` ``api.py:869``)."""
+    (reference: ``serve.run`` ``api.py:869``). ``local_testing_mode=True``
+    instantiates the graph in-process without a cluster (reference:
+    ``_private/local_testing_mode.py``)."""
+    if local_testing_mode:
+        from ray_tpu.serve.local_testing import run_local
+
+        return run_local(app)
     import ray_tpu
 
     controller = _get_or_start_controller()

@@ -251,3 +251,35 @@ def test_gang_member_death_recycles_whole_gang(srv):
         assert h.remote(3).result(timeout=30) == 3
     finally:
         serve.shutdown()
+
+
+def test_local_testing_mode_no_cluster():
+    """serve.run(..., local_testing_mode=True) runs the graph in-process —
+    no init(), no actors (reference: local_testing_mode.py)."""
+    from ray_tpu import serve
+
+    @serve.deployment(user_config={"suffix": "!"})
+    class Shouter:
+        def __init__(self, downstream=None):
+            self.suffix = ""
+            self.downstream = downstream
+
+        def reconfigure(self, cfg):
+            self.suffix = cfg["suffix"]
+
+        def __call__(self, text):
+            if self.downstream is not None:
+                text = self.downstream.remote(text).result()
+            return text.upper() + self.suffix
+
+        def whisper(self, text):
+            return text.lower()
+
+    @serve.deployment(name="inner")
+    class Inner:
+        def __call__(self, text):
+            return f"<{text}>"
+
+    h = serve.run(Shouter.bind(Inner.bind()), local_testing_mode=True)
+    assert h.remote("hey").result() == "<HEY>!"
+    assert h.whisper.remote("LOUD").result() == "loud"
