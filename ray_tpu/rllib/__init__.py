@@ -4,13 +4,20 @@ Reference analog: the ``rllib/`` tree (new API stack: EnvRunnerGroup +
 RLModule + Learner/LearnerGroup + Algorithm/AlgorithmConfig).
 """
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, make_trainable
-from ray_tpu.rllib.algorithms import IMPALA, IMPALAConfig, PPO, PPOConfig
+from ray_tpu.rllib.algorithms import (
+    DQN,
+    DQNConfig,
+    IMPALA,
+    IMPALAConfig,
+    PPO,
+    PPOConfig,
+)
 from ray_tpu.rllib.env_runner import EnvRunnerGroup, SingleAgentEnvRunner
 from ray_tpu.rllib.learner import Learner, LearnerHyperparams
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "make_trainable",
-    "PPO", "PPOConfig", "IMPALA", "IMPALAConfig",
+    "PPO", "PPOConfig", "IMPALA", "IMPALAConfig", "DQN", "DQNConfig",
     "EnvRunnerGroup", "SingleAgentEnvRunner",
     "Learner", "LearnerHyperparams",
 ]

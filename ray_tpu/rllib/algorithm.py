@@ -102,21 +102,26 @@ class Algorithm:
     """Train loop driver; Tune-compatible via ``as_trainable``."""
 
     def __init__(self, config: AlgorithmConfig):
-        self.config = config
-        creator = config.get_env_creator()
-        probe_env = creator()
-        self.module_config = rl_module.module_config_for_env(probe_env)
-        probe_env.close()
+        self._init_common(config)
         self.learner = Learner(
             config.algo_name, self.module_config, config.hp,
             seed=config.seed, mesh=config.mesh,
         )
         self.runner_group = EnvRunnerGroup(
-            creator, config.num_env_runners, config.num_envs_per_runner,
-            config.rollout_fragment_length, self.module_config,
-            seed=config.seed, gamma=config.hp.gamma,
+            config.get_env_creator(), config.num_env_runners,
+            config.num_envs_per_runner, config.rollout_fragment_length,
+            self.module_config, seed=config.seed, gamma=config.hp.gamma,
         )
         self.runner_group.sync_weights(self.learner.get_weights())
+
+    def _init_common(self, config: AlgorithmConfig):
+        """Bookkeeping shared by every algorithm (subclasses that build
+        their own learner/runners call this instead of __init__)."""
+        self.config = config
+        creator = config.get_env_creator()
+        probe_env = creator()
+        self.module_config = rl_module.module_config_for_env(probe_env)
+        probe_env.close()
         self.iteration = 0
         self._total_env_steps = 0
         self._last_step_count = 0

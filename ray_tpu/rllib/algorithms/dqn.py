@@ -1,0 +1,270 @@
+"""DQN: Q-learning with replay, target network, epsilon-greedy exploration.
+
+Reference analog: ``rllib/algorithms/dqn/`` (new API stack DQN). The Q
+network reuses the RLModule MLP (``pi`` head = Q-values,
+exploration="epsilon_greedy"); the TD update is one jitted program over
+replay minibatches; the target network is a second param pytree synced every
+``target_update_freq`` gradient steps; epsilon decays per training step and
+reaches runners through the normal weight broadcast (it lives in params).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rllib import module as rl_module
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+
+
+class ReplayBuffer:
+    """Flat numpy ring of transitions (reference:
+    ``rllib/utils/replay_buffers``)."""
+
+    def __init__(self, capacity: int, obs_dim: int):
+        self.capacity = capacity
+        self.obs = np.zeros((capacity, obs_dim), np.float32)
+        self.next_obs = np.zeros((capacity, obs_dim), np.float32)
+        self.actions = np.zeros((capacity,), np.int32)
+        self.rewards = np.zeros((capacity,), np.float32)
+        self.dones = np.zeros((capacity,), np.float32)
+        self.size = 0
+        self._pos = 0
+        self._rng = np.random.RandomState(0)
+
+    def add_fragments(self, batch: Dict[str, np.ndarray]):
+        """Consume a [T, N] fragment batch: transitions t -> t+1 (the last
+        step of each column has no in-fragment successor and is dropped).
+        Ring insertion is vectorized — this runs every training step."""
+        obs, act = batch["obs"], batch["actions"]
+        rew, done = batch["rewards"], batch["dones"]
+        T = obs.shape[0]
+        if T < 2:
+            return
+        o = obs[:-1].reshape(-1, obs.shape[-1])
+        no = obs[1:].reshape(-1, obs.shape[-1])
+        a = act[:-1].reshape(-1)
+        r = rew[:-1].reshape(-1)
+        d = done[:-1].reshape(-1)
+        n = o.shape[0]
+        if n >= self.capacity:  # keep only the newest capacity-many
+            o, no, a, r, d = (x[-self.capacity:] for x in (o, no, a, r, d))
+            n = self.capacity
+        idx = (self._pos + np.arange(n)) % self.capacity
+        self.obs[idx] = o
+        self.next_obs[idx] = no
+        self.actions[idx] = a
+        self.rewards[idx] = r
+        self.dones[idx] = d
+        self._pos = (self._pos + n) % self.capacity
+        self.size = min(self.size + n, self.capacity)
+
+    def sample(self, n: int) -> Dict[str, np.ndarray]:
+        idx = self._rng.randint(0, self.size, n)
+        return {
+            "obs": self.obs[idx],
+            "next_obs": self.next_obs[idx],
+            "actions": self.actions[idx],
+            "rewards": self.rewards[idx],
+            "dones": self.dones[idx],
+        }
+
+
+class DQNConfig(AlgorithmConfig):
+    algo_name = "dqn"
+
+    def __init__(self):
+        super().__init__()
+        self.training(lr=1e-3, gamma=0.99)
+        self.replay_capacity = 50_000
+        self.learn_batch_size = 64
+        self.updates_per_step = 16
+        self.target_update_freq = 100     # gradient steps between syncs
+        self.min_replay_size = 500
+        self.epsilon_start = 1.0
+        self.epsilon_end = 0.05
+        self.epsilon_decay_steps = 50     # training_step calls to anneal over
+        self.double_q = True
+
+    def build_algo(self) -> "DQN":
+        return DQN(self)
+
+
+class DQN(Algorithm):
+    def __init__(self, config: DQNConfig):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        import dataclasses
+
+        # Q-head module: pi outputs Q-values; epsilon-greedy exploration
+        self._init_common(config)
+        if not self.module_config.discrete:
+            raise ValueError(
+                "DQN requires a discrete action space; "
+                f"{config.env or config.env_creator} has a continuous one"
+            )
+        self.module_config = dataclasses.replace(
+            self.module_config, exploration="epsilon_greedy"
+        )
+        cfg = self.module_config
+        hp = config.hp
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(hp.grad_clip), optax.adam(hp.lr)
+        )
+        key = jax.random.PRNGKey(config.seed)
+        self.q_params = rl_module.init_params(cfg, key)
+        self.q_params["epsilon"] = jnp.float32(config.epsilon_start)
+        self.target_params = jax.tree.map(jnp.copy, self.q_params)
+        self.opt_state = self.optimizer.init(self.q_params)
+        self.buffer = ReplayBuffer(config.replay_capacity, cfg.obs_dim)
+        self._grad_steps = 0
+
+        gamma, double_q = hp.gamma, config.double_q
+
+        def td_update(params, target, opt_state, batch):
+            def loss_fn(p):
+                q = rl_module.forward_policy(p, cfg, batch["obs"])
+                q_sa = jnp.take_along_axis(
+                    q, batch["actions"][:, None].astype(jnp.int32), -1
+                )[:, 0]
+                q_next_t = rl_module.forward_policy(
+                    target, cfg, batch["next_obs"]
+                )
+                if double_q:
+                    # Double DQN: online net picks, target net evaluates
+                    q_next_on = rl_module.forward_policy(
+                        p, cfg, batch["next_obs"]
+                    )
+                    a_star = jnp.argmax(q_next_on, axis=-1)
+                    q_next = jnp.take_along_axis(
+                        q_next_t, a_star[:, None], -1
+                    )[:, 0]
+                else:
+                    q_next = jnp.max(q_next_t, axis=-1)
+                tgt = batch["rewards"] + gamma * (1 - batch["dones"]) * (
+                    jax.lax.stop_gradient(q_next)
+                )
+                td = q_sa - tgt
+                # huber
+                loss = jnp.mean(
+                    jnp.where(jnp.abs(td) < 1.0, 0.5 * td ** 2,
+                              jnp.abs(td) - 0.5)
+                )
+                return loss, jnp.mean(jnp.abs(td))
+
+            (loss, td_abs), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params
+            )
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, td_abs
+
+        self._td_update = jax.jit(td_update)
+
+        from ray_tpu.rllib.env_runner import EnvRunnerGroup
+
+        self.runner_group = EnvRunnerGroup(
+            config.get_env_creator(), config.num_env_runners,
+            config.num_envs_per_runner, config.rollout_fragment_length,
+            self.module_config, seed=config.seed, gamma=hp.gamma,
+        )
+        self.runner_group.sync_weights(jax.device_get(self.q_params))
+
+    # ---------------------------------------------------------------- train
+
+    def training_step(self) -> Dict[str, float]:
+        import jax
+        import jax.numpy as jnp
+
+        fragments = self.runner_group.sample()
+        if not fragments:
+            self._last_step_count = 0
+            return {"num_healthy_runners": 0}
+        batch = self._build_batch(fragments)
+        self.buffer.add_fragments(batch)
+        self._record_env_steps(batch)
+
+        metrics: Dict[str, float] = {
+            "replay_size": float(self.buffer.size),
+            "epsilon": float(self.q_params["epsilon"]),
+        }
+        if self.buffer.size >= self.config.min_replay_size:
+            losses = []
+            for _ in range(self.config.updates_per_step):
+                mb = {
+                    k: jnp.asarray(v)
+                    for k, v in self.buffer.sample(
+                        self.config.learn_batch_size
+                    ).items()
+                }
+                (self.q_params, self.opt_state, loss, td
+                 ) = self._td_update(
+                    self.q_params, self.target_params, self.opt_state, mb
+                )
+                losses.append(float(loss))
+                self._grad_steps += 1
+                if self._grad_steps % self.config.target_update_freq == 0:
+                    self.target_params = jax.tree.map(
+                        jnp.copy, self.q_params
+                    )
+            metrics["total_loss"] = float(np.mean(losses))
+
+        # anneal epsilon and broadcast (it rides params)
+        frac = min(self.iteration / max(self.config.epsilon_decay_steps, 1),
+                   1.0)
+        eps = (self.config.epsilon_start
+               + (self.config.epsilon_end - self.config.epsilon_start) * frac)
+        self.q_params["epsilon"] = jnp.float32(eps)
+        self.runner_group.sync_weights(jax.device_get(self.q_params))
+        return metrics
+
+    # ------------------------------------------------------------ lifecycle
+
+    def get_weights(self):
+        import jax
+
+        return jax.device_get(self.q_params)
+
+    def save(self, path: str) -> str:
+        import os
+        import pickle
+
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "algorithm_state.pkl"), "wb") as f:
+            pickle.dump({
+                "q_params": jax.device_get(self.q_params),
+                "target_params": jax.device_get(self.target_params),
+                "opt_state": jax.device_get(self.opt_state),
+                "iteration": self.iteration,
+                "grad_steps": self._grad_steps,
+                "total_env_steps": self._total_env_steps,
+                "algo": "dqn",
+            }, f)
+        return path
+
+    def restore(self, path: str):
+        import os
+        import pickle
+
+        import jax.numpy as jnp
+        import jax
+
+        with open(os.path.join(path, "algorithm_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        self.q_params = jax.tree.map(jnp.asarray, state["q_params"])
+        self.target_params = jax.tree.map(jnp.asarray,
+                                          state["target_params"])
+        self.opt_state = jax.tree.map(
+            lambda x: jnp.asarray(x) if isinstance(x, np.ndarray) else x,
+            state["opt_state"],
+        )
+        self.iteration = state["iteration"]
+        self._grad_steps = state["grad_steps"]
+        self._total_env_steps = state.get("total_env_steps", 0)
+        self.runner_group.sync_weights(jax.device_get(self.q_params))

@@ -26,6 +26,10 @@ class RLModuleConfig:
     dtype: Any = jnp.float32
     # Initial log-stddev for gaussian policies.
     init_logstd: float = 0.0
+    # "categorical" (PG methods) | "epsilon_greedy" (value methods: the pi
+    # head outputs Q-values; exploration epsilon rides params["epsilon"] so
+    # decay flows to runners through weight sync).
+    exploration: str = "categorical"
 
 
 def _init_mlp(rng, sizes, dtype):
@@ -71,6 +75,11 @@ def forward_policy(params, config: RLModuleConfig, obs):
 
 
 def forward_value(params, config: RLModuleConfig, obs):
+    if config.exploration == "epsilon_greedy":
+        # value-based module: the state value is max_a Q — the vf head is
+        # untrained (TD only updates pi/Q), so using it (e.g. for the
+        # runner's truncation bootstrap) would silently bias targets.
+        return jnp.max(forward_policy(params, config, obs), axis=-1)
     return _mlp(params["vf"], obs)[..., 0]
 
 
@@ -78,6 +87,18 @@ def sample_action(params, config: RLModuleConfig, obs, rng):
     """(action, logp, value) for rollout collection — one fused jit."""
     out = forward_policy(params, config, obs)
     value = forward_value(params, config, obs)
+    if config.exploration == "epsilon_greedy":
+        # out = Q-values; epsilon-greedy with epsilon carried in params
+        k_eps, k_rand = jax.random.split(rng)
+        eps = params.get("epsilon", jnp.float32(0.0))
+        greedy = jnp.argmax(out, axis=-1)
+        random_a = jax.random.randint(
+            k_rand, greedy.shape, 0, config.action_dim
+        )
+        explore = jax.random.uniform(k_eps, greedy.shape) < eps
+        action = jnp.where(explore, random_a, greedy)
+        logp = jnp.zeros(action.shape, out.dtype)  # off-policy: unused
+        return action, logp, value  # value = max Q via forward_value
     if config.discrete:
         logits = jax.nn.log_softmax(out)
         action = jax.random.categorical(rng, out)

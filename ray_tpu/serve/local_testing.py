@@ -8,7 +8,31 @@ graph directly in the driver process so unit tests exercise user callables
 from __future__ import annotations
 
 import inspect
+import threading
 from typing import Any, Dict
+
+
+def _run_coro_in_thread(coro):
+    """Run a coroutine to completion on its own loop in a fresh thread:
+    nested handle calls (async deployment -> async deployment via
+    .result()) each get an independent loop, mirroring how distinct
+    replicas run on distinct loops in the cluster path."""
+    box = {}
+
+    def runner():
+        import asyncio
+
+        try:
+            box["value"] = asyncio.run(coro)
+        except BaseException as e:  # surfaced by the caller
+            box["error"] = e
+
+    t = threading.Thread(target=runner, name="rt-serve-local")
+    t.start()
+    t.join()
+    if "error" in box:
+        raise box["error"]
+    return box["value"]
 
 
 class LocalResponse:
@@ -50,9 +74,7 @@ class LocalDeploymentHandle:
                 fn = getattr(self._instance, method)
             out = fn(*args, **kwargs)
             if inspect.iscoroutine(out):
-                import asyncio
-
-                out = asyncio.run(out)
+                out = _run_coro_in_thread(out)
             return LocalResponse(out)
         except Exception as e:
             return LocalResponse(error=e)
