@@ -190,6 +190,28 @@ class Dataset:
         )
         return Dataset([put_block(table.take(idx))], [], self._executor)
 
+    def join(self, other: "Dataset", on: Union[str, List[str]], *,
+             how: str = "inner", suffix: str = "_r", **_) -> "Dataset":
+        """Hash join on key column(s) (reference: the join physical operator
+        under ``_internal/execution/operators``). Arrow-native via
+        pyarrow.Table.join; supported ``how``: inner, left outer, right
+        outer, full outer."""
+        how_map = {
+            "inner": "inner", "left": "left outer", "right": "right outer",
+            "outer": "full outer", "left outer": "left outer",
+            "right outer": "right outer", "full outer": "full outer",
+        }
+        if how not in how_map:
+            raise ValueError(f"unsupported join type {how!r}")
+        keys = [on] if isinstance(on, str) else list(on)
+        left = BlockAccessor.concat(self._materialized_blocks())
+        right = BlockAccessor.concat(other._materialized_blocks())
+        joined = left.join(
+            right, keys=keys, join_type=how_map[how],
+            right_suffix=suffix,
+        )
+        return Dataset([put_block(joined)], [], self._executor)
+
     def union(self, *others: "Dataset") -> "Dataset":
         blocks = list(self.materialize()._blocks)
         for o in others:

@@ -28,6 +28,7 @@ from ray_tpu.tune.schedulers import (
     TrialScheduler,
 )
 from ray_tpu.tune.search import (
+    OptunaSearch,
     BasicVariantGenerator,
     Choice,
     ConcurrencyLimiter,
@@ -65,6 +66,7 @@ __all__ = [
     "get_checkpoint",
     "get_context",
     "grid_search",
+    "OptunaSearch",
     "lograndint",
     "loguniform",
     "quniform",

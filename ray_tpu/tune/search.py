@@ -214,3 +214,104 @@ class ConcurrencyLimiter(Searcher):
     def on_trial_complete(self, trial_id, result=None, error=False):
         self._live.discard(trial_id)
         self.searcher.on_trial_complete(trial_id, result, error)
+
+
+class OptunaSearch(Searcher):
+    """Optuna TPE searcher (reference: ``search/optuna``). Import-guarded:
+    optuna is an optional dependency. ``metric`` is required (the study
+    needs an objective); ``num_samples`` bounds the trial count (external
+    searchers are not capped by TuneConfig.num_samples)."""
+
+    def __init__(self, param_space: Dict[str, Any], *, metric: str,
+                 mode: str = "min", num_samples: int = 16,
+                 seed: Optional[int] = None):
+        try:
+            import optuna
+        except ImportError as e:
+            raise ImportError(
+                "OptunaSearch requires the optional 'optuna' package "
+                "(pip install optuna); built-in alternatives: "
+                "BasicVariantGenerator (random/grid) + ASHA/PBT schedulers"
+            ) from e
+        if not metric:
+            raise ValueError("OptunaSearch requires metric=")
+        self._optuna = optuna
+        self._space = param_space
+        self._metric = metric
+        self._mode = mode
+        self._num_samples = num_samples
+        self._suggested = 0
+        sampler = optuna.samplers.TPESampler(seed=seed)
+        self._study = optuna.create_study(
+            direction="minimize" if mode == "min" else "maximize",
+            sampler=sampler,
+        )
+        self._trials: Dict[str, Any] = {}
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        if metric:
+            self._metric = metric
+        if mode:
+            self._mode = mode
+        return True
+
+    def _suggest_value(self, trial, name: str, domain):
+        import math
+
+        if isinstance(domain, LogUniform):
+            # LogUniform stores log-space bounds (lo/hi)
+            return trial.suggest_float(
+                name, math.exp(domain.lo), math.exp(domain.hi), log=True
+            )
+        if isinstance(domain, QUniform):
+            return trial.suggest_float(name, domain.low, domain.high,
+                                       step=domain.q)
+        if isinstance(domain, Uniform):
+            return trial.suggest_float(name, domain.low, domain.high)
+        if isinstance(domain, LogRandInt):
+            return trial.suggest_int(name, domain.low, domain.high - 1,
+                                     log=True)
+        if isinstance(domain, RandInt):
+            return trial.suggest_int(name, domain.low, domain.high - 1)
+        if isinstance(domain, Choice):
+            return trial.suggest_categorical(name, domain.categories)
+        raise ValueError(
+            f"OptunaSearch cannot optimize param {name!r} of type "
+            f"{type(domain).__name__}; supported: uniform/quniform/"
+            f"loguniform/randint/lograndint/choice"
+        )
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._suggested >= self._num_samples:
+            return None  # search exhausted -> Tuner terminates
+        self._suggested += 1
+        trial = self._study.ask()
+        self._trials[trial_id] = trial
+        cfg = {}
+        for name, domain in self._space.items():
+            if isinstance(domain, Domain):
+                cfg[name] = self._suggest_value(trial, name, domain)
+            elif isinstance(domain, dict) and "grid_search" in domain:
+                cfg[name] = trial.suggest_categorical(
+                    name, domain["grid_search"]
+                )
+            elif isinstance(domain, dict):
+                raise ValueError(
+                    f"OptunaSearch does not support nested spaces "
+                    f"(param {name!r}); flatten the space"
+                )
+            else:
+                cfg[name] = domain
+        return cfg
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict],
+                          error: bool = False):
+        trial = self._trials.pop(trial_id, None)
+        if trial is None:
+            return
+        if error or not result or self._metric not in result:
+            self._study.tell(
+                trial, state=self._optuna.trial.TrialState.FAIL
+            )
+        else:
+            self._study.tell(trial, result[self._metric])

@@ -192,3 +192,27 @@ def test_dataset_feeds_trainer(rt, tmp_path):
     assert res.error is None
     # shards partition the id space: rank 0's sum + rank 1's = 0..15 total
     assert res.metrics["total"] < sum(range(16))
+
+
+def test_join_inner_and_left(rt_start):
+    from ray_tpu import data
+
+    users = data.from_items([
+        {"uid": 1, "name": "ada"},
+        {"uid": 2, "name": "bob"},
+        {"uid": 3, "name": "cy"},
+    ])
+    orders = data.from_items([
+        {"uid": 1, "amount": 10},
+        {"uid": 1, "amount": 5},
+        {"uid": 3, "amount": 7},
+    ])
+    inner = users.join(orders, on="uid").sort("amount").take_all()
+    assert [(r["name"], r["amount"]) for r in inner] == [
+        ("ada", 5), ("cy", 7), ("ada", 10),
+    ]
+    left = users.join(orders, on="uid", how="left").take_all()
+    assert len(left) == 4  # bob kept with null amount
+    assert any(r["name"] == "bob" and r["amount"] is None for r in left)
+    with pytest.raises(ValueError):
+        users.join(orders, on="uid", how="cross")
