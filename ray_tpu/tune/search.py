@@ -235,6 +235,8 @@ class OptunaSearch(Searcher):
             ) from e
         if not metric:
             raise ValueError("OptunaSearch requires metric=")
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
         self._optuna = optuna
         self._space = param_space
         self._metric = metric
@@ -251,8 +253,10 @@ class OptunaSearch(Searcher):
     def set_search_properties(self, metric, mode, config) -> bool:
         if metric:
             self._metric = metric
-        if mode:
-            self._mode = mode
+        if mode and mode != self._mode:
+            # the study's direction is frozen at construction; pretending to
+            # flip it would silently optimize the wrong way
+            return False
         return True
 
     def _suggest_value(self, trial, name: str, domain):
@@ -292,8 +296,11 @@ class OptunaSearch(Searcher):
             if isinstance(domain, Domain):
                 cfg[name] = self._suggest_value(trial, name, domain)
             elif isinstance(domain, dict) and "grid_search" in domain:
-                cfg[name] = trial.suggest_categorical(
-                    name, domain["grid_search"]
+                raise ValueError(
+                    f"OptunaSearch does not support grid_search (param "
+                    f"{name!r}): TPE samples and cannot guarantee every "
+                    f"grid value runs — use choice() or the default "
+                    f"BasicVariantGenerator"
                 )
             elif isinstance(domain, dict):
                 raise ValueError(

@@ -173,3 +173,63 @@ def test_put_nested_ref_pinned(rt_start):
     time.sleep(0.2)
     inner_again = ray_tpu.get(outer)[0]
     assert ray_tpu.get(inner_again, timeout=10) == 123
+
+
+def test_util_queue(rt_start):
+    from ray_tpu.util.queue import Empty, Full, Queue
+
+    q = Queue(maxsize=2)
+    try:
+        q.put(1)
+        q.put(2)
+        assert q.qsize() == 2 and q.full()
+        with pytest.raises(Full):
+            q.put_nowait(3)
+        assert q.get() == 1
+        assert q.get() == 2
+        assert q.empty()
+        with pytest.raises(Empty):
+            q.get_nowait()
+        with pytest.raises(Empty):
+            q.get(timeout=0.2)
+
+        # producer/consumer across tasks (handle pickles)
+        @ray_tpu.remote
+        def produce(queue, n):
+            for i in range(n):
+                queue.put(i * 10)
+            return True
+
+        ref = produce.remote(q, 4)
+        got = [q.get(timeout=30) for _ in range(4)]
+        assert got == [0, 10, 20, 30]
+        assert ray_tpu.get(ref)
+    finally:
+        q.shutdown()
+
+
+def test_util_actor_pool(rt_start):
+    from ray_tpu.util.actor_pool import ActorPool
+
+    @ray_tpu.remote
+    class Sq:
+        def sq(self, x):
+            import time as _t
+
+            _t.sleep(0.01 * (x % 3))
+            return x * x
+
+    pool = ActorPool([Sq.remote() for _ in range(3)])
+    assert list(pool.map(lambda a, v: a.sq.remote(v), range(8))) == [
+        v * v for v in range(8)
+    ]
+    unordered = list(
+        pool.map_unordered(lambda a, v: a.sq.remote(v), range(8))
+    )
+    assert sorted(unordered) == sorted(v * v for v in range(8))
+    # submit/get_next interleaving
+    pool.submit(lambda a, v: a.sq.remote(v), 9)
+    pool.submit(lambda a, v: a.sq.remote(v), 10)
+    assert pool.get_next() == 81
+    assert pool.get_next() == 100
+    assert not pool.has_next()

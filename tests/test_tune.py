@@ -142,3 +142,42 @@ def test_pbt_exploits_checkpoints(rt_start, tmp_path):
     assert any("_pbt" in t.trial_id for t in grid._trials)
     best = grid.get_best_result()
     assert best.metrics["score"] >= 12 * 1.0 - 4  # good lineage dominated
+
+
+def test_optuna_search_validation():
+    """Gate + argument validation (optuna itself is optional)."""
+    from ray_tpu.tune import OptunaSearch
+
+    try:
+        import optuna  # noqa: F401
+        have_optuna = True
+    except ImportError:
+        have_optuna = False
+
+    if not have_optuna:
+        with pytest.raises(ImportError, match="optuna"):
+            OptunaSearch({}, metric="loss")
+        return
+
+    from ray_tpu.tune import choice, grid_search, loguniform, uniform
+
+    with pytest.raises(ValueError, match="metric"):
+        OptunaSearch({}, metric="")
+    with pytest.raises(ValueError, match="mode"):
+        OptunaSearch({}, metric="loss", mode="minimize")
+    s = OptunaSearch(
+        {"lr": loguniform(1e-4, 1e-1), "act": choice(["a", "b"]),
+         "c": 3},
+        metric="loss", num_samples=2, seed=0,
+    )
+    cfg = s.suggest("t0")
+    assert 1e-4 <= cfg["lr"] <= 1e-1 and cfg["act"] in ("a", "b")
+    assert cfg["c"] == 3
+    s.on_trial_complete("t0", {"loss": 1.0})
+    assert s.suggest("t1") is not None
+    assert s.suggest("t2") is None  # num_samples exhausted
+    assert s.set_search_properties("loss", "max", {}) is False  # frozen dir
+    with pytest.raises(ValueError, match="grid_search"):
+        OptunaSearch(
+            {"x": grid_search([1, 2])}, metric="loss"
+        ).suggest("t")
