@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from collections import defaultdict
 from dataclasses import dataclass, field
@@ -147,6 +148,20 @@ class HeadService:
         self.pending_demands: Dict[int, dict] = {}
         self.job_procs: Dict[str, object] = {}  # submission_id -> Popen
         self.worker_metrics: Dict[str, list] = {}  # worker -> metric snapshot
+        # Native C++ scheduler (reference: the C++ ClusterResourceScheduler,
+        # ``raylet/scheduling/cluster_resource_scheduler.cc:155``): fixed-point
+        # resource accounting + best-node policies in ray_tpu/native/src/sched.cc.
+        # The NodeInfo.available dicts stay as a mirror for the state API and
+        # autoscaler; scheduling decisions come from the native side when the
+        # library is buildable (RT_NATIVE_SCHED=0 forces the Python fallback).
+        self._nsched = None
+        if os.environ.get("RT_NATIVE_SCHED", "1") != "0":
+            try:
+                from ray_tpu.native import sched as _native_sched
+
+                self._nsched = _native_sched.create()
+            except Exception:
+                logger.exception("native scheduler unavailable; Python fallback")
 
     # ------------------------------------------------------------------ setup
 
@@ -285,10 +300,15 @@ class HeadService:
             addr=tuple(h["addr"]),
             resources=dict(h["resources"]),
             available=dict(h["resources"]),
-            labels=dict(h.get("labels", {})),
+            # Label values are strings (as in the reference's label
+            # selectors); stringify so the Python and native comparison
+            # paths agree for non-string inputs.
+            labels={k: str(v) for k, v in h.get("labels", {}).items()},
             conn=conn,
         )
         self.nodes[info.node_id] = info
+        if self._nsched is not None:
+            self._nsched.add_node(info.node_id, info.resources, info.labels)
         conn.peer_info["node_id"] = info.node_id
         conn.on_close = self._make_node_close_handler(info.node_id)
         self._wake_waiters()
@@ -320,6 +340,8 @@ class HeadService:
         if info is None or not info.alive:
             return
         info.alive = False
+        if self._nsched is not None:
+            self._nsched.set_alive(node_id, False)
         logger.warning("node %s dead: %s", node_id[:8], reason)
         self.publish("nodes", {"event": "node_dead", "node_id": node_id})
         # Fail/restart actors that lived there.
@@ -346,6 +368,17 @@ class HeadService:
 
     # -------------------------------------------------------------- scheduler
 
+    def _node_acquire(self, node: NodeInfo, need: Dict[str, float]):
+        """Node-level resource acquisition: Python mirror + native scheduler."""
+        _acquire(node.available, need)
+        if self._nsched is not None:
+            self._nsched.acquire(node.node_id, need)
+
+    def _node_release(self, node: NodeInfo, need: Dict[str, float]):
+        _release(node.available, need)
+        if self._nsched is not None:
+            self._nsched.release(node.node_id, need)
+
     def _schedulable_nodes(self, need, labels=None, node_id=None):
         out = []
         for n in self.nodes.values():
@@ -353,7 +386,9 @@ class HeadService:
                 continue
             if node_id is not None and n.node_id != node_id:
                 continue
-            if labels and any(n.labels.get(k) != v for k, v in labels.items()):
+            if labels and any(
+                n.labels.get(k) != str(v) for k, v in labels.items()
+            ):
                 continue
             out.append(n)
         return out
@@ -367,6 +402,15 @@ class HeadService:
         pg_id = strategy.get("pg_id")
         if pg_id:
             return self._pick_pg_node(need, pg_id, strategy.get("bundle_index", -1))
+        if self._nsched is not None:
+            node_id = self._nsched.best_node(
+                need,
+                spread=bool(strategy.get("spread")),
+                affinity_node=strategy.get("node_id"),
+                labels=strategy.get("labels"),
+                avoid=avoid or (),
+            )
+            return self.nodes.get(node_id) if node_id else None
         cands = self._schedulable_nodes(
             need, strategy.get("labels"), strategy.get("node_id")
         )
@@ -418,7 +462,7 @@ class HeadService:
             node = self._pick_node(need, strategy, avoid)
             if node is not None:
                 if not strategy.get("pg_id"):
-                    _acquire(node.available, need)
+                    self._node_acquire(node, need)
                 grants.append({"node_id": node.node_id, "addr": list(node.addr)})
                 continue
             if grants:
@@ -447,19 +491,28 @@ class HeadService:
         pg_id = strategy.get("pg_id")
         if pg_id:
             pg = self.pgs.get(pg_id)
-            if pg is not None:
+            reserved = self.pg_reserved.get(pg_id)
+            if pg is not None and reserved is not None:
                 # return to the bundle's reservation
                 idx = strategy.get("bundle_index", -1)
                 node_id = h.get("node_id")
                 indices = [idx] if idx >= 0 else range(len(pg.bundles))
                 for i in indices:
                     if pg.bundle_nodes[i] == node_id:
-                        _release(self.pg_reserved[pg_id][i], need)
+                        _release(reserved[i], need)
                         break
+            elif pg is not None:
+                # PG was removed while this lease was outstanding: the bundle
+                # reservation is gone, so the loaned resources go straight
+                # back to the node (remove_pg only returned the unloaned
+                # remainder).
+                node = self.nodes.get(h.get("node_id") or "")
+                if node is not None and node.alive:
+                    self._node_release(node, need)
         else:
             node = self.nodes.get(h["node_id"])
             if node is not None:
-                _release(node.available, need)
+                self._node_release(node, need)
         self._wake_waiters()
         return {}, []
 
@@ -532,7 +585,7 @@ class HeadService:
                     self.pending_demands.pop(id(fut), None)
                 continue
             if not strategy.get("pg_id"):
-                _acquire(node.available, info.resources)
+                self._node_acquire(node, info.resources)
             try:
                 await node.conn.call(
                     "create_actor",
@@ -542,7 +595,7 @@ class HeadService:
             except protocol.RpcError as e:
                 # Actor __init__ raised: actor is born dead; surface the error.
                 if not strategy.get("pg_id"):
-                    _release(node.available, info.resources)
+                    self._node_release(node, info.resources)
                 info.state = "DEAD"
                 info.death_reason = str(e)
                 self.publish(f"actor:{info.actor_id}", info.to_public())
@@ -569,6 +622,10 @@ class HeadService:
             reserved = self.pg_reserved.get(actor.pg_id)
             pg = self.pgs.get(actor.pg_id)
             if reserved is None or pg is None:
+                # PG removed while the actor was alive: its loaned bundle
+                # resources return straight to the node.
+                self._node_release(node, actor.resources)
+                self._wake_waiters()
                 return
             indices = (
                 [actor.bundle_index]
@@ -581,7 +638,7 @@ class HeadService:
             if indices:
                 _release(reserved[indices[0]], actor.resources)
         else:
-            _release(node.available, actor.resources)
+            self._node_release(node, actor.resources)
         self._wake_waiters()
 
     async def _on_actor_dead(self, actor: ActorInfo, reason: str):
@@ -673,7 +730,7 @@ class HeadService:
             placement = self._try_place_bundles(pg)
             if placement is not None:
                 for i, node in enumerate(placement):
-                    _acquire(node.available, bundles[i])
+                    self._node_acquire(node, bundles[i])
                     pg.bundle_nodes[i] = node.node_id
                 self.pg_reserved[pg_id] = [dict(b) for b in bundles]
                 pg.state = "CREATED"
@@ -732,7 +789,11 @@ class HeadService:
                 if node is not None and node.alive:
                     # Return whatever of the bundle is not currently loaned out;
                     # loaned resources return via release_lease.
-                    _release(node.available, pg.bundles[i])
+                    remainder = self.pg_reserved.get(pg.pg_id)
+                    self._node_release(
+                        node,
+                        remainder[i] if remainder is not None else pg.bundles[i],
+                    )
         pg.state = "REMOVED"
         self.pg_reserved.pop(pg.pg_id, None)
         self._wake_waiters()

@@ -126,6 +126,7 @@ class _ActorChannel:
         self.actor_id = actor_id
         self.addr = tuple(addr) if addr else None
         self.seq = 0
+        self.epoch = 0  # bumps on every (re)connect: a fresh ordering domain
         self.conn: Optional[protocol.Connection] = None
         self.lock = asyncio.Lock()
         self.dead = False
@@ -168,7 +169,11 @@ class CoreWorker:
         self.job_id = job_id
         self.worker_id = WorkerID.from_random()
         self.node_id = NodeID.from_random().hex()
-        self.node_resources = node_resources or {}
+        self.node_resources = dict(node_resources or {})
+        # Slot marker backing zero-CPU tasks/actors (_build_resources maps
+        # num_cpus=0 to 0.001 node:slot): every node advertises capacity for
+        # 1000 of them.
+        self.node_resources.setdefault("node:slot", 1.0)
         self.node_labels = node_labels or {}
         self.head = head  # in-process HeadService when this is the head driver
 
@@ -1081,10 +1086,22 @@ class CoreWorker:
         attempt = 0
         while True:
             try:
-                conn = await self._actor_conn(ch)
+                # One atomic critical section for connection resolution AND
+                # sequence assignment: dispatch tasks are created in
+                # submission order and asyncio.Lock wakes waiters FIFO, so
+                # seq order == submission order. (Resolving the connection
+                # outside the lock let every coroutine resuming from
+                # get_peer re-run the `new connection => seq = 0` reset,
+                # clobbering sequence numbers already handed out and
+                # reordering actor calls under load.)
                 async with ch.lock:
+                    conn = await self._actor_conn(ch)
                     ch.seq += 1
                     header["seq"] = ch.seq
+                    # The ordering domain is (caller, connection epoch): a
+                    # reconnect starts a fresh contiguous seq stream and the
+                    # server must not mix it with the old stream's cursor.
+                    header["caller"] = f"{self.worker_id.hex()}:{ch.epoch}"
                 h, rframes = await conn.call("push_actor_task", header, frames)
                 self._handle_task_reply(header, h, rframes)
                 return
@@ -1147,8 +1164,10 @@ class CoreWorker:
             if not await self._await_actor_alive(ch):
                 raise exc.ActorDiedError(ch.actor_id, ch.death_reason)
         ch.conn = await self.get_peer(ch.addr)
-        # New connection = new ordering domain for this caller.
+        # New connection = new ordering domain for this caller. Callers hold
+        # ch.lock across this reset and their own seq assignment.
         ch.seq = 0
+        ch.epoch += 1
         return ch.conn
 
     async def _await_actor_alive(self, ch: _ActorChannel, timeout=60.0) -> bool:

@@ -1,0 +1,224 @@
+"""ctypes wrapper for the native cluster resource scheduler (src/sched.cc).
+
+TPU-era equivalent of the reference's C++ scheduling stack
+(``src/ray/common/scheduling/`` + ``src/ray/raylet/scheduling/policy/``):
+fixed-point resource accounting with interned resource ids and
+hybrid/spread/affinity/label best-node selection, embedded in the head
+service. ``create()`` returns a :class:`NativeScheduler` or ``None`` when the
+native toolchain is unavailable (callers keep the Python fallback).
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Dict, Iterable, Optional
+
+logger = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "librt_sched.so")
+_SRC = os.path.join(_DIR, "src", "sched.cc")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _needs_build() -> bool:
+    return not os.path.exists(_LIB_PATH) or (
+        os.path.exists(_SRC)
+        and os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)
+    )
+
+
+def _build() -> bool:
+    import fcntl
+
+    try:
+        with open(os.path.join(_DIR, ".build.lock"), "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            if not _needs_build():
+                return True
+            res = subprocess.run(
+                ["make", "-C", _DIR, "librt_sched.so"],
+                capture_output=True,
+                text=True,
+                timeout=120,
+            )
+    except (OSError, subprocess.TimeoutExpired) as e:
+        logger.warning("native sched build unavailable: %s", e)
+        return False
+    if res.returncode != 0:
+        logger.warning("native sched build failed:\n%s", res.stderr[-2000:])
+        return False
+    return True
+
+
+def _load_library():
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if _needs_build():
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError as e:
+            logger.warning("native sched load failed: %s", e)
+            return None
+
+        c_char_pp = ctypes.POINTER(ctypes.c_char_p)
+        c_double_p = ctypes.POINTER(ctypes.c_double)
+        lib.rts_sched_new.argtypes = []
+        lib.rts_sched_new.restype = ctypes.c_void_p
+        lib.rts_sched_free.argtypes = [ctypes.c_void_p]
+        lib.rts_sched_free.restype = None
+        lib.rts_sched_add_node.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rts_sched_add_node.restype = ctypes.c_int
+        lib.rts_sched_remove_node.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rts_sched_remove_node.restype = ctypes.c_int
+        lib.rts_sched_set_alive.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.rts_sched_set_alive.restype = ctypes.c_int
+        lib.rts_sched_set_resource.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_double,
+        ]
+        lib.rts_sched_set_resource.restype = ctypes.c_int
+        lib.rts_sched_set_label.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ]
+        lib.rts_sched_set_label.restype = ctypes.c_int
+        for name in ("rts_sched_acquire", "rts_sched_release"):
+            fn = getattr(lib, name)
+            fn.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, c_char_pp, c_double_p,
+                ctypes.c_int,
+            ]
+            fn.restype = ctypes.c_int
+        lib.rts_sched_fits.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, c_char_pp, c_double_p,
+            ctypes.c_int,
+        ]
+        lib.rts_sched_fits.restype = ctypes.c_int
+        lib.rts_sched_available.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+        ]
+        lib.rts_sched_available.restype = ctypes.c_double
+        lib.rts_sched_num_nodes.argtypes = [ctypes.c_void_p]
+        lib.rts_sched_num_nodes.restype = ctypes.c_int
+        lib.rts_sched_best_node.argtypes = [
+            ctypes.c_void_p, c_char_pp, c_double_p, ctypes.c_int,  # demand
+            ctypes.c_int,  # spread
+            ctypes.c_char_p,  # affinity
+            c_char_pp, c_char_pp, ctypes.c_int,  # labels
+            c_char_pp, ctypes.c_int,  # avoid
+            ctypes.c_char_p, ctypes.c_int,  # out
+        ]
+        lib.rts_sched_best_node.restype = ctypes.c_int
+        _lib = lib
+        return _lib
+
+
+def _pack(need: Dict[str, float]):
+    n = len(need)
+    names = (ctypes.c_char_p * n)(*(k.encode() for k in need))
+    vals = (ctypes.c_double * n)(*(float(v) for v in need.values()))
+    return names, vals, n
+
+
+class NativeScheduler:
+    """Owns a native Sched instance; mirrors the head's resource tables."""
+
+    def __init__(self, lib):
+        self._lib = lib
+        self._h = lib.rts_sched_new()
+
+    def __del__(self):
+        h, self._h = self._h, None
+        if h and self._lib:
+            self._lib.rts_sched_free(h)
+
+    def add_node(self, node_id: str, resources: Dict[str, float],
+                 labels: Optional[Dict[str, str]] = None):
+        nid = node_id.encode()
+        self._lib.rts_sched_add_node(self._h, nid)
+        for name, total in resources.items():
+            self._lib.rts_sched_set_resource(
+                self._h, nid, name.encode(), float(total)
+            )
+        for k, v in (labels or {}).items():
+            self._lib.rts_sched_set_label(
+                self._h, nid, k.encode(), str(v).encode()
+            )
+
+    def remove_node(self, node_id: str):
+        self._lib.rts_sched_remove_node(self._h, node_id.encode())
+
+    def set_alive(self, node_id: str, alive: bool):
+        self._lib.rts_sched_set_alive(self._h, node_id.encode(), int(alive))
+
+    def acquire(self, node_id: str, need: Dict[str, float]):
+        names, vals, n = _pack(need)
+        self._lib.rts_sched_acquire(self._h, node_id.encode(), names, vals, n)
+
+    def release(self, node_id: str, need: Dict[str, float]):
+        names, vals, n = _pack(need)
+        self._lib.rts_sched_release(self._h, node_id.encode(), names, vals, n)
+
+    def fits(self, node_id: str, need: Dict[str, float]) -> bool:
+        names, vals, n = _pack(need)
+        return bool(
+            self._lib.rts_sched_fits(self._h, node_id.encode(), names, vals, n)
+        )
+
+    def available(self, node_id: str, resource: str) -> float:
+        return self._lib.rts_sched_available(
+            self._h, node_id.encode(), resource.encode()
+        )
+
+    def num_nodes(self) -> int:
+        return self._lib.rts_sched_num_nodes(self._h)
+
+    def best_node(
+        self,
+        need: Dict[str, float],
+        *,
+        spread: bool = False,
+        affinity_node: Optional[str] = None,
+        labels: Optional[Dict[str, str]] = None,
+        avoid: Iterable[str] = (),
+    ) -> Optional[str]:
+        names, vals, n = _pack(need)
+        labels = labels or {}
+        nl = len(labels)
+        lkeys = (ctypes.c_char_p * max(nl, 1))(
+            *(k.encode() for k in labels) or (b"",)
+        )
+        lvals = (ctypes.c_char_p * max(nl, 1))(
+            *(str(v).encode() for v in labels.values()) or (b"",)
+        )
+        avoid = list(avoid)
+        na = len(avoid)
+        av = (ctypes.c_char_p * max(na, 1))(
+            *(a.encode() for a in avoid) or (b"",)
+        )
+        out = ctypes.create_string_buffer(256)
+        found = self._lib.rts_sched_best_node(
+            self._h, names, vals, n, int(spread),
+            affinity_node.encode() if affinity_node else None,
+            lkeys, lvals, nl, av, na, out, len(out),
+        )
+        return out.value.decode() if found else None
+
+
+def create() -> Optional[NativeScheduler]:
+    lib = _load_library()
+    if lib is None:
+        return None
+    return NativeScheduler(lib)

@@ -1,0 +1,299 @@
+// Native cluster resource scheduler.
+//
+// TPU-era equivalent of the reference's C++ scheduling stack
+// (src/ray/common/scheduling/: FixedPoint `fixed_point.h`, interned
+// resource ids `scheduling_ids.h`, `ResourceSet`/`NodeResources`
+// `cluster_resource_data.h`; policy selection
+// src/ray/raylet/scheduling/cluster_resource_scheduler.cc:155
+// GetBestSchedulableNode and scheduling/policy/*:
+// hybrid pack-then-spread, spread, node-affinity, node-label).
+//
+// Runs in-process inside the head service (single lease authority), loaded
+// via ctypes. Resource quantities are fixed-point int64 (scale 1e4) so
+// repeated acquire/release cycles can never drift the way float arithmetic
+// does; resource names are interned to small ids once per scheduler so the
+// hot best-node scan compares integers, not strings.
+//
+// Policy semantics intentionally match the Python fallback in
+// ray_tpu/_private/gcs.py::HeadService._pick_node so the two paths are
+// interchangeable and cross-checked by tests:
+//   - candidates: alive, optional hard node-affinity, label equality, fits
+//   - soft avoid-list: filtered only when an alternative fits
+//   - pack (default): min (sum of available, node_id) — binpack onto the
+//     most-utilized node, stable by id
+//   - spread: round-robin cursor over fitting candidates
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+constexpr int64_t kScale = 10000;  // 1e-4 resource granularity
+
+int64_t ToFixed(double v) {
+  return static_cast<int64_t>(v * kScale + (v >= 0 ? 0.5 : -0.5));
+}
+
+double FromFixed(int64_t v) { return static_cast<double>(v) / kScale; }
+
+struct Node {
+  std::string id;
+  bool alive = true;
+  // Indexed by interned resource id; missing ids mean 0.
+  std::vector<int64_t> total;
+  std::vector<int64_t> available;
+  std::unordered_map<std::string, std::string> labels;
+
+  int64_t Get(const std::vector<int64_t>& vec, size_t rid) const {
+    return rid < vec.size() ? vec[rid] : 0;
+  }
+  void Set(std::vector<int64_t>& vec, size_t rid, int64_t v) {
+    if (rid >= vec.size()) vec.resize(rid + 1, 0);
+    vec[rid] = v;
+  }
+};
+
+struct Sched {
+  // Interned resource names (reference: scheduling_ids.h string interning).
+  std::vector<std::string> resource_names;
+  std::unordered_map<std::string, size_t> resource_ids;
+  // Insertion-ordered nodes (matches Python dict iteration order).
+  std::vector<Node> nodes;
+  std::unordered_map<std::string, size_t> node_index;
+  uint64_t rr = 0;  // spread round-robin cursor
+
+  size_t InternResource(const std::string& name) {
+    auto it = resource_ids.find(name);
+    if (it != resource_ids.end()) return it->second;
+    size_t id = resource_names.size();
+    resource_names.push_back(name);
+    resource_ids.emplace(name, id);
+    return id;
+  }
+
+  Node* Find(const char* node_id) {
+    auto it = node_index.find(node_id);
+    return it == node_index.end() ? nullptr : &nodes[it->second];
+  }
+};
+
+// A resolved resource demand: interned ids + fixed-point amounts.
+struct Demand {
+  std::vector<size_t> ids;
+  std::vector<int64_t> amounts;
+};
+
+Demand ResolveDemand(Sched* s, const char** names, const double* vals, int n) {
+  Demand d;
+  d.ids.reserve(n);
+  d.amounts.reserve(n);
+  for (int i = 0; i < n; ++i) {
+    d.ids.push_back(s->InternResource(names[i]));
+    d.amounts.push_back(ToFixed(vals[i]));
+  }
+  return d;
+}
+
+bool Fits(const Node& node, const Demand& d) {
+  for (size_t i = 0; i < d.ids.size(); ++i) {
+    if (node.Get(node.available, d.ids[i]) < d.amounts[i]) return false;
+  }
+  return true;
+}
+
+int64_t SumAvailable(const Node& node) {
+  int64_t sum = 0;
+  for (int64_t v : node.available) sum += v;
+  return sum;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* rts_sched_new() { return new Sched(); }
+
+void rts_sched_free(void* h) { delete static_cast<Sched*>(h); }
+
+// Create (or reset) a node: clears resources/labels, marks alive.
+// Mirrors head re-registration, which rebuilds NodeInfo from scratch.
+int rts_sched_add_node(void* h, const char* node_id) {
+  Sched* s = static_cast<Sched*>(h);
+  Node* n = s->Find(node_id);
+  if (n == nullptr) {
+    s->node_index.emplace(node_id, s->nodes.size());
+    s->nodes.emplace_back();
+    n = &s->nodes.back();
+    n->id = node_id;
+  } else {
+    n->total.clear();
+    n->available.clear();
+    n->labels.clear();
+  }
+  n->alive = true;
+  return 0;
+}
+
+int rts_sched_remove_node(void* h, const char* node_id) {
+  Sched* s = static_cast<Sched*>(h);
+  auto it = s->node_index.find(node_id);
+  if (it == s->node_index.end()) return -1;
+  size_t idx = it->second;
+  s->nodes.erase(s->nodes.begin() + idx);
+  s->node_index.erase(it);
+  for (auto& kv : s->node_index) {
+    if (kv.second > idx) --kv.second;
+  }
+  return 0;
+}
+
+int rts_sched_set_alive(void* h, const char* node_id, int alive) {
+  Node* n = static_cast<Sched*>(h)->Find(node_id);
+  if (n == nullptr) return -1;
+  n->alive = alive != 0;
+  return 0;
+}
+
+// Sets a resource's total AND available (registration-time semantics).
+int rts_sched_set_resource(void* h, const char* node_id, const char* name,
+                           double total) {
+  Sched* s = static_cast<Sched*>(h);
+  Node* n = s->Find(node_id);
+  if (n == nullptr) return -1;
+  size_t rid = s->InternResource(name);
+  int64_t v = ToFixed(total);
+  n->Set(n->total, rid, v);
+  n->Set(n->available, rid, v);
+  return 0;
+}
+
+int rts_sched_set_label(void* h, const char* node_id, const char* key,
+                        const char* val) {
+  Node* n = static_cast<Sched*>(h)->Find(node_id);
+  if (n == nullptr) return -1;
+  n->labels[key] = val;
+  return 0;
+}
+
+// Unconditional subtract (callers check fit first, as the head does);
+// returns -1 only for unknown nodes.
+int rts_sched_acquire(void* h, const char* node_id, const char** names,
+                      const double* vals, int n) {
+  Sched* s = static_cast<Sched*>(h);
+  Node* node = s->Find(node_id);
+  if (node == nullptr) return -1;
+  Demand d = ResolveDemand(s, names, vals, n);
+  for (size_t i = 0; i < d.ids.size(); ++i) {
+    node->Set(node->available, d.ids[i],
+              node->Get(node->available, d.ids[i]) - d.amounts[i]);
+  }
+  return 0;
+}
+
+int rts_sched_release(void* h, const char* node_id, const char** names,
+                      const double* vals, int n) {
+  Sched* s = static_cast<Sched*>(h);
+  Node* node = s->Find(node_id);
+  if (node == nullptr) return -1;
+  Demand d = ResolveDemand(s, names, vals, n);
+  for (size_t i = 0; i < d.ids.size(); ++i) {
+    node->Set(node->available, d.ids[i],
+              node->Get(node->available, d.ids[i]) + d.amounts[i]);
+  }
+  return 0;
+}
+
+double rts_sched_available(void* h, const char* node_id, const char* name) {
+  Sched* s = static_cast<Sched*>(h);
+  Node* node = s->Find(node_id);
+  if (node == nullptr) return -1.0;
+  auto it = s->resource_ids.find(name);
+  if (it == s->resource_ids.end()) return 0.0;
+  return FromFixed(node->Get(node->available, it->second));
+}
+
+int rts_sched_fits(void* h, const char* node_id, const char** names,
+                   const double* vals, int n) {
+  Sched* s = static_cast<Sched*>(h);
+  Node* node = s->Find(node_id);
+  if (node == nullptr) return 0;
+  Demand d = ResolveDemand(s, names, vals, n);
+  return Fits(*node, d) ? 1 : 0;
+}
+
+int rts_sched_num_nodes(void* h) {
+  return static_cast<int>(static_cast<Sched*>(h)->nodes.size());
+}
+
+// Pick the best schedulable node (reference:
+// cluster_resource_scheduler.cc:155 GetBestSchedulableNode).
+//
+//   spread         0 = hybrid pack, 1 = spread (round-robin)
+//   affinity_node  hard node-affinity (NULL = any)
+//   label_keys/vals  required label equalities
+//   avoid          soft blocklist of node ids
+//
+// Returns 1 and writes the chosen node id into out (NUL-terminated) on
+// success; 0 if nothing fits.
+int rts_sched_best_node(void* h, const char** need_names,
+                        const double* need_vals, int n_need, int spread,
+                        const char* affinity_node, const char** label_keys,
+                        const char** label_vals, int n_labels,
+                        const char** avoid, int n_avoid, char* out,
+                        int out_cap) {
+  Sched* s = static_cast<Sched*>(h);
+  Demand d = ResolveDemand(s, need_names, need_vals, n_need);
+
+  std::vector<const Node*> fitting;
+  for (const Node& node : s->nodes) {
+    if (!node.alive) continue;
+    if (affinity_node != nullptr && node.id != affinity_node) continue;
+    bool labels_ok = true;
+    for (int i = 0; i < n_labels; ++i) {
+      auto it = node.labels.find(label_keys[i]);
+      if (it == node.labels.end() || it->second != label_vals[i]) {
+        labels_ok = false;
+        break;
+      }
+    }
+    if (!labels_ok) continue;
+    if (!Fits(node, d)) continue;
+    fitting.push_back(&node);
+  }
+
+  if (n_avoid > 0 && !fitting.empty()) {
+    std::unordered_set<std::string> avoid_set;
+    for (int i = 0; i < n_avoid; ++i) avoid_set.insert(avoid[i]);
+    std::vector<const Node*> preferred;
+    for (const Node* node : fitting) {
+      if (avoid_set.find(node->id) == avoid_set.end()) preferred.push_back(node);
+    }
+    if (!preferred.empty()) fitting = std::move(preferred);
+  }
+
+  if (fitting.empty()) return 0;
+
+  const Node* chosen;
+  if (spread) {
+    ++s->rr;
+    chosen = fitting[s->rr % fitting.size()];
+  } else {
+    chosen = *std::min_element(
+        fitting.begin(), fitting.end(), [](const Node* a, const Node* b) {
+          int64_t sa = SumAvailable(*a), sb = SumAvailable(*b);
+          if (sa != sb) return sa < sb;
+          return a->id < b->id;
+        });
+  }
+  size_t len = chosen->id.size();
+  if (len + 1 > static_cast<size_t>(out_cap)) return 0;
+  std::memcpy(out, chosen->id.c_str(), len + 1);
+  return 1;
+}
+
+}  // extern "C"

@@ -237,6 +237,22 @@ class OptunaSearch(Searcher):
             raise ValueError("OptunaSearch requires metric=")
         if mode not in ("min", "max"):
             raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
+        # Validate the whole space up front: a bad domain must fail at
+        # configuration time, not abort a running experiment at the first
+        # suggest() call.
+        for name, domain in param_space.items():
+            if isinstance(domain, dict) and "grid_search" in domain:
+                raise ValueError(
+                    f"OptunaSearch does not support grid_search (param "
+                    f"{name!r}): TPE samples and cannot guarantee every "
+                    f"grid value runs — use choice() or the default "
+                    f"BasicVariantGenerator"
+                )
+            if isinstance(domain, dict):
+                raise ValueError(
+                    f"OptunaSearch does not support nested spaces "
+                    f"(param {name!r}); flatten the space"
+                )
         self._optuna = optuna
         self._space = param_space
         self._metric = metric
@@ -295,20 +311,8 @@ class OptunaSearch(Searcher):
         for name, domain in self._space.items():
             if isinstance(domain, Domain):
                 cfg[name] = self._suggest_value(trial, name, domain)
-            elif isinstance(domain, dict) and "grid_search" in domain:
-                raise ValueError(
-                    f"OptunaSearch does not support grid_search (param "
-                    f"{name!r}): TPE samples and cannot guarantee every "
-                    f"grid value runs — use choice() or the default "
-                    f"BasicVariantGenerator"
-                )
-            elif isinstance(domain, dict):
-                raise ValueError(
-                    f"OptunaSearch does not support nested spaces "
-                    f"(param {name!r}); flatten the space"
-                )
             else:
-                cfg[name] = domain
+                cfg[name] = domain  # constants (dicts rejected in __init__)
         return cfg
 
     def on_trial_complete(self, trial_id: str, result: Optional[dict],

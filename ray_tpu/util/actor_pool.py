@@ -5,6 +5,7 @@ Reference analog: ``python/ray/util/actor_pool.py`` — ``map``/
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Iterable, Iterator, List, Optional
 
 
@@ -66,8 +67,16 @@ class ActorPool:
 
         if not self.has_next():
             raise StopIteration("no pending results")
+        deadline = (
+            time.monotonic() + timeout if timeout is not None else None
+        )
         while self._next_return not in self._buffered:
-            self._wait_one(timeout)
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError("no actor result ready in time")
+            self._wait_one(remaining)
         idx = self._next_return
         ref = self._buffered.pop(idx)
         self._advance_cursor(idx)

@@ -70,6 +70,9 @@ class Queue:
 
         opts = dict(actor_options or {})
         opts.setdefault("max_concurrency", 64)
+        # The queue actor is pure coordination; it must not hold a CPU slot
+        # (on a 1-CPU cluster the default would starve the producer task).
+        opts.setdefault("num_cpus", 0)
         self._actor = ray_tpu.remote(_QueueActor).options(**opts).remote(
             maxsize
         )
