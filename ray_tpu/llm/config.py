@@ -18,12 +18,15 @@ from typing import Any, Dict, Optional, Sequence
 class LLMConfig:
     model_id: str = "gpt2-scratch"
     # Model: either explicit architecture numbers (fresh weights) or a path
-    # to a pickled {"config": GPT2Config kwargs, "params": pytree} bundle.
+    # to a pickled {"family": ..., "config": config kwargs, "params": pytree}
+    # bundle ("family" defaults to gpt2 for old bundles).
     model_source: Optional[str] = None
+    model_family: str = "gpt2"  # "gpt2" | "llama"
     vocab_size: int = 512
     max_seq_len: int = 1024
     num_layers: int = 4
     num_heads: int = 4
+    num_kv_heads: Optional[int] = None  # llama GQA; None = num_heads (MHA)
     embed_dim: int = 256
     dtype: str = "bfloat16"
 
@@ -42,16 +45,28 @@ class LLMConfig:
     def model_config(self):
         import jax.numpy as jnp
 
-        from ray_tpu.models.gpt2 import GPT2Config
-
-        return GPT2Config(
+        dtype = jnp.bfloat16 if self.dtype == "bfloat16" else jnp.float32
+        common = dict(
             vocab_size=self.vocab_size,
             max_seq_len=self.max_seq_len,
             num_layers=self.num_layers,
             num_heads=self.num_heads,
             embed_dim=self.embed_dim,
-            dtype=jnp.bfloat16 if self.dtype == "bfloat16" else jnp.float32,
+            dtype=dtype,
             attention_impl="xla",
+        )
+        if self.model_family == "llama":
+            from ray_tpu.models.llama import LlamaConfig
+
+            return LlamaConfig(
+                num_kv_heads=self.num_kv_heads or self.num_heads, **common
+            )
+        if self.model_family == "gpt2":
+            from ray_tpu.models.gpt2 import GPT2Config
+
+            return GPT2Config(**common)
+        raise ValueError(
+            f"unknown model_family {self.model_family!r} (gpt2 | llama)"
         )
 
     def to_dict(self) -> dict:
@@ -80,8 +95,10 @@ class ByteTokenizer:
         return [b + 2 for b in text.encode("utf-8")]
 
     def decode(self, ids) -> str:
+        # Total over any model vocab: ids beyond the byte range (the model
+        # may have vocab_size > 258) decode to nothing rather than raising.
         return bytes(
-            i - 2 for i in ids if i >= 2
+            i - 2 for i in ids if 2 <= i <= 257
         ).decode("utf-8", errors="replace")
 
 

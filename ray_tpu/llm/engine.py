@@ -52,14 +52,12 @@ class DecodeEngine:
         import jax
         import jax.numpy as jnp
 
-        from ray_tpu.models import gpt2
+        from ray_tpu.models import module_for
 
         self.config = config
         self.model_config = config.model_config()
         if params is None and config.model_source:
             import pickle
-
-            from ray_tpu.models.gpt2 import GPT2Config
 
             with open(config.model_source, "rb") as f:
                 bundle = pickle.load(f)
@@ -67,23 +65,32 @@ class DecodeEngine:
             if "config" in bundle:
                 # checkpoint architecture wins over LLMConfig defaults — a
                 # mismatch would allocate a KV cache with the wrong layout
-                self.model_config = GPT2Config(**bundle["config"])
-        if self.model_config.moe is not None:
+                family = bundle.get("family", self.config.model_family)
+                if family == "llama":
+                    from ray_tpu.models.llama import LlamaConfig
+
+                    self.model_config = LlamaConfig(**bundle["config"])
+                else:
+                    from ray_tpu.models.gpt2 import GPT2Config
+
+                    self.model_config = GPT2Config(**bundle["config"])
+        if getattr(self.model_config, "moe", None) is not None:
             raise NotImplementedError("decode engine: dense models only")
+        model = module_for(self.model_config)
         self.tokenizer = load_tokenizer(config)
         if params is None:
-            params = gpt2.init_params(
+            params = model.init_params(
                 self.model_config, jax.random.PRNGKey(seed)
             )
         self.params = params
         B, S = config.max_batch_slots, config.max_seq_len
-        self._cache = gpt2.init_kv_cache(self.model_config, B, S)
+        self._cache = model.init_kv_cache(self.model_config, B, S)
         self._rng = np.random.RandomState(seed)
 
         cfg = self.model_config
 
         def prefill(params, tokens, cache1):
-            logits, cache1 = gpt2.forward_cached(
+            logits, cache1 = model.forward_cached(
                 params, tokens, cache1, jnp.zeros((1,), jnp.int32), cfg
             )
             return logits, cache1
@@ -97,13 +104,13 @@ class DecodeEngine:
             )
 
         def decode(params, tokens, cache, lens):
-            logits, cache = gpt2.forward_cached(params, tokens, cache, lens, cfg)
+            logits, cache = model.forward_cached(params, tokens, cache, lens, cfg)
             return logits[:, -1], cache
 
         self._prefill = jax.jit(prefill)
         self._insert = jax.jit(insert, donate_argnums=(0,))
         self._decode = jax.jit(decode, donate_argnums=(2,))
-        self._empty_slot_cache = lambda: gpt2.init_kv_cache(cfg, 1, S)
+        self._empty_slot_cache = lambda: model.init_kv_cache(cfg, 1, S)
 
         self._slots = [_Slot() for _ in range(B)]
         self._pending: "queue.Queue" = queue.Queue()

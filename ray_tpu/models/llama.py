@@ -1,0 +1,380 @@
+"""Llama-family decoder in pure functional JAX: second flagship model.
+
+Covers the architecture family the reference serves through its LLM layer
+(vLLM engine passthrough, ``python/ray/llm/_internal/serve/engines/vllm/``;
+the reference ships no model code of its own): RMSNorm, rotary position
+embeddings (RoPE), SwiGLU MLP, grouped-query attention (GQA), untied LM
+head. Same TPU-first skeleton as :mod:`ray_tpu.models.gpt2`:
+
+- plain-pytree params with a parallel logical-axis tree for pjit sharding
+- one scanned super-layer (``lax.scan`` over depth), remat on the body
+- pluggable attention (xla | flash pallas | ring | ulysses)
+- bfloat16 activations over f32 params
+- static-shape KV cache (GQA-sized: kv heads, not query heads) for the
+  slot-based continuous-batching decode engine
+"""
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ray_tpu.ops.attention import attention
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    max_seq_len: int = 2048
+    num_layers: int = 16
+    num_heads: int = 16
+    num_kv_heads: int = 4            # GQA: kv heads < query heads
+    embed_dim: int = 1024
+    mlp_dim: Optional[int] = None    # default: 8/3 * E rounded to 128
+    rope_theta: float = 10000.0      # 500000.0 for llama-3-style long context
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    attention_impl: str = "auto"     # auto | xla | flash | ring | ulysses
+    remat: bool = True
+    seq_axis: str = "seq"
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+    @property
+    def hidden_dim(self) -> int:
+        if self.mlp_dim is not None:
+            return self.mlp_dim
+        h = int(self.embed_dim * 8 / 3)
+        return (h + 127) // 128 * 128
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.num_heads // self.num_kv_heads
+
+
+LLAMA_TINY = LlamaConfig(  # test size
+    vocab_size=512, max_seq_len=128, num_layers=2, num_heads=4,
+    num_kv_heads=2, embed_dim=64,
+)
+LLAMA_160M = LlamaConfig(
+    num_layers=12, num_heads=12, num_kv_heads=4, embed_dim=768,
+    vocab_size=32000,
+)
+LLAMA_1B = LlamaConfig(
+    num_layers=16, num_heads=32, num_kv_heads=8, embed_dim=2048,
+    max_seq_len=4096, rope_theta=500000.0,
+)
+LLAMA_8B = LlamaConfig(
+    num_layers=32, num_heads=32, num_kv_heads=8, embed_dim=4096,
+    mlp_dim=14336, max_seq_len=8192, vocab_size=128256, rope_theta=500000.0,
+)
+
+PRESETS = {
+    "llama-tiny": LLAMA_TINY,
+    "llama-160m": LLAMA_160M,
+    "llama-1b": LLAMA_1B,
+    "llama-8b": LLAMA_8B,
+}
+
+
+def init_params(config: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
+    """Block params carry a leading [num_layers] dim (scanned)."""
+    k = jax.random.split(key, 9)
+    E, H, KV, M, V, L, D = (
+        config.embed_dim, config.num_heads, config.num_kv_heads,
+        config.hidden_dim, config.vocab_size, config.num_layers,
+        config.head_dim,
+    )
+    pd = config.param_dtype
+    std = 0.02
+    res_std = std / (2 * L) ** 0.5
+
+    def normal(key, shape, s=std):
+        return (jax.random.normal(key, shape) * s).astype(pd)
+
+    return {
+        "wte": normal(k[0], (V, E)),
+        "blocks": {
+            "attn_norm": jnp.ones((L, E), pd),
+            "wq": normal(k[1], (L, E, H, D)),
+            "wk": normal(k[2], (L, E, KV, D)),
+            "wv": normal(k[3], (L, E, KV, D)),
+            "wo": normal(k[4], (L, H, D, E), res_std),
+            "mlp_norm": jnp.ones((L, E), pd),
+            "w_gate": normal(k[5], (L, E, M)),
+            "w_up": normal(k[6], (L, E, M)),
+            "w_down": normal(k[7], (L, M, E), res_std),
+        },
+        "norm_f": jnp.ones((E,), pd),
+        "lm_head": normal(k[8], (V, E)),
+    }
+
+
+def param_axes(config: LlamaConfig) -> Dict[str, Any]:
+    """Logical axis names per parameter (see sharding.DEFAULT_RULES).
+    kv-head dims use the "kv" axis (replicated by default — GQA kv heads
+    often don't divide the tensor axis; override rules to shard them)."""
+    return {
+        "wte": ("vocab", "embed"),
+        "blocks": {
+            "attn_norm": ("stage", "norm"),
+            "wq": ("stage", "embed", "heads", "head_dim"),
+            "wk": ("stage", "embed", "kv", "head_dim"),
+            "wv": ("stage", "embed", "kv", "head_dim"),
+            "wo": ("stage", "heads", "head_dim", "embed"),
+            "mlp_norm": ("stage", "norm"),
+            "w_gate": ("stage", "embed", "mlp"),
+            "w_up": ("stage", "embed", "mlp"),
+            "w_down": ("stage", "mlp", "embed"),
+        },
+        "norm_f": ("norm",),
+        "lm_head": ("vocab", "embed"),
+    }
+
+
+def _rms_norm(x, g, eps):
+    x32 = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt((x32 * x32).mean(-1, keepdims=True) + eps)
+    return (x32 * scale * g).astype(x.dtype)
+
+
+def _rope(x: jax.Array, pos: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding. x: [B, T, H, D], pos: [B, T] absolute positions."""
+    D = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, D // 2, dtype=jnp.float32) / (D // 2))
+    angles = pos[..., None].astype(jnp.float32) * freqs      # [B, T, D/2]
+    cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)     # [B, T, 1, D/2]
+    sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., : D // 2], x[..., D // 2:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
+
+
+def _repeat_kv(x: jax.Array, n: int) -> jax.Array:
+    """[B, T, KV, D] -> [B, T, KV*n, D] (GQA head expansion)."""
+    if n == 1:
+        return x
+    B, T, KV, D = x.shape
+    return jnp.broadcast_to(
+        x[:, :, :, None, :], (B, T, KV, n, D)
+    ).reshape(B, T, KV * n, D)
+
+
+def _attention_dispatch(config: LlamaConfig, q, k, v, mesh: Optional[Mesh]):
+    impl = config.attention_impl
+    if impl == "ring":
+        from ray_tpu.parallel.ring_attention import ring_attention
+
+        return ring_attention(q, k, v, mesh=mesh, axis=config.seq_axis,
+                              causal=True)
+    if impl == "ulysses":
+        from ray_tpu.parallel.ring_attention import ulysses_attention
+
+        return ulysses_attention(q, k, v, mesh=mesh, axis=config.seq_axis,
+                                 causal=True)
+    return attention(q, k, v, causal=True, impl=impl)
+
+
+def _block(config: LlamaConfig, mesh: Optional[Mesh], x, layer,
+           pos: jax.Array):
+    """One decoder block. x: [B, T, E], pos: [B, T] absolute positions."""
+    h = _rms_norm(x, layer["attn_norm"], config.rms_eps)
+    q = jnp.einsum("bte,ehd->bthd", h, layer["wq"].astype(h.dtype))
+    k = jnp.einsum("bte,ehd->bthd", h, layer["wk"].astype(h.dtype))
+    v = jnp.einsum("bte,ehd->bthd", h, layer["wv"].astype(h.dtype))
+    q = _rope(q, pos, config.rope_theta)
+    k = _rope(k, pos, config.rope_theta)
+    k = _repeat_kv(k, config.q_per_kv)
+    v = _repeat_kv(v, config.q_per_kv)
+    attn = _attention_dispatch(config, q, k, v, mesh)
+    x = x + jnp.einsum("bthd,hde->bte", attn, layer["wo"].astype(x.dtype))
+
+    h = _rms_norm(x, layer["mlp_norm"], config.rms_eps)
+    gate = jnp.einsum("bte,em->btm", h, layer["w_gate"].astype(h.dtype))
+    up = jnp.einsum("bte,em->btm", h, layer["w_up"].astype(h.dtype))
+    h = jax.nn.silu(gate) * up
+    h = jnp.einsum("btm,me->bte", h, layer["w_down"].astype(h.dtype))
+    return x + h
+
+
+def forward(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    config: LlamaConfig,
+    mesh: Optional[Mesh] = None,
+    rng: Optional[jax.Array] = None,  # unused; gpt2-interface parity
+) -> Tuple[jax.Array, jax.Array]:
+    """tokens [B, T] int32 -> (logits [B, T, V] f32, aux loss scalar=0)."""
+    del rng
+    B, T = tokens.shape
+    x = params["wte"][tokens].astype(config.dtype)
+    pos = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+
+    body = functools.partial(_block, config, mesh)
+    if config.remat:
+        body = jax.checkpoint(body)
+
+    def scan_fn(x, layer):
+        return body(x, layer, pos), None
+
+    x, _ = jax.lax.scan(scan_fn, x, params["blocks"])
+    x = _rms_norm(x, params["norm_f"], config.rms_eps)
+    logits = jnp.einsum("bte,ve->btv", x, params["lm_head"].astype(x.dtype))
+    return logits.astype(jnp.float32), jnp.float32(0.0)
+
+
+def init_kv_cache(config: LlamaConfig, batch: int, max_len: int,
+                  dtype=None) -> Dict[str, jax.Array]:
+    """Static-shape GQA cache: [L, B, S, KV, D] — kv heads only, an
+    H/KV-fold HBM saving over caching query-expanded heads."""
+    dtype = dtype or config.dtype
+    L, KV, D = config.num_layers, config.num_kv_heads, config.head_dim
+    shape = (L, batch, max_len, KV, D)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def forward_cached(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    cache: Dict[str, jax.Array],
+    start: jax.Array,
+    config: LlamaConfig,
+) -> tuple:
+    """Incremental forward with RoPE at absolute positions; same contract as
+    :func:`ray_tpu.models.gpt2.forward_cached` (static shapes; per-sequence
+    offsets via vmapped dynamic_update_slice)."""
+    B, T = tokens.shape
+    S = cache["k"].shape[2]
+    pos = start[:, None] + jnp.arange(T)[None, :]            # [B, T]
+    x = params["wte"][tokens].astype(config.dtype)
+
+    key_pos = jnp.arange(S)[None, None, :]
+    mask = key_pos <= pos[:, :, None]                        # [B, T, S]
+
+    def block(carry, layer_and_cache):
+        x = carry
+        layer, ck, cv = layer_and_cache
+        h = _rms_norm(x, layer["attn_norm"], config.rms_eps)
+        q = jnp.einsum("bte,ehd->bthd", h, layer["wq"].astype(h.dtype))
+        k_new = jnp.einsum("bte,ehd->bthd", h, layer["wk"].astype(h.dtype))
+        v_new = jnp.einsum("bte,ehd->bthd", h, layer["wv"].astype(h.dtype))
+        q = _rope(q, pos, config.rope_theta)
+        k_new = _rope(k_new, pos, config.rope_theta)
+        upd = jax.vmap(
+            lambda c, n, s: jax.lax.dynamic_update_slice(c, n, (s, 0, 0))
+        )
+        ck = upd(ck, k_new.astype(ck.dtype), start)          # [B, S, KV, D]
+        cv = upd(cv, v_new.astype(cv.dtype), start)
+        # GQA attention over the cache: group query heads per kv head.
+        g = config.q_per_kv
+        qg = q.reshape(B, T, config.num_kv_heads, g, config.head_dim)
+        scores = jnp.einsum("btkgd,bskd->bkgts", qg, ck).astype(jnp.float32)
+        scores = scores / jnp.sqrt(jnp.float32(config.head_dim))
+        scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        attn = jnp.einsum("bkgts,bskd->btkgd", probs, cv)
+        attn = attn.reshape(B, T, config.num_heads, config.head_dim)
+        x = x + jnp.einsum("bthd,hde->bte", attn, layer["wo"].astype(x.dtype))
+        h = _rms_norm(x, layer["mlp_norm"], config.rms_eps)
+        gate = jnp.einsum("bte,em->btm", h, layer["w_gate"].astype(h.dtype))
+        up = jnp.einsum("bte,em->btm", h, layer["w_up"].astype(h.dtype))
+        h = jax.nn.silu(gate) * up
+        x = x + jnp.einsum("btm,me->bte", h, layer["w_down"].astype(h.dtype))
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        block, x, (params["blocks"], cache["k"], cache["v"])
+    )
+    x = _rms_norm(x, params["norm_f"], config.rms_eps)
+    logits = jnp.einsum("bte,ve->btv", x, params["lm_head"].astype(x.dtype))
+    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
+def loss_fn(
+    params: Dict[str, Any],
+    batch: Dict[str, jax.Array],
+    config: LlamaConfig,
+    mesh: Optional[Mesh] = None,
+    pipeline_microbatches: Optional[int] = None,
+    rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Next-token cross entropy; same batch contract as gpt2.loss_fn."""
+    del rng
+    if "tokens" in batch:
+        inputs = batch["tokens"][:, :-1]
+        targets = batch["tokens"][:, 1:]
+    else:
+        inputs, targets = batch["inputs"], batch["targets"]
+    if pipeline_microbatches:
+        logits, aux = forward_pipelined(
+            params, inputs, config, mesh, pipeline_microbatches
+        )
+    else:
+        logits, aux = forward(params, inputs, config, mesh)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = batch.get("mask")
+    if mask is None:
+        return -ll.mean() + aux
+    return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1) + aux
+
+
+def forward_pipelined(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    config: LlamaConfig,
+    mesh: Mesh,
+    num_microbatches: int = 4,
+) -> Tuple[jax.Array, jax.Array]:
+    """Pipeline-parallel forward over the "stage" mesh axis (GPipe microbatch
+    loop, ``parallel.pipeline.pipeline_apply``); embedding/head outside."""
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.parallel.pipeline import pipeline_apply
+
+    B, T = tokens.shape
+    x = params["wte"][tokens].astype(config.dtype)
+    pos = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
+
+    body = functools.partial(_block, config, mesh)
+    if config.remat:
+        body = jax.checkpoint(body)
+
+    def apply_stage(local_blocks, mb):
+        # Microbatches split the batch dim; positions are batch-invariant.
+        mb_pos = pos[: mb.shape[0]]
+
+        def scan_fn(x, layer):
+            return body(x, layer, mb_pos), None
+
+        out, _ = jax.lax.scan(scan_fn, mb, local_blocks)
+        return out
+
+    params_spec = jax.tree.map(lambda _: P("stage"), params["blocks"])
+    x = pipeline_apply(
+        params["blocks"], x, mesh=mesh, apply_stage=apply_stage,
+        num_microbatches=num_microbatches, params_spec=params_spec,
+        x_spec=P(),
+    )
+    x = _rms_norm(x, params["norm_f"], config.rms_eps)
+    logits = jnp.einsum("bte,ve->btv", x, params["lm_head"].astype(x.dtype))
+    return logits.astype(jnp.float32), jnp.float32(0.0)
+
+
+def count_params(params) -> int:
+    return sum(int(x.size) for x in jax.tree.leaves(params))
+
+
+def flops_per_token(config: LlamaConfig) -> float:
+    """~6N FLOPs/token for training; N = non-embedding params."""
+    E, D = config.embed_dim, config.head_dim
+    attn = E * config.num_heads * D * 2 + E * config.num_kv_heads * D * 2
+    mlp = 3 * E * config.hidden_dim
+    n = config.num_layers * (attn + mlp) + config.vocab_size * E
+    return 6.0 * n

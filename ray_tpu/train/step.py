@@ -18,7 +18,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ray_tpu.models import gpt2
+from ray_tpu.models import module_for
 from ray_tpu.parallel.sharding import (
     DEFAULT_RULES,
     named_sharding,
@@ -50,8 +50,10 @@ class OptimizerConfig:
         )
 
 
-def param_shardings(mesh: Mesh, config: gpt2.GPT2Config, rules=None):
-    axes = gpt2.param_axes(config)
+def param_shardings(mesh: Mesh, config, rules=None):
+    """``config`` may be any model family's config (GPT2Config,
+    LlamaConfig, ...); dispatch goes through ``models.module_for``."""
+    axes = module_for(config).param_axes(config)
     return jax.tree.map(
         lambda a: named_sharding(mesh, a, rules),
         axes,
@@ -60,7 +62,7 @@ def param_shardings(mesh: Mesh, config: gpt2.GPT2Config, rules=None):
 
 
 def create_train_state(
-    config: gpt2.GPT2Config,
+    config,
     opt: optax.GradientTransformation,
     key: jax.Array,
     mesh: Optional[Mesh] = None,
@@ -68,14 +70,15 @@ def create_train_state(
 ) -> Dict[str, Any]:
     """Initialize {params, opt_state, step} directly sharded on the mesh
     (init under jit with out_shardings: no host-memory detour)."""
+    model = module_for(config)
     if mesh is None:
-        params = gpt2.init_params(config, key)
+        params = model.init_params(config, key)
         return {"params": params, "opt_state": opt.init(params), "step": 0}
 
     p_shard = param_shardings(mesh, config, rules)
 
     def init_fn(key):
-        params = gpt2.init_params(config, key)
+        params = model.init_params(config, key)
         return params
 
     params = jax.jit(init_fn, out_shardings=p_shard)(key)
@@ -90,7 +93,7 @@ def create_train_state(
 
 
 def make_train_step(
-    config: gpt2.GPT2Config,
+    config,
     opt: optax.GradientTransformation,
     mesh: Optional[Mesh] = None,
     rules=None,
@@ -106,7 +109,9 @@ def make_train_step(
     Stochastic layers (MoE router jitter) draw from a per-step key folded
     from ``seed`` and ``state["step"]``.
     """
-    needs_rng = config.moe is not None and config.moe.router_jitter > 0
+    model = module_for(config)
+    moe = getattr(config, "moe", None)
+    needs_rng = moe is not None and moe.router_jitter > 0
     p_shard = (
         param_shardings(mesh, config, rules)
         if (mesh is not None and rules is not None)
@@ -114,7 +119,7 @@ def make_train_step(
     )
 
     def loss(params, batch, rng):
-        return gpt2.loss_fn(
+        return model.loss_fn(
             params, batch, config, mesh,
             pipeline_microbatches=pipeline_microbatches, rng=rng,
         )
@@ -150,8 +155,10 @@ def make_train_step(
     return jax.jit(step_fn, donate_argnums=(0,) if donate else ())
 
 
-def make_eval_step(config: gpt2.GPT2Config, mesh=None) -> Callable:
+def make_eval_step(config, mesh=None) -> Callable:
+    model = module_for(config)
+
     def eval_fn(params, batch):
-        return gpt2.loss_fn(params, batch, config, mesh)
+        return model.loss_fn(params, batch, config, mesh)
 
     return jax.jit(eval_fn)

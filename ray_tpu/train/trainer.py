@@ -97,7 +97,7 @@ def default_jax_train_loop(config: Dict[str, Any]):
     import jax.numpy as jnp
     import numpy as np
 
-    from ray_tpu.models import gpt2
+    from ray_tpu.models import get_preset
     from ray_tpu.parallel.mesh import MeshConfig
     from ray_tpu.train import checkpoint as ckpt_mod
     from ray_tpu.train.context import get_checkpoint, get_context, report
@@ -109,14 +109,22 @@ def default_jax_train_loop(config: Dict[str, Any]):
 
     ctx = get_context()
     model = config.get("model", {})
-    if isinstance(model, str):  # zoo preset, e.g. "gpt2-small"
-        model_cfg = gpt2.PRESETS[model]
+    if isinstance(model, str):  # zoo preset, e.g. "gpt2-small" / "llama-1b"
+        model_cfg = get_preset(model)
     else:
         model = dict(model)
+        family = model.pop("family", "gpt2")
         for k in ("dtype", "param_dtype"):
             if isinstance(model.get(k), str):
                 model[k] = jnp.dtype(model[k]).type
-        model_cfg = gpt2.GPT2Config(**model)
+        if family == "llama":
+            from ray_tpu.models.llama import LlamaConfig
+
+            model_cfg = LlamaConfig(**model)
+        else:
+            from ray_tpu.models.gpt2 import GPT2Config
+
+            model_cfg = GPT2Config(**model)
     mesh = MeshConfig(**config.get("mesh", {"data": -1})).build()
     opt_cfg = OptimizerConfig(**config.get("optimizer", {}))
     opt = opt_cfg.build()
