@@ -5,12 +5,18 @@ RLModule + Learner/LearnerGroup + Algorithm/AlgorithmConfig).
 """
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, make_trainable
 from ray_tpu.rllib.algorithms import (
+    BC,
+    BCConfig,
     DQN,
     DQNConfig,
     IMPALA,
     IMPALAConfig,
+    MARWIL,
+    MARWILConfig,
     PPO,
     PPOConfig,
+    SAC,
+    SACConfig,
 )
 from ray_tpu.rllib.env_runner import EnvRunnerGroup, SingleAgentEnvRunner
 from ray_tpu.rllib.learner import Learner, LearnerHyperparams
@@ -18,6 +24,7 @@ from ray_tpu.rllib.learner import Learner, LearnerHyperparams
 __all__ = [
     "Algorithm", "AlgorithmConfig", "make_trainable",
     "PPO", "PPOConfig", "IMPALA", "IMPALAConfig", "DQN", "DQNConfig",
+    "SAC", "SACConfig", "MARWIL", "MARWILConfig", "BC", "BCConfig",
     "EnvRunnerGroup", "SingleAgentEnvRunner",
     "Learner", "LearnerHyperparams",
 ]

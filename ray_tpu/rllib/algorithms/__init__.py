@@ -1,5 +1,10 @@
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
+from ray_tpu.rllib.algorithms.marwil import BC, BCConfig, MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 
-__all__ = ["PPO", "PPOConfig", "IMPALA", "IMPALAConfig", "DQN", "DQNConfig"]
+__all__ = [
+    "PPO", "PPOConfig", "IMPALA", "IMPALAConfig", "DQN", "DQNConfig",
+    "SAC", "SACConfig", "MARWIL", "MARWILConfig", "BC", "BCConfig",
+]

@@ -1,0 +1,279 @@
+"""MARWIL and BC: offline RL from logged episodes.
+
+Reference analog: ``rllib/algorithms/marwil/`` and ``rllib/algorithms/bc/``
+(BC subclasses MARWIL with beta=0). MARWIL is advantage-weighted behavior
+cloning: actions are imitated with weight exp(beta * advantage / c) where
+the advantage is (monte-carlo return - V(s)) and c is a running scale
+normalizer; beta=0 degenerates to plain behavior cloning. Offline data comes
+from logged episodes (lists of dicts or a :class:`ray_tpu.data.Dataset`),
+not env runners; an environment is optional and used only for evaluation
+rollouts.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib import module as rl_module
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+
+
+class _NullRunnerGroup:
+    """Stands in when no evaluation env is configured."""
+
+    def sample(self):
+        return []
+
+    def metrics(self):
+        return []
+
+    def sync_weights(self, params):
+        pass
+
+    def stop(self):
+        pass
+
+
+def episodes_to_transitions(
+    episodes: List[Dict[str, Any]], gamma: float
+) -> Dict[str, np.ndarray]:
+    """Flatten episodes into {obs, actions, returns} with discounted
+    monte-carlo returns per step (the MARWIL advantage target)."""
+    all_obs, all_act, all_ret = [], [], []
+    for ep in episodes:
+        obs = np.asarray(ep["obs"], np.float32)
+        act = np.asarray(ep["actions"])
+        rew = np.asarray(ep["rewards"], np.float32)
+        T = len(rew)
+        ret = np.zeros(T, np.float32)
+        acc = 0.0
+        for t in range(T - 1, -1, -1):
+            acc = rew[t] + gamma * acc
+            ret[t] = acc
+        all_obs.append(obs[:T])
+        all_act.append(act[:T])
+        all_ret.append(ret)
+    return {
+        "obs": np.concatenate(all_obs),
+        "actions": np.concatenate(all_act),
+        "returns": np.concatenate(all_ret),
+    }
+
+
+class MARWILConfig(AlgorithmConfig):
+    algo_name = "marwil"
+
+    def __init__(self):
+        super().__init__()
+        self.training(lr=1e-3, gamma=0.99)
+        self.beta = 1.0                # 0 = BC
+        self.vf_coeff = 1.0
+        self.learn_batch_size = 256
+        self.updates_per_step = 32
+        self.moving_avg_coeff = 1e-2   # running normalizer for exp weights
+        self.max_weight = 20.0
+        self.episodes: Optional[List[Dict[str, Any]]] = None
+        self.dataset = None            # ray_tpu.data.Dataset of episode rows
+        self.evaluation_env = True     # rollout eval when an env is set
+
+    def offline_data(self, *, episodes=None, dataset=None):
+        """Provide logged episodes: a list of {obs, actions, rewards} dicts
+        or a ray_tpu.data.Dataset whose rows are such episodes."""
+        self.episodes = episodes
+        self.dataset = dataset
+        return self
+
+    def build_algo(self) -> "MARWIL":
+        return MARWIL(self)
+
+
+class BCConfig(MARWILConfig):
+    algo_name = "bc"
+
+    def __init__(self):
+        super().__init__()
+        self.beta = 0.0
+
+    def build_algo(self) -> "BC":
+        return BC(self)
+
+
+# BC is MARWIL with beta=0 (reference: rllib/algorithms/bc/bc.py)
+class MARWIL(Algorithm):
+    def __init__(self, config: MARWILConfig):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.config = config
+        episodes = list(config.episodes or [])
+        if config.dataset is not None:
+            episodes.extend(config.dataset.take_all())
+        if not episodes:
+            raise ValueError(
+                "MARWIL/BC needs offline data: "
+                "config.offline_data(episodes=...) or (dataset=...)"
+            )
+        self.data = episodes_to_transitions(episodes, config.hp.gamma)
+        n = self.data["obs"].shape[0]
+
+        # module config: from the eval env when given, else from the data
+        if config.env is not None or config.env_creator is not None:
+            self._init_common(config)
+        else:
+            self.iteration = 0
+            self._total_env_steps = 0
+            self._last_step_count = 0
+            self._recent_returns = []
+            acts = self.data["actions"]
+            discrete = np.issubdtype(acts.dtype, np.integer)
+            self.module_config = rl_module.RLModuleConfig(
+                obs_dim=self.data["obs"].shape[1],
+                action_dim=(
+                    int(acts.max()) + 1 if discrete else acts.shape[1]
+                ),
+                discrete=discrete,
+            )
+        cfg = self.module_config
+        hp = config.hp
+
+        key = jax.random.PRNGKey(config.seed)
+        self.params = rl_module.init_params(cfg, key)
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(hp.grad_clip), optax.adam(hp.lr)
+        )
+        self.opt_state = self.optimizer.init(self.params)
+        self.c_sq = jnp.float32(1.0)  # running mean of advantage^2
+        self._rng = np.random.RandomState(config.seed)
+        self._n = n
+
+        beta = config.beta
+        vf_coeff = config.vf_coeff
+        ma = config.moving_avg_coeff
+        max_w = config.max_weight
+
+        def update(params, opt_state, c_sq, batch):
+            def loss_fn(p):
+                logp, _, value = rl_module.logp_entropy_value(
+                    p, cfg, batch["obs"], batch["actions"]
+                )
+                if beta > 0:
+                    adv = batch["returns"] - value
+                    c_sq_new = c_sq + ma * (jnp.mean(adv ** 2) - c_sq)
+                    w = jnp.exp(
+                        beta * jax.lax.stop_gradient(adv)
+                        / jnp.sqrt(c_sq_new + 1e-8)
+                    )
+                    w = jnp.minimum(w, max_w)
+                    pi_loss = -jnp.mean(jax.lax.stop_gradient(w) * logp)
+                    vf_loss = jnp.mean(adv ** 2)
+                    total = pi_loss + vf_coeff * vf_loss
+                else:
+                    # BC: pure behavior cloning — no advantage weights and
+                    # no value head training (reference: bc.py skips the
+                    # value branch entirely)
+                    pi_loss = -jnp.mean(logp)
+                    vf_loss = jnp.float32(0.0)
+                    c_sq_new = c_sq
+                    total = pi_loss
+                return total, (pi_loss, vf_loss, c_sq_new)
+
+            (total, (pi_l, vf_l, c_sq_new)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(params)
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params
+            )
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, c_sq_new, total, pi_l, vf_l
+
+        self._update = jax.jit(update)
+
+        if config.env is not None or config.env_creator is not None:
+            from ray_tpu.rllib.env_runner import EnvRunnerGroup
+
+            self.runner_group = EnvRunnerGroup(
+                config.get_env_creator(), config.num_env_runners,
+                config.num_envs_per_runner, config.rollout_fragment_length,
+                self.module_config, seed=config.seed, gamma=hp.gamma,
+            )
+            self.runner_group.sync_weights(jax.device_get(self.params))
+        else:
+            self.runner_group = _NullRunnerGroup()
+
+    # ---------------------------------------------------------------- train
+
+    def training_step(self) -> Dict[str, float]:
+        import jax
+        import jax.numpy as jnp
+
+        losses, pi_ls, vf_ls = [], [], []
+        bs = min(self.config.learn_batch_size, self._n)
+        for _ in range(self.config.updates_per_step):
+            idx = self._rng.randint(0, self._n, bs)
+            mb = {
+                "obs": jnp.asarray(self.data["obs"][idx]),
+                "actions": jnp.asarray(self.data["actions"][idx]),
+                "returns": jnp.asarray(self.data["returns"][idx]),
+            }
+            (self.params, self.opt_state, self.c_sq, total, pi_l, vf_l
+             ) = self._update(self.params, self.opt_state, self.c_sq, mb)
+            losses.append(float(total))
+            pi_ls.append(float(pi_l))
+            vf_ls.append(float(vf_l))
+        # evaluation rollouts (when an env is configured)
+        self.runner_group.sync_weights(jax.device_get(self.params))
+        frags = self.runner_group.sample()
+        if frags:
+            batch = self._build_batch(frags)
+            self._record_env_steps(batch)
+        else:
+            self._last_step_count = 0
+        return {
+            "total_loss": float(np.mean(losses)),
+            "policy_loss": float(np.mean(pi_ls)),
+            "vf_loss": float(np.mean(vf_ls)),
+            "num_offline_transitions": float(self._n),
+        }
+
+    # ------------------------------------------------------------ lifecycle
+
+    def get_weights(self):
+        import jax
+
+        return jax.device_get(self.params)
+
+    def save(self, path: str) -> str:
+        import os
+        import pickle
+
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "algorithm_state.pkl"), "wb") as f:
+            pickle.dump({
+                "params": jax.device_get(self.params),
+                "c_sq": float(self.c_sq),
+                "iteration": self.iteration,
+                "algo": self.config.algo_name,
+            }, f)
+        return path
+
+    def restore(self, path: str):
+        import os
+        import pickle
+
+        import jax
+        import jax.numpy as jnp
+
+        with open(os.path.join(path, "algorithm_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        self.params = jax.tree.map(jnp.asarray, state["params"])
+        self.c_sq = jnp.float32(state["c_sq"])
+        self.iteration = state["iteration"]
+        self.runner_group.sync_weights(jax.device_get(self.params))
+
+
+class BC(MARWIL):
+    """Behavior cloning = MARWIL with beta=0 (reference: bc.py)."""

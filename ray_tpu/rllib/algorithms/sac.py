@@ -1,0 +1,319 @@
+"""SAC: soft actor-critic for continuous control.
+
+Reference analog: ``rllib/algorithms/sac/`` (new API stack SAC). Off-policy
+maximum-entropy RL: a tanh-squashed gaussian policy (reparameterized), twin
+Q critics with clipped double-Q targets, polyak-averaged target critics, and
+automatic entropy-temperature tuning toward a target entropy of -action_dim.
+The whole update (critic + actor + alpha) is one jitted program over replay
+minibatches; runners explore with the same squashed-gaussian head via the
+normal weight broadcast.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from ray_tpu.rllib import module as rl_module
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+
+
+class ContinuousReplayBuffer:
+    """Flat numpy ring of (s, a, r, s', done) with float action vectors."""
+
+    def __init__(self, capacity: int, obs_dim: int, action_dim: int,
+                 seed: int = 0):
+        self.capacity = capacity
+        self.obs = np.zeros((capacity, obs_dim), np.float32)
+        self.next_obs = np.zeros((capacity, obs_dim), np.float32)
+        self.actions = np.zeros((capacity, action_dim), np.float32)
+        self.rewards = np.zeros((capacity,), np.float32)
+        self.dones = np.zeros((capacity,), np.float32)
+        self.size = 0
+        self._pos = 0
+        self._rng = np.random.RandomState(seed)
+
+    def add_fragments(self, batch: Dict[str, np.ndarray]):
+        """Consume a [T, N] fragment batch (transitions t -> t+1; the last
+        step of each column has no in-fragment successor and is dropped).
+        Time-limit-truncated steps are dropped entirely: their stored
+        next_obs is the reset observation and SAC has no trained V(s) to
+        fold a bootstrap into the reward with."""
+        obs, act = batch["obs"], batch["actions"]
+        rew, done = batch["rewards"], batch["dones"]
+        T = obs.shape[0]
+        if T < 2:
+            return
+        o = obs[:-1].reshape(-1, obs.shape[-1])
+        no = obs[1:].reshape(-1, obs.shape[-1])
+        a = act[:-1].reshape(-1, act.shape[-1])
+        r = rew[:-1].reshape(-1)
+        d = done[:-1].reshape(-1)
+        trunc = batch.get("truncateds")
+        if trunc is not None:
+            keep = trunc[:-1].reshape(-1) < 0.5
+            o, no, a, r, d = o[keep], no[keep], a[keep], r[keep], d[keep]
+        n = o.shape[0]
+        if n == 0:
+            return
+        if n >= self.capacity:
+            o, no, a, r, d = (x[-self.capacity:] for x in (o, no, a, r, d))
+            n = self.capacity
+        idx = (self._pos + np.arange(n)) % self.capacity
+        self.obs[idx] = o
+        self.next_obs[idx] = no
+        self.actions[idx] = a
+        self.rewards[idx] = r
+        self.dones[idx] = d
+        self._pos = (self._pos + n) % self.capacity
+        self.size = min(self.size + n, self.capacity)
+
+    def sample(self, n: int) -> Dict[str, np.ndarray]:
+        idx = self._rng.randint(0, self.size, n)
+        return {
+            "obs": self.obs[idx],
+            "next_obs": self.next_obs[idx],
+            "actions": self.actions[idx],
+            "rewards": self.rewards[idx],
+            "dones": self.dones[idx],
+        }
+
+
+class SACConfig(AlgorithmConfig):
+    algo_name = "sac"
+
+    def __init__(self):
+        super().__init__()
+        self.training(lr=3e-4, gamma=0.99)
+        self.replay_capacity = 100_000
+        self.learn_batch_size = 128
+        self.updates_per_step = 16
+        self.min_replay_size = 500
+        self.tau = 0.005                 # polyak rate for target critics
+        self.init_alpha = 0.1
+        self.target_entropy = None       # None -> -action_dim
+        self.critic_hidden = (128, 128)
+
+    def build_algo(self) -> "SAC":
+        return SAC(self)
+
+
+class SAC(Algorithm):
+    def __init__(self, config: SACConfig):
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self._init_common(config)
+        if self.module_config.discrete:
+            raise ValueError(
+                "SAC requires a continuous (Box) action space; "
+                f"{config.env or config.env_creator} has a discrete one"
+            )
+        self.module_config = dataclasses.replace(
+            self.module_config, exploration="squashed_gaussian"
+        )
+        cfg = self.module_config
+        hp = config.hp
+        A = cfg.action_dim
+        target_entropy = (
+            config.target_entropy
+            if config.target_entropy is not None else -float(A)
+        )
+
+        key = jax.random.PRNGKey(config.seed)
+        k_pi, k_q1, k_q2 = jax.random.split(key, 3)
+        self.pi_params = rl_module.init_params(cfg, k_pi)
+        q_sizes = [cfg.obs_dim + A, *config.critic_hidden, 1]
+        self.q_params = {
+            "q1": rl_module._init_mlp(k_q1, q_sizes, cfg.dtype),
+            "q2": rl_module._init_mlp(k_q2, q_sizes, cfg.dtype),
+        }
+        self.q_target = jax.tree.map(jnp.copy, self.q_params)
+        self.log_alpha = jnp.log(jnp.float32(config.init_alpha))
+
+        self.pi_opt = optax.adam(hp.lr)
+        self.q_opt = optax.adam(hp.lr)
+        self.alpha_opt = optax.adam(hp.lr)
+        self.pi_opt_state = self.pi_opt.init(self.pi_params)
+        self.q_opt_state = self.q_opt.init(self.q_params)
+        self.alpha_opt_state = self.alpha_opt.init(self.log_alpha)
+
+        self.buffer = ContinuousReplayBuffer(
+            config.replay_capacity, cfg.obs_dim, A, seed=config.seed
+        )
+        self._update_key = jax.random.PRNGKey(config.seed + 1)
+
+        gamma, tau = hp.gamma, config.tau
+
+        def q_value(qp, obs, act):
+            x = jnp.concatenate([obs, act], -1)
+            return rl_module._mlp(qp, x)[..., 0]
+
+        def update(pi_p, q_p, q_t, log_alpha, pi_os, q_os, a_os, batch, rng):
+            k_next, k_pi_new = jax.random.split(rng)
+            alpha = jnp.exp(log_alpha)
+
+            # ---- critic: clipped double-Q soft target
+            mean_n, logstd_n = rl_module.squashed_gaussian_dist(
+                pi_p, cfg, batch["next_obs"]
+            )
+            a_next, logp_next = rl_module.squashed_sample_logp(
+                mean_n, logstd_n, k_next
+            )
+            q_next = jnp.minimum(
+                q_value(q_t["q1"], batch["next_obs"], a_next),
+                q_value(q_t["q2"], batch["next_obs"], a_next),
+            )
+            target = batch["rewards"] + gamma * (1 - batch["dones"]) * (
+                q_next - alpha * logp_next
+            )
+            target = jax.lax.stop_gradient(target)
+
+            def critic_loss(q_p):
+                q1 = q_value(q_p["q1"], batch["obs"], batch["actions"])
+                q2 = q_value(q_p["q2"], batch["obs"], batch["actions"])
+                return jnp.mean((q1 - target) ** 2 + (q2 - target) ** 2)
+
+            c_loss, q_grads = jax.value_and_grad(critic_loss)(q_p)
+            q_upd, q_os = self.q_opt.update(q_grads, q_os, q_p)
+            import optax as _optax
+
+            q_p = _optax.apply_updates(q_p, q_upd)
+
+            # ---- actor: maximize E[min Q - alpha * logp] (reparameterized)
+            def actor_loss(pi_p):
+                mean, logstd = rl_module.squashed_gaussian_dist(
+                    pi_p, cfg, batch["obs"]
+                )
+                a_new, logp = rl_module.squashed_sample_logp(
+                    mean, logstd, k_pi_new
+                )
+                q_new = jnp.minimum(
+                    q_value(q_p["q1"], batch["obs"], a_new),
+                    q_value(q_p["q2"], batch["obs"], a_new),
+                )
+                return jnp.mean(alpha * logp - q_new), jnp.mean(logp)
+
+            (a_loss, mean_logp), pi_grads = jax.value_and_grad(
+                actor_loss, has_aux=True
+            )(pi_p)
+            pi_upd, pi_os = self.pi_opt.update(pi_grads, pi_os, pi_p)
+            pi_p = _optax.apply_updates(pi_p, pi_upd)
+
+            # ---- temperature: drive policy entropy toward target_entropy
+            def alpha_loss(log_a):
+                return -log_a * jax.lax.stop_gradient(
+                    mean_logp + target_entropy
+                )
+
+            al_loss, a_grad = jax.value_and_grad(alpha_loss)(log_alpha)
+            a_upd, a_os = self.alpha_opt.update(a_grad, a_os, log_alpha)
+            log_alpha = _optax.apply_updates(log_alpha, a_upd)
+
+            # ---- polyak target update
+            q_t = jax.tree.map(
+                lambda t, p: (1 - tau) * t + tau * p, q_t, q_p
+            )
+            metrics = {
+                "critic_loss": c_loss,
+                "actor_loss": a_loss,
+                "alpha_loss": al_loss,
+                "alpha": jnp.exp(log_alpha),
+                "entropy": -mean_logp,
+            }
+            return pi_p, q_p, q_t, log_alpha, pi_os, q_os, a_os, metrics
+
+        self._update = jax.jit(update)
+
+        from ray_tpu.rllib.env_runner import EnvRunnerGroup
+
+        self.runner_group = EnvRunnerGroup(
+            config.get_env_creator(), config.num_env_runners,
+            config.num_envs_per_runner, config.rollout_fragment_length,
+            self.module_config, seed=config.seed, gamma=hp.gamma,
+        )
+        self.runner_group.sync_weights(jax.device_get(self.pi_params))
+
+    # ---------------------------------------------------------------- train
+
+    def training_step(self) -> Dict[str, float]:
+        import jax
+        import jax.numpy as jnp
+
+        fragments = self.runner_group.sample()
+        if not fragments:
+            self._last_step_count = 0
+            return {"num_healthy_runners": 0}
+        batch = self._build_batch(fragments)
+        self.buffer.add_fragments(batch)
+        self._record_env_steps(batch)
+
+        metrics: Dict[str, float] = {"replay_size": float(self.buffer.size)}
+        if self.buffer.size >= self.config.min_replay_size:
+            last = {}
+            for _ in range(self.config.updates_per_step):
+                self._update_key, k = jax.random.split(self._update_key)
+                mb = {
+                    k2: jnp.asarray(v)
+                    for k2, v in self.buffer.sample(
+                        self.config.learn_batch_size
+                    ).items()
+                }
+                (self.pi_params, self.q_params, self.q_target,
+                 self.log_alpha, self.pi_opt_state, self.q_opt_state,
+                 self.alpha_opt_state, last) = self._update(
+                    self.pi_params, self.q_params, self.q_target,
+                    self.log_alpha, self.pi_opt_state, self.q_opt_state,
+                    self.alpha_opt_state, mb, k,
+                )
+            metrics.update({k: float(v) for k, v in last.items()})
+            metrics["total_loss"] = metrics.get("critic_loss", 0.0)
+        self.runner_group.sync_weights(jax.device_get(self.pi_params))
+        return metrics
+
+    # ------------------------------------------------------------ lifecycle
+
+    def get_weights(self):
+        import jax
+
+        return jax.device_get(self.pi_params)
+
+    def save(self, path: str) -> str:
+        import os
+        import pickle
+
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "algorithm_state.pkl"), "wb") as f:
+            pickle.dump({
+                "pi_params": jax.device_get(self.pi_params),
+                "q_params": jax.device_get(self.q_params),
+                "q_target": jax.device_get(self.q_target),
+                "log_alpha": float(self.log_alpha),
+                "iteration": self.iteration,
+                "total_env_steps": self._total_env_steps,
+                "algo": "sac",
+            }, f)
+        return path
+
+    def restore(self, path: str):
+        import os
+        import pickle
+
+        import jax
+        import jax.numpy as jnp
+
+        with open(os.path.join(path, "algorithm_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        as_jnp = lambda t: jax.tree.map(jnp.asarray, t)  # noqa: E731
+        self.pi_params = as_jnp(state["pi_params"])
+        self.q_params = as_jnp(state["q_params"])
+        self.q_target = as_jnp(state["q_target"])
+        self.log_alpha = jnp.float32(state["log_alpha"])
+        self.iteration = state["iteration"]
+        self._total_env_steps = state.get("total_env_steps", 0)
+        self.runner_group.sync_weights(jax.device_get(self.pi_params))

@@ -77,6 +77,7 @@ class SingleAgentEnvRunner:
         act_buf = np.empty(act_shape, act_dtype)
         rew_buf = np.empty((T, N), np.float32)
         done_buf = np.empty((T, N), np.float32)
+        trunc_buf = np.zeros((T, N), np.float32)
         logp_buf = np.empty((T, N), np.float32)
         val_buf = np.empty((T, N), np.float32)
 
@@ -91,9 +92,15 @@ class SingleAgentEnvRunner:
             for i, env in enumerate(self.envs):
                 a = action[i]
                 if not self.config.discrete:
-                    a = np.clip(
-                        a, env.action_space.low, env.action_space.high
-                    )
+                    low = env.action_space.low
+                    high = env.action_space.high
+                    if self.config.exploration == "squashed_gaussian":
+                        # SAC: tanh actions live in [-1, 1]; rescale to the
+                        # env bounds (the buffer keeps the policy-space
+                        # action from act_buf, not this env-space one).
+                        a = low + (a + 1.0) * 0.5 * (high - low)
+                    else:
+                        a = np.clip(a, low, high)
                 nobs, rew, term, trunc, _ = env.step(
                     a if not self.config.discrete else int(a)
                 )
@@ -103,14 +110,20 @@ class SingleAgentEnvRunner:
                 done = term or trunc
                 done_buf[t, i] = float(done)
                 if trunc and not term:
+                    trunc_buf[t, i] = 1.0
                     # Time-limit truncation is not a true terminal: fold the
                     # tail value into the reward (partial bootstrap), then
-                    # treat the step as done for advantage estimation.
-                    fv = self._value_fn(
-                        self.params,
-                        np.asarray(nobs, np.float32).ravel()[None, :],
-                    )
-                    rew_buf[t, i] += self.gamma * float(np.asarray(fv)[0])
+                    # treat the step as done for advantage estimation. NOT
+                    # for squashed_gaussian (SAC): its vf head is untrained,
+                    # so the fold would bake random-network output into
+                    # replay rewards — SAC instead drops truncation-boundary
+                    # transitions via the truncateds array.
+                    if self.config.exploration != "squashed_gaussian":
+                        fv = self._value_fn(
+                            self.params,
+                            np.asarray(nobs, np.float32).ravel()[None, :],
+                        )
+                        rew_buf[t, i] += self.gamma * float(np.asarray(fv)[0])
                 if done:
                     self._completed.append(
                         (self._ep_return[i], int(self._ep_len[i]))
@@ -123,7 +136,8 @@ class SingleAgentEnvRunner:
         self._total_steps += T * N
         return {
             "obs": obs_buf, "actions": act_buf, "rewards": rew_buf,
-            "dones": done_buf, "logp": logp_buf, "values": val_buf,
+            "dones": done_buf, "truncateds": trunc_buf,
+            "logp": logp_buf, "values": val_buf,
             "bootstrap_value": bootstrap,
         }
 

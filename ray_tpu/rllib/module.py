@@ -28,7 +28,9 @@ class RLModuleConfig:
     init_logstd: float = 0.0
     # "categorical" (PG methods) | "epsilon_greedy" (value methods: the pi
     # head outputs Q-values; exploration epsilon rides params["epsilon"] so
-    # decay flows to runners through weight sync).
+    # decay flows to runners through weight sync) | "squashed_gaussian"
+    # (SAC: the pi head outputs [mean, logstd] and actions are
+    # tanh-squashed samples).
     exploration: str = "categorical"
 
 
@@ -50,11 +52,17 @@ def _init_mlp(rng, sizes, dtype):
 def init_params(config: RLModuleConfig, rng) -> Dict[str, Any]:
     k_pi, k_vf = jax.random.split(rng)
     sizes = [config.obs_dim, *config.hidden]
+    # squashed_gaussian: state-dependent logstd rides the pi head
+    out_dim = (
+        2 * config.action_dim
+        if config.exploration == "squashed_gaussian"
+        else config.action_dim
+    )
     params = {
-        "pi": _init_mlp(k_pi, sizes + [config.action_dim], config.dtype),
+        "pi": _init_mlp(k_pi, sizes + [out_dim], config.dtype),
         "vf": _init_mlp(k_vf, sizes + [1], config.dtype),
     }
-    if not config.discrete:
+    if not config.discrete and config.exploration != "squashed_gaussian":
         params["logstd"] = jnp.full(
             (config.action_dim,), config.init_logstd, config.dtype
         )
@@ -83,8 +91,38 @@ def forward_value(params, config: RLModuleConfig, obs):
     return _mlp(params["vf"], obs)[..., 0]
 
 
+LOGSTD_MIN, LOGSTD_MAX = -20.0, 2.0
+
+
+def squashed_gaussian_dist(params, config: RLModuleConfig, obs):
+    """(mean, logstd) of the pre-tanh gaussian (SAC policy head)."""
+    out = forward_policy(params, config, obs)
+    mean, logstd = jnp.split(out, 2, axis=-1)
+    return mean, jnp.clip(logstd, LOGSTD_MIN, LOGSTD_MAX)
+
+
+def squashed_sample_logp(mean, logstd, rng):
+    """Reparameterized tanh-squashed sample and its log-prob."""
+    std = jnp.exp(logstd)
+    pre = mean + std * jax.random.normal(rng, mean.shape)
+    action = jnp.tanh(pre)
+    logp = _gaussian_logp(pre, mean, logstd)
+    # tanh change of variables (numerically stable form)
+    logp = logp - jnp.sum(
+        2.0 * (jnp.log(2.0) - pre - jax.nn.softplus(-2.0 * pre)), axis=-1
+    )
+    return action, logp
+
+
 def sample_action(params, config: RLModuleConfig, obs, rng):
     """(action, logp, value) for rollout collection — one fused jit."""
+    if config.exploration == "squashed_gaussian":
+        mean, logstd = squashed_gaussian_dist(params, config, obs)
+        action, logp = squashed_sample_logp(mean, logstd, rng)
+        # off-policy (replay) training: the runner-side value is unused;
+        # the vf head is untrained so returning it would bias bootstraps
+        value = jnp.zeros(logp.shape, mean.dtype)
+        return action, logp, value
     out = forward_policy(params, config, obs)
     value = forward_value(params, config, obs)
     if config.exploration == "epsilon_greedy":

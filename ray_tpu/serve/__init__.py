@@ -27,6 +27,7 @@ from ray_tpu.serve.deployment import (
     deployment,
 )
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.replica import GangContext, batch, get_gang_context
 
 __all__ = [
@@ -38,6 +39,8 @@ __all__ = [
     "GangContext",
     "batch",
     "get_gang_context",
+    "get_multiplexed_model_id",
+    "multiplexed",
     "delete",
     "deployment",
     "get_app_handle",

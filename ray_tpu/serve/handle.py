@@ -15,23 +15,80 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 
+class _StreamIterator:
+    """Pulls chunks of a replica-side generator (reference: streaming
+    DeploymentResponses / StreamingResponse). Iterating drives
+    ``next_chunks`` pulls; the router slot settles on exhaustion."""
+
+    def __init__(self, replica, stream_id: str, settle):
+        self._replica = replica
+        self._stream_id = stream_id
+        self._settle = settle
+        self._buf: list = []
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        import ray_tpu
+
+        while not self._buf:
+            if self._done:
+                raise StopIteration
+            try:
+                chunks, done = ray_tpu.get(
+                    self._replica.next_chunks.remote(self._stream_id),
+                    timeout=600,
+                )
+            except Exception:
+                self._done = True
+                self._settle()
+                raise
+            self._buf.extend(chunks)
+            if done:
+                self._done = True
+                self._settle()
+        return self._buf.pop(0)
+
+
 class DeploymentResponse:
     """Future-like result of ``handle.remote()`` (reference:
     ``serve/handle.py DeploymentResponse``)."""
 
-    def __init__(self, ref, router, replica_key):
+    def __init__(self, ref, router, replica_key, replica=None):
         self._ref = ref
         self._router = router
         self._key = replica_key
+        self._replica = replica
         self._done = False
 
     def result(self, timeout: Optional[float] = None):
         import ray_tpu
 
         try:
-            return ray_tpu.get(self._ref, timeout=timeout)
-        finally:
+            out = ray_tpu.get(self._ref, timeout=timeout)
+        except Exception:
             self._settle()
+            raise
+        if (
+            isinstance(out, dict)
+            and "__rt_stream__" in out
+            and self._replica is not None
+        ):
+            # generator deployment: hand back an iterator; the router slot
+            # stays held until the stream drains
+            return _StreamIterator(
+                self._replica, out["__rt_stream__"], self._settle
+            )
+        self._settle()
+        return out
+
+    def __iter__(self):
+        out = self.result()
+        if isinstance(out, _StreamIterator):
+            return out
+        return iter([out])
 
     def _settle(self):
         if not self._done:
@@ -55,6 +112,11 @@ class _Router:
         self._inflight: Dict[int, int] = {}
         self._fetched_at = -10.0
         self._lock = threading.Lock()
+        # Multiplexing: model_id -> {replica key}; only populated once a
+        # model-routed request has been seen (non-multiplexed deployments
+        # pay nothing).
+        self._multiplex = False
+        self._model_map: Dict[str, set] = {}
         # Autoscaling signal: refs of requests this handle has issued that
         # haven't completed yet (queued + executing), pushed to the
         # controller (reference: handle-side metrics in _private/router.py →
@@ -162,6 +224,17 @@ class _Router:
         except Exception:
             self._controller_handle = None  # stale after controller restart
             raise
+        model_map: Dict[str, set] = {}
+        if self._multiplex and handles:
+            try:
+                ids_per_replica = ray_tpu.get(
+                    [h.multiplexed_ids.remote() for h in handles], timeout=10
+                )
+                for h, ids in zip(handles, ids_per_replica):
+                    for m in ids:
+                        model_map.setdefault(m, set()).add(id(h))
+            except Exception:
+                model_map = {}  # affinity is an optimization, not required
         with self._lock:
             self._replicas = handles
             live = {id(h) for h in handles}
@@ -170,10 +243,16 @@ class _Router:
             }
             for h in handles:
                 self._inflight.setdefault(id(h), 0)
+            self._model_map = model_map
             self._fetched_at = now
 
-    def pick(self):
-        """Power-of-two-choices on locally tracked in-flight counts."""
+    def pick(self, model_id: Optional[str] = None):
+        """Power-of-two-choices on locally tracked in-flight counts; with a
+        model_id, replicas already holding that model are preferred
+        (reference: model-multiplex-aware routing)."""
+        if model_id and not self._multiplex:
+            self._multiplex = True
+            self._fetched_at = -10.0  # force a refresh with model info
         self._refresh()
         deadline = time.monotonic() + 30
         while not self._replicas:
@@ -184,10 +263,16 @@ class _Router:
             time.sleep(0.05)
             self._refresh(force=True)
         with self._lock:
-            if len(self._replicas) == 1:
-                chosen = self._replicas[0]
+            pool = self._replicas
+            if model_id:
+                holders = self._model_map.get(model_id, ())
+                preferred = [r for r in pool if id(r) in holders]
+                if preferred:
+                    pool = preferred
+            if len(pool) == 1:
+                chosen = pool[0]
             else:
-                a, b = random.sample(self._replicas, 2)
+                a, b = random.sample(pool, 2)
                 chosen = (
                     a if self._inflight.get(id(a), 0)
                     <= self._inflight.get(id(b), 0) else b
@@ -218,23 +303,50 @@ class _MethodCaller:
 
 
 class DeploymentHandle:
-    def __init__(self, deployment: str):
+    def __init__(self, deployment: str, _router: Optional[_Router] = None,
+                 _multiplexed_model_id: str = "", _stream: bool = False):
         self._deployment = deployment
-        self._router = _Router(deployment)
+        self._router = _router or _Router(deployment)
+        self._multiplexed_model_id = _multiplexed_model_id
+        self._stream = _stream
 
     @property
     def deployment_name(self) -> str:
         return self._deployment
 
+    def options(self, *, multiplexed_model_id: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
+        """Per-call options (reference: ``handle.options(...)``):
+        ``multiplexed_model_id`` routes to replicas holding that model and
+        is readable in the request via ``serve.get_multiplexed_model_id()``;
+        ``stream=True`` returns an iterator over a generator deployment's
+        chunks. The returned handle shares this handle's router state."""
+        return DeploymentHandle(
+            self._deployment,
+            _router=self._router,
+            _multiplexed_model_id=(
+                self._multiplexed_model_id
+                if multiplexed_model_id is None else multiplexed_model_id
+            ),
+            _stream=self._stream if stream is None else stream,
+        )
+
     def _call(self, method: str, args, kwargs) -> DeploymentResponse:
-        replica, key = self._router.pick()
+        model_id = self._multiplexed_model_id
+        replica, key = self._router.pick(model_id or None)
         try:
-            ref = replica.handle_request.remote(method, args, kwargs)
+            if model_id or self._stream:
+                ref = replica.handle_request.remote(
+                    method, args, kwargs,
+                    model_id=model_id or None, stream=self._stream,
+                )
+            else:
+                ref = replica.handle_request.remote(method, args, kwargs)
         except Exception:
             self._router.evict(key)
             raise
         self._router.track_request(ref)
-        return DeploymentResponse(ref, self._router, key)
+        return DeploymentResponse(ref, self._router, key, replica=replica)
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
         return self._call("__call__", args, kwargs)
@@ -245,4 +357,8 @@ class DeploymentHandle:
         return _MethodCaller(self, item)
 
     def __reduce__(self):
-        return (DeploymentHandle, (self._deployment,))
+        return (
+            DeploymentHandle,
+            (self._deployment, None, self._multiplexed_model_id,
+             self._stream),
+        )

@@ -65,6 +65,9 @@ class Replica:
                 _gang_ctx_var.reset(token)
         self._ongoing = 0
         self._total = 0
+        # live generator streams: stream_id -> [iter, last_access, model_id]
+        self._streams: Dict[str, list] = {}
+        self._stream_seq = 0
         if user_config is not None:
             self.reconfigure(user_config)
 
@@ -84,9 +87,91 @@ class Replica:
     def stats(self) -> dict:
         return {"ongoing": self._ongoing, "total": self._total}
 
-    async def handle_request(self, method: str, args, kwargs):
+    def multiplexed_ids(self) -> List[str]:
+        """Model ids THIS replica's instance holds (router affinity;
+        reference: replica-side model-id reporting in ``serve/multiplex.py``)."""
+        from ray_tpu.serve.multiplex import instance_model_ids
+
+        return instance_model_ids(self._instance)
+
+    # ------------------------------------------------------------ streaming
+
+    def _register_stream(self, gen, model_id: Optional[str]) -> dict:
+        self._stream_seq += 1
+        sid = f"s{self._stream_seq}"
+        self._streams[sid] = [gen, time.monotonic(), model_id]
+        return {"__rt_stream__": sid}
+
+    async def next_chunks(self, stream_id: str, max_n: int = 16):
+        """Pull up to max_n chunks; returns (chunks, done). Abandoned
+        streams are swept after 10 minutes idle; pulling a swept (or
+        unknown) stream raises instead of faking a clean end."""
+        now = time.monotonic()
+        for sid in [
+            s for s, rec in self._streams.items() if now - rec[1] > 600
+        ]:
+            self._streams.pop(sid, None)
+        rec = self._streams.get(stream_id)
+        if rec is None:
+            raise ValueError(
+                f"stream {stream_id} unknown or expired (streams idle "
+                f">600s are swept); chunks may have been lost"
+            )
+        gen, _, model_id = rec
+        rec[1] = now
+        # The generator body runs in THIS task (async gen) or an executor
+        # thread (sync gen), not the handle_request task that created the
+        # stream — restore its request context here.
         if self._gang_ctx is not None:
             _gang_ctx_var.set(self._gang_ctx)
+        if model_id is not None:
+            from ray_tpu.serve.multiplex import _set_request_model_id
+
+            _set_request_model_id(model_id)
+        chunks: List[Any] = []
+        try:
+            if inspect.isasyncgen(gen):
+                while len(chunks) < max_n:
+                    try:
+                        chunks.append(await gen.__anext__())
+                    except StopAsyncIteration:
+                        self._streams.pop(stream_id, None)
+                        return chunks, True
+            else:
+                import contextvars
+
+                loop = asyncio.get_running_loop()
+
+                def pull():
+                    out = []
+                    try:
+                        while len(out) < max_n:
+                            out.append(next(gen))
+                    except StopIteration:
+                        return out, True
+                    return out, False
+
+                call_ctx = contextvars.copy_context()
+                chunks, done = await loop.run_in_executor(
+                    None, lambda: call_ctx.run(pull)
+                )
+                if done:
+                    self._streams.pop(stream_id, None)
+                return chunks, done
+        except Exception:
+            self._streams.pop(stream_id, None)
+            raise
+        return chunks, False
+
+    async def handle_request(self, method: str, args, kwargs,
+                             model_id: Optional[str] = None,
+                             stream: bool = False):
+        if self._gang_ctx is not None:
+            _gang_ctx_var.set(self._gang_ctx)
+        if model_id is not None:
+            from ray_tpu.serve.multiplex import _set_request_model_id
+
+            _set_request_model_id(model_id)
         self._ongoing += 1
         self._total += 1
         try:
@@ -94,10 +179,17 @@ class Replica:
                 fn = self._instance
             else:
                 fn = getattr(self._instance, method)
+            if inspect.isasyncgenfunction(fn) or (
+                stream and inspect.isgeneratorfunction(fn)
+            ):
+                return self._register_stream(fn(*args, **kwargs), model_id)
             if inspect.iscoroutinefunction(fn) or (
                 hasattr(fn, "_is_serve_batch")
             ):
-                return await fn(*args, **kwargs)
+                out = await fn(*args, **kwargs)
+                if stream and inspect.isgenerator(out):
+                    return self._register_stream(out, model_id)
+                return out
             # Sync callables run on an executor thread: they may block (e.g.
             # a composition handle's .result()) and must not stall this
             # replica's event loop. copy_context carries the GangContext var
@@ -111,6 +203,8 @@ class Replica:
             )
             if inspect.isawaitable(out):
                 out = await out
+            if stream and inspect.isgenerator(out):
+                return self._register_stream(out, model_id)
             return out
         finally:
             self._ongoing -= 1
