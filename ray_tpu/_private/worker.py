@@ -205,6 +205,8 @@ class CoreWorker:
         self.hosted_actors: Dict[str, _ActorInstance] = {}
         self.task_executor: Optional[ThreadPoolExecutor] = None
         self.num_task_slots = int(self.node_resources.get("CPU", 1)) or 1
+        # Native transfer-server address, set in start() when available.
+        self.xfer_addr: Optional[Tuple[str, int]] = None
         self._shutdown = False
         self._stats = {"tasks_executed": 0, "tasks_submitted": 0}
         self._task_events_buf: List[dict] = []
@@ -258,6 +260,23 @@ class CoreWorker:
         )
         self.server = protocol.RpcServer(self._handle_rpc)
         self.addr = await self.server.start()
+        # Native object-transfer server (reference: the object_manager data
+        # plane, ``object_manager.h:128``): serves this worker's shm-backed
+        # objects over TCP so remote hosts pull bulk payloads through C++
+        # instead of the Python RPC plane. Binds the SAME host the RPC plane
+        # advertises (no wider), and starts in an executor — the first call
+        # may compile the library and must not stall the event loop.
+        if os.environ.get("RT_NATIVE_XFER", "1") != "0":
+            try:
+                from ray_tpu.native import xfer as native_xfer
+
+                port = await asyncio.get_running_loop().run_in_executor(
+                    None, native_xfer.start_server, self.addr[0]
+                )
+                if port:
+                    self.xfer_addr = (self.addr[0], port)
+            except Exception:
+                logger.debug("native xfer server unavailable", exc_info=True)
         self.gcs = await protocol.connect(
             self.gcs_addr, self._handle_rpc, name="gcs-client"
         )
@@ -465,7 +484,7 @@ class CoreWorker:
         if size <= INLINE_OBJECT_MAX:
             self.memory_store[hex_] = ("mem", frames)
         else:
-            meta = self.shm.put_frames(hex_, frames)
+            meta = self._with_xfer(self.shm.put_frames(hex_, frames))
             self.memory_store[hex_] = ("shm", meta)
             await self.gcs.call("object_register", {"oid": hex_, "meta": meta})
         ev = self.store_events.get(hex_)
@@ -514,9 +533,12 @@ class CoreWorker:
         if kind == "shm":
             frames = self.shm.get_frames(hex_, entry[1])
             if frames is None:
-                # Local mapping unavailable (this process has no arena, or
-                # the segment died with its creator): fall back to pulling
-                # the bytes from the owner over RPC.
+                # Not mappable here: bulk-fetch through the native transfer
+                # plane into a local segment (C++ end to end).
+                frames = await self._native_fetch(hex_, entry[1], deadline)
+            if frames is None:
+                # Native plane unavailable (or object lost): fall back to
+                # pulling the bytes from the owner over RPC.
                 try:
                     entry = await self._pull_from_owner(ref, deadline, inline=True)
                 except exc.RayTpuError as e:
@@ -530,6 +552,49 @@ class CoreWorker:
                 return exc.ObjectLostError(hex_, "shm segment missing")
             return self.ctx.deserialize_frames(frames)
         return exc.ObjectLostError(hex_, f"bad store entry {kind}")
+
+    def _with_xfer(self, meta: dict) -> dict:
+        """Stamp shm metadata with this worker's transfer-server address so
+        any process that cannot map the segment can bulk-fetch it natively."""
+        if meta is not None and self.xfer_addr is not None:
+            meta = dict(meta, xfer=list(self.xfer_addr))
+        return meta
+
+    async def _native_fetch(self, hex_: str, meta: dict, deadline=None):
+        """Fetch a remote shm object through the C++ transfer plane into a
+        local per-object segment; returns zero-copy frames or None. The
+        socket IO is bounded by the get() deadline."""
+        xfer = meta.get("xfer") if isinstance(meta, dict) else None
+        if not xfer:
+            return None
+        try:
+            from ray_tpu.native import xfer as native_xfer
+        except Exception:
+            return None
+        timeout_s = None
+        if deadline is not None:
+            timeout_s = deadline - time.monotonic()
+            if timeout_s <= 0:
+                return None
+        store = getattr(self.shm, "fallback", self.shm)
+        dest = store.seg_name(hex_)
+        loop = asyncio.get_running_loop()
+        new_meta = await loop.run_in_executor(
+            None, native_xfer.fetch_to_segment,
+            xfer[0], xfer[1], meta, hex_, dest, timeout_s,
+        )
+        if new_meta is None:
+            return None
+        frames = store.get_frames(hex_, new_meta)
+        if frames is not None:
+            if new_meta.get("size", 0) > 0:
+                # We materialized this local copy (size 0 = a complete copy
+                # already existed): own its unlink on free/evict.
+                store._created[hex_] = True
+            # Repeat gets must resolve locally, not re-stream the payload
+            # (the arena-meta miss would otherwise re-fetch every time).
+            self.memory_store[hex_] = ("shm", dict(new_meta))
+        return frames
 
     async def _wait_local(self, hex_: str, deadline):
         ev = self.store_events.get(hex_)
@@ -1452,7 +1517,9 @@ class CoreWorker:
             else:
                 oid = ObjectID.for_return(tid, i).hex()
                 # written into shm before this call returns: zero-copy safe
-                meta = self.shm.put_frames(oid, sobj.to_frames(copy=False))
+                meta = self._with_xfer(
+                    self.shm.put_frames(oid, sobj.to_frames(copy=False))
+                )
                 await self.gcs.call("object_register", {"oid": oid, "meta": meta})
                 rets.append({"kind": "shm", "meta": meta})
         return {"rets": rets}, out_frames

@@ -24,37 +24,63 @@ _lib = None
 _tried = False
 
 
-def _needs_build() -> bool:
-    return not os.path.exists(_LIB_PATH) or (
-        os.path.exists(_SRC)
-        and os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)
+def _lib_needs_build(lib_path: str, srcs) -> bool:
+    if not os.path.exists(lib_path):
+        return True
+    lib_mtime = os.path.getmtime(lib_path)
+    return any(
+        os.path.exists(s) and os.path.getmtime(s) > lib_mtime for s in srcs
     )
 
 
-def _build() -> bool:
-    """Build under an exclusive file lock: N workers can start concurrently
-    and must not relink the .so while another process dlopens it (the link
-    itself is also atomic — temp output + rename, see Makefile)."""
+def build_lib(make_target: str, lib_path: str, srcs) -> bool:
+    """Build one native library under an exclusive file lock: N workers can
+    start concurrently and must not relink the .so while another process
+    dlopens it (the link itself is also atomic — temp output + rename, see
+    Makefile). Shared by every native component's loader."""
     import fcntl
 
     try:
         with open(os.path.join(_DIR, ".build.lock"), "w") as lockf:
             fcntl.flock(lockf, fcntl.LOCK_EX)
-            if not _needs_build():  # another process built while we waited
-                return True
+            if not _lib_needs_build(lib_path, srcs):
+                return True  # another process built while we waited
             res = subprocess.run(
-                ["make", "-C", _DIR],
+                ["make", "-C", _DIR, make_target],
                 capture_output=True,
                 text=True,
                 timeout=120,
             )
     except (OSError, subprocess.TimeoutExpired) as e:
-        logger.warning("native build unavailable: %s", e)
+        logger.warning("native build (%s) unavailable: %s", make_target, e)
         return False
     if res.returncode != 0:
-        logger.warning("native build failed:\n%s", res.stderr[-2000:])
+        logger.warning(
+            "native build (%s) failed:\n%s", make_target, res.stderr[-2000:]
+        )
         return False
     return True
+
+
+def build_and_load(make_target: str, lib_path: str, srcs):
+    """Build (if stale) and dlopen one native library; None on failure.
+    Callers cache the handle and set up their own argtypes."""
+    if _lib_needs_build(lib_path, srcs):
+        if not build_lib(make_target, lib_path, srcs):
+            return None
+    try:
+        return ctypes.CDLL(lib_path)
+    except OSError as e:
+        logger.warning("native load of %s failed: %s", lib_path, e)
+        return None
+
+
+def _needs_build() -> bool:
+    return _lib_needs_build(_LIB_PATH, [_SRC])
+
+
+def _build() -> bool:
+    return build_lib("librt_native.so", _LIB_PATH, [_SRC])
 
 
 def load_library():

@@ -12,9 +12,10 @@ from __future__ import annotations
 import ctypes
 import logging
 import os
-import subprocess
 import threading
 from typing import Dict, Iterable, Optional
+
+from ray_tpu.native import build_and_load
 
 logger = logging.getLogger(__name__)
 
@@ -27,49 +28,14 @@ _lib = None
 _tried = False
 
 
-def _needs_build() -> bool:
-    return not os.path.exists(_LIB_PATH) or (
-        os.path.exists(_SRC)
-        and os.path.getmtime(_SRC) > os.path.getmtime(_LIB_PATH)
-    )
-
-
-def _build() -> bool:
-    import fcntl
-
-    try:
-        with open(os.path.join(_DIR, ".build.lock"), "w") as lockf:
-            fcntl.flock(lockf, fcntl.LOCK_EX)
-            if not _needs_build():
-                return True
-            res = subprocess.run(
-                ["make", "-C", _DIR, "librt_sched.so"],
-                capture_output=True,
-                text=True,
-                timeout=120,
-            )
-    except (OSError, subprocess.TimeoutExpired) as e:
-        logger.warning("native sched build unavailable: %s", e)
-        return False
-    if res.returncode != 0:
-        logger.warning("native sched build failed:\n%s", res.stderr[-2000:])
-        return False
-    return True
-
-
 def _load_library():
     global _lib, _tried
     with _lock:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if _needs_build():
-            if not _build():
-                return None
-        try:
-            lib = ctypes.CDLL(_LIB_PATH)
-        except OSError as e:
-            logger.warning("native sched load failed: %s", e)
+        lib = build_and_load("librt_sched.so", _LIB_PATH, [_SRC])
+        if lib is None:
             return None
 
         c_char_pp = ctypes.POINTER(ctypes.c_char_p)

@@ -1,0 +1,367 @@
+// Native object-transfer plane: bulk object fetch between hosts.
+//
+// TPU-era equivalent of the reference's object_manager data plane
+// (src/ray/object_manager/: ObjectManager object_manager.h:128, chunked
+// PushManager/PullManager, ObjectBufferPool) — the path that moves object
+// payloads BETWEEN machines. Intra-host sharing stays zero-copy through the
+// shm arena / per-object segments; this server exposes those same bytes
+// over TCP so a remote host's fetch never touches the Python RPC plane.
+//
+// Protocol (little-endian):
+//   request:  u32 magic "RTX1" | u8 kind (0 = shm segment, 1 = arena object)
+//             u16 len1, name1   (kind 0: segment name; kind 1: arena name)
+//             u16 len2, name2   (kind 1: object hex; else empty)
+//   response: u8 status (0 ok, 1 not found, 2 error) | u64 len | payload
+//
+// The payload is the segment's/object's raw bytes — the store's
+// [u32 nframes][u64 len]*n | frames layout — so the fetching side writes it
+// into a local segment verbatim and reads it with the normal store code.
+//
+// Server: one accept thread + one detached thread per connection (transfers
+// are long, connections few). Arena attachments are cached per arena name.
+// Serving pins arena objects via rt_obj_get/rt_obj_release; plain segments
+// stay readable through the mmap even if unlinked mid-transfer.
+
+#include <arpa/inet.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/mman.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+
+// Exported by arena_store.cc (linked into this same .so).
+extern "C" {
+int rt_arena_attach(const char* name);
+void* rt_arena_base(int handle);
+int64_t rt_obj_get(int handle, const char* object_hex, uint64_t* size_out);
+int rt_obj_release(int handle, const char* object_hex);
+}
+
+namespace {
+
+constexpr uint32_t kMagic = 0x31585452;  // "RTX1"
+
+// Only framework-owned shm names are served (segments "rt*", arenas "/rt*"):
+// the server must not let a peer read arbitrary host shared memory.
+bool AllowedName(const std::string& name) {
+  size_t i = (!name.empty() && name[0] == '/') ? 1 : 0;
+  return name.size() >= i + 2 && name[i] == 'r' && name[i + 1] == 't';
+}
+
+std::string ShmPath(const std::string& name) {
+  std::string n = name;
+  while (!n.empty() && n[0] == '/') n.erase(0, 1);
+  return "/dev/shm/" + n;
+}
+
+void SetIoTimeout(int fd, int timeout_ms) {
+  if (timeout_ms <= 0) return;
+  struct timeval tv;
+  tv.tv_sec = timeout_ms / 1000;
+  tv.tv_usec = (timeout_ms % 1000) * 1000;
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+}
+
+bool ReadFull(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = read(fd, p, n);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= r;
+  }
+  return true;
+}
+
+bool WriteFull(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = write(fd, p, n);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= r;
+  }
+  return true;
+}
+
+bool SendResponse(int fd, uint8_t status, const void* payload, uint64_t len) {
+  if (!WriteFull(fd, &status, 1)) return false;
+  if (!WriteFull(fd, &len, 8)) return false;
+  if (len > 0 && !WriteFull(fd, payload, len)) return false;
+  return true;
+}
+
+std::mutex g_arena_mu;
+std::unordered_map<std::string, int> g_arenas;  // arena name -> handle
+
+int ArenaHandle(const std::string& name) {
+  std::lock_guard<std::mutex> lock(g_arena_mu);
+  auto it = g_arenas.find(name);
+  if (it != g_arenas.end()) return it->second;
+  int h = rt_arena_attach(name.c_str());
+  if (h >= 0) g_arenas.emplace(name, h);
+  return h;
+}
+
+bool ReadName(int fd, std::string* out) {
+  uint16_t len;
+  if (!ReadFull(fd, &len, 2)) return false;
+  if (len > 4096) return false;
+  out->resize(len);
+  return len == 0 || ReadFull(fd, out->data(), len);
+}
+
+void ServeSegment(int fd, const std::string& name) {
+  std::string path = name;
+  int sfd = shm_open(path.c_str(), O_RDONLY, 0);
+  if (sfd < 0) {
+    SendResponse(fd, 1, nullptr, 0);
+    return;
+  }
+  struct stat st;
+  if (fstat(sfd, &st) != 0 || st.st_size <= 0) {
+    close(sfd);
+    SendResponse(fd, 2, nullptr, 0);
+    return;
+  }
+  void* base = mmap(nullptr, st.st_size, PROT_READ, MAP_SHARED, sfd, 0);
+  close(sfd);
+  if (base == MAP_FAILED) {
+    SendResponse(fd, 2, nullptr, 0);
+    return;
+  }
+  SendResponse(fd, 0, base, static_cast<uint64_t>(st.st_size));
+  munmap(base, st.st_size);
+}
+
+void ServeArenaObject(int fd, const std::string& arena,
+                      const std::string& hex) {
+  int h = ArenaHandle(arena);
+  if (h < 0) {
+    SendResponse(fd, 1, nullptr, 0);
+    return;
+  }
+  uint64_t size = 0;
+  int64_t off = rt_obj_get(h, hex.c_str(), &size);
+  if (off < 0) {
+    SendResponse(fd, 1, nullptr, 0);
+    return;
+  }
+  const char* base = static_cast<const char*>(rt_arena_base(h)) + off;
+  SendResponse(fd, 0, base, size);
+  rt_obj_release(h, hex.c_str());
+}
+
+void HandleConn(int fd) {
+  uint32_t magic;
+  uint8_t kind;
+  std::string name1, name2;
+  SetIoTimeout(fd, 120000);  // a wedged peer must not pin a thread forever
+  if (ReadFull(fd, &magic, 4) && magic == kMagic && ReadFull(fd, &kind, 1) &&
+      ReadName(fd, &name1) && ReadName(fd, &name2)) {
+    if (!AllowedName(name1)) {
+      SendResponse(fd, 2, nullptr, 0);
+    } else if (kind == 0) {
+      ServeSegment(fd, name1);
+    } else if (kind == 1) {
+      ServeArenaObject(fd, name1, name2);
+    } else {
+      SendResponse(fd, 2, nullptr, 0);
+    }
+  }
+  close(fd);
+}
+
+void AcceptLoop(int listen_fd) {
+  for (;;) {
+    int fd = accept(listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (errno == EINTR) continue;
+      return;  // listen socket closed
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::thread(HandleConn, fd).detach();
+  }
+}
+
+int Connect(const char* host, int port, int timeout_ms) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -errno;
+  struct sockaddr_in addr;
+  std::memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    close(fd);
+    return -EINVAL;
+  }
+  if (timeout_ms > 0) {
+    // bounded connect: non-blocking + poll, then back to blocking IO with
+    // SO_RCVTIMEO/SO_SNDTIMEO (get(timeout=...) must not hang on a wedged
+    // owner host)
+    int flags = fcntl(fd, F_GETFL, 0);
+    fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+    int rc = connect(fd, reinterpret_cast<struct sockaddr*>(&addr),
+                     sizeof(addr));
+    if (rc != 0 && errno == EINPROGRESS) {
+      fd_set wfds;
+      FD_ZERO(&wfds);
+      FD_SET(fd, &wfds);
+      struct timeval tv;
+      tv.tv_sec = timeout_ms / 1000;
+      tv.tv_usec = (timeout_ms % 1000) * 1000;
+      rc = select(fd + 1, nullptr, &wfds, nullptr, &tv);
+      if (rc <= 0) {
+        close(fd);
+        return rc == 0 ? -ETIMEDOUT : -errno;
+      }
+      int err = 0;
+      socklen_t elen = sizeof(err);
+      getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &elen);
+      if (err != 0) {
+        close(fd);
+        return -err;
+      }
+    } else if (rc != 0) {
+      int e = errno;
+      close(fd);
+      return -e;
+    }
+    fcntl(fd, F_SETFL, flags);
+    SetIoTimeout(fd, timeout_ms);
+  } else if (connect(fd, reinterpret_cast<struct sockaddr*>(&addr),
+                     sizeof(addr)) != 0) {
+    int e = errno;
+    close(fd);
+    return -e;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+bool SendName(int fd, const std::string& s) {
+  uint16_t len = static_cast<uint16_t>(s.size());
+  return WriteFull(fd, &len, 2) && (len == 0 || WriteFull(fd, s.data(), len));
+}
+
+}  // namespace
+
+extern "C" {
+
+// Start the transfer server on host:port (port 0 = ephemeral). Returns the
+// bound port, or -errno. The accept thread runs for the process lifetime.
+int rt_xfer_serve(const char* host, int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -errno;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  struct sockaddr_in addr;
+  std::memset(&addr, 0, sizeof(addr));
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    close(fd);
+    return -EINVAL;
+  }
+  if (bind(fd, reinterpret_cast<struct sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(fd, 64) != 0) {
+    int e = errno;
+    close(fd);
+    return -e;
+  }
+  socklen_t alen = sizeof(addr);
+  if (getsockname(fd, reinterpret_cast<struct sockaddr*>(&addr), &alen) != 0) {
+    int e = errno;
+    close(fd);
+    return -e;
+  }
+  std::thread(AcceptLoop, fd).detach();
+  return ntohs(addr.sin_port);
+}
+
+// Fetch an object from a remote transfer server into local shm segment
+// `dest_name`. kind 0: name1 = segment name; kind 1: name1 = arena name,
+// name2 = object hex. The payload lands in a temp segment and is published
+// to `dest_name` by atomic rename, so a segment under its final name is
+// always complete — concurrent fetchers that find it existing may read it
+// immediately. timeout_ms <= 0 means no IO bound. Returns the payload
+// size, -EEXIST if a complete copy already exists locally, or -errno.
+int64_t rt_xfer_fetch(const char* host, int port, int kind, const char* name1,
+                      const char* name2, const char* dest_name,
+                      int timeout_ms) {
+  int pre = shm_open(dest_name, O_RDONLY, 0);
+  if (pre >= 0) {
+    close(pre);
+    return -EEXIST;  // complete by the rename-publication invariant
+  }
+  int fd = Connect(host, port, timeout_ms);
+  if (fd < 0) return fd;
+  uint8_t k = static_cast<uint8_t>(kind);
+  if (!WriteFull(fd, &kMagic, 4) || !WriteFull(fd, &k, 1) ||
+      !SendName(fd, name1) || !SendName(fd, name2 ? name2 : "")) {
+    close(fd);
+    return -EIO;
+  }
+  uint8_t status;
+  uint64_t len;
+  if (!ReadFull(fd, &status, 1) || !ReadFull(fd, &len, 8)) {
+    close(fd);
+    return -EIO;
+  }
+  if (status != 0) {
+    close(fd);
+    return status == 1 ? -ENOENT : -EIO;
+  }
+  std::string tmp =
+      std::string(dest_name) + ".t" + std::to_string(getpid());
+  int dfd = shm_open(tmp.c_str(), O_RDWR | O_CREAT | O_EXCL, 0600);
+  if (dfd < 0) {
+    int e = errno;
+    close(fd);
+    return -e;
+  }
+  int64_t result = -EIO;
+  void* base = MAP_FAILED;
+  if (ftruncate(dfd, static_cast<off_t>(len ? len : 1)) == 0) {
+    base = mmap(nullptr, len ? len : 1, PROT_WRITE, MAP_SHARED, dfd, 0);
+  }
+  close(dfd);
+  if (base != MAP_FAILED) {
+    bool ok = len == 0 || ReadFull(fd, base, len);
+    munmap(base, len ? len : 1);
+    if (ok) {
+      // Atomic publication (POSIX shm lives in /dev/shm on Linux): readers
+      // can never observe a half-written segment under the final name.
+      if (rename(ShmPath(tmp).c_str(), ShmPath(dest_name).c_str()) == 0) {
+        result = static_cast<int64_t>(len);
+      } else {
+        result = -errno;
+      }
+    }
+  }
+  close(fd);
+  if (result < 0) shm_unlink(tmp.c_str());
+  return result;
+}
+
+}  // extern "C"

@@ -1,0 +1,103 @@
+"""ctypes wrapper for the native object-transfer plane (src/xfer.cc).
+
+TPU-era equivalent of the reference's object_manager push/pull data plane
+(``src/ray/object_manager/object_manager.h:128``): every worker runs one
+C++ TCP server thread that serves object payloads straight out of shm
+(per-object segments or the arena), and remote workers fetch them into a
+local segment without touching the Python RPC plane. Falls back silently —
+callers keep the asyncio inline-pull path when the library is unavailable.
+"""
+from __future__ import annotations
+
+import ctypes
+import errno as _errno
+import logging
+import os
+import threading
+from typing import Optional
+
+from ray_tpu.native import build_and_load
+
+logger = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "librt_xfer.so")
+_SRCS = [
+    os.path.join(_DIR, "src", "xfer.cc"),
+    os.path.join(_DIR, "src", "arena_store.cc"),
+]
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _load_library():
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        lib = build_and_load("librt_xfer.so", _LIB_PATH, _SRCS)
+        if lib is None:
+            return None
+        lib.rt_xfer_serve.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.rt_xfer_serve.restype = ctypes.c_int
+        lib.rt_xfer_fetch.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.rt_xfer_fetch.restype = ctypes.c_int64
+        _lib = lib
+        return _lib
+
+
+def start_server(host: str = "127.0.0.1") -> Optional[int]:
+    """Start this process's transfer server; returns the bound port or
+    None when the native library is unavailable. ``host`` should be the
+    same address the worker's RPC plane advertises — the transfer plane
+    must not be reachable more widely than the rest of the runtime."""
+    lib = _load_library()
+    if lib is None:
+        return None
+    port = lib.rt_xfer_serve(host.encode(), 0)
+    if port < 0:
+        logger.warning("xfer server failed to start: errno %d", -port)
+        return None
+    return port
+
+
+def fetch_to_segment(
+    host: str, port: int, meta: dict, object_hex: str, dest_seg: str,
+    timeout_s: Optional[float] = None,
+) -> Optional[dict]:
+    """Fetch a remote object into local segment ``dest_seg``. ``meta`` is
+    the object's directory metadata ({"seg": ...} or {"arena": ...}).
+    Returns per-segment metadata for the local store, or None on failure
+    (caller falls back to the RPC pull). ``timeout_s`` bounds connect and
+    every socket read/write."""
+    lib = _load_library()
+    if lib is None:
+        return None
+    if "seg" in meta:
+        kind, name1, name2 = 0, meta["seg"], ""
+    elif "arena" in meta:
+        kind, name1, name2 = 1, meta["arena"], object_hex
+    else:
+        return None
+    timeout_ms = int(timeout_s * 1000) if timeout_s else 600_000
+    n = lib.rt_xfer_fetch(
+        host.encode(), int(port), kind,
+        name1.encode(), name2.encode(), dest_seg.encode(), timeout_ms,
+    )
+    if n == -_errno.EEXIST:
+        # A complete local copy already exists (publication is by atomic
+        # rename, so existence implies completeness).
+        return {"seg": dest_seg, "size": 0}
+    if n < 0:
+        logger.debug(
+            "native fetch of %s from %s:%s failed: errno %d",
+            object_hex[:8], host, port, -n,
+        )
+        return None
+    return {"seg": dest_seg, "size": int(n)}

@@ -1,0 +1,157 @@
+"""Native object-transfer plane: C++ TCP server serving shm-backed objects.
+
+Mirrors the reference's object_manager transfer tests
+(src/ray/object_manager/: push/pull of chunked object payloads between
+nodes) against the ctypes-wrapped src/xfer.cc: segment-backed and
+arena-backed objects served over TCP into fresh local segments, plus the
+worker-level fetch path.
+"""
+import os
+import secrets
+
+import pytest
+
+from ray_tpu._private.object_store import LocalShmStore
+from ray_tpu.native import xfer as native_xfer
+
+
+@pytest.fixture(scope="module")
+def server_port():
+    port = native_xfer.start_server("127.0.0.1")
+    if port is None:
+        pytest.skip("native toolchain unavailable")
+    return port
+
+
+def _hex() -> str:
+    return secrets.token_hex(28)
+
+
+def test_fetch_segment_roundtrip(server_port):
+    src = LocalShmStore(prefix=f"rtsrc{os.getpid()}")
+    dst = LocalShmStore(prefix=f"rtdst{os.getpid()}")
+    oid = _hex()
+    frames = [b"header", os.urandom(200_000), b"", b"tail"]
+    meta = src.put_frames(oid, frames)
+    try:
+        new_meta = native_xfer.fetch_to_segment(
+            "127.0.0.1", server_port, meta, oid, dst.seg_name(oid)
+        )
+        assert new_meta is not None
+        assert new_meta["size"] == meta["size"]
+        got = dst.get_frames(oid, new_meta)
+        assert [bytes(f) for f in got] == frames
+        # concurrent-fetcher race: destination exists -> size-0 success
+        again = native_xfer.fetch_to_segment(
+            "127.0.0.1", server_port, meta, oid, dst.seg_name(oid)
+        )
+        assert again is not None and again["size"] == 0
+    finally:
+        dst._created[oid] = True
+        dst.free(oid)
+        src.free(oid, meta)
+
+
+def test_fetch_arena_object(server_port):
+    from ray_tpu.native import load_library
+    from ray_tpu.native.arena import NativeArenaStore
+
+    if load_library() is None:
+        pytest.skip("native arena unavailable")
+    name = f"/rtx_test_{os.getpid()}_{secrets.token_hex(4)}"
+    arena = NativeArenaStore(name, capacity=1 << 24)
+    dst = LocalShmStore(prefix=f"rtad{os.getpid()}")
+    oid = _hex()
+    frames = [os.urandom(64_000), b"x"]
+    meta = arena.put_frames(oid, frames)
+    assert meta is not None and meta["arena"] == name
+    try:
+        new_meta = native_xfer.fetch_to_segment(
+            "127.0.0.1", server_port, meta, oid, dst.seg_name(oid)
+        )
+        assert new_meta is not None and new_meta["size"] == meta["size"]
+        got = dst.get_frames(oid, new_meta)
+        assert [bytes(f) for f in got] == frames
+    finally:
+        dst._created[oid] = True
+        dst.free(oid)
+        arena.close_all()
+
+
+def test_fetch_missing_object(server_port):
+    dst = LocalShmStore(prefix=f"rtmiss{os.getpid()}")
+    oid = _hex()
+    out = native_xfer.fetch_to_segment(
+        "127.0.0.1", server_port, {"seg": "rt_no_such_segment"}, oid,
+        dst.seg_name(oid),
+    )
+    assert out is None
+    # failed fetch must not leave a destination segment behind
+    assert dst.get_frames(oid, {"seg": dst.seg_name(oid)}) is None
+
+
+def test_fetch_unreachable_server():
+    dst = LocalShmStore(prefix=f"rtun{os.getpid()}")
+    oid = _hex()
+    out = native_xfer.fetch_to_segment(
+        "127.0.0.1", 1, {"seg": "rt_x"}, oid, dst.seg_name(oid)
+    )
+    assert out is None
+
+
+def test_worker_native_fetch_path():
+    """The worker's _native_fetch materializes a foreign segment (one its
+    own store cannot resolve — the cross-machine case) via the C++ plane."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2, num_nodes=1)
+    try:
+        w = ray_tpu._private.worker.get_global_worker()
+        if w.xfer_addr is None:
+            pytest.skip("native xfer unavailable")
+        # "remote" object: lives under a prefix the worker's store does not
+        # use, so shm.get_frames(meta) would fail but the transfer plane
+        # serves it by segment name.
+        src = LocalShmStore(prefix=f"rtF{os.getpid()}")
+        oid = _hex()
+        frames = [b"abc", os.urandom(100_000)]
+        meta = dict(src.put_frames(oid, frames), xfer=list(w.xfer_addr))
+        try:
+            assert w.shm.get_frames(oid, {"seg": "rt_bogus"}) is None
+            got = w.run_sync(w._native_fetch(oid, meta))
+            assert got is not None
+            assert [bytes(f) for f in got] == frames
+        finally:
+            src.free(oid, meta)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_worker_meta_carries_xfer_addr():
+    """Large puts register directory metadata stamped with the owner's
+    transfer address, and the cluster still round-trips objects."""
+    import numpy as np
+
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=2, num_nodes=1)
+    try:
+        w = ray_tpu._private.worker.get_global_worker()
+        if w.xfer_addr is None:
+            pytest.skip("native xfer unavailable")
+        big = np.arange(300_000, dtype=np.int64)
+        ref = ray_tpu.put(big)
+        entry = w.memory_store.get(ref.id().hex())
+        assert entry[0] == "shm"
+        assert entry[1].get("xfer") == list(w.xfer_addr)
+        np.testing.assert_array_equal(ray_tpu.get(ref), big)
+
+        @ray_tpu.remote
+        def make():
+            return np.ones(200_000, dtype=np.float64)
+
+        out_ref = make.remote()
+        out = ray_tpu.get(out_ref)
+        assert out.shape == (200_000,)
+    finally:
+        ray_tpu.shutdown()
