@@ -1718,6 +1718,14 @@ class CoreWorker:
     def shutdown(self):
         self._shutdown = True
         ObjectRef._release_hook = None
+        if self.xfer_addr is not None:
+            try:
+                from ray_tpu.native import xfer as native_xfer
+
+                native_xfer.stop_server(self.xfer_addr[1])
+            except Exception:
+                pass
+            self.xfer_addr = None
         if self.loop is None:
             return
 

@@ -26,6 +26,7 @@
 #include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/mman.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
@@ -223,13 +224,12 @@ int Connect(const char* host, int port, int timeout_ms) {
     int rc = connect(fd, reinterpret_cast<struct sockaddr*>(&addr),
                      sizeof(addr));
     if (rc != 0 && errno == EINPROGRESS) {
-      fd_set wfds;
-      FD_ZERO(&wfds);
-      FD_SET(fd, &wfds);
-      struct timeval tv;
-      tv.tv_sec = timeout_ms / 1000;
-      tv.tv_usec = (timeout_ms % 1000) * 1000;
-      rc = select(fd + 1, nullptr, &wfds, nullptr, &tv);
+      // poll, not select: long-lived workers can hold >FD_SETSIZE
+      // descriptors, where FD_SET is a stack overflow
+      struct pollfd pfd;
+      pfd.fd = fd;
+      pfd.events = POLLOUT;
+      rc = poll(&pfd, 1, timeout_ms);
       if (rc <= 0) {
         close(fd);
         return rc == 0 ? -ETIMEDOUT : -errno;
@@ -266,10 +266,16 @@ bool SendName(int fd, const std::string& s) {
 
 }  // namespace
 
+namespace {
+// Live listen sockets by bound port (for rt_xfer_stop).
+std::mutex g_serve_mu;
+std::unordered_map<int, int> g_listeners;  // port -> listen fd
+}  // namespace
+
 extern "C" {
 
 // Start the transfer server on host:port (port 0 = ephemeral). Returns the
-// bound port, or -errno. The accept thread runs for the process lifetime.
+// bound port, or -errno. The accept thread runs until rt_xfer_stop.
 int rt_xfer_serve(const char* host, int port) {
   int fd = socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) return -errno;
@@ -295,8 +301,30 @@ int rt_xfer_serve(const char* host, int port) {
     close(fd);
     return -e;
   }
+  int bound = ntohs(addr.sin_port);
+  {
+    std::lock_guard<std::mutex> lock(g_serve_mu);
+    g_listeners[bound] = fd;
+  }
   std::thread(AcceptLoop, fd).detach();
-  return ntohs(addr.sin_port);
+  return bound;
+}
+
+// Stop a server started by rt_xfer_serve: closing the listen socket makes
+// the accept loop exit (in-flight transfers finish on their own threads).
+// A worker shutdown must not leave a listener serving this host's shm.
+int rt_xfer_stop(int port) {
+  int fd = -1;
+  {
+    std::lock_guard<std::mutex> lock(g_serve_mu);
+    auto it = g_listeners.find(port);
+    if (it == g_listeners.end()) return -ENOENT;
+    fd = it->second;
+    g_listeners.erase(it);
+  }
+  shutdown(fd, SHUT_RDWR);
+  close(fd);
+  return 0;
 }
 
 // Fetch an object from a remote transfer server into local shm segment

@@ -43,6 +43,8 @@ def _load_library():
             return None
         lib.rt_xfer_serve.argtypes = [ctypes.c_char_p, ctypes.c_int]
         lib.rt_xfer_serve.restype = ctypes.c_int
+        lib.rt_xfer_stop.argtypes = [ctypes.c_int]
+        lib.rt_xfer_stop.restype = ctypes.c_int
         lib.rt_xfer_fetch.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
@@ -67,6 +69,15 @@ def start_server(host: str = "127.0.0.1") -> Optional[int]:
     return port
 
 
+def stop_server(port: int) -> bool:
+    """Stop a server started by :func:`start_server` (closes the listener;
+    in-flight transfers drain on their own threads)."""
+    lib = _load_library()
+    if lib is None:
+        return False
+    return lib.rt_xfer_stop(int(port)) == 0
+
+
 def fetch_to_segment(
     host: str, port: int, meta: dict, object_hex: str, dest_seg: str,
     timeout_s: Optional[float] = None,
@@ -85,7 +96,9 @@ def fetch_to_segment(
         kind, name1, name2 = 1, meta["arena"], object_hex
     else:
         return None
-    timeout_ms = int(timeout_s * 1000) if timeout_s else 600_000
+    # never 0: the C side treats <=0 as "no IO bound", which would invert a
+    # nearly-expired deadline into unbounded blocking
+    timeout_ms = max(1, int(timeout_s * 1000)) if timeout_s else 600_000
     n = lib.rt_xfer_fetch(
         host.encode(), int(port), kind,
         name1.encode(), name2.encode(), dest_seg.encode(), timeout_ms,

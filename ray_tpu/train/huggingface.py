@@ -1,0 +1,86 @@
+"""HuggingFace Transformers integration for the Train layer.
+
+Reference analog: ``python/ray/train/huggingface/transformers/`` —
+``RayTrainReportCallback`` (HF Trainer callback that forwards logs and saved
+checkpoints to ``ray.train.report``) and ``prepare_trainer``. Usage inside a
+``TorchTrainer`` train_fn::
+
+    from ray_tpu.train.huggingface import RayTrainReportCallback, prepare_trainer
+
+    def train_fn(config):
+        trainer = transformers.Trainer(model=..., args=..., ...)
+        trainer = prepare_trainer(trainer)   # adds the report callback
+        trainer.train()
+
+Import-guarded: transformers is optional.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Optional
+
+try:
+    from transformers.trainer_callback import TrainerCallback
+except ImportError:  # pragma: no cover - transformers always in test image
+    TrainerCallback = object  # type: ignore[assignment,misc]
+
+
+class RayTrainReportCallback(TrainerCallback):
+    """Forward HF Trainer logs + checkpoints to ``ray_tpu.train.report``
+    (reference: ``train/huggingface/transformers/_transformers_utils.py``
+    RayTrainReportCallback). Metrics reported on every log; when the HF
+    Trainer saves a checkpoint, the next report attaches it."""
+
+    def __init__(self):
+        self._pending_checkpoint: Optional[str] = None
+
+    def on_save(self, args, state, control, **kwargs):
+        ckpt_dir = os.path.join(
+            args.output_dir, f"checkpoint-{state.global_step}"
+        )
+        if os.path.isdir(ckpt_dir):
+            self._pending_checkpoint = ckpt_dir
+        return control
+
+    def on_log(self, args, state, control, logs=None, **kwargs):
+        from ray_tpu.train import report
+        from ray_tpu.train.checkpoint import Checkpoint
+
+        metrics = dict(logs or {})
+        metrics.setdefault("step", state.global_step)
+        metrics.setdefault("epoch", state.epoch)
+        ckpt = None
+        if self._pending_checkpoint is not None:
+            ckpt = Checkpoint(self._pending_checkpoint)
+            self._pending_checkpoint = None
+        report(metrics, checkpoint=ckpt)
+        return control
+
+    def on_train_end(self, args, state, control, **kwargs):
+        # flush a trailing checkpoint that saved after the last log,
+        # carrying the last logged metrics forward — this report becomes
+        # the trial's last_result and must not erase e.g. "loss"
+        if self._pending_checkpoint is not None:
+            from ray_tpu.train import report
+            from ray_tpu.train.checkpoint import Checkpoint
+
+            metrics = {}
+            for rec in state.log_history:
+                metrics.update(rec)
+            metrics.update({"step": state.global_step, "train_done": True})
+            report(metrics, checkpoint=Checkpoint(self._pending_checkpoint))
+            self._pending_checkpoint = None
+        return control
+
+
+def prepare_trainer(trainer):
+    """Attach :class:`RayTrainReportCallback` to an HF Trainer if absent
+    (reference: ``prepare_trainer``). Returns the trainer."""
+    has = any(
+        isinstance(cb, RayTrainReportCallback)
+        for cb in getattr(trainer, "callback_handler").callbacks
+    )
+    if not has:
+        trainer.add_callback(RayTrainReportCallback())
+    return trainer

@@ -132,6 +132,42 @@ class Tuner:
         self._param_space = param_space or {}
         self._tune_config = tune_config or TuneConfig()
         self._run_config = run_config or RunConfig()
+        self._restore: Optional[dict] = None  # set by Tuner.restore
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable, *,
+                resume_errored: bool = False,
+                tune_config: Optional[TuneConfig] = None) -> "Tuner":
+        """Resume an interrupted experiment from its run directory
+        (reference: ``Tuner.restore`` + ``tune/execution/experiment_state.py``).
+        Finished trials keep their results; unfinished (and, with
+        ``resume_errored``, failed) trials restart from their latest
+        checkpoint; the searcher continues where it stopped when its
+        pickled state is readable."""
+        state = _load_experiment_state(path)
+        if state is None:
+            raise FileNotFoundError(
+                f"no experiment state under {path!r} "
+                f"(expected {_STATE_FILE})"
+            )
+        run_dir = os.path.abspath(path)
+        if tune_config is None and state.get("tune_config"):
+            # carry the original experiment's metric/mode/limits forward
+            tune_config = TuneConfig(**state["tune_config"])
+        tuner = cls(
+            trainable,
+            tune_config=tune_config,
+            run_config=RunConfig(
+                name=os.path.basename(run_dir),
+                storage_path=os.path.dirname(run_dir),
+            ),
+        )
+        tuner._restore = {
+            "state": state,
+            "resume_errored": resume_errored,
+            "run_dir": run_dir,
+        }
+        return tuner
 
     def fit(self) -> ResultGrid:
         tc = self._tune_config
@@ -143,32 +179,184 @@ class Tuner:
             self._param_space, num_samples=tc.num_samples, seed=tc.seed
         )
         scheduler = tc.scheduler or FIFOScheduler()
+        initial_trials: List[Trial] = []
+        counter = 0
+        if self._restore is not None:
+            state = self._restore["state"]
+            run_dir = self._restore["run_dir"]
+            counter = state.get("counter", 0)
+            search_state = _load_search_state(run_dir)
+            if search_state.get("searcher") is not None:
+                searcher = search_state["searcher"]
+            elif tc.search_alg is None:
+                # No searcher state to continue from and none supplied:
+                # resume only the recorded trials, don't invent new ones.
+                searcher = _ExhaustedSearcher()
+            if tc.scheduler is None:
+                if search_state.get("scheduler") is not None:
+                    scheduler = search_state["scheduler"]
+                elif not isinstance(scheduler, FIFOScheduler):
+                    pass  # user supplied one via tune_config
+                else:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "Tuner.restore: original scheduler state "
+                        "unavailable; resuming under FIFOScheduler "
+                        "(pass tune_config=TuneConfig(scheduler=...) to "
+                        "restore early stopping/PBT behavior)"
+                    )
+            initial_trials = _trials_from_state(
+                state, run_dir, self._restore["resume_errored"]
+            )
         controller = _TrialRunner(
-            self._trainable, searcher, scheduler, tc, run_dir
+            self._trainable, searcher, scheduler, tc, run_dir,
+            initial_trials=initial_trials, counter=counter,
         )
         trials = controller.run()
         return ResultGrid(trials, tc.metric, tc.mode)
 
 
+_STATE_FILE = "experiment_state.json"
+_SEARCHER_FILE = "searcher.pkl"
+
+
+class _ExhaustedSearcher(Searcher):
+    """Yields nothing: used on restore when the original searcher's state
+    is unavailable (resuming recorded trials must not mint new ones)."""
+
+    def suggest(self, trial_id: str):
+        return None
+
+
+def _load_experiment_state(run_dir: str) -> Optional[dict]:
+    import json
+
+    try:
+        with open(os.path.join(run_dir, _STATE_FILE)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _load_search_state(run_dir: str) -> dict:
+    """{"searcher": ..., "scheduler": ...} — empty on any failure
+    (unpicklable state: unfinished trials still resume)."""
+    try:
+        import cloudpickle
+
+        with open(os.path.join(run_dir, _SEARCHER_FILE), "rb") as f:
+            state = cloudpickle.load(f)
+        return state if isinstance(state, dict) else {"searcher": state}
+    except Exception:
+        return {}
+
+
+def _trials_from_state(state: dict, run_dir: str,
+                       resume_errored: bool) -> List[Trial]:
+    trials: List[Trial] = []
+    for rec in state.get("trials", []):
+        t = Trial(
+            rec["trial_id"], rec["config"],
+            os.path.join(run_dir, rec["trial_id"]),
+        )
+        t.status = rec["status"]
+        t.iteration = rec.get("iteration", 0)
+        t.last_result = rec.get("last_result", {})
+        t.metrics_history = rec.get("metrics_history", [])
+        t.latest_checkpoint = rec.get("latest_checkpoint")
+        t.error = rec.get("error")
+        if t.status == RUNNING or (resume_errored and t.status == ERROR):
+            # re-run from the latest checkpoint
+            t.status = PENDING
+            t.error = None
+        trials.append(t)
+    return trials
+
+
 class _TrialRunner:
-    """The trial event loop (reference: ``execution/tune_controller.py``)."""
+    """The trial event loop (reference: ``execution/tune_controller.py``),
+    checkpointing experiment state so interrupted runs resume."""
 
     def __init__(self, trainable, searcher, scheduler, tc: TuneConfig,
-                 run_dir: str):
+                 run_dir: str, initial_trials: Optional[List[Trial]] = None,
+                 counter: int = 0):
         self._trainable = trainable
         self._searcher = searcher
         self._scheduler = scheduler
         self._tc = tc
         self._run_dir = run_dir
-        self._trials: List[Trial] = []
-        self._counter = 0
+        self._trials: List[Trial] = list(initial_trials or [])
+        self._counter = counter
         self._fits = 1
         self._fits_at = -10.0
+        self._state_saved_at = -10.0
+
+    # ------------------------------------------------------- experiment state
+
+    def _save_state(self, force: bool = False):
+        """Periodic experiment snapshot (reference:
+        ``execution/experiment_state.py``): trial table + searcher state,
+        written atomically so a crash mid-write cannot corrupt resume."""
+        import json
+        import tempfile
+
+        now = time.monotonic()
+        if not force and now - self._state_saved_at < 1.0:
+            return
+        self._state_saved_at = now
+        state = {
+            "version": 1,
+            "counter": self._counter,
+            "tune_config": {
+                "metric": self._tc.metric,
+                "mode": self._tc.mode,
+                "num_samples": self._tc.num_samples,
+                "max_concurrent_trials": self._tc.max_concurrent_trials,
+                "trial_resources": self._tc.trial_resources,
+            },
+            "trials": [
+                {
+                    "trial_id": t.trial_id,
+                    "config": t.config,
+                    "status": t.status,
+                    "iteration": t.iteration,
+                    "last_result": t.last_result,
+                    "metrics_history": t.metrics_history[-100:],
+                    "latest_checkpoint": t.latest_checkpoint,
+                    "error": t.error,
+                }
+                for t in self._trials
+            ],
+        }
+        try:
+            fd, tmp = tempfile.mkstemp(dir=self._run_dir, prefix=".state_")
+            with os.fdopen(fd, "w") as f:
+                json.dump(state, f, default=str)
+            os.replace(tmp, os.path.join(self._run_dir, _STATE_FILE))
+        except OSError:
+            pass
+        try:
+            import cloudpickle
+
+            blob = cloudpickle.dumps(
+                {"searcher": self._searcher, "scheduler": self._scheduler}
+            )
+            fd, tmp = tempfile.mkstemp(dir=self._run_dir, prefix=".searcher_")
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, os.path.join(self._run_dir, _SEARCHER_FILE))
+        except Exception:
+            pass  # unpicklable searcher/scheduler (e.g. live study handles)
 
     # ------------------------------------------------------------ lifecycle
 
     def _next_trial(self):
         """Trial, "PENDING" (retry later), or None (search exhausted)."""
+        # restored-but-unfinished trials launch before new suggestions
+        for t in self._trials:
+            if t.status == PENDING and t.actor is None:
+                return t
         tid = f"trial_{self._counter:05d}"
         cfg = self._searcher.suggest(tid)
         if cfg is None or cfg == "PENDING":
@@ -263,11 +451,13 @@ class _TrialRunner:
                 if exhausted and all(
                     t.status in (TERMINATED, ERROR) for t in self._trials
                 ):
+                    self._save_state(force=True)
                     return self._trials
                 time.sleep(0.05)
                 continue
             for trial in running:
                 self._poll_trial(trial)
+            self._save_state()
             time.sleep(0.02)
 
     def _poll_trial(self, trial: Trial):

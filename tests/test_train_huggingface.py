@@ -1,0 +1,89 @@
+"""HF Transformers Train integration.
+
+Reference analog: ``python/ray/train/huggingface/transformers`` tests —
+an HF Trainer inside a TorchTrainer train_fn reports metrics/checkpoints
+through the Ray-style report callback.
+"""
+import math
+
+import pytest
+
+import ray_tpu
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+
+@pytest.fixture
+def hf_cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+class TinyRegressor(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.lin = torch.nn.Linear(4, 1)
+
+    def forward(self, x=None, labels=None):
+        pred = self.lin(x).squeeze(-1)
+        loss = torch.nn.functional.mse_loss(pred, labels)
+        return {"loss": loss, "logits": pred}
+
+
+class TinyData(torch.utils.data.Dataset):
+    def __len__(self):
+        return 16
+
+    def __getitem__(self, i):
+        g = torch.Generator().manual_seed(i)
+        x = torch.randn(4, generator=g)
+        return {"x": x, "labels": x.sum()}
+
+
+def test_hf_trainer_reports_through_torch_trainer(hf_cluster, tmp_path):
+    from ray_tpu.train import RunConfig, ScalingConfig
+    from ray_tpu.train.torch import TorchTrainer
+
+    def train_fn(config):
+        from transformers import Trainer, TrainingArguments
+
+        from ray_tpu.train.huggingface import prepare_trainer
+
+        args = TrainingArguments(
+            output_dir=config["out"],
+            max_steps=4,
+            per_device_train_batch_size=4,
+            logging_steps=2,
+            save_steps=4,
+            report_to=[],
+            use_cpu=True,
+            disable_tqdm=True,
+        )
+        trainer = Trainer(
+            model=TinyRegressor(), args=args, train_dataset=TinyData()
+        )
+        trainer = prepare_trainer(trainer)
+        trainer = prepare_trainer(trainer)  # idempotent
+        n_cbs = sum(
+            type(cb).__name__ == "RayTrainReportCallback"
+            for cb in trainer.callback_handler.callbacks
+        )
+        assert n_cbs == 1
+        trainer.train()
+
+    result = TorchTrainer(
+        train_fn,
+        train_loop_config={"out": str(tmp_path / "hf_out")},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="hf_e2e", storage_path=str(tmp_path)),
+    ).fit()
+    assert result.error is None
+    assert math.isfinite(result.metrics.get("loss", result.metrics.get("step", 0)))
+    # the HF save at step 4 surfaced as a train checkpoint
+    assert result.checkpoint is not None
+    with result.checkpoint.as_directory() as d:
+        import os
+
+        assert any("model" in f or "safetensors" in f for f in os.listdir(d))

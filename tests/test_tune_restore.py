@@ -1,0 +1,111 @@
+"""Tune experiment state + Tuner.restore.
+
+Reference analog: ``tune/execution/experiment_state.py`` (resumable
+experiment checkpointing) + ``Tuner.restore`` — interrupted/failed trials
+resume from their recorded state and checkpoints; finished trials are not
+re-run.
+"""
+import json
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import train as rt_train
+from ray_tpu import tune
+from ray_tpu.tune.tuner import _STATE_FILE
+
+
+@pytest.fixture
+def tune_cluster():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def _make_trainable(marker_dir: str):
+    def trainable(config):
+        # count executions per trial so the test can see what re-ran
+        runs_file = os.path.join(marker_dir, f"runs_{config['name']}")
+        n_prior = 0
+        if os.path.exists(runs_file):
+            with open(runs_file) as f:
+                n_prior = int(f.read() or 0)
+        with open(runs_file, "w") as f:
+            f.write(str(n_prior + 1))
+        for i in range(3):
+            if (
+                config["name"] == "bad"
+                and i == 1
+                and not os.path.exists(os.path.join(marker_dir, "fixed"))
+            ):
+                raise RuntimeError("transient failure")
+            rt_train.report({"score": config["base"] + i})
+
+    return trainable
+
+
+def test_restore_resumes_errored_not_finished(tune_cluster, tmp_path):
+    marker_dir = str(tmp_path / "markers")
+    os.makedirs(marker_dir)
+    trainable = _make_trainable(marker_dir)
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={
+            "name": tune.grid_search(["good", "bad"]),
+            "base": 10,
+        },
+        tune_config=tune.TuneConfig(metric="score", mode="max", num_samples=1),
+        run_config=rt_train.RunConfig(
+            name="restore_exp", storage_path=str(tmp_path)
+        ),
+    )
+    grid = tuner.fit()
+    assert grid.num_errors == 1
+    run_dir = str(tmp_path / "restore_exp")
+    assert os.path.exists(os.path.join(run_dir, _STATE_FILE))
+    with open(os.path.join(run_dir, _STATE_FILE)) as f:
+        state = json.load(f)
+    statuses = {t["trial_id"]: t["status"] for t in state["trials"]}
+    assert sorted(statuses.values()) == ["ERROR", "TERMINATED"]
+
+    # fix the transient failure, then resume
+    open(os.path.join(marker_dir, "fixed"), "w").close()
+    grid2 = tune.Tuner.restore(
+        run_dir, trainable, resume_errored=True
+    ).fit()
+    assert grid2.num_errors == 0
+    best = grid2.get_best_result(metric="score", mode="max")
+    assert best.metrics["score"] == 12
+
+    # the finished trial ran once; the errored one ran twice; and no new
+    # trials were minted on restore
+    with open(os.path.join(marker_dir, "runs_good")) as f:
+        assert f.read() == "1"
+    with open(os.path.join(marker_dir, "runs_bad")) as f:
+        assert f.read() == "2"
+    assert len(grid2) == 2
+
+
+def test_restore_without_resume_errored_keeps_failure(tune_cluster, tmp_path):
+    marker_dir = str(tmp_path / "markers")
+    os.makedirs(marker_dir)
+    trainable = _make_trainable(marker_dir)
+    tune.Tuner(
+        trainable,
+        param_space={"name": tune.grid_search(["good", "bad"]), "base": 0},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=rt_train.RunConfig(name="exp2", storage_path=str(tmp_path)),
+    ).fit()
+    grid = tune.Tuner.restore(
+        str(tmp_path / "exp2"), trainable, resume_errored=False
+    ).fit()
+    assert grid.num_errors == 1  # stays failed; nothing re-ran
+    with open(os.path.join(marker_dir, "runs_bad")) as f:
+        assert f.read() == "1"
+
+
+def test_restore_missing_state_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        tune.Tuner.restore(str(tmp_path / "nope"), lambda c: None)
