@@ -6,13 +6,16 @@ from ray_tpu.dag.channel import Channel, ChannelClosedError, ChannelTimeoutError
 from ray_tpu.dag.compiled import CompiledDAG, CompiledDAGRef
 from ray_tpu.dag.nodes import (
     ClassMethodNode,
+    CollectiveOutputNode,
     DAGNode,
     InputNode,
     MultiOutputNode,
+    allreduce,
 )
 
 __all__ = [
     "Channel", "ChannelClosedError", "ChannelTimeoutError",
     "CompiledDAG", "CompiledDAGRef",
-    "ClassMethodNode", "DAGNode", "InputNode", "MultiOutputNode",
+    "ClassMethodNode", "CollectiveOutputNode", "DAGNode", "InputNode",
+    "MultiOutputNode", "allreduce",
 ]

@@ -188,8 +188,15 @@ class Channel:
             pass
         self._spills.clear()
         if self.created:
+            from ray_tpu._private.object_store import _safe_unlink
+
             try:
-                self._shm.unlink()
+                # re-register + unlink keeps the resource_tracker's books
+                # balanced (we unregistered at create; unlink unregisters
+                # again — unbalanced, its process logs KeyErrors at exit)
+                _safe_unlink(self._shm)
             except FileNotFoundError:
+                pass
+            except Exception:
                 pass
         # keep the mapping (readers may be mid-read); dies with the process

@@ -27,9 +27,11 @@ from ray_tpu.dag.channel import (
 )
 from ray_tpu.dag.nodes import (
     ClassMethodNode,
+    CollectiveOutputNode,
     DAGNode,
     InputNode,
     MultiOutputNode,
+    reduce_values,
 )
 
 
@@ -50,11 +52,27 @@ def _dag_actor_loop(instance, plan: dict):
                     chans[spec[1]].read(ctx) if spec[0] == "ch" else spec[1]
                     for spec in task["args"]
                 ]
-                kwargs = {
-                    k: chans[spec[1]].read(ctx) if spec[0] == "ch" else spec[1]
-                    for k, spec in task["kwargs"].items()
-                }
-                result = getattr(instance, task["method"])(*args, **kwargs)
+                kind = task.get("kind", "call")
+                if kind == "call":
+                    kwargs = {
+                        k: chans[spec[1]].read(ctx)
+                        if spec[0] == "ch" else spec[1]
+                        for k, spec in task["kwargs"].items()
+                    }
+                    result = getattr(instance, task["method"])(*args, **kwargs)
+                elif kind == "coll_member":
+                    # contribute, then wait for the leader's reduction
+                    chans[task["contrib"]].write(args[0], ctx)
+                    result = chans[task["result"]].read(ctx)
+                elif kind == "coll_leader":
+                    values = [args[0]] + [
+                        chans[c].read(ctx) for c in task["contribs"]
+                    ]
+                    result = reduce_values(task["op"], values)
+                    for r in task["results"]:
+                        chans[r].write(result, ctx)
+                else:
+                    raise ValueError(f"unknown task kind {kind!r}")
                 for out in task["out"]:
                     chans[out].write(result, ctx)
     except ChannelClosedError:
@@ -121,8 +139,20 @@ class CompiledDAG:
             list(root.args) if self._is_multi else [root]
         )
         for out in self._outputs:
-            if not isinstance(out, ClassMethodNode):
+            if not isinstance(out, (ClassMethodNode, CollectiveOutputNode)):
                 raise ValueError("DAG outputs must be actor method nodes")
+        # Every output of an allreduce group must be reachable: a dropped
+        # participant would never contribute and the leader would block.
+        in_order = {id(n) for n in order}
+        for n in order:
+            if isinstance(n, CollectiveOutputNode):
+                for sibling in n.group.outputs:
+                    if id(sibling) not in in_order:
+                        raise ValueError(
+                            "all outputs of an allreduce group must be "
+                            "consumed in the DAG (participant "
+                            f"#{sibling.index} is unreachable)"
+                        )
 
         # ---- allocate channels: one per (producer → consumer) edge ----
         self._channels: Dict[str, Channel] = {}
@@ -140,8 +170,9 @@ class CompiledDAG:
             return name
 
         trigger_ch: Dict[int, str] = {}
+        producer_types = (ClassMethodNode, CollectiveOutputNode)
         for n in order:
-            if not isinstance(n, ClassMethodNode):
+            if not isinstance(n, producer_types):
                 continue
             has_upstream = False
             for pos, a in enumerate(n.args):
@@ -150,7 +181,7 @@ class CompiledDAG:
                     self._input_chs.append(ch)
                     in_ch[(id(n), pos)] = ch
                     has_upstream = True
-                elif isinstance(a, ClassMethodNode):
+                elif isinstance(a, producer_types):
                     ch = new_channel()
                     out_chs.setdefault(id(a), []).append(ch)
                     in_ch[(id(n), pos)] = ch
@@ -161,11 +192,13 @@ class CompiledDAG:
                     self._input_chs.append(ch)
                     in_ch[(id(n), k)] = ch
                     has_upstream = True
-                elif isinstance(v, ClassMethodNode):
+                elif isinstance(v, producer_types):
                     ch = new_channel()
                     out_chs.setdefault(id(v), []).append(ch)
                     in_ch[(id(n), k)] = ch
                     has_upstream = True
+            if isinstance(n, CollectiveOutputNode):
+                continue  # collective tasks always have an upstream edge
             if not has_upstream:
                 # Constant-only task: without an upstream edge its exec loop
                 # would free-run ahead of execute() (side effects firing with
@@ -179,11 +212,26 @@ class CompiledDAG:
             out_chs.setdefault(id(out), []).append(ch)
             self._output_chs.append(ch)
 
+        # ---- collective-group internal channels (contribution + result) ----
+        # leader = participant 0's actor: members send contributions to it,
+        # it reduces and broadcasts results back (star topology over shm;
+        # reference: _CollectiveOperation lowering onto NCCL — here the
+        # channel plane is the host/DCN transport).
+        coll_chs: Dict[int, dict] = {}  # id(group) -> {"m": [...], "r": [...]}
+        for n in order:
+            if isinstance(n, CollectiveOutputNode) and n.index == 0:
+                group = n.group
+                members = len(group.outputs) - 1
+                coll_chs[id(group)] = {
+                    "m": [new_channel() for _ in range(members)],
+                    "r": [new_channel() for _ in range(members)],
+                }
+
         # ---- per-actor plans (tasks stay in global topo order) ----
         plans: Dict[str, dict] = {}
         actors: Dict[str, Any] = {}
         for n in order:
-            if not isinstance(n, ClassMethodNode):
+            if not isinstance(n, producer_types):
                 continue
             aid = n.actor._actor_id
             actors[aid] = n.actor
@@ -196,6 +244,37 @@ class CompiledDAG:
                     plan["channels"].add(ch)
                 else:
                     arg_specs.append(("val", a))
+            outs = out_chs.get(id(n), [])
+            plan["channels"].update(outs)
+            if isinstance(n, CollectiveOutputNode):
+                group_chs = coll_chs[id(n.group)]
+                if n.index == 0:
+                    task = {
+                        "kind": "coll_leader",
+                        "args": arg_specs,
+                        "kwargs": {},
+                        "op": n.group.op,
+                        "contribs": group_chs["m"],
+                        "results": group_chs["r"],
+                        "out": outs,
+                        "trigger": None,
+                    }
+                    plan["channels"].update(group_chs["m"])
+                    plan["channels"].update(group_chs["r"])
+                else:
+                    task = {
+                        "kind": "coll_member",
+                        "args": arg_specs,
+                        "kwargs": {},
+                        "contrib": group_chs["m"][n.index - 1],
+                        "result": group_chs["r"][n.index - 1],
+                        "out": outs,
+                        "trigger": None,
+                    }
+                    plan["channels"].add(task["contrib"])
+                    plan["channels"].add(task["result"])
+                plan["tasks"].append(task)
+                continue
             kwarg_specs = {}
             for k, v in n.kwargs.items():
                 if isinstance(v, DAGNode):
@@ -204,8 +283,6 @@ class CompiledDAG:
                     plan["channels"].add(ch)
                 else:
                     kwarg_specs[k] = ("val", v)
-            outs = out_chs.get(id(n), [])
-            plan["channels"].update(outs)
             trig = trigger_ch.get(id(n))
             if trig is not None:
                 plan["channels"].add(trig)

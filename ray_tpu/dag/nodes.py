@@ -69,6 +69,23 @@ def _exec_eager(node: DAGNode, input_value, cache: Dict[int, Any]):
             for k, v in kwargs.items()
         }
         result = getattr(node.actor, node.method_name).remote(*args, **kwargs)
+    elif isinstance(node, CollectiveOutputNode):
+        import ray_tpu
+        from ray_tpu.object_ref import ObjectRef
+
+        # reduce once per group, then share the result among all outputs;
+        # launch every contributor before blocking so they run in parallel
+        refs = [
+            _exec_eager(out.args[0], input_value, cache)
+            for out in node.group.outputs
+        ]
+        values = [
+            ray_tpu.get(v) if isinstance(v, ObjectRef) else v for v in refs
+        ]
+        reduced = reduce_values(node.group.op, values)
+        for out in node.group.outputs:
+            cache[id(out)] = reduced
+        result = reduced
     else:
         raise TypeError(f"unknown node {node}")
     cache[id(node)] = result
@@ -103,3 +120,86 @@ class ClassMethodNode(DAGNode):
 class MultiOutputNode(DAGNode):
     def __init__(self, outputs: List[DAGNode]):
         super().__init__(tuple(outputs), {})
+
+
+# ---------------------------------------------------------- in-DAG collectives
+
+
+def reduce_values(op: str, values: List[Any]):
+    """Elementwise pytree reduction used by in-DAG allreduce (host-side —
+    the compiled-graph channel plane; in-jit collectives use XLA psum)."""
+    import jax
+    import numpy as np
+
+    def combine(*leaves):
+        stack = np.stack([np.asarray(x) for x in leaves])
+        if op == "sum":
+            out = stack.sum(0)
+        elif op == "mean":
+            out = stack.mean(0)
+        elif op == "max":
+            out = stack.max(0)
+        elif op == "min":
+            out = stack.min(0)
+        else:
+            raise ValueError(f"unknown allreduce op {op!r}")
+        return out if out.ndim else out.item()
+
+    return jax.tree.map(combine, *values)
+
+
+class CollectiveOutputNode(DAGNode):
+    """One participant's output of an in-DAG allreduce (reference:
+    ``dag/collective_node.py:23 _CollectiveOperation``). Created via
+    ``allreduce.bind([...])``; lives on the same actor as its contributor."""
+
+    def __init__(self, upstream: "ClassMethodNode", group: "_CollectiveGroup",
+                 index: int):
+        super().__init__((upstream,), {})
+        self.actor = upstream.actor
+        self.group = group
+        self.index = index
+
+    def __repr__(self):
+        return (
+            f"CollectiveOutputNode({self.group.op} #{self.index}"
+            f"/{len(self.group.outputs)})"
+        )
+
+
+class _CollectiveGroup:
+    def __init__(self, op: str):
+        self.op = op
+        self.outputs: List[CollectiveOutputNode] = []
+
+
+class _AllReduce:
+    """``allreduce.bind(nodes, op=...)`` — returns one output node per
+    participant; participants must be method nodes on distinct actors."""
+
+    @staticmethod
+    def bind(nodes: List["ClassMethodNode"], op: str = "sum"
+             ) -> List["CollectiveOutputNode"]:
+        if not nodes:
+            raise ValueError("allreduce.bind requires at least one node")
+        for n in nodes:
+            if not isinstance(n, ClassMethodNode):
+                raise ValueError(
+                    "allreduce participants must be actor method nodes"
+                )
+        # distinctness by actor ID, not handle identity: get_actor() and
+        # deserialization mint fresh handle objects for the same actor, and
+        # two group members on one actor would deadlock its exec loop
+        actors = {n.actor._actor_id for n in nodes}
+        if len(actors) != len(nodes):
+            raise ValueError(
+                "allreduce participants must be on distinct actors"
+            )
+        group = _CollectiveGroup(op)
+        group.outputs = [
+            CollectiveOutputNode(n, group, i) for i, n in enumerate(nodes)
+        ]
+        return list(group.outputs)
+
+
+allreduce = _AllReduce()

@@ -277,3 +277,76 @@ def test_single_element_multioutput_consistency(dag_cluster):
         assert compiled.execute(5).get() == [6]  # compiled: also list
     finally:
         compiled.teardown()
+
+
+# ------------------------------------------------------- in-DAG collectives
+
+
+def test_eager_allreduce(dag_cluster):
+    from ray_tpu.dag import allreduce
+
+    ws = [Adder.remote(i) for i in (1, 2, 3)]
+    with InputNode() as inp:
+        contribs = [w.add.bind(inp) for w in ws]
+        reduced = allreduce.bind(contribs, op="sum")
+        dag = MultiOutputNode(reduced)
+    out = dag.execute(10)
+    # contributions 11, 12, 13 -> everyone sees 36
+    assert out == [36, 36, 36]
+
+
+def test_compiled_allreduce_sum_and_consume(dag_cluster):
+    from ray_tpu.dag import allreduce
+
+    ws = [Adder.remote(i) for i in (1, 2, 3)]
+    with InputNode() as inp:
+        contribs = [w.add.bind(inp) for w in ws]
+        reduced = allreduce.bind(contribs, op="sum")
+        outs = [w.add.bind(r) for w, r in zip(ws, reduced)]
+        dag = MultiOutputNode(outs).experimental_compile()
+    try:
+        for x in (0, 5, 7):
+            s = 3 * x + 6  # sum of (x+1, x+2, x+3)
+            assert dag.execute(x).get() == [s + 1, s + 2, s + 3]
+    finally:
+        dag.teardown()
+
+
+def test_compiled_allreduce_mean_arrays(dag_cluster):
+    from ray_tpu.dag import allreduce
+
+    @ray_tpu.remote
+    class Vec:
+        def __init__(self, scale):
+            self.scale = scale
+
+        def make(self, x):
+            return np.full(4, float(x * self.scale))
+
+    ws = [Vec.remote(s) for s in (1, 3)]
+    with InputNode() as inp:
+        reduced = allreduce.bind([w.make.bind(inp) for w in ws], op="mean")
+        dag = MultiOutputNode(reduced).experimental_compile()
+    try:
+        out = dag.execute(2).get()
+        np.testing.assert_allclose(out[0], np.full(4, 4.0))  # mean(2, 6)
+        np.testing.assert_allclose(out[1], np.full(4, 4.0))
+    finally:
+        dag.teardown()
+
+
+def test_allreduce_validation(dag_cluster):
+    from ray_tpu.dag import allreduce
+
+    a = Adder.remote(1)
+    with InputNode() as inp:
+        n1 = a.add.bind(inp)
+        n2 = a.add.bind(inp)
+        with pytest.raises(ValueError, match="distinct actors"):
+            allreduce.bind([n1, n2])
+    b = Adder.remote(2)
+    with InputNode() as inp:
+        reduced = allreduce.bind([a.add.bind(inp), b.add.bind(inp)])
+        # dropping one participant's output must fail compile
+        with pytest.raises(ValueError, match="unreachable"):
+            reduced[0].experimental_compile()
