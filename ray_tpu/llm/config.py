@@ -34,6 +34,10 @@ class LLMConfig:
     max_batch_slots: int = 8
     prefill_buckets: Sequence[int] = (64, 128, 256)
     tensor_parallel_size: int = 1  # reserved: mesh "tensor" axis size
+    # Automatic prefix caching (vLLM-APC parity): completed prompt prefills
+    # are kept in an LRU; identical prompts skip prefill entirely and
+    # shared prefixes (system prompts) prefill only their tail. 0 disables.
+    prefix_cache_size: int = 8
 
     # Serving
     max_new_tokens_default: int = 64

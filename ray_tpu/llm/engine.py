@@ -89,9 +89,11 @@ class DecodeEngine:
 
         cfg = self.model_config
 
-        def prefill(params, tokens, cache1):
+        def prefill(params, tokens, cache1, start):
+            # start > 0 = continuation from a cached prefix: only the
+            # prompt's tail runs through the model
             logits, cache1 = model.forward_cached(
-                params, tokens, cache1, jnp.zeros((1,), jnp.int32), cfg
+                params, tokens, cache1, start, cfg
             )
             return logits, cache1
 
@@ -117,7 +119,17 @@ class DecodeEngine:
         self._loop_thread: Optional[threading.Thread] = None
         self._stopped = False
         self._lock = threading.Lock()
-        self.stats = {"requests": 0, "tokens_generated": 0, "ticks": 0}
+        # Automatic prefix cache: prompt-token tuple -> {"cache": slot-cache
+        # pytree (immutable jax arrays — safe to share), "len": prompt_len,
+        # "logits_row": final-position logits for per-request sampling}.
+        # LRU-bounded; entries are whole completed prefills.
+        from collections import OrderedDict
+
+        self._prefix_cache: "OrderedDict[tuple, dict]" = OrderedDict()
+        self.stats = {
+            "requests": 0, "tokens_generated": 0, "ticks": 0,
+            "prefix_hits": 0, "prefix_partial_hits": 0,
+        }
 
     # ------------------------------------------------------------- sampling
 
@@ -145,19 +157,99 @@ class DecodeEngine:
             f"{max(self.config.prefill_buckets)}"
         )
 
+    def _prefix_lookup_locked(self, prompt_ids):
+        """(entry, matched_len): exact entry, the longest cached strict
+        prefix, or (None, 0)."""
+        key = tuple(prompt_ids)
+        entry = self._prefix_cache.get(key)
+        if entry is not None:
+            self._prefix_cache.move_to_end(key)
+            return entry, len(prompt_ids)
+        best, best_len, best_key = None, 0, None
+        for k, e in self._prefix_cache.items():
+            n = len(k)
+            if best_len < n < len(prompt_ids) and key[:n] == k:
+                best, best_len, best_key = e, n, k
+        if best_key is not None:
+            # a hot shared prefix must stay resident under LRU pressure
+            self._prefix_cache.move_to_end(best_key)
+        return best, best_len
+
+    def _prefix_store_locked(self, prompt_ids, cache1, logits_np, base):
+        """Store the full prompt AND its bucket-boundary prefixes (system
+        prompts shared by many requests match through these). All entries
+        alias the same immutable cache pytree; ``logits_np`` rows cover
+        absolute positions base..base+rows-1."""
+        cap = self.config.prefix_cache_size
+        if cap <= 0:
+            return
+        n = len(prompt_ids)
+        lengths = {n}
+        for b in self.config.prefill_buckets:
+            if base < b < n:
+                lengths.add(b)
+        for ln in lengths:
+            row_idx = ln - base - 1
+            if not (0 <= row_idx < logits_np.shape[0]):
+                continue
+            key = tuple(prompt_ids[:ln])
+            self._prefix_cache[key] = {
+                "cache": cache1,
+                # copy: a view would pin the whole [Tpad, vocab] buffer
+                "logits_row": logits_np[row_idx].copy(),
+            }
+            self._prefix_cache.move_to_end(key)
+        while len(self._prefix_cache) > cap:
+            self._prefix_cache.popitem(last=False)
+
     def _prefill_locked(self, prompt_ids, params):
-        """(slot_cache jax pytree, first_token). Caller holds the lock."""
+        """(slot_cache jax pytree, first_token). Caller holds the lock.
+        Consults the prefix cache: an exact hit skips the model entirely; a
+        strict-prefix hit prefills only the tail from the cached KV state."""
         import jax.numpy as jnp
 
-        Tpad = self._bucket(len(prompt_ids))
-        toks = np.zeros((1, Tpad), np.int32)
-        toks[0, : len(prompt_ids)] = prompt_ids
-        logits, cache1 = self._prefill(
-            self.params, jnp.asarray(toks), self._empty_slot_cache()
+        n = len(prompt_ids)
+        entry, matched = (
+            self._prefix_lookup_locked(prompt_ids)
+            if self.config.prefix_cache_size > 0
+            else (None, 0)
         )
-        first = self._sample(
-            np.asarray(logits)[0, len(prompt_ids) - 1], params
-        )
+        if entry is not None and matched == n:
+            self.stats["prefix_hits"] += 1
+            first = self._sample(entry["logits_row"], params)
+            return entry["cache"], first
+        if entry is not None and (
+            matched + self._bucket(n - matched) > self.config.max_seq_len
+        ):
+            # the padded tail write would clamp inside dynamic_update_slice
+            # and corrupt valid prefix KV — full prefill instead
+            entry, matched = None, 0
+        if entry is not None:
+            self.stats["prefix_partial_hits"] += 1
+            base = matched
+            rem = prompt_ids[matched:]
+            Tpad = self._bucket(len(rem))
+            toks = np.zeros((1, Tpad), np.int32)
+            toks[0, : len(rem)] = rem
+            logits, cache1 = self._prefill(
+                self.params, jnp.asarray(toks), entry["cache"],
+                jnp.full((1,), matched, jnp.int32),
+            )
+            logits_np = np.asarray(logits)[0]
+            row = logits_np[len(rem) - 1]
+        else:
+            base = 0
+            Tpad = self._bucket(n)
+            toks = np.zeros((1, Tpad), np.int32)
+            toks[0, :n] = prompt_ids
+            logits, cache1 = self._prefill(
+                self.params, jnp.asarray(toks), self._empty_slot_cache(),
+                jnp.zeros((1,), jnp.int32),
+            )
+            logits_np = np.asarray(logits)[0]
+            row = logits_np[n - 1]
+        self._prefix_store_locked(prompt_ids, cache1, logits_np, base)
+        first = self._sample(row, params)
         return cache1, first
 
     def _activate_slot_locked(self, b, cache1, first, prompt_len, params,

@@ -211,3 +211,89 @@ def test_pd_serving_app(llm_cluster):
         assert out["choices"][0]["text"] == expect
     finally:
         serve.shutdown()
+
+
+# ------------------------------------------------------------ prefix caching
+
+
+def test_prefix_cache_exact_hit_same_output():
+    """Identical prompts: the second request skips prefill entirely and
+    greedy output is unchanged."""
+    eng = _engine(prefix_cache_size=4)
+    try:
+        prompt = list(range(2, 14))
+        p = SamplingParams(max_new_tokens=6)
+        out1 = eng.generate(prompt, p)
+        assert eng.stats["prefix_hits"] == 0
+        out2 = eng.generate(prompt, p)
+        assert eng.stats["prefix_hits"] == 1
+        assert out1 == out2
+    finally:
+        eng.shutdown()
+
+
+def test_prefix_cache_partial_hit_matches_uncached():
+    """A prompt sharing a cached prefix prefills only its tail — output must
+    equal a cache-disabled engine's."""
+    base = list(range(2, 18))           # 16 tokens: fills bucket 16
+    longer = base + [30, 31, 32, 33]
+    p = SamplingParams(max_new_tokens=6)
+
+    ref_eng = _engine(prefix_cache_size=0)
+    try:
+        expected = ref_eng.generate(longer, p)
+        assert ref_eng.stats["prefix_hits"] == 0
+    finally:
+        ref_eng.shutdown()
+
+    eng = _engine(prefix_cache_size=4)
+    try:
+        eng.generate(base, p)           # seeds the prefix cache
+        out = eng.generate(longer, p)
+        assert eng.stats["prefix_partial_hits"] == 1
+        assert out == expected
+    finally:
+        eng.shutdown()
+
+
+def test_prefix_cache_lru_bound():
+    eng = _engine(prefix_cache_size=2)
+    try:
+        p = SamplingParams(max_new_tokens=2)
+        for start in (2, 20, 40):
+            eng.generate([start, start + 1, start + 2], p)
+        assert len(eng._prefix_cache) == 2  # oldest evicted
+        # evicted prompt re-prefills without error
+        eng.generate([2, 3, 4], p)
+        assert eng.stats["prefix_hits"] == 0
+    finally:
+        eng.shutdown()
+
+
+def test_prefix_cache_tail_overflow_falls_back():
+    """When matched + bucket(tail) would exceed max_seq_len, the padded tail
+    write would clamp and corrupt prefix KV — the engine must fall back to a
+    full prefill and still produce the uncached output."""
+    cfg = dict(
+        vocab_size=128, max_seq_len=64, num_layers=2, num_heads=2,
+        embed_dim=64, dtype="float32", max_batch_slots=2,
+        prefill_buckets=(16, 64),
+    )
+    base = list(range(2, 18))          # 16 tokens -> cached boundary at 16
+    longer = base + list(range(40, 84))  # 60 tokens; tail bucket = 64
+    p = SamplingParams(max_new_tokens=3)
+
+    ref = DecodeEngine(LLMConfig(prefix_cache_size=0, **cfg), seed=0)
+    try:
+        expected = ref.generate(longer, p)
+    finally:
+        ref.shutdown()
+
+    eng = DecodeEngine(LLMConfig(prefix_cache_size=4, **cfg), seed=0)
+    try:
+        eng.generate(base, p)
+        out = eng.generate(longer, p)  # 16 + bucket(44)=64 > 64: fallback
+        assert eng.stats["prefix_partial_hits"] == 0
+        assert out == expected
+    finally:
+        eng.shutdown()
