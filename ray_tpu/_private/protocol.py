@@ -89,6 +89,19 @@ class Connection:
         self._recv_task: Optional[asyncio.Task] = None
         self.on_close: Optional[Callable[["Connection"], None]] = None
         self.peer_info: dict = {}  # set by registration handshakes
+        # Write coalescing: send_raw buffers encoded messages and a single
+        # call_soon callback flushes them next loop tick — a burst of small
+        # RPCs (the task-submission hot loop) costs one send(2) instead of
+        # one per message. Ordering is preserved; latency cost is one tick.
+        # Buffered bytes are capped: at FLUSH_BYTES the flush happens
+        # synchronously, so writer.drain() sees bulk traffic in the
+        # transport and flow control still engages (at most FLUSH_BYTES per
+        # connection are invisible to drain).
+        self._out_buf: List[bytes] = []
+        self._out_bytes = 0
+        self._flush_scheduled = False
+
+    FLUSH_BYTES = 256 * 1024
 
     def start(self):
         self._recv_task = asyncio.get_running_loop().create_task(self._recv_loop())
@@ -120,6 +133,7 @@ class Connection:
     def _teardown(self):
         if self._closed:
             return
+        self._flush()  # pending buffered messages go out before close
         self._closed = True
         for fut in self._pending.values():
             if not fut.done():
@@ -154,6 +168,9 @@ class Connection:
             return
         try:
             self.send_raw(reply_header, reply_frames)
+            # replies are latency-critical (a sync caller is blocked on this
+            # round trip): flush now instead of waiting for the tick
+            self._flush()
             await self.writer.drain()
         except (ConnectionLost, ConnectionResetError, OSError):
             pass
@@ -161,7 +178,27 @@ class Connection:
     def send_raw(self, header: dict, frames: List[bytes]):
         if self._closed:
             raise ConnectionLost(f"connection {self.name} closed")
-        self.writer.write(encode_message(header, frames))
+        data = encode_message(header, frames)
+        self._out_buf.append(data)
+        self._out_bytes += len(data)
+        if self._out_bytes >= self.FLUSH_BYTES:
+            self._flush()  # bulk payloads reach the transport before drain()
+        elif not self._flush_scheduled:
+            self._flush_scheduled = True
+            asyncio.get_running_loop().call_soon(self._flush)
+
+    def _flush(self):
+        self._flush_scheduled = False
+        if not self._out_buf:
+            return
+        buf, self._out_buf = self._out_buf, []
+        self._out_bytes = 0
+        if self._closed:
+            return
+        try:
+            self.writer.write(buf[0] if len(buf) == 1 else b"".join(buf))
+        except Exception:
+            pass  # transport gone; the recv loop tears the connection down
 
     async def call(
         self, method: str, extras: Optional[dict] = None, frames: List[bytes] = ()

@@ -36,8 +36,10 @@ class LLMConfig:
     tensor_parallel_size: int = 1  # reserved: mesh "tensor" axis size
     # Automatic prefix caching (vLLM-APC parity): completed prompt prefills
     # are kept in an LRU; identical prompts skip prefill entirely and
-    # shared prefixes (system prompts) prefill only their tail. 0 disables.
-    prefix_cache_size: int = 8
+    # shared prefixes (system prompts) prefill only their tail. OPT-IN
+    # (0 disables): each entry pins a full [L, 1, max_seq_len, ...] KV
+    # pytree on device — size it against your HBM budget.
+    prefix_cache_size: int = 0
 
     # Serving
     max_new_tokens_default: int = 64

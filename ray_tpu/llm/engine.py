@@ -120,9 +120,9 @@ class DecodeEngine:
         self._stopped = False
         self._lock = threading.Lock()
         # Automatic prefix cache: prompt-token tuple -> {"cache": slot-cache
-        # pytree (immutable jax arrays — safe to share), "len": prompt_len,
-        # "logits_row": final-position logits for per-request sampling}.
-        # LRU-bounded; entries are whole completed prefills.
+        # pytree (immutable jax arrays — safe to share), "logits_row":
+        # final-position logits for per-request sampling}. LRU-bounded;
+        # entries are whole completed prefills.
         from collections import OrderedDict
 
         self._prefix_cache: "OrderedDict[tuple, dict]" = OrderedDict()
@@ -209,6 +209,8 @@ class DecodeEngine:
         import jax.numpy as jnp
 
         n = len(prompt_ids)
+        self._bucket(n)  # uniform length limit: acceptance must not depend
+        # on transient prefix-cache residency
         entry, matched = (
             self._prefix_lookup_locked(prompt_ids)
             if self.config.prefix_cache_size > 0
