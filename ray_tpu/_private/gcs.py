@@ -359,6 +359,46 @@ class HeadService:
             if rec.get("node_id") != node_id
         }
 
+    async def rpc_cluster_stacks(self, h, frames, conn):
+        """Fan out all-thread stack dumps to every alive node (reference:
+        ``ray stack`` + the reporter agent's py-spy hooks; workers answer
+        natively from sys._current_frames — util/debug.py)."""
+        alive = [
+            n for n in self.nodes.values() if n.alive and n.conn is not None
+        ]
+
+        async def one(node):
+            try:
+                hh, _ = await asyncio.wait_for(
+                    node.conn.call("dump_stacks", {}), timeout=10
+                )
+                return node.node_id, hh.get("stacks", "")
+            except Exception as e:
+                return (
+                    node.node_id,
+                    f"<unavailable: {type(e).__name__}: {e}>",
+                )
+
+        # concurrent fan-out: a partially-hung cluster (the very case a
+        # stack tool exists for) costs one timeout, not one per dead node
+        results = await asyncio.gather(*(one(n) for n in alive))
+        return {"nodes": dict(results)}, []
+
+    async def rpc_node_debug(self, h, frames, conn):
+        """Relay a debug RPC (memory_profile, dump_stacks) to one node."""
+        node = self.nodes.get(h.get("node_id") or "")
+        if node is None or not node.alive or node.conn is None:
+            raise protocol.RpcError(f"node {h.get('node_id')!r} unavailable")
+        method = h.get("method")
+        if method not in ("memory_profile", "dump_stacks"):
+            raise protocol.RpcError(f"node_debug: unsupported {method!r}")
+        fwd = {k: h[k] for k in ("action", "top") if k in h}
+        hh, _ = await asyncio.wait_for(
+            node.conn.call(method, fwd), timeout=30
+        )
+        # strip the forwarded reply's RPC envelope fields
+        return {k: v for k, v in hh.items() if k not in ("i", "r")}, []
+
     async def rpc_drain_node(self, h, frames, conn):
         await self._on_node_dead(h["node_id"], "drained")
         return {}, []

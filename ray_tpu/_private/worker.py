@@ -1700,6 +1700,21 @@ class CoreWorker:
         self._async_actor_loop = holder["loop"]
         return self._async_actor_loop
 
+    async def rpc_dump_stacks(self, h, frames, conn):
+        """All-thread stack dump (reference: py-spy via the reporter agent's
+        profile_manager; here native to the worker — util/debug.py)."""
+        from ray_tpu.util.debug import dump_local_stacks
+
+        return {"stacks": dump_local_stacks()}, []
+
+    async def rpc_memory_profile(self, h, frames, conn):
+        """tracemalloc control on this worker (memray analog)."""
+        from ray_tpu.util.debug import memory_profile_local
+
+        return memory_profile_local(
+            h.get("action", "snapshot"), h.get("top", 10)
+        ), []
+
     async def rpc_run_control(self, h, frames, conn):
         """Run a pickled zero-arg callable on this process's control loop —
         internal hook for tests and the chaos killer."""

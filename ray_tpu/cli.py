@@ -157,6 +157,17 @@ def cmd_summary(args):
         print(json.dumps({"nodes": len(nodes)}, indent=2))
 
 
+def cmd_stack(args):
+    """Per-node all-thread stack dumps (reference: ``ray stack``)."""
+    from ray_tpu.util.debug import get_cluster_stacks
+
+    stacks = get_cluster_stacks(_resolve_address(args), include_driver=False)
+    for node_id, text in stacks.items():
+        print(f"===== node {node_id[:12]} =====")
+        print(text)
+        print()
+
+
 def cmd_timeline(args):
     """Dump task events as chrome://tracing JSON (reference: ray timeline)."""
     from ray_tpu.util import state
@@ -224,6 +235,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("what", choices=["tasks", "actors", "nodes"])
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser("stack", help="all-thread stack dump of every node")
+    sp.add_argument("--address", default=None)
+    sp.set_defaults(fn=cmd_stack)
 
     sp = sub.add_parser("timeline")
     sp.add_argument("--address", default=None)

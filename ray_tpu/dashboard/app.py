@@ -37,6 +37,7 @@ class DashboardApp:
         r.add_post("/api/jobs/{submission_id}/stop", self._stop_job)
         r.add_get("/api/tasks", self._tasks)
         r.add_get("/api/cluster_status", self._cluster_status)
+        r.add_get("/api/stacks", self._stacks)
         r.add_get("/metrics", self._metrics)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
@@ -139,6 +140,14 @@ class DashboardApp:
         from aiohttp import web
 
         h, _ = await self._head("cluster_load", {})
+        return web.json_response(h)
+
+    async def _stacks(self, request):
+        """Per-node all-thread stack dumps (reference: the reporter agent's
+        py-spy profiling endpoint; see util/debug.py)."""
+        from aiohttp import web
+
+        h, _ = await self._head("cluster_stacks", {})
         return web.json_response(h)
 
     async def _metrics(self, request):

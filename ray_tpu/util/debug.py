@@ -1,0 +1,94 @@
+"""Cluster debugging: live stack dumps and memory profiling, no deps.
+
+Reference analog: the dashboard reporter agent's profiling hooks
+(``dashboard/modules/reporter/profile_manager.py`` — py-spy stack dumps /
+flamegraphs, memray memory tracking) and the ``ray stack`` CLI. TPU-era
+redesign: workers are CPython processes we own, so stacks come from
+``sys._current_frames`` and allocation profiles from ``tracemalloc`` —
+no external profilers to install, and the same RPCs work on any host.
+"""
+from __future__ import annotations
+
+import sys
+import threading
+import traceback
+from typing import Any, Dict, List, Optional
+
+
+def dump_local_stacks() -> str:
+    """Format every thread's current Python stack (py-spy dump analog)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out: List[str] = []
+    for tid, frame in sorted(sys._current_frames().items()):
+        name = names.get(tid, "?")
+        out.append(f"--- thread {name} (tid={tid}) ---")
+        out.extend(
+            line.rstrip("\n")
+            for line in traceback.format_stack(frame)
+        )
+    return "\n".join(out)
+
+
+def memory_profile_local(action: str = "snapshot", top: int = 10):
+    """tracemalloc control (memray analog): action in start|stop|snapshot.
+    Snapshot returns the top allocation sites since start()."""
+    import tracemalloc
+
+    if action == "start":
+        if not tracemalloc.is_tracing():
+            tracemalloc.start()
+        return {"tracing": True}
+    if action == "stop":
+        if tracemalloc.is_tracing():
+            tracemalloc.stop()
+        return {"tracing": False}
+    if action != "snapshot":
+        raise ValueError(f"unknown memory_profile action {action!r}")
+    if not tracemalloc.is_tracing():
+        return {"tracing": False, "top": []}
+    snap = tracemalloc.take_snapshot()
+    stats = snap.statistics("lineno")[: max(top, 1)]
+    return {
+        "tracing": True,
+        "top": [
+            {
+                "site": str(s.traceback[0]) if s.traceback else "?",
+                "size_bytes": s.size,
+                "count": s.count,
+            }
+            for s in stats
+        ],
+    }
+
+
+# ----------------------------------------------------------- cluster-facing
+
+
+def get_cluster_stacks(
+    address: Optional[str] = None, include_driver: bool = True
+) -> Dict[str, str]:
+    """Per-node stack dumps for every alive node (reference: ``ray stack``),
+    keyed by node id. With ``include_driver`` the calling process's own
+    stacks are added under "driver" (off for detached tools like the CLI,
+    whose stacks are noise)."""
+    from ray_tpu.util.state import _call
+
+    out = dict(_call("cluster_stacks", {}, address).get("nodes", {}))
+    if include_driver:
+        out["driver"] = dump_local_stacks()
+    return out
+
+
+def node_memory_profile(
+    node_id: str, action: str = "snapshot", top: int = 10,
+    address: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Drive tracemalloc on one node: start -> (workload) -> snapshot."""
+    from ray_tpu.util.state import _call
+
+    return _call(
+        "node_debug",
+        {"node_id": node_id, "method": "memory_profile",
+         "action": action, "top": top},
+        address,
+    )
