@@ -182,6 +182,9 @@ def init(
             driver.start_driver()
             _worker_mod.global_worker = driver
         atexit.register(shutdown)
+        from ray_tpu._private.usage_stats import record_session_start
+
+        record_session_start(extra={"mode": "connect" if address else "local"})
         return ClientContext(driver)
 
 

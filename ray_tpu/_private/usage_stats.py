@@ -1,0 +1,52 @@
+"""Usage stats: opt-out, local-record-only.
+
+Reference analog: ``python/ray/_private/usage`` + ``usage_stats_client.cc``
+(opt-out usage pings). This environment has no egress, so the equivalent
+records a single local JSON blob per session under the session temp dir —
+the collection/opt-out shape is preserved (RAY_TPU_USAGE_STATS_ENABLED=0
+disables), the reporting sink is a file instead of a service.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Optional
+
+
+def usage_stats_enabled() -> bool:
+    return os.environ.get("RAY_TPU_USAGE_STATS_ENABLED", "1") != "0"
+
+
+def record_session_start(session_dir: Optional[str] = None,
+                         extra: Optional[dict] = None) -> Optional[str]:
+    """Write the session's usage record; returns the path or None when
+    disabled/unwritable. Never raises — telemetry must not break startup."""
+    if not usage_stats_enabled():
+        return None
+    try:
+        # per-uid dir (multi-user hosts must not collide on a shared /tmp
+        # path) and a timestamped name (PID reuse must not overwrite a
+        # prior session's record)
+        uid = os.getuid() if hasattr(os, "getuid") else 0
+        d = session_dir or os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), f"ray_tpu_{uid}"
+        )
+        os.makedirs(d, exist_ok=True)
+        payload = {
+            "schema_version": 1,
+            "timestamp": time.time(),
+            "python": sys.version.split()[0],
+            "platform": sys.platform,
+            "num_cpus": os.cpu_count(),
+            **(extra or {}),
+        }
+        path = os.path.join(
+            d, f"usage_stats_{int(time.time() * 1000)}_{os.getpid()}.json"
+        )
+        with open(path, "w") as f:
+            json.dump(payload, f)
+        return path
+    except Exception:
+        return None
