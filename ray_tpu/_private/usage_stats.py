@@ -33,7 +33,14 @@ def record_session_start(session_dir: Optional[str] = None,
         d = session_dir or os.path.join(
             os.environ.get("TMPDIR", "/tmp"), f"ray_tpu_{uid}"
         )
-        os.makedirs(d, exist_ok=True)
+        os.makedirs(d, mode=0o700, exist_ok=True)
+        # /tmp is shared: never write into a directory another user (or a
+        # symlink planter) controls
+        st = os.lstat(d)
+        import stat as _stat
+
+        if not _stat.S_ISDIR(st.st_mode) or st.st_uid != uid:
+            return None
         payload = {
             "schema_version": 1,
             "timestamp": time.time(),

@@ -146,7 +146,9 @@ def param_axes(config: GPT2Config) -> Dict[str, Any]:
         "ln_f_b": ("norm",),
     }
     if config.moe is not None:
-        axes["blocks"]["moe"] = moe_param_axes(num_layers=config.num_layers)
+        axes["blocks"]["moe"] = moe_param_axes(
+            num_layers=config.num_layers, config=config.moe
+        )
     return axes
 
 
@@ -367,8 +369,13 @@ def forward_pipelined(
 ) -> jax.Array:
     """Pipeline-parallel forward: blocks run under the GPipe microbatch loop
     (``parallel.pipeline.pipeline_apply``) over the "stage" mesh axis;
-    embedding/head run outside the pipe. MoE aux loss is not accumulated in
-    the pipelined path (stage-local scalars; TODO round 2)."""
+    embedding/head run outside the pipe."""
+    if config.moe is not None:
+        raise NotImplementedError(
+            "MoE + pipeline parallelism: the microbatch loop would silently "
+            "drop the router's load-balancing aux loss (experts could "
+            "collapse unnoticed); train MoE models without the stage axis"
+        )
     from jax.sharding import PartitionSpec as P
 
     from ray_tpu.parallel.pipeline import pipeline_apply

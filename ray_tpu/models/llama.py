@@ -24,6 +24,12 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from ray_tpu.ops.attention import attention
+from ray_tpu.parallel.moe import (
+    MoEConfig,
+    init_moe_params,
+    moe_layer,
+    moe_param_axes,
+)
 
 
 @dataclass(frozen=True)
@@ -42,6 +48,9 @@ class LlamaConfig:
     attention_impl: str = "auto"     # auto | xla | flash | ring | ulysses
     remat: bool = True
     seq_axis: str = "seq"
+    # Mixtral-style MoE: replaces the SwiGLU MLP with routed experts (use
+    # MoEConfig(activation="swiglu") for the Mixtral shape).
+    moe: Optional[MoEConfig] = None
 
     @property
     def head_dim(self) -> int:
@@ -99,19 +108,26 @@ def init_params(config: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
     def normal(key, shape, s=std):
         return (jax.random.normal(key, shape) * s).astype(pd)
 
+    blocks = {
+        "attn_norm": jnp.ones((L, E), pd),
+        "wq": normal(k[1], (L, E, H, D)),
+        "wk": normal(k[2], (L, E, KV, D)),
+        "wv": normal(k[3], (L, E, KV, D)),
+        "wo": normal(k[4], (L, H, D, E), res_std),
+        "mlp_norm": jnp.ones((L, E), pd),
+    }
+    if config.moe is not None:
+        # routed experts replace the dense FFN (never materialize both)
+        blocks["moe"] = init_moe_params(
+            k[5], E, M, config.moe, pd, num_layers=L
+        )
+    else:
+        blocks["w_gate"] = normal(k[5], (L, E, M))
+        blocks["w_up"] = normal(k[6], (L, E, M))
+        blocks["w_down"] = normal(k[7], (L, M, E), res_std)
     return {
         "wte": normal(k[0], (V, E)),
-        "blocks": {
-            "attn_norm": jnp.ones((L, E), pd),
-            "wq": normal(k[1], (L, E, H, D)),
-            "wk": normal(k[2], (L, E, KV, D)),
-            "wv": normal(k[3], (L, E, KV, D)),
-            "wo": normal(k[4], (L, H, D, E), res_std),
-            "mlp_norm": jnp.ones((L, E), pd),
-            "w_gate": normal(k[5], (L, E, M)),
-            "w_up": normal(k[6], (L, E, M)),
-            "w_down": normal(k[7], (L, M, E), res_std),
-        },
+        "blocks": blocks,
         "norm_f": jnp.ones((E,), pd),
         "lm_head": normal(k[8], (V, E)),
     }
@@ -121,7 +137,7 @@ def param_axes(config: LlamaConfig) -> Dict[str, Any]:
     """Logical axis names per parameter (see sharding.DEFAULT_RULES).
     kv-head dims use the "kv" axis (replicated by default — GQA kv heads
     often don't divide the tensor axis; override rules to shard them)."""
-    return {
+    axes = {
         "wte": ("vocab", "embed"),
         "blocks": {
             "attn_norm": ("stage", "norm"),
@@ -137,6 +153,13 @@ def param_axes(config: LlamaConfig) -> Dict[str, Any]:
         "norm_f": ("norm",),
         "lm_head": ("vocab", "embed"),
     }
+    if config.moe is not None:
+        for name in ("w_gate", "w_up", "w_down"):
+            del axes["blocks"][name]
+        axes["blocks"]["moe"] = moe_param_axes(
+            num_layers=config.num_layers, config=config.moe
+        )
+    return axes
 
 
 def _rms_norm(x, g, eps):
@@ -181,9 +204,22 @@ def _attention_dispatch(config: LlamaConfig, q, k, v, mesh: Optional[Mesh]):
     return attention(q, k, v, causal=True, impl=impl)
 
 
+def _ffn(config: LlamaConfig, layer, x, rng=None):
+    """mlp_norm + SwiGLU MLP (or routed MoE) + residual → (x, aux_loss)."""
+    h = _rms_norm(x, layer["mlp_norm"], config.rms_eps)
+    if config.moe is not None:
+        h, aux = moe_layer(layer["moe"], h, config.moe, rng=rng)
+        return x + h, aux
+    gate = jnp.einsum("bte,em->btm", h, layer["w_gate"].astype(h.dtype))
+    up = jnp.einsum("bte,em->btm", h, layer["w_up"].astype(h.dtype))
+    h = jax.nn.silu(gate) * up
+    h = jnp.einsum("btm,me->bte", h, layer["w_down"].astype(h.dtype))
+    return x + h, jnp.float32(0.0)
+
+
 def _block(config: LlamaConfig, mesh: Optional[Mesh], x, layer,
-           pos: jax.Array):
-    """One decoder block. x: [B, T, E], pos: [B, T] absolute positions."""
+           pos: jax.Array, rng=None):
+    """One decoder block → (x, aux). x: [B, T, E], pos: [B, T] absolute."""
     h = _rms_norm(x, layer["attn_norm"], config.rms_eps)
     q = jnp.einsum("bte,ehd->bthd", h, layer["wq"].astype(h.dtype))
     k = jnp.einsum("bte,ehd->bthd", h, layer["wk"].astype(h.dtype))
@@ -194,13 +230,7 @@ def _block(config: LlamaConfig, mesh: Optional[Mesh], x, layer,
     v = _repeat_kv(v, config.q_per_kv)
     attn = _attention_dispatch(config, q, k, v, mesh)
     x = x + jnp.einsum("bthd,hde->bte", attn, layer["wo"].astype(x.dtype))
-
-    h = _rms_norm(x, layer["mlp_norm"], config.rms_eps)
-    gate = jnp.einsum("bte,em->btm", h, layer["w_gate"].astype(h.dtype))
-    up = jnp.einsum("bte,em->btm", h, layer["w_up"].astype(h.dtype))
-    h = jax.nn.silu(gate) * up
-    h = jnp.einsum("btm,me->bte", h, layer["w_down"].astype(h.dtype))
-    return x + h
+    return _ffn(config, layer, x, rng=rng)
 
 
 def forward(
@@ -208,10 +238,9 @@ def forward(
     tokens: jax.Array,
     config: LlamaConfig,
     mesh: Optional[Mesh] = None,
-    rng: Optional[jax.Array] = None,  # unused; gpt2-interface parity
+    rng: Optional[jax.Array] = None,  # feeds MoE router jitter
 ) -> Tuple[jax.Array, jax.Array]:
-    """tokens [B, T] int32 -> (logits [B, T, V] f32, aux loss scalar=0)."""
-    del rng
+    """tokens [B, T] int32 -> (logits [B, T, V] f32, moe aux loss)."""
     B, T = tokens.shape
     x = params["wte"][tokens].astype(config.dtype)
     pos = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
@@ -220,13 +249,31 @@ def forward(
     if config.remat:
         body = jax.checkpoint(body)
 
-    def scan_fn(x, layer):
-        return body(x, layer, pos), None
+    if rng is not None:
+        layer_rngs = jax.random.split(rng, config.num_layers)
 
-    x, _ = jax.lax.scan(scan_fn, x, params["blocks"])
+        def scan_rng(carry, xs):
+            layer, lrng = xs
+            x, aux = carry
+            x, layer_aux = body(x, layer, pos, lrng)
+            return (x, aux + layer_aux), None
+
+        (x, aux), _ = jax.lax.scan(
+            scan_rng, (x, jnp.float32(0.0)), (params["blocks"], layer_rngs)
+        )
+    else:
+
+        def scan_fn(carry, layer):
+            x, aux = carry
+            x, layer_aux = body(x, layer, pos)
+            return (x, aux + layer_aux), None
+
+        (x, aux), _ = jax.lax.scan(
+            scan_fn, (x, jnp.float32(0.0)), params["blocks"]
+        )
     x = _rms_norm(x, params["norm_f"], config.rms_eps)
     logits = jnp.einsum("bte,ve->btv", x, params["lm_head"].astype(x.dtype))
-    return logits.astype(jnp.float32), jnp.float32(0.0)
+    return logits.astype(jnp.float32), aux
 
 
 def init_kv_cache(config: LlamaConfig, batch: int, max_len: int,
@@ -249,6 +296,11 @@ def forward_cached(
     """Incremental forward with RoPE at absolute positions; same contract as
     :func:`ray_tpu.models.gpt2.forward_cached` (static shapes; per-sequence
     offsets via vmapped dynamic_update_slice)."""
+    if config.moe is not None:
+        raise NotImplementedError(
+            "forward_cached: dense llama only (the decode engine gates "
+            "MoE models the same way)"
+        )
     B, T = tokens.shape
     S = cache["k"].shape[2]
     pos = start[:, None] + jnp.arange(T)[None, :]            # [B, T]
@@ -304,8 +356,8 @@ def loss_fn(
     pipeline_microbatches: Optional[int] = None,
     rng: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Next-token cross entropy; same batch contract as gpt2.loss_fn."""
-    del rng
+    """Next-token cross entropy; same batch contract as gpt2.loss_fn.
+    ``rng`` feeds MoE router jitter (unpipelined path only)."""
     if "tokens" in batch:
         inputs = batch["tokens"][:, :-1]
         targets = batch["tokens"][:, 1:]
@@ -316,7 +368,7 @@ def loss_fn(
             params, inputs, config, mesh, pipeline_microbatches
         )
     else:
-        logits, aux = forward(params, inputs, config, mesh)
+        logits, aux = forward(params, inputs, config, mesh, rng=rng)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     mask = batch.get("mask")
@@ -334,6 +386,12 @@ def forward_pipelined(
 ) -> Tuple[jax.Array, jax.Array]:
     """Pipeline-parallel forward over the "stage" mesh axis (GPipe microbatch
     loop, ``parallel.pipeline.pipeline_apply``); embedding/head outside."""
+    if config.moe is not None:
+        raise NotImplementedError(
+            "MoE + pipeline parallelism: the microbatch loop would silently "
+            "drop the router's load-balancing aux loss (experts could "
+            "collapse unnoticed); train MoE models without the stage axis"
+        )
     from jax.sharding import PartitionSpec as P
 
     from ray_tpu.parallel.pipeline import pipeline_apply
@@ -348,10 +406,13 @@ def forward_pipelined(
 
     def apply_stage(local_blocks, mb):
         # Microbatches split the batch dim; positions are batch-invariant.
+        # MoE aux loss is not accumulated in the pipelined path
+        # (stage-local scalars; same TODO as gpt2.forward_pipelined).
         mb_pos = pos[: mb.shape[0]]
 
         def scan_fn(x, layer):
-            return body(x, layer, mb_pos), None
+            y, _ = body(x, layer, mb_pos)
+            return y, None
 
         out, _ = jax.lax.scan(scan_fn, mb, local_blocks)
         return out
@@ -372,9 +433,16 @@ def count_params(params) -> int:
 
 
 def flops_per_token(config: LlamaConfig) -> float:
-    """~6N FLOPs/token for training; N = non-embedding params."""
+    """~6N FLOPs/token for training; N = ACTIVE non-embedding params
+    (MoE counts only the top_k routed experts per token)."""
     E, D = config.embed_dim, config.head_dim
     attn = E * config.num_heads * D * 2 + E * config.num_kv_heads * D * 2
-    mlp = 3 * E * config.hidden_dim
+    if config.moe is not None:
+        per_expert = (
+            3 if config.moe.activation == "swiglu" else 2
+        ) * E * config.hidden_dim
+        mlp = config.moe.top_k * per_expert + E * config.moe.num_experts
+    else:
+        mlp = 3 * E * config.hidden_dim
     n = config.num_layers * (attn + mlp) + config.vocab_size * E
     return 6.0 * n

@@ -24,6 +24,8 @@ class MoEConfig:
     capacity_factor: float = 1.25
     aux_loss_weight: float = 0.01
     router_jitter: float = 0.0
+    # "gelu" (Switch-style experts) | "swiglu" (Mixtral-style gated experts)
+    activation: str = "gelu"
 
 
 def init_moe_params(
@@ -31,27 +33,35 @@ def init_moe_params(
     param_dtype=jnp.float32, num_layers: Optional[int] = None,
 ) -> Dict[str, jax.Array]:
     """Per-layer expert weights; with num_layers, adds a leading stacked dim."""
-    k1, k2, k3 = jax.random.split(key, 3)
+    k1, k2, k3, k4 = jax.random.split(key, 4)
     lead = () if num_layers is None else (num_layers,)
     E = config.num_experts
 
     def normal(key, shape, s=0.02):
         return (jax.random.normal(key, shape) * s).astype(param_dtype)
 
-    return {
+    params = {
         "router_w": normal(k1, lead + (embed_dim, E)),
         "expert_fc": normal(k2, lead + (E, embed_dim, mlp_dim)),
         "expert_out": normal(k3, lead + (E, mlp_dim, embed_dim)),
     }
+    if config.activation == "swiglu":
+        # Mixtral-style gated experts: fc is the "up" proj, gate multiplies
+        params["expert_gate"] = normal(k4, lead + (E, embed_dim, mlp_dim))
+    return params
 
 
-def moe_param_axes(num_layers: Optional[int] = None) -> Dict[str, tuple]:
+def moe_param_axes(num_layers: Optional[int] = None,
+                   config: Optional[MoEConfig] = None) -> Dict[str, tuple]:
     lead = () if num_layers is None else ("stage",)
-    return {
+    axes = {
         "router_w": lead + ("embed", None),
         "expert_fc": lead + ("expert", "embed", "mlp"),
         "expert_out": lead + ("expert", "mlp", "embed"),
     }
+    if config is not None and config.activation == "swiglu":
+        axes["expert_gate"] = lead + ("expert", "embed", "mlp")
+    return axes
 
 
 def _top_k_mask(probs: jax.Array, k: int) -> jax.Array:
@@ -104,7 +114,12 @@ def moe_layer(
     expert_in = jnp.einsum("tec,td->ecd", dispatch, tokens)  # [E, C, D]
     h = jnp.einsum("ecd,edm->ecm", expert_in,
                    params["expert_fc"].astype(x.dtype))
-    h = jax.nn.gelu(h)
+    if config.activation == "swiglu":
+        gate = jnp.einsum("ecd,edm->ecm", expert_in,
+                          params["expert_gate"].astype(x.dtype))
+        h = jax.nn.silu(gate) * h
+    else:
+        h = jax.nn.gelu(h)
     expert_out = jnp.einsum("ecm,emd->ecd", h,
                             params["expert_out"].astype(x.dtype))
     out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_out)

@@ -117,6 +117,10 @@ def default_jax_train_loop(config: Dict[str, Any]):
         for k in ("dtype", "param_dtype"):
             if isinstance(model.get(k), str):
                 model[k] = jnp.dtype(model[k]).type
+        if isinstance(model.get("moe"), dict):
+            from ray_tpu.parallel.moe import MoEConfig
+
+            model["moe"] = MoEConfig(**model["moe"])
         if family == "llama":
             from ray_tpu.models.llama import LlamaConfig
 
