@@ -207,6 +207,14 @@ class CoreWorker:
         self.num_task_slots = int(self.node_resources.get("CPU", 1)) or 1
         # Native transfer-server address, set in start() when available.
         self.xfer_addr: Optional[Tuple[str, int]] = None
+        # Streaming-generator tasks this worker submitted:
+        # tid hex -> {"count": total or None, "event": asyncio.Event,
+        #             "produced": int, "consumed": int, "abandoned": bool,
+        #             "conn": producer connection (set on first item)}
+        self._task_streams: Dict[str, dict] = {}
+        # Streams this worker is EXECUTING: tid hex -> {"consumed": int,
+        # "event": asyncio.Event} (owner credits; bounds in-flight items)
+        self._stream_credits: Dict[str, dict] = {}
         self._shutdown = False
         self._stats = {"tasks_executed": 0, "tasks_submitted": 0}
         self._task_events_buf: List[dict] = []
@@ -755,13 +763,17 @@ class CoreWorker:
         args,
         kwargs,
         *,
-        num_returns: int = 1,
+        num_returns=1,
         resources: Optional[Dict[str, float]] = None,
         strategy: Optional[dict] = None,
         max_retries: int = 3,
         name: str = "",
         runtime_env: Optional[dict] = None,
-    ) -> List[ObjectRef]:
+    ):
+        """Returns a list of ObjectRefs, or a StreamingObjectRefGenerator
+        when num_returns == "streaming" (reference: generator tasks,
+        ``task_manager.h`` streaming returns)."""
+        streaming = num_returns == "streaming"
         fkey = self.export_function(fn)
         task_id = TaskID.of()
         frames, ref_ids, borrow_ids = self._serialize_args(args, kwargs)
@@ -770,7 +782,7 @@ class CoreWorker:
         header = {
             "tid": task_id.hex(),
             "fkey": fkey,
-            "nret": num_returns,
+            "nret": -1 if streaming else num_returns,
             "argrefs": ref_ids,
             "borrows": borrow_ids,
             "owner": list(self.addr),
@@ -781,17 +793,26 @@ class CoreWorker:
 
         if tracing_helper.enabled():
             header["trace"] = tracing_helper.inject_context()
+        if streaming:
+            # A re-executed generator would re-emit items: no retries.
+            max_retries = 0
+            self._task_streams[task_id.hex()] = {"count": None, "produced": 0}
         refs = []
-        for i in range(num_returns):
-            oid = ObjectID.for_return(task_id, i)
-            self._register_owned(oid.hex())
-            refs.append(ObjectRef(oid, tuple(self.addr)))
+        if not streaming:
+            for i in range(num_returns):
+                oid = ObjectID.for_return(task_id, i)
+                self._register_owned(oid.hex())
+                refs.append(ObjectRef(oid, tuple(self.addr)))
         self._stats["tasks_submitted"] += 1
         self.loop.call_soon_threadsafe(
             lambda: self.loop.create_task(
                 self._dispatch_task(header, frames, resources, strategy, max_retries)
             )
         )
+        if streaming:
+            from ray_tpu.object_ref import StreamingObjectRefGenerator
+
+            return StreamingObjectRefGenerator(self, task_id, tuple(self.addr))
         return refs
 
     def _sched_key(self, resources, strategy):
@@ -840,6 +861,21 @@ class CoreWorker:
 
     def _fail_task(self, header, err: Exception):
         tid = TaskID.from_hex(header["tid"])
+        if header["nret"] == -1:
+            # streaming: the failure becomes the final item so consumers
+            # iterate up to it and then raise
+            rec = self._task_streams.get(header["tid"])
+            produced = rec.get("produced", 0) if rec else 0
+            self._store_error(
+                ObjectID.for_return(tid, produced).hex(), err
+            )
+            if rec is not None:
+                rec["count"] = produced + 1
+                ev = rec.get("event")
+                if ev is not None:
+                    ev.set()
+            self._release_borrows(header.get("borrows", []))
+            return
         for i in range(header["nret"]):
             self._store_error(ObjectID.for_return(tid, i).hex(), err)
         self._release_borrows(header.get("borrows", []))
@@ -1017,6 +1053,16 @@ class CoreWorker:
         """Process a push_task reply: inline values, shm descriptors, errors."""
         tid = TaskID.from_hex(header["tid"])
         self._release_borrows(header.get("borrows", []))
+        if h.get("stream"):
+            rec = self._task_streams.get(header["tid"])
+            if rec is not None:
+                rec["count"] = h.get("count", 0)
+                ev = rec.get("event")
+                if ev is not None:
+                    ev.set()
+                if rec.get("abandoned"):
+                    self._task_streams.pop(header["tid"], None)
+            return
         rets = h.get("rets", [])
         cursor = 0
         for i, r in enumerate(rets):
@@ -1112,6 +1158,12 @@ class CoreWorker:
         num_returns: int = 1,
         max_task_retries: int = 0,
     ) -> List[ObjectRef]:
+        if num_returns == "streaming":
+            raise ValueError(
+                "num_returns='streaming' is not supported for actor "
+                "methods (only plain tasks); return a list, or move the "
+                "generator into a task"
+            )
         task_id = TaskID.of(ActorID.from_hex(actor_id_hex))
         frames, ref_ids, borrow_ids = self._serialize_args(args, kwargs)
         header = {
@@ -1444,6 +1496,8 @@ class CoreWorker:
             )
         fn = await self._load_function(h["fkey"])
         args, kwargs = await self._materialize_args(h, frames)
+        if h.get("nret") == -1:
+            return await self._execute_streaming_task(h, fn, args, kwargs, conn)
         loop = asyncio.get_running_loop()
 
         def run():
@@ -1476,6 +1530,216 @@ class CoreWorker:
             "node_id": self.node_id,
         })
         return await self._package_result(h, ok, result)
+
+    async def _execute_streaming_task(self, h, fn, args, kwargs, conn):
+        """Run a generator task, pushing each yielded item to the owner as
+        it is produced (reference: streaming generator returns — the owner
+        can consume item i while item i+1 is still being computed). The
+        bounded queue backpressures the producer against a slow consumer
+        path; items ride oneway "stream_item" messages on the same
+        connection, so they arrive before the final count reply."""
+        import inspect
+
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue(maxsize=8)
+        tid = TaskID.from_hex(h["tid"])
+        t0 = time.time()
+
+        def produce():
+            old = self._apply_runtime_env(h.get("renv"))
+            self.current_task_id.value = tid
+            self.current_actor_id.value = None
+            self.put_counter.value = 0
+            try:
+                gen = fn(*args, **kwargs)
+                if not inspect.isgenerator(gen):
+                    raise TypeError(
+                        "num_returns='streaming' requires a generator "
+                        f"function; {h.get('name', 'task')} returned "
+                        f"{type(gen).__name__}"
+                    )
+                for item in gen:
+                    asyncio.run_coroutine_threadsafe(
+                        q.put(("item", item)), loop
+                    ).result()
+                asyncio.run_coroutine_threadsafe(
+                    q.put(("end", None)), loop
+                ).result()
+            except Exception as e:
+                tb = traceback.format_exc()
+                try:
+                    asyncio.run_coroutine_threadsafe(
+                        q.put(("err", (e, tb))), loop
+                    ).result()
+                except Exception:
+                    pass
+            finally:
+                self._restore_env(old)
+
+        prod = loop.run_in_executor(self.task_executor, produce)
+        credits = self._stream_credits[h["tid"]] = {
+            "consumed": 0, "event": asyncio.Event(),
+        }
+        idx = 0
+        failed = False
+        while True:
+            kind, payload = await q.get()
+            if kind == "item":
+                try:
+                    # Owner-side flow control: never run more than WINDOW
+                    # items ahead of what the consumer acknowledged — a fast
+                    # producer must not fill the owner's memory. A consumer
+                    # silent for 10 minutes fails the stream rather than
+                    # pinning this executor slot forever.
+                    while idx >= credits["consumed"] + self._STREAM_WINDOW:
+                        credits["event"].clear()
+                        try:
+                            await asyncio.wait_for(
+                                credits["event"].wait(), timeout=600
+                            )
+                        except asyncio.TimeoutError:
+                            raise exc.RayTpuError(
+                                "stream consumer stalled >600s; aborting "
+                                "generator task"
+                            )
+                    await self._send_stream_item(conn, h, tid, idx, payload)
+                    idx += 1
+                except Exception as e:
+                    await self._send_stream_error(
+                        conn, h, tid, idx,
+                        exc.TaskError(f"stream item send failed: {e!r}"),
+                    )
+                    idx += 1
+                    failed = True
+                    # drain so the (blocked) producer can finish
+                    while (await q.get())[0] == "item":
+                        pass
+                    break
+            elif kind == "err":
+                e, tb = payload
+                await self._send_stream_error(
+                    conn, h, tid, idx, exc.TaskError(repr(e), tb, cause=e)
+                )
+                idx += 1
+                failed = True
+                break
+            else:
+                break
+        await prod
+        self._stream_credits.pop(h["tid"], None)
+        self._stats["tasks_executed"] += 1
+        self._record_task_event({
+            "task_id": h["tid"], "name": h.get("name") or h["fkey"],
+            "type": "NORMAL_TASK",
+            "state": "FAILED" if failed else "FINISHED",
+            "start_time": t0, "end_time": time.time(),
+            "node_id": self.node_id,
+        })
+        return {"stream": 1, "count": idx}, []
+
+    async def _send_stream_item(self, conn, h, tid, idx, value):
+        sobj = self.ctx.serialize(value)
+        base = {"tid": h["tid"], "idx": idx}
+        if sobj.total_bytes() <= INLINE_OBJECT_MAX:
+            conn.notify(
+                "stream_item", {**base, "kind": "mem"}, sobj.to_frames()
+            )
+        else:
+            oid = ObjectID.for_return(tid, idx).hex()
+            meta = self._with_xfer(
+                self.shm.put_frames(oid, sobj.to_frames(copy=False))
+            )
+            await self.gcs.call("object_register", {"oid": oid, "meta": meta})
+            conn.notify("stream_item", {**base, "kind": "shm", "meta": meta})
+
+    # Max items a generator may run ahead of its consumer's acknowledgments.
+    _STREAM_WINDOW = 16
+
+    async def rpc_stream_credit(self, h, frames, conn):
+        """Executor side: the consumer acknowledged items up to `consumed`
+        (or abandoned the stream — consumed jumps effectively unbounded so
+        the producer drains to completion instead of hanging)."""
+        rec = self._stream_credits.get(h["tid"])
+        if rec is not None:
+            rec["consumed"] = max(rec["consumed"], int(h["consumed"]))
+            rec["event"].set()
+        return {}, []
+
+    def _send_stream_credit(self, tid_hex: str, consumed: int):
+        """Owner side: fire a credit on the stream's producer connection."""
+        rec = self._task_streams.get(tid_hex)
+        conn = rec.get("conn") if rec else None
+        if conn is None:
+            return
+        try:
+            conn.notify(
+                "stream_credit", {"tid": tid_hex, "consumed": consumed}
+            )
+        except Exception:
+            pass  # producer gone: nothing left to throttle
+
+    def _abandon_stream(self, tid_hex: str, next_index: int):
+        """The consumer dropped its generator: free arrived-but-unconsumed
+        items, discard future arrivals, and un-throttle the producer so the
+        executing task can run to completion."""
+        rec = self._task_streams.get(tid_hex)
+        if rec is None:
+            return
+        rec["abandoned"] = True
+        tid = TaskID.from_hex(tid_hex)
+        for i in range(next_index, rec.get("produced", 0)):
+            self._dec_ref_local(ObjectID.for_return(tid, i).hex())
+        self._send_stream_credit(tid_hex, 1 << 60)
+        if rec.get("count") is not None:
+            self._task_streams.pop(tid_hex, None)
+
+    async def _send_stream_error(self, conn, h, tid, idx, err):
+        try:
+            fr = self.ctx.serialize(err).to_frames()
+        except Exception:
+            fr = self.ctx.serialize(
+                exc.TaskError(f"unserializable stream error: {err!r}")
+            ).to_frames()
+        conn.notify(
+            "stream_item", {"tid": h["tid"], "idx": idx, "kind": "err"}, fr
+        )
+
+    async def rpc_stream_item(self, h, frames, conn):
+        """Owner side: one streamed item landed (stored like a task return;
+        an "err" item raises on get, ending consumption with the failure)."""
+        rec = self._task_streams.get(h["tid"])
+        if rec is not None:
+            rec["conn"] = conn  # credit/abandon messages ride this
+        if rec is None or rec.get("abandoned"):
+            # consumer is gone: discard, and free any shm registration
+            if h["kind"] == "shm":
+                oid = ObjectID.for_return(
+                    TaskID.from_hex(h["tid"]), h["idx"]
+                ).hex()
+                try:
+                    self.gcs.notify("object_free", {"oids": [oid]})
+                except Exception:
+                    pass
+            return {}, []
+        oid = ObjectID.for_return(
+            TaskID.from_hex(h["tid"]), h["idx"]
+        ).hex()
+        if h["kind"] == "mem":
+            entry = ("mem", frames)
+        elif h["kind"] == "shm":
+            entry = ("shm", h["meta"])
+        else:
+            entry = ("err", self.ctx.deserialize_frames(frames))
+        self.memory_store[oid] = entry
+        self._register_owned(oid)
+        ev = self.store_events.get(oid)
+        if ev is not None:
+            ev.set()
+        rec["produced"] = max(rec.get("produced", 0), h["idx"] + 1)
+        sev = rec.get("event")
+        if sev is not None:
+            sev.set()
+        return {}, []
 
     async def _package_result(self, h, ok, result):
         nret = h.get("nret", 1)

@@ -101,22 +101,74 @@ def _deserialize_ref(object_id: ObjectID, owner: Optional[tuple]) -> ObjectRef:
 
 
 class StreamingObjectRefGenerator:
-    """Iterator over a dynamic number of returns (reference: streaming generators,
-    ``core_worker/task_manager.h`` generator returns)."""
+    """Iterator over a generator task's returns (reference: streaming
+    generators, ``core_worker/task_manager.h``). ``__next__`` yields the
+    next item's ObjectRef as soon as the remote generator produced it — the
+    consumer processes item i while item i+1 is still being computed. When
+    the task failed, the final yielded ref raises on ``get``."""
 
-    def __init__(self, refs: List[ObjectRef]):
-        self._refs = list(refs)
+    def __init__(self, worker, task_id, owner_addr):
+        self._worker = worker
+        self._task_id = task_id
+        self._owner_addr = tuple(owner_addr)
         self._i = 0
+        self._exhausted = False
 
     def __iter__(self):
         return self
 
-    def __next__(self) -> ObjectRef:
-        if self._i >= len(self._refs):
-            raise StopIteration
-        ref = self._refs[self._i]
-        self._i += 1
-        return ref
+    def __next__(self) -> "ObjectRef":
+        import asyncio
 
-    def __len__(self):
-        return len(self._refs)
+        from ray_tpu._private.ids import ObjectID
+
+        w = self._worker
+        tid_hex = self._task_id.hex()
+        i = self._i
+
+        async def wait_next():
+            rec = w._task_streams.get(tid_hex)
+            while True:
+                oid = ObjectID.for_return(self._task_id, i).hex()
+                if oid in w.memory_store:
+                    return oid
+                if rec is None or (
+                    rec["count"] is not None and i >= rec["count"]
+                ):
+                    return None
+                ev = rec.get("event")
+                if ev is None:
+                    ev = rec["event"] = asyncio.Event()
+                ev.clear()
+                await ev.wait()
+
+        oid = w.run_sync(wait_next())
+        if oid is None:
+            self._exhausted = True
+            w._task_streams.pop(tid_hex, None)  # exhausted: drop the record
+            raise StopIteration
+        self._i += 1
+        # acknowledge consumption: the producer's credit window advances
+        # (owner-side flow control — a fast generator can only run
+        # _STREAM_WINDOW items ahead of this point)
+        w.loop.call_soon_threadsafe(w._send_stream_credit, tid_hex, self._i)
+        return ObjectRef(
+            ObjectID.for_return(self._task_id, i), self._owner_addr
+        )
+
+    def __del__(self):
+        # Abandoned before exhaustion: free unconsumed items, discard
+        # future arrivals, and un-throttle the producer.
+        if getattr(self, "_exhausted", False):
+            return
+        w = self._worker
+        tid_hex = self._task_id.hex()
+        if tid_hex not in getattr(w, "_task_streams", {}):
+            return
+        loop = getattr(w, "loop", None)
+        if loop is None or loop.is_closed():
+            return
+        try:
+            loop.call_soon_threadsafe(w._abandon_stream, tid_hex, self._i)
+        except RuntimeError:
+            pass  # loop tearing down with the process

@@ -27,6 +27,13 @@ class MoEConfig:
     # "gelu" (Switch-style experts) | "swiglu" (Mixtral-style gated experts)
     activation: str = "gelu"
 
+    def __post_init__(self):
+        if self.activation not in ("gelu", "swiglu"):
+            raise ValueError(
+                f"MoEConfig.activation must be 'gelu' or 'swiglu', got "
+                f"{self.activation!r}"
+            )
+
 
 def init_moe_params(
     key: jax.Array, embed_dim: int, mlp_dim: int, config: MoEConfig,

@@ -88,6 +88,8 @@ class RemoteFunction:
             name=opts.get("name", ""),
             runtime_env=opts.get("runtime_env"),
         )
+        if num_returns == "streaming":
+            return refs  # a StreamingObjectRefGenerator
         if num_returns == 1:
             return refs[0]
         return refs

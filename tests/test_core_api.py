@@ -233,3 +233,130 @@ def test_util_actor_pool(rt_start):
     assert pool.get_next() == 81
     assert pool.get_next() == 100
     assert not pool.has_next()
+
+
+# ------------------------------------------------------- streaming generators
+
+
+def test_streaming_generator_task(rt_start):
+    """num_returns="streaming": the task yields, the driver iterates refs
+    (reference: streaming generator returns, task_manager.h)."""
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * i
+
+    out = [ray_tpu.get(ref) for ref in gen.remote(6)]
+    assert out == [0, 1, 4, 9, 16, 25]
+
+
+def test_streaming_items_arrive_before_completion(rt_start):
+    """Items are consumable while the generator is still running."""
+    import time
+
+    @ray_tpu.remote(num_returns="streaming")
+    def slow_gen():
+        for i in range(4):
+            yield i
+            time.sleep(0.4)
+
+    t0 = time.monotonic()
+    it = slow_gen.remote()
+    first = ray_tpu.get(next(it))
+    first_latency = time.monotonic() - t0
+    assert first == 0
+    # total runtime is ~1.6s; the first item must arrive far sooner
+    assert first_latency < 1.0, first_latency
+    assert [ray_tpu.get(r) for r in it] == [1, 2, 3]
+
+
+def test_streaming_large_items(rt_start):
+    import numpy as np
+
+    @ray_tpu.remote(num_returns="streaming")
+    def big_gen():
+        for i in range(3):
+            yield np.full(100_000, float(i))
+
+    outs = [ray_tpu.get(r) for r in big_gen.remote()]
+    assert [float(o[0]) for o in outs] == [0.0, 1.0, 2.0]
+    assert all(o.shape == (100_000,) for o in outs)
+
+
+def test_streaming_error_mid_stream(rt_start):
+    @ray_tpu.remote(num_returns="streaming")
+    def bad_gen():
+        yield 1
+        yield 2
+        raise ValueError("stream broke")
+
+    it = bad_gen.remote()
+    assert ray_tpu.get(next(it)) == 1
+    assert ray_tpu.get(next(it)) == 2
+    with pytest.raises(TaskError, match="stream broke"):
+        ray_tpu.get(next(it))
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_streaming_requires_generator(rt_start):
+    @ray_tpu.remote(num_returns="streaming")
+    def not_a_gen():
+        return 42
+
+    it = not_a_gen.remote()
+    with pytest.raises(TaskError, match="generator"):
+        ray_tpu.get(next(it))
+
+
+def test_streaming_flow_control(rt_start):
+    """A fast producer may only run _STREAM_WINDOW items ahead of the
+    consumer: the owner's memory stays bounded."""
+    import time
+
+    from ray_tpu._private.worker import CoreWorker, get_global_worker
+
+    @ray_tpu.remote(num_returns="streaming")
+    def firehose():
+        for i in range(200):
+            yield i
+
+    w = get_global_worker()
+    it = firehose.remote()
+    first = ray_tpu.get(next(it))
+    assert first == 0
+    time.sleep(1.0)  # producer would finish instantly without the window
+    tid = it._task_id.hex()
+    rec = w._task_streams.get(tid)
+    assert rec is not None and rec["count"] is None  # still throttled
+    assert rec["produced"] <= 1 + CoreWorker._STREAM_WINDOW + 1
+    # draining completes the stream
+    rest = [ray_tpu.get(r) for r in it]
+    assert rest == list(range(1, 200))
+
+
+def test_streaming_abandonment_cleans_up(rt_start):
+    """Dropping the generator frees unconsumed items and lets the producer
+    finish instead of hanging on the credit window."""
+    import gc
+    import time
+
+    from ray_tpu._private.worker import get_global_worker
+
+    @ray_tpu.remote(num_returns="streaming")
+    def many():
+        for i in range(100):
+            yield bytes(10)
+
+    w = get_global_worker()
+    it = many.remote()
+    tid = it._task_id.hex()
+    assert ray_tpu.get(next(it)) == bytes(10)
+    del it
+    gc.collect()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if tid not in w._task_streams:
+            break
+        time.sleep(0.05)
+    assert tid not in w._task_streams, "stream record leaked"
