@@ -4,9 +4,13 @@ from ray_tpu.autoscaler.autoscaler import (
     AutoscalerMonitor,
     NodeTypeConfig,
 )
-from ray_tpu.autoscaler.node_provider import LocalNodeProvider, NodeProvider
+from ray_tpu.autoscaler.node_provider import (
+    GCETPUNodeProvider,
+    LocalNodeProvider,
+    NodeProvider,
+)
 
 __all__ = [
     "Autoscaler", "AutoscalerConfig", "AutoscalerMonitor", "NodeTypeConfig",
-    "NodeProvider", "LocalNodeProvider",
+    "NodeProvider", "LocalNodeProvider", "GCETPUNodeProvider",
 ]

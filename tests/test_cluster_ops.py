@@ -283,3 +283,44 @@ def test_head_state_survives_restart(tmp_path):
     finally:
         proc.terminate()
         proc.wait(timeout=10)
+
+
+def test_gce_tpu_node_provider_fake_gcloud():
+    """GCE TPU-VM provider drives gcloud through an injected runner
+    (reference: the GCP provider + tpu_command_runner.py); slices are the
+    atomic scaling unit and new VMs join the head via startup script."""
+    from ray_tpu.autoscaler import GCETPUNodeProvider
+
+    calls, vms = [], {}
+
+    def fake_gcloud(args):
+        calls.append(args)
+        cmd = args[4]
+        if cmd == "create":
+            vms[args[5]] = {
+                "name": f"projects/p/z/nodes/{args[5]}", "state": "READY",
+            }
+            return ""
+        if cmd == "delete":
+            vms.pop(args[5], None)
+            return ""
+        assert cmd == "list"
+        return json.dumps(list(vms.values()))
+
+    p = GCETPUNodeProvider(
+        "10.0.0.2:6379", project="proj", zone="us-central2-b",
+        node_types={"v5e-16": {"accelerator_type": "v5litepod-16"}},
+        runner=fake_gcloud,
+    )
+    pid = p.create_node("v5e-16", {"TPU": 16.0})
+    create = calls[0]
+    assert "v5litepod-16" in create
+    assert any(
+        "ray_tpu.cli start --address 10.0.0.2:6379" in a for a in create
+    )
+    assert len(p.non_terminated_nodes()) == 1
+    vms.clear()  # VM deleted out-of-band: drops from the provider view
+    assert p.non_terminated_nodes() == []
+    pid2 = p.create_node("v5e-16", {"TPU": 16.0})
+    p.terminate_node(pid2)
+    assert p.non_terminated_nodes() == []
