@@ -129,6 +129,12 @@ class HeadService:
     def __init__(self):
         self.kv: Dict[str, Dict[str, bytes]] = defaultdict(dict)  # ns -> key -> val
         self.nodes: Dict[str, NodeInfo] = {}
+        # Bounded tombstones for the state API: node ids are fresh per
+        # registration, so without pruning both this dict and the native
+        # scheduler's node vector grow forever under autoscaler churn
+        # (reference: GcsNodeManager keeps a capped dead-node cache).
+        self.dead_nodes: Dict[str, NodeInfo] = {}
+        self._DEAD_NODE_CACHE = 256
         self.actors: Dict[str, ActorInfo] = {}
         self.named_actors: Dict[Tuple[str, str], str] = {}  # (ns, name) -> actor_id
         self.pgs: Dict[str, PlacementGroupInfo] = {}
@@ -307,6 +313,11 @@ class HeadService:
             conn=conn,
         )
         self.nodes[info.node_id] = info
+        # A fixed-id node (worker_main --node-id) may re-register after a
+        # death: drop its tombstone or it would be listed both alive and
+        # dead — and the autoscaler's dead_ids check would terminate the
+        # healthy instance on every reconcile.
+        self.dead_nodes.pop(info.node_id, None)
         if self._nsched is not None:
             self._nsched.add_node(info.node_id, info.resources, info.labels)
         conn.peer_info["node_id"] = info.node_id
@@ -358,6 +369,18 @@ class HeadService:
             wid: rec for wid, rec in self.worker_metrics.items()
             if rec.get("node_id") != node_id
         }
+        # Actors/PG reservations are drained above and lease releases tolerate
+        # a missing node, so retire the node now: scheduler state goes away
+        # entirely (best_node scans linearly), the public record moves to the
+        # bounded tombstone cache.
+        if self._nsched is not None:
+            self._nsched.remove_node(node_id)
+        info = self.nodes.pop(node_id, None)
+        if info is not None:
+            info.conn = None
+            self.dead_nodes[node_id] = info
+            while len(self.dead_nodes) > self._DEAD_NODE_CACHE:
+                self.dead_nodes.pop(next(iter(self.dead_nodes)))
 
     async def rpc_cluster_stacks(self, h, frames, conn):
         """Fan out all-thread stack dumps to every alive node (reference:
@@ -403,8 +426,16 @@ class HeadService:
         await self._on_node_dead(h["node_id"], "drained")
         return {}, []
 
+    def _public_nodes(self) -> list:
+        """Alive nodes plus dead tombstones — the state API and the
+        autoscaler (phantom-instance reclaim) both need the dead ones."""
+        return [
+            n.to_public()
+            for n in (*self.nodes.values(), *self.dead_nodes.values())
+        ]
+
     async def rpc_get_nodes(self, h, frames, conn):
-        return {"nodes": [n.to_public() for n in self.nodes.values()]}, []
+        return {"nodes": self._public_nodes()}, []
 
     # -------------------------------------------------------------- scheduler
 
@@ -910,7 +941,7 @@ class HeadService:
         return {
             "pending": list(self.pending_demands.values()),
             "pending_pgs": pending_pgs,
-            "nodes": [n.to_public() for n in self.nodes.values()],
+            "nodes": self._public_nodes(),
         }, []
 
     async def rpc_metrics_push(self, h, frames, conn):

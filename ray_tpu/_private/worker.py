@@ -30,7 +30,12 @@ import os
 import threading
 import time
 import traceback
-from concurrent.futures import Future as SyncFuture, ThreadPoolExecutor
+from concurrent.futures import (
+    CancelledError as SyncCancelledError,
+    Future as SyncFuture,
+    ThreadPoolExecutor,
+    TimeoutError as SyncTimeoutError,
+)
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -871,6 +876,7 @@ class CoreWorker:
             )
             if rec is not None:
                 rec["count"] = produced + 1
+                rec["failed_idx"] = produced
                 ev = rec.get("event")
                 if ev is not None:
                     ev.set()
@@ -1545,6 +1551,36 @@ class CoreWorker:
         tid = TaskID.from_hex(h["tid"])
         t0 = time.time()
 
+        abandon = threading.Event()
+
+        def qput(entry) -> bool:
+            """Blocking put that stays abandonable: the pump (or its
+            teardown) sets `abandon` and this producer thread unblocks
+            within a second even if the event loop never drains the queue
+            again (e.g. the pump task was cancelled)."""
+            # Checked up front: once the pump abandons the stream it drains
+            # the queue, so puts would keep succeeding and an infinite
+            # generator would never stop producing.
+            if abandon.is_set() or loop.is_closed():
+                return False
+            try:
+                f = asyncio.run_coroutine_threadsafe(q.put(entry), loop)
+            except RuntimeError:
+                return False  # loop shut down under us
+            # Never cancel the put: cancellation can race its completion and
+            # a retry would enqueue the entry twice. Keep waiting on the SAME
+            # future, bailing out between waits once abandoned (the dangling
+            # put then lands, at worst, in a queue nobody reads again).
+            while True:
+                try:
+                    f.result(timeout=1.0)
+                    return True
+                except SyncTimeoutError:
+                    if abandon.is_set() or loop.is_closed():
+                        return False
+                except (SyncCancelledError, RuntimeError):
+                    return False  # loop shut down under us
+
         def produce():
             old = self._apply_runtime_env(h.get("renv"))
             self.current_task_id.value = tid
@@ -1559,20 +1595,12 @@ class CoreWorker:
                         f"{type(gen).__name__}"
                     )
                 for item in gen:
-                    asyncio.run_coroutine_threadsafe(
-                        q.put(("item", item)), loop
-                    ).result()
-                asyncio.run_coroutine_threadsafe(
-                    q.put(("end", None)), loop
-                ).result()
+                    if not qput(("item", item)):
+                        return
+                qput(("end", None))
             except Exception as e:
                 tb = traceback.format_exc()
-                try:
-                    asyncio.run_coroutine_threadsafe(
-                        q.put(("err", (e, tb))), loop
-                    ).result()
-                except Exception:
-                    pass
+                qput(("err", (e, tb)))
             finally:
                 self._restore_env(old)
 
@@ -1582,51 +1610,85 @@ class CoreWorker:
         }
         idx = 0
         failed = False
-        while True:
-            kind, payload = await q.get()
-            if kind == "item":
-                try:
-                    # Owner-side flow control: never run more than WINDOW
-                    # items ahead of what the consumer acknowledged — a fast
-                    # producer must not fill the owner's memory. A consumer
-                    # silent for 10 minutes fails the stream rather than
-                    # pinning this executor slot forever.
-                    while idx >= credits["consumed"] + self._STREAM_WINDOW:
-                        credits["event"].clear()
+        sentinel = False  # saw the producer's final "end"/"err" entry
+        try:
+            while True:
+                kind, payload = await q.get()
+                if kind == "item":
+                    try:
+                        # Owner-side flow control: never run more than WINDOW
+                        # items ahead of what the consumer acknowledged — a
+                        # fast producer must not fill the owner's memory. A
+                        # consumer silent for 10 minutes fails the stream
+                        # rather than pinning this executor slot forever.
+                        while idx >= credits["consumed"] + self._STREAM_WINDOW:
+                            credits["event"].clear()
+                            try:
+                                await asyncio.wait_for(
+                                    credits["event"].wait(), timeout=600
+                                )
+                            except asyncio.TimeoutError:
+                                raise exc.RayTpuError(
+                                    "stream consumer stalled >600s; aborting "
+                                    "generator task"
+                                )
+                        await self._send_stream_item(
+                            conn, h, tid, idx, payload
+                        )
+                        idx += 1
+                    except Exception as e:
+                        # The usual cause is the owner connection closing, so
+                        # the error notification itself may fail — it must
+                        # not skip the producer unblock below.
                         try:
-                            await asyncio.wait_for(
-                                credits["event"].wait(), timeout=600
+                            await self._send_stream_error(
+                                conn, h, tid, idx,
+                                exc.TaskError(
+                                    f"stream item send failed: {e!r}"
+                                ),
                             )
-                        except asyncio.TimeoutError:
-                            raise exc.RayTpuError(
-                                "stream consumer stalled >600s; aborting "
-                                "generator task"
-                            )
-                    await self._send_stream_item(conn, h, tid, idx, payload)
-                    idx += 1
-                except Exception as e:
-                    await self._send_stream_error(
-                        conn, h, tid, idx,
-                        exc.TaskError(f"stream item send failed: {e!r}"),
-                    )
+                        except Exception:
+                            pass
+                        idx += 1
+                        failed = True
+                        break
+                elif kind == "err":
+                    e, tb = payload
+                    sentinel = True
+                    try:
+                        await self._send_stream_error(
+                            conn, h, tid, idx,
+                            exc.TaskError(repr(e), tb, cause=e),
+                        )
+                    except Exception:
+                        pass
                     idx += 1
                     failed = True
-                    # drain so the (blocked) producer can finish
-                    while (await q.get())[0] == "item":
-                        pass
                     break
-            elif kind == "err":
-                e, tb = payload
-                await self._send_stream_error(
-                    conn, h, tid, idx, exc.TaskError(repr(e), tb, cause=e)
-                )
-                idx += 1
-                failed = True
-                break
-            else:
-                break
-        await prod
-        self._stream_credits.pop(h["tid"], None)
+                else:
+                    sentinel = True
+                    break
+        finally:
+            # Runs on every exit — send failure, handler cancellation at
+            # teardown, unexpected errors — and must always unblock the
+            # producer thread (queue maxsize is small; a stuck producer
+            # permanently leaks a task_executor slot).
+            self._stream_credits.pop(h["tid"], None)
+            if not sentinel:
+                abandon.set()  # timed puts in the producer observe this
+            try:
+                while not sentinel and not prod.done():
+                    try:
+                        q.get_nowait()
+                    except asyncio.QueueEmpty:
+                        await asyncio.sleep(0.05)
+                await prod
+            except BaseException:
+                # Re-cancelled during teardown: the abandon event still
+                # guarantees the producer exits within its put timeout.
+                if not abandon.is_set():
+                    abandon.set()
+                raise
         self._stats["tasks_executed"] += 1
         self._record_task_event({
             "task_id": h["tid"], "name": h.get("name") or h["fkey"],
@@ -1704,6 +1766,19 @@ class CoreWorker:
             "stream_item", {"tid": h["tid"], "idx": idx, "kind": "err"}, fr
         )
 
+    def _drop_stream_item(self, h):
+        """Discard an unwanted stream item, releasing its shm registration
+        (abandoned consumer, or a late arrival after the stream's length was
+        finalized)."""
+        if h["kind"] == "shm":
+            oid = ObjectID.for_return(
+                TaskID.from_hex(h["tid"]), h["idx"]
+            ).hex()
+            try:
+                self.gcs.notify("object_free", {"oids": [oid]})
+            except Exception:
+                pass
+
     async def rpc_stream_item(self, h, frames, conn):
         """Owner side: one streamed item landed (stored like a task return;
         an "err" item raises on get, ending consumption with the failure)."""
@@ -1712,14 +1787,16 @@ class CoreWorker:
             rec["conn"] = conn  # credit/abandon messages ride this
         if rec is None or rec.get("abandoned"):
             # consumer is gone: discard, and free any shm registration
-            if h["kind"] == "shm":
-                oid = ObjectID.for_return(
-                    TaskID.from_hex(h["tid"]), h["idx"]
-                ).hex()
-                try:
-                    self.gcs.notify("object_free", {"oids": [oid]})
-                except Exception:
-                    pass
+            self._drop_stream_item(h)
+            return {}, []
+        count = rec.get("count")
+        if count is not None and (
+            h["idx"] >= count or h["idx"] == rec.get("failed_idx", -1)
+        ):
+            # The stream's length is already finalized: a late in-flight item
+            # at/after that index — or at the slot where _fail_task stored
+            # the failure — must not overwrite the recorded outcome.
+            self._drop_stream_item(h)
             return {}, []
         oid = ObjectID.for_return(
             TaskID.from_hex(h["tid"]), h["idx"]

@@ -133,9 +133,28 @@ def main(argv=None):
         loop.stop()
 
     signal.signal(signal.SIGTERM, handle_term)
+    # RT_PROFILE_DIR: dump a cProfile of this process's event-loop thread on
+    # exit (perf investigation tool; reference analog: py-spy in the
+    # reporter agent).
+    profile_dir = os.environ.get("RT_PROFILE_DIR")
+    prof = None
+    if profile_dir:
+        import cProfile
+
+        prof = cProfile.Profile()
+        prof.enable()
     try:
         loop.run_forever()
     finally:
+        if prof is not None:
+            prof.disable()
+            try:
+                os.makedirs(profile_dir, exist_ok=True)
+                prof.dump_stats(
+                    os.path.join(profile_dir, f"worker-{os.getpid()}.pstats")
+                )
+            except Exception:
+                pass
         sys.exit(0)
 
 

@@ -61,6 +61,11 @@ class Autoscaler:
         self.provider = provider
         self._client = SyncHeadClient(head_address)
         self._idle_since: Dict[str, float] = {}  # cluster node_id -> ts
+        # node_ids this autoscaler has ever seen alive: a provider instance
+        # whose node registered and later vanished from the head's view is a
+        # phantom even if its dead-node tombstone was evicted from the
+        # head's bounded cache (gcs.py dead_nodes).
+        self._ever_alive: set = set()
 
     # ---------------------------------------------------------------- update
 
@@ -81,6 +86,7 @@ class Autoscaler:
         alive_ids = {
             n["node_id"] for n in load["nodes"] if n.get("alive")
         }
+        self._ever_alive |= alive_ids
         dead_ids = {
             n["node_id"] for n in load["nodes"] if not n.get("alive")
         }
@@ -88,11 +94,21 @@ class Autoscaler:
             dict(n["available"]) for n in load["nodes"] if n.get("alive")
         ]
         provider_nodes = self.provider.non_terminated_nodes()
+        # Bound _ever_alive: once a provider instance is gone its id can
+        # never match the phantom check again, so only ids still backing a
+        # provider node need remembering.
+        provider_ids = {
+            n.get("node_id") for n in provider_nodes if n.get("node_id")
+        }
+        self._ever_alive &= provider_ids | alive_ids
         by_type: Dict[str, int] = {}
         for n in provider_nodes:
             node_id = n.get("node_id")
-            if node_id in dead_ids:
-                # registered then died: phantom — reclaim, never credit
+            if node_id in dead_ids or (
+                node_id in self._ever_alive and node_id not in alive_ids
+            ):
+                # registered then died: phantom — reclaim, never credit.
+                # The _ever_alive check survives tombstone-cache eviction.
                 self.provider.terminate_node(n["provider_node_id"])
                 continue
             by_type[n["node_type"]] = by_type.get(n["node_type"], 0) + 1

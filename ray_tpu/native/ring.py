@@ -1,0 +1,173 @@
+"""ctypes binding for the native shm message ring (src/ring.cc).
+
+A ``RingChannel`` is one bidirectional same-host channel between two
+processes: the server side creates it, the client side attaches. Sends are
+serialized with a thread lock (the C ring is single-producer per direction);
+receives happen on one pump thread per channel and drain many messages per
+futex wakeup.
+
+Reference analog (behavior, not code): the C++ core worker's native
+submit/reply plane (``src/ray/core_worker/core_worker.h:167``) — the hot
+task path never touches the Python event loop's socket machinery.
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import threading
+from typing import List, Optional
+
+from ray_tpu import native as native_mod
+
+logger = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(native_mod.__file__))
+_LIB_PATH = os.path.join(_DIR, "librt_ring.so")
+_SRCS = [os.path.join(_DIR, "src", "ring.cc")]
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+DEFAULT_CAPACITY = 4 * 1024 * 1024  # per direction
+
+
+def _load_library():
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        lib = native_mod.build_and_load("librt_ring.so", _LIB_PATH, _SRCS)
+        if lib is None:
+            return None
+        lib.rt_ring_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint32, ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.rt_ring_create.restype = ctypes.c_void_p
+        lib.rt_ring_attach.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.rt_ring_attach.restype = ctypes.c_void_p
+        lib.rt_ring_send.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32, ctypes.c_int,
+        ]
+        lib.rt_ring_send.restype = ctypes.c_int
+        lib.rt_ring_recv_many.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+            ctypes.c_uint32, ctypes.POINTER(ctypes.c_uint32), ctypes.c_int,
+        ]
+        lib.rt_ring_recv_many.restype = ctypes.c_int64
+        lib.rt_ring_close.argtypes = [ctypes.c_void_p]
+        lib.rt_ring_close.restype = None
+        lib.rt_ring_peer_closed.argtypes = [ctypes.c_void_p]
+        lib.rt_ring_peer_closed.restype = ctypes.c_int
+        lib.rt_ring_detach.argtypes = [ctypes.c_void_p]
+        lib.rt_ring_detach.restype = None
+        lib.rt_ring_unlink.argtypes = [ctypes.c_char_p]
+        lib.rt_ring_unlink.restype = ctypes.c_int
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return (
+        os.environ.get("RT_NATIVE_RING", "1") != "0"
+        and _load_library() is not None
+    )
+
+
+class RingClosed(Exception):
+    pass
+
+
+class NativeRing:
+    """One endpoint of a ring channel. Thread-safe sends; single receiver."""
+
+    _RECV_BATCH = 128
+
+    def __init__(self, name: str, create: bool,
+                 capacity: int = DEFAULT_CAPACITY):
+        lib = _load_library()
+        if lib is None:
+            raise RuntimeError("native ring library unavailable")
+        self._lib = lib
+        self.name = name
+        self.created = create
+        err = ctypes.c_int(0)
+        if create:
+            self._h = lib.rt_ring_create(
+                name.encode(), capacity, ctypes.byref(err)
+            )
+        else:
+            self._h = lib.rt_ring_attach(name.encode(), ctypes.byref(err))
+        if not self._h:
+            raise OSError(err.value, os.strerror(err.value), name)
+        self._send_lock = threading.Lock()
+        self._recv_buf = ctypes.create_string_buffer(1 << 20)
+        self._recv_lens = (ctypes.c_uint32 * self._RECV_BATCH)()
+        self._closed = False
+
+    def send(self, data: bytes, timeout_ms: int = -1):
+        with self._send_lock:
+            if self._h is None:
+                raise RingClosed(f"ring {self.name}: detached")
+            rc = self._lib.rt_ring_send(self._h, data, len(data), timeout_ms)
+        if rc == 0:
+            return
+        if rc == -32:  # EPIPE
+            raise RingClosed(f"ring {self.name}: peer closed")
+        raise OSError(-rc, os.strerror(-rc), f"ring send {self.name}")
+
+    def recv_many(self, timeout_ms: int) -> Optional[List[bytes]]:
+        """Drain up to a batch of messages; None on timeout; raises
+        RingClosed when the peer closed and the ring is empty."""
+        if self._h is None:
+            raise RingClosed(f"ring {self.name}: detached")
+        n = self._lib.rt_ring_recv_many(
+            self._h, self._recv_buf, len(self._recv_buf),
+            self._RECV_BATCH, self._recv_lens, timeout_ms,
+        )
+        if n == 0:
+            return None
+        if n == -32:  # EPIPE
+            raise RingClosed(f"ring {self.name}: peer closed")
+        if n == -90:  # EMSGSIZE: grow and retry (message already waiting)
+            need = max(self._recv_lens[0] * 2, len(self._recv_buf) * 2)
+            self._recv_buf = ctypes.create_string_buffer(need)
+            return self.recv_many(timeout_ms)
+        if n < 0:
+            raise OSError(-n, os.strerror(-n), f"ring recv {self.name}")
+        out = []
+        pos = 0
+        mv = memoryview(self._recv_buf)  # .raw would copy the whole buffer
+        for i in range(n):
+            ln = self._recv_lens[i]
+            out.append(bytes(mv[pos:pos + ln]))
+            pos += ln
+        return out
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        if self._h is not None:
+            self._lib.rt_ring_close(self._h)
+
+    def detach(self):
+        """Unmap the segment. The receiver pump must have exited (close()
+        wakes it); send/recv after detach raise RingClosed rather than
+        handing C a dangling handle."""
+        self.close()
+        with self._send_lock:
+            if self._h:
+                self._lib.rt_ring_detach(self._h)
+                self._h = None
+        if self.created:
+            self._lib.rt_ring_unlink(self.name.encode())
+
+    def peer_closed(self) -> bool:
+        return self._h is None or bool(
+            self._lib.rt_ring_peer_closed(self._h)
+        )

@@ -32,6 +32,7 @@
 #include <sys/stat.h>
 #include <unistd.h>
 
+#include <atomic>
 #include <cerrno>
 #include <cstdint>
 #include <cstdio>
@@ -360,8 +361,14 @@ int64_t rt_xfer_fetch(const char* host, int port, int kind, const char* name1,
     close(fd);
     return status == 1 ? -ENOENT : -EIO;
   }
-  std::string tmp =
-      std::string(dest_name) + ".t" + std::to_string(getpid());
+  // The temp name must be unique per *call*, not just per process: two
+  // threads fetching the same object would collide on O_EXCL and the loser's
+  // -EEXIST would be indistinguishable from "published copy exists" — it
+  // would report completion while the segment is still mid-write.
+  static std::atomic<uint64_t> fetch_seq{0};
+  std::string tmp = std::string(dest_name) + ".t" +
+                    std::to_string(getpid()) + "." +
+                    std::to_string(fetch_seq.fetch_add(1));
   int dfd = shm_open(tmp.c_str(), O_RDWR | O_CREAT | O_EXCL, 0600);
   if (dfd < 0) {
     int e = errno;

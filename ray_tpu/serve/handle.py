@@ -51,6 +51,19 @@ class _StreamIterator:
                 self._settle()
         return self._buf.pop(0)
 
+    def close(self):
+        """Settle the router slot for a stream abandoned mid-iteration
+        (the replica-side generator is swept separately); idempotent."""
+        if not self._done:
+            self._done = True
+            self._settle()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
 
 class DeploymentResponse:
     """Future-like result of ``handle.remote()`` (reference:
@@ -110,6 +123,7 @@ class _Router:
         self._refresh_s = refresh_s
         self._replicas: List[Any] = []
         self._inflight: Dict[int, int] = {}
+        self._settled: List[int] = []  # finished keys awaiting lock-drain
         self._fetched_at = -10.0
         self._lock = threading.Lock()
         # Multiplexing: model_id -> {replica key}; only populated once a
@@ -263,6 +277,7 @@ class _Router:
             time.sleep(0.05)
             self._refresh(force=True)
         with self._lock:
+            self._drain_settled_locked()  # counts deferred from __del__ paths
             pool = self._replicas
             if model_id:
                 holders = self._model_map.get(model_id, ())
@@ -281,8 +296,24 @@ class _Router:
             return chosen, id(chosen)
 
     def request_finished(self, key: int):
-        with self._lock:
-            if key in self._inflight and self._inflight[key] > 0:
+        """Decrement a replica's in-flight count. Lock-free enqueue + best-
+        effort drain: this is reachable from __del__ (abandoned stream
+        iterators), where blocking on the router lock could self-deadlock a
+        thread that already holds it mid-GC."""
+        self._settled.append(key)  # list.append is atomic under the GIL
+        if self._lock.acquire(blocking=False):
+            try:
+                self._drain_settled_locked()
+            finally:
+                self._lock.release()
+
+    def _drain_settled_locked(self):
+        while True:
+            try:
+                key = self._settled.pop()
+            except IndexError:
+                return
+            if self._inflight.get(key, 0) > 0:
                 self._inflight[key] -= 1
 
     def evict(self, key: int):
