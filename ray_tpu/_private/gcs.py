@@ -353,7 +353,11 @@ class HeadService:
         info.alive = False
         if self._nsched is not None:
             self._nsched.set_alive(node_id, False)
-        logger.warning("node %s dead: %s", node_id[:8], reason)
+        log = (
+            logger.debug if getattr(self, "_shutting_down", False)
+            else logger.warning
+        )
+        log("node %s dead: %s", node_id[:8], reason)
         self.publish("nodes", {"event": "node_dead", "node_id": node_id})
         # Fail/restart actors that lived there.
         for actor in list(self.actors.values()):

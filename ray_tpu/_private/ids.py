@@ -9,6 +9,7 @@ hex. IDs are hashable, comparable, and msgpack/pickle-friendly.
 """
 from __future__ import annotations
 
+import itertools
 import os
 import threading
 
@@ -91,17 +92,44 @@ class ActorID(BaseID):
 
 
 class TaskID(BaseID):
-    """TaskID = actor id (16 bytes, nil for normal tasks) + 8 random bytes."""
+    """TaskID = actor id (16 bytes, nil for normal tasks) + 8 unique bytes.
+
+    The unique suffix is a per-process 4-byte random prefix (pid-mixed) +
+    4-byte counter with a RANDOM start: collision-free within a process and
+    ~10x cheaper than an os.urandom syscall per task on the submit path.
+    Cross-process collision needs BOTH an equal prefix (2^-32) and
+    overlapping counter windows (~tasks/2^32 given the random start), i.e.
+    ~2^-44 per process pair for million-task processes — comparable to the
+    8-random-byte scheme this replaced.
+    """
 
     _len = 24
+    _NIL_PREFIX = b"\x00" * 16
+    # itertools.count is a single C call per next(): atomic under the GIL,
+    # unlike a load-add-store on a class attribute (two driver threads
+    # racing that would mint duplicate TaskIDs).
+    _seq = itertools.count(int.from_bytes(os.urandom(4), "big"))
+    _rand = (
+        int.from_bytes(os.urandom(4), "big") ^ (os.getpid() & 0xFFFFFFFF)
+    ).to_bytes(4, "big")
 
     @classmethod
     def of(cls, actor_id: ActorID | None = None):
-        prefix = actor_id.binary() if actor_id is not None else b"\x00" * 16
-        return cls(prefix + os.urandom(8))
+        prefix = actor_id.binary() if actor_id is not None else cls._NIL_PREFIX
+        seq = next(cls._seq) & 0xFFFFFFFF
+        return cls(prefix + cls._rand + seq.to_bytes(4, "big"))
 
     def actor_id(self) -> ActorID:
         return ActorID(self._bytes[:16])
+
+
+# A forked child must not continue the parent's TaskID sequence.
+os.register_at_fork(
+    after_in_child=lambda: (
+        setattr(TaskID, "_rand", (int.from_bytes(os.urandom(4), "big") ^ (os.getpid() & 0xFFFFFFFF)).to_bytes(4, "big")),
+        setattr(TaskID, "_seq", itertools.count(int.from_bytes(os.urandom(4), "big"))),
+    )
+)
 
 
 class ObjectID(BaseID):

@@ -123,6 +123,11 @@ class LocalCluster:
 
     def shutdown(self):
         atexit.unregister(self.shutdown)
+        # Planned teardown: node-death events that follow are expected and
+        # must not emit failure-looking warnings (they mask real failures
+        # in bench/CI logs).
+        if self.head is not None:
+            self.head._shutting_down = True
         for n in self.nodes:
             n.terminate()
         deadline = time.monotonic() + 3

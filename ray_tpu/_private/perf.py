@@ -92,9 +92,161 @@ def bench_get_calls(n: int = 2000) -> float:
     return _rate(n, time.perf_counter() - t0)
 
 
+def _client_task_burst(addr: str, n: int, q):
+    """Subprocess body for the multi-client benches (spawn-safe)."""
+    import time as _time
+
+    import ray_tpu as rt
+
+    rt.init(address=addr)
+
+    @rt.remote
+    def noop():
+        return None
+
+    rt.get([noop.remote() for _ in range(50)])
+    t0 = _time.perf_counter()
+    rt.get([noop.remote() for _ in range(n)])
+    q.put(n / (_time.perf_counter() - t0))
+    rt.shutdown()
+
+
+def _client_put_burst(addr: str, total_mb: int, q):
+    import time as _time
+
+    import numpy as _np
+
+    import ray_tpu as rt
+
+    rt.init(address=addr)
+    chunk = _np.random.rand(50 * 1024 * 1024 // 8)  # 50MB
+    n = max(total_mb // 50, 1)
+    r = rt.put(chunk)
+    del r
+    t0 = _time.perf_counter()
+    for _ in range(n):
+        r = rt.put(chunk)
+        del r
+    q.put(n * chunk.nbytes / (1024 ** 3) / (_time.perf_counter() - t0))
+    rt.shutdown()
+
+
+def _run_clients(target, args_list, timeout=300.0):
+    """Run client subprocesses concurrently; returns (results, wall_s).
+    A crashed client aborts the wait promptly (no 5-minute stall) and the
+    survivors are always reaped."""
+    import multiprocessing as mp
+    import queue as queue_mod
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(target=target, args=(*a, q)) for a in args_list
+    ]
+    t0 = time.perf_counter()
+    try:
+        for p in procs:
+            p.start()
+        out = []
+        deadline = time.perf_counter() + timeout
+        while len(out) < len(procs):
+            try:
+                out.append(q.get(timeout=1.0))
+                continue
+            except queue_mod.Empty:
+                pass
+            if time.perf_counter() > deadline:
+                raise RuntimeError("bench clients timed out")
+            missing = len(procs) - len(out)
+            dead = sum(
+                1 for p in procs
+                if not p.is_alive() and p.exitcode not in (0, None)
+            )
+            if dead >= missing:
+                raise RuntimeError(
+                    f"{dead} bench client(s) crashed before reporting"
+                )
+        wall = time.perf_counter() - t0
+        return out, wall
+    finally:
+        for p in procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.kill()
+
+
+def bench_multi_client_tasks_async(clients: int = 4, n: int = 1000) -> float:
+    """Aggregate async-task throughput across independent driver processes
+    (reference: multi_client_tasks_async in ray_perf / release benchmarks).
+    Reported as total tasks / wall — clients run concurrently."""
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.get_global_worker()
+    addr = f"{w.gcs_addr[0]}:{w.gcs_addr[1]}"
+    _, wall = _run_clients(
+        _client_task_burst, [(addr, n) for _ in range(clients)]
+    )
+    return clients * n / wall
+
+
+def bench_multi_client_put(clients: int = 4, total_mb: int = 500) -> float:
+    """Aggregate put bandwidth (GB/s) across driver processes."""
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.get_global_worker()
+    addr = f"{w.gcs_addr[0]}:{w.gcs_addr[1]}"
+    _, wall = _run_clients(
+        _client_put_burst, [(addr, total_mb) for _ in range(clients)]
+    )
+    return clients * total_mb / 1024 / wall
+
+
+def bench_pg_churn(n: int = 50) -> float:
+    """Placement-group create/ready/remove cycles per second (reference
+    baseline: placement_group create/removal rate in BASELINE.md)."""
+    from ray_tpu.util.placement_group import (
+        placement_group,
+        remove_placement_group,
+    )
+
+    t0 = time.perf_counter()
+    for _ in range(n):
+        pg = placement_group([{"CPU": 0.01}])
+        pg.ready(timeout=30)
+        remove_placement_group(pg)
+    return _rate(n, time.perf_counter() - t0)
+
+
+def bench_many_nodes_tasks(target_nodes: int = 32, n: int = 500) -> float:
+    """Task throughput with many registered nodes: exercises the head's
+    lease path at scale (reference: many_nodes release benchmark). Node
+    count is capped by host cores; simulated nodes carry fractional CPU."""
+    import os as _os
+
+    import ray_tpu as rt
+
+    cluster = rt._internal_cluster()
+    cores = _os.cpu_count() or 1
+    extra = max(min(target_nodes, cores * 4) - len(cluster.nodes), 0)
+    added = [cluster.add_node({"CPU": 1}) for _ in range(extra)]
+    time.sleep(0.5)
+
+    @rt.remote
+    def noop():
+        return None
+
+    rt.get([noop.remote() for _ in range(50)])
+    t0 = time.perf_counter()
+    rt.get([noop.remote() for _ in range(n)])
+    rate = _rate(n, time.perf_counter() - t0)
+    for nh in added:
+        cluster.kill_node(nh)
+    return rate
+
+
 def run_core_benchmarks(quick: bool = False) -> Dict[str, float]:
     scale = 0.25 if quick else 1.0
-    return {
+    out = {
         "single_client_tasks_async_per_s": bench_single_client_tasks_async(
             int(2000 * scale)
         ),
@@ -105,4 +257,26 @@ def run_core_benchmarks(quick: bool = False) -> Dict[str, float]:
         "actor_calls_sync_per_s": bench_actor_calls_sync(int(300 * scale)),
         "single_client_put_gb_per_s": bench_put_gigabytes(0.5 if quick else 2.0),
         "single_client_get_calls_per_s": bench_get_calls(int(2000 * scale)),
+        "pg_create_remove_per_s": bench_pg_churn(20 if quick else 50),
     }
+    try:
+        out["multi_client_tasks_async_per_s"] = bench_multi_client_tasks_async(
+            clients=2 if quick else 4, n=int(1000 * scale)
+        )
+        out["multi_client_put_gb_per_s"] = bench_multi_client_put(
+            clients=2 if quick else 4, total_mb=200 if quick else 500
+        )
+    except Exception as e:  # multi-process benches must not sink the run
+        out["multi_client_error"] = 0.0
+        import logging
+
+        logging.getLogger(__name__).warning("multi-client bench failed: %s", e)
+    try:
+        out["many_nodes_tasks_per_s"] = bench_many_nodes_tasks(
+            8 if quick else 32, int(500 * scale)
+        )
+    except Exception as e:
+        import logging
+
+        logging.getLogger(__name__).warning("many-nodes bench failed: %s", e)
+    return out

@@ -38,6 +38,21 @@ def encode_message(header: dict, frames: List[bytes]) -> bytes:
     return b"".join(parts)
 
 
+def decode_message_bytes(data: bytes) -> Tuple[dict, List[bytes]]:
+    """Decode one complete encoded message from a bytes buffer (the shm-ring
+    transport delivers whole messages; same wire format as the TCP plane)."""
+    nframes = _HDR.unpack_from(data, 0)[0]
+    pos = 4
+    frames: List[bytes] = []
+    for _ in range(nframes):
+        ln = _HDR.unpack_from(data, pos)[0]
+        pos += 4
+        frames.append(data[pos:pos + ln])
+        pos += ln
+    header = msgpack.unpackb(frames[0], raw=False)
+    return header, frames[1:]
+
+
 async def read_message(reader: asyncio.StreamReader) -> Tuple[dict, List[bytes]]:
     nframes = _HDR.unpack(await reader.readexactly(4))[0]
     frames: List[bytes] = []

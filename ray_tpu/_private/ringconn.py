@@ -1,0 +1,405 @@
+"""Same-host RPC transport over the native shm ring (native/src/ring.cc).
+
+``RingConnection`` presents the same call/notify surface as
+``protocol.Connection`` but rides the futex-doorbell shared-memory ring
+instead of asyncio TCP: a request is one encode + one ring send from the
+caller's thread, and the receiving side drains whole batches per wakeup on a
+dedicated pump thread — the hot task path never touches either process's
+event-loop socket machinery.
+
+Reference shape (behavior, not code): the C++ core worker's in-process
+submit/reply plane — ``src/ray/core_worker/core_worker.h:167`` and
+``task_submission/normal_task_submitter.h:86`` run task submission on native
+threads; Python is only entered to execute the user function. Here the
+native layer is the transport + wakeup; header decode stays msgpack for
+wire-format parity with the TCP plane (msgpack is C-speed).
+
+Fast-path dispatch: the owning CoreWorker may register a ``fast_dispatch``
+callback, tried on the pump thread for each incoming request; returning True
+means the request was fully handled off-loop (e.g. a cached-function task
+executed straight on the task executor, reply sent from that thread).
+Everything else is forwarded to the asyncio handler, preserving slow-path
+semantics exactly.
+"""
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import threading
+from typing import Callable, List, Optional, Tuple
+
+from ray_tpu._private import protocol
+from ray_tpu.native.ring import (
+    NativeRing,
+    RingClosed,
+    RingFull,
+    RingMessageTooBig,
+)
+
+logger = logging.getLogger(__name__)
+
+# One send may block briefly while the peer drains a full ring; beyond this
+# the peer is considered wedged and the connection is torn down.
+SEND_TIMEOUT_MS = 30_000
+
+
+class MessageTooBig(protocol.RpcError):
+    """Payload exceeds the ring; caller should retry over TCP. NOT fatal to
+    the connection."""
+
+
+class RingConnection:
+    """One endpoint of a bidirectional shm-ring RPC channel.
+
+    Mirrors ``protocol.Connection``: either side may issue requests; replies
+    are matched by correlation id. ``call`` must run on the event loop;
+    ``notify``/``send_reply`` may run on any thread (the ring binding
+    serializes senders).
+    """
+
+    def __init__(
+        self,
+        ring: NativeRing,
+        loop: asyncio.AbstractEventLoop,
+        handler=None,
+        fast_dispatch: Optional[Callable] = None,
+        name: str = "",
+    ):
+        self.ring = ring
+        self.loop = loop
+        self.handler = handler
+        self.fast_dispatch = fast_dispatch
+        self.name = name or ring.name
+        self.peer_info: dict = {}
+        self.on_close: Optional[Callable] = None
+        self._ids = itertools.count(1)
+        self._pending = {}
+        self._plock = threading.Lock()
+        self._closed = False
+        # Loop-thread sends never futex-block: when the ring is full the
+        # encoded message joins this FIFO backlog and a drainer task pushes
+        # it from an executor thread (order preserved; the loop stays live).
+        self._backlog: List[bytes] = []
+        self._drainer_running = False
+        self._pump = threading.Thread(
+            target=self._pump_loop, daemon=True,
+            name=f"rt-ringpump-{self.name}",
+        )
+        self._pump.start()
+
+    @property
+    def max_msg(self) -> int:
+        return self.ring.max_msg
+
+    # ------------------------------------------------------------- sending
+
+    def _send(self, header: dict, frames: List[bytes]):
+        """Blocking send — call from non-loop threads (executor replies)."""
+        data = protocol.encode_message(header, list(frames))
+        if len(data) > self.ring.max_msg:
+            raise MessageTooBig(
+                f"{len(data)}B exceeds ring {self.name} capacity"
+            )
+        try:
+            self.ring.send(data, timeout_ms=SEND_TIMEOUT_MS)
+        except RingMessageTooBig:
+            raise MessageTooBig(f"ring {self.name}: message too big")
+        except RingFull:
+            self._teardown()  # peer wedged for SEND_TIMEOUT_MS
+            raise protocol.ConnectionLost(f"ring {self.name}: peer wedged")
+        except (RingClosed, OSError) as e:
+            self._teardown()
+            raise protocol.ConnectionLost(
+                f"ring {self.name}: {e}"
+            ) from None
+
+    def _send_from_loop(self, header: dict, frames: List[bytes]):
+        """Ordered non-blocking send for the event-loop thread: try a
+        zero-timeout push; when full, append to the backlog drained by an
+        executor thread."""
+        data = protocol.encode_message(header, list(frames))
+        if len(data) > self.ring.max_msg:
+            raise MessageTooBig(
+                f"{len(data)}B exceeds ring {self.name} capacity"
+            )
+        if self._closed:
+            raise protocol.ConnectionLost(f"ring {self.name} closed")
+        if not self._backlog:
+            try:
+                self.ring.send(data, timeout_ms=0)
+                return
+            except RingFull:
+                pass
+            except RingMessageTooBig:
+                raise MessageTooBig(f"ring {self.name}: message too big")
+            except (RingClosed, OSError) as e:
+                self._teardown()
+                raise protocol.ConnectionLost(
+                    f"ring {self.name}: {e}"
+                ) from None
+        self._backlog.append(data)
+        if not self._drainer_running:
+            self._drainer_running = True
+            self.loop.create_task(self._drain_backlog())
+
+    async def _drain_backlog(self):
+        try:
+            while self._backlog and not self._closed:
+                data = self._backlog[0]
+
+                def push(d=data):
+                    self.ring.send(d, timeout_ms=SEND_TIMEOUT_MS)
+
+                try:
+                    await self.loop.run_in_executor(None, push)
+                except (RingClosed, RingFull, OSError):
+                    self._teardown()
+                    return
+                self._backlog.pop(0)
+        finally:
+            self._drainer_running = False
+
+    def _send_auto(self, header: dict, frames):
+        """Route to the non-blocking loop path or the blocking thread path
+        depending on the calling thread."""
+        try:
+            on_loop = asyncio.get_running_loop() is self.loop
+        except RuntimeError:
+            on_loop = False
+        if on_loop:
+            self._send_from_loop(header, list(frames))
+        else:
+            self._send(header, list(frames))
+
+    async def call(
+        self, method: str, extras: Optional[dict] = None, frames=()
+    ) -> Tuple[dict, List[bytes]]:
+        if self._closed:
+            raise protocol.ConnectionLost(f"ring {self.name} closed")
+        cid = next(self._ids)
+        header = {"i": cid, "m": method}
+        if extras:
+            header.update(extras)
+        fut = self.loop.create_future()
+        with self._plock:
+            self._pending[cid] = fut
+        try:
+            self._send_auto(header, frames)
+        except (protocol.ConnectionLost, MessageTooBig):
+            with self._plock:
+                self._pending.pop(cid, None)
+            raise
+        return await fut
+
+    def notify(self, method: str, extras: Optional[dict] = None, frames=()):
+        header = {"i": next(self._ids), "m": method, "oneway": 1}
+        if extras:
+            header.update(extras)
+        self._send_auto(header, frames)
+
+    def call_batch(self, method: str, items) -> list:
+        """Issue many requests in ONE ring message (must run on the loop).
+
+        ``items``: [(extras, frames)]. Returns one future per item; the
+        receiver replies to each sub-request individually under its own
+        correlation id, so failures and results resolve per item. This is
+        the wire analog of pipelined task submission: a burst of small
+        pushes costs one encode + one send + one peer wakeup.
+        """
+        if self._closed:
+            raise protocol.ConnectionLost(f"ring {self.name} closed")
+        futs = []
+        subs = []
+        counts = []
+        all_frames: List[bytes] = []
+        with self._plock:
+            for extras, frames in items:
+                cid = next(self._ids)
+                fut = self.loop.create_future()
+                self._pending[cid] = fut
+                futs.append(fut)
+                subs.append({"i": cid, **(extras or {})})
+                counts.append(len(frames))
+                all_frames.extend(frames)
+        header = {
+            "i": next(self._ids), "m": "batch", "oneway": 1,
+            "bm": method, "bh": subs, "bn": counts,
+        }
+        try:
+            self._send_auto(header, all_frames)
+        except (protocol.ConnectionLost, MessageTooBig):
+            with self._plock:
+                for sub in subs:
+                    self._pending.pop(sub["i"], None)
+            raise
+        return futs
+
+    def send_reply(self, header: dict, frames: List[bytes]):
+        """Reply to a request (any thread)."""
+        try:
+            self._send_auto(header, frames)
+        except protocol.ConnectionLost:
+            pass  # peer gone; its pending future fails via teardown there
+        except MessageTooBig:
+            # Reply exceeds the ring: deliver an error instead so the caller
+            # fails fast rather than timing out (large results normally ride
+            # shm metas, not inline frames).
+            try:
+                self._send_auto(
+                    {
+                        "i": header.get("i"), "r": 1,
+                        "e": "reply too large for ring transport",
+                    },
+                    [],
+                )
+            except Exception:
+                pass
+
+    # ----------------------------------------------------------- receiving
+
+    def _pump_loop(self):
+        try:
+            while not self._closed:
+                try:
+                    msgs = self.ring.recv_many(500)
+                except RingClosed:
+                    break
+                except OSError as e:
+                    logger.debug("ring %s recv error: %s", self.name, e)
+                    break
+                if not msgs:
+                    continue
+                replies = []
+                slow = []
+                fast = self.fast_dispatch
+                for m in msgs:
+                    try:
+                        header, frames = protocol.decode_message_bytes(m)
+                    except Exception:
+                        logger.exception("ring %s: undecodable message",
+                                         self.name)
+                        continue
+                    if header.get("r"):
+                        replies.append((header, frames))
+                        continue
+                    if header.get("m") == "batch":
+                        # Unpack sub-requests: each carries its own id and
+                        # resolves (fast or slow) independently.
+                        method = header.get("bm")
+                        pos = 0
+                        for sub, n in zip(header["bh"], header["bn"]):
+                            sub["m"] = method
+                            sfr = frames[pos:pos + n]
+                            pos += n
+                            if fast is not None:
+                                try:
+                                    if fast(sub, sfr, self):
+                                        continue
+                                except Exception:
+                                    logger.exception(
+                                        "ring fast dispatch failed; slow"
+                                    )
+                            slow.append((sub, sfr))
+                        continue
+                    if fast is not None:
+                        try:
+                            if fast(header, frames, self):
+                                continue
+                        except Exception:
+                            logger.exception(
+                                "ring fast dispatch failed; slow path"
+                            )
+                    slow.append((header, frames))
+                if replies or slow:
+                    # One loop wakeup per drained batch, covering both reply
+                    # resolution and slow-path request dispatch.
+                    try:
+                        self.loop.call_soon_threadsafe(
+                            self._apply_batch, replies, slow
+                        )
+                    except RuntimeError:
+                        break  # loop closed
+        finally:
+            self._teardown()
+
+    def _apply_batch(self, replies, slow):
+        self._apply_replies(replies)
+        for header, frames in slow:
+            self.loop.create_task(self._handle_slow(header, frames))
+
+    async def _handle_slow(self, header: dict, frames: List[bytes]):
+        reply = {"i": header["i"], "r": 1}
+        try:
+            extras, rframes = await self.handler(
+                header["m"], header, frames, self
+            )
+            if extras:
+                reply.update(extras)
+        except Exception as e:
+            reply["e"] = f"{type(e).__name__}: {e}"
+            code = getattr(e, "code", None)
+            if code is not None:
+                reply["ec"] = code
+            rframes = []
+        if header.get("oneway"):
+            return
+        self.send_reply(reply, rframes)
+
+    def _apply_replies(self, replies):
+        for header, frames in replies:
+            with self._plock:
+                fut = self._pending.pop(header.get("i"), None)
+            if fut is None or fut.done():
+                continue
+            if header.get("e") is not None:
+                fut.set_exception(
+                    protocol.RpcError(header["e"], code=header.get("ec"))
+                )
+            else:
+                fut.set_result((header, frames))
+
+    # ------------------------------------------------------------ teardown
+
+    def _teardown(self):
+        if self._closed:
+            return
+        self._closed = True
+        self.ring.close()
+        # Drop the /dev/shm name now (creator side): mappings keep the
+        # segment alive for any in-flight reader, but a closed connection
+        # must not leak 8MB of tmpfs per ring until reboot.
+        try:
+            self.ring.unlink_name()
+        except Exception:
+            pass
+        with self._plock:
+            pending, self._pending = dict(self._pending), {}
+
+        def fail_all():
+            for fut in pending.values():
+                if not fut.done():
+                    fut.set_exception(
+                        protocol.ConnectionLost(f"ring {self.name} lost")
+                    )
+
+        if pending:
+            try:
+                self.loop.call_soon_threadsafe(fail_all)
+            except RuntimeError:
+                pass
+        if self.on_close is not None:
+            try:
+                self.on_close(self)
+            except Exception:
+                logger.exception("ring on_close failed")
+
+    async def close(self):
+        self._teardown()
+
+    def detach(self):
+        """Final cleanup after the pump exited: unmap the segment."""
+        self._teardown()
+        if self._pump.is_alive():
+            self._pump.join(timeout=2)
+        self.ring.detach()

@@ -1,0 +1,93 @@
+"""Object spilling: sealed arena objects overflow to disk under memory
+pressure and are restored on demand.
+
+Reference behavior being reproduced (not copied):
+``src/ray/raylet/local_object_manager.h:46`` — SpillObjects (:144) writes
+primary copies to external storage and frees the store memory;
+AsyncRestoreSpilledObject (:156) reads them back on demand. The reference
+runs spill IO in dedicated workers against pluggable storage
+(``python/ray/_private/external_storage.py``); here spilling is a library
+call made by the process that hits arena pressure — the arena's
+pin/seal/delete protocol (native/src/arena_store.cc) already makes
+concurrent spill vs. read crash-safe, so no broker process is needed.
+
+File format: little-endian u32 frame count, u32 lengths, then the frames
+back to back (no alignment: files are read sequentially, not mapped into
+typed views).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import struct
+import tempfile
+import threading
+from typing import List, Optional
+
+logger = logging.getLogger(__name__)
+
+_U32 = struct.Struct("<I")
+
+
+class SpillManager:
+    """Writes/reads spilled objects under one session-scoped directory.
+
+    Paths embed a random token so a crashed session's leftovers can never be
+    read by the next one (the directory is also session-named).
+    """
+
+    def __init__(self, root: Optional[str] = None, session: str = ""):
+        self.root = root or os.environ.get("RT_SPILL_DIR") or os.path.join(
+            tempfile.gettempdir(), f"rt_spill_{session or os.getpid()}"
+        )
+        self._lock = threading.Lock()
+        self._made = False
+
+    def _ensure_dir(self):
+        if not self._made:
+            os.makedirs(self.root, exist_ok=True)
+            self._made = True
+
+    def spill(self, object_hex: str, frames: List) -> dict:
+        """Write frames to disk; returns the meta describing the copy."""
+        self._ensure_dir()
+        path = os.path.join(self.root, object_hex)
+        total = 0
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(_U32.pack(len(frames)))
+            for fr in frames:
+                f.write(_U32.pack(len(fr)))
+            for fr in frames:
+                f.write(fr)
+                total += len(fr)
+        os.replace(tmp, path)  # atomic publish, mirroring the arena rename
+        return {"spill": path, "size": total}
+
+    def read(self, meta: dict) -> Optional[List[bytes]]:
+        path = meta.get("spill")
+        if not path:
+            return None
+        try:
+            with open(path, "rb") as f:
+                (count,) = _U32.unpack(f.read(4))
+                lens = [_U32.unpack(f.read(4))[0] for _ in range(count)]
+                return [f.read(n) for n in lens]
+        except (OSError, struct.error):
+            return None
+
+    def delete(self, meta: dict):
+        path = meta.get("spill")
+        if path:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    def cleanup(self):
+        try:
+            import shutil
+
+            shutil.rmtree(self.root, ignore_errors=True)
+        except Exception:
+            pass

@@ -107,6 +107,10 @@ class _LeaseSlot:
     addr: Tuple[str, int]
     busy: int = 0
     draining: bool = False  # evicted (e.g. OOM); release once in-flight done
+    # When this slot last went idle: per-slot release (an idle slot pins a
+    # whole CPU at the head — holding it while a sibling slot runs a long
+    # task starves every other lease requester, e.g. nested tasks).
+    idle_since: float = field(default_factory=time.monotonic)
 
 
 class _LeaseSet:
@@ -222,6 +226,28 @@ class CoreWorker:
         self._stream_credits: Dict[str, dict] = {}
         self._shutdown = False
         self._stats = {"tasks_executed": 0, "tasks_submitted": 0}
+        # Submission batching: driver threads enqueue dispatch coroutines
+        # here; ONE call_soon_threadsafe wakes the loop per burst instead of
+        # one per task (the self-pipe write is a syscall per call).
+        self._submit_buf: List[tuple] = []
+        self._submit_lock = threading.Lock()
+        self._submit_scheduled = False
+        # Same-host shm-ring transport (native/src/ring.cc): addr -> live
+        # RingConnection, or False = known-unavailable. Rings we serve (we
+        # attached as side B) are kept for teardown.
+        self._ring_peers: Dict[Tuple[str, int], Any] = {}
+        self._ring_seq = 0
+        self._served_rings: List[Any] = []
+        # Lineage: producing-task specs for owned return objects so a lost
+        # object can be reconstructed by resubmitting its task (reference:
+        # object_recovery_manager.h:41 + reference_counter lineage pinning).
+        # Byte-bounded; eviction disables reconstruction for old tasks.
+        self._lineage: Dict[str, dict] = {}
+        self._lineage_bytes = 0
+        self._LINEAGE_MAX_BYTES = int(
+            os.environ.get("RT_LINEAGE_BYTES", 256 * 1024 * 1024)
+        )
+        self._reconstructing: set = set()
         self._task_events_buf: List[dict] = []
         from ray_tpu._private.memory_monitor import MemoryMonitor
 
@@ -237,7 +263,62 @@ class CoreWorker:
             port = self.gcs_addr[1]
             arena = f"/rt_arena_{port}_{os.getuid()}" if port else None
             self._shm = HybridShmStore(arena)
+            self._shm.spill_handler = self._spill_for_space
         return self._shm
+
+    def _spill_for_space(self, need: int) -> int:
+        """Free arena space by spilling this process's oldest sealed objects
+        to disk (reference: ``local_object_manager.h:144`` SpillObjects).
+        Returns bytes freed. Any process may spill its own objects — the
+        arena's pin/delete protocol makes concurrent readers safe, and the
+        head's directory entry is updated so every other process finds the
+        disk copy on its next lookup."""
+        arena = self._shm.arena if self._shm is not None else None
+        if arena is None:
+            return 0
+        freed = 0
+        regs = []
+        for hex_ in list(arena._created):  # insertion order = oldest first
+            if freed >= need:
+                break
+            frames = arena.get_frames(hex_, {})
+            if frames is None:
+                continue
+            try:
+                meta = self._shm.spill.spill(hex_, frames)
+            except OSError:
+                logger.exception("spill of %s failed; disk unavailable?",
+                                 hex_[:12])
+                break
+            finally:
+                del frames  # drop the read pin before delete
+            arena.free(hex_)
+            freed += meta["size"]
+            # "addr" routes readers that cannot open the path (other hosts)
+            # to this worker's RPC service, which serves the file's bytes.
+            meta = dict(
+                meta, node=self.node_id,
+                addr=list(self.addr) if self.addr else None,
+            )
+            if hex_ in self.memory_store:
+                self.memory_store[hex_] = ("shm", meta)
+            regs.append((hex_, meta))
+        if regs:
+            def register():
+                for hex_, meta in regs:
+                    try:
+                        self.gcs.notify(
+                            "object_register", {"oid": hex_, "meta": meta}
+                        )
+                    except protocol.ConnectionLost:
+                        return
+            try:
+                self.loop.call_soon_threadsafe(register)
+            except RuntimeError:
+                pass
+            logger.info("spilled %d object(s), %.1f MB freed",
+                        len(regs), freed / 1e6)
+        return freed
 
     # ------------------------------------------------------------------ setup
 
@@ -262,6 +343,7 @@ class CoreWorker:
 
     async def _async_setup(self):
         self.peer_lock = asyncio.Lock()
+        self.ring_lock = asyncio.Lock()
         if self.is_driver:
             # Create the session arena now so the *driver* owns it: the driver
             # is the one process guaranteed to run close_all at shutdown, so
@@ -352,6 +434,181 @@ class CoreWorker:
             self.peers[addr] = conn
             return conn
 
+    # ----------------------------------------------------- ring transport
+
+    async def get_ring(self, addr):
+        """Same-host shm-ring transport to the peer at ``addr``; None when
+        unavailable (different host, native lib missing, or peer refused).
+        The hot task/actor push path prefers this over TCP (reference: the
+        C++ core worker's native submission plane,
+        ``task_submission/normal_task_submitter.h:86``)."""
+        from ray_tpu.native import ring as ring_mod
+
+        addr = tuple(addr)
+        cached = self._ring_peers.get(addr)
+        if cached is False:
+            return None
+        if cached is not None and not cached._closed:
+            return cached
+        if (
+            not ring_mod.available()
+            or self.addr is None
+            or addr[0] != self.addr[0]  # other host: TCP plane
+        ):
+            return None
+        from ray_tpu._private.ringconn import RingConnection
+
+        async with self.ring_lock:  # NOT peer_lock: get_peer acquires that
+            cached = self._ring_peers.get(addr)
+            if cached is False:
+                return None
+            if cached is not None and not cached._closed:
+                return cached
+            conn = await self.get_peer(addr)
+            self._ring_seq += 1
+            name = f"/rtring_{os.getpid()}_{self._ring_seq}"
+            try:
+                nring = ring_mod.NativeRing(name, create=True)
+            except (OSError, RuntimeError):
+                self._ring_peers[addr] = False
+                return None
+            try:
+                await conn.call("ring_attach", {"name": name})
+            except (protocol.RpcError, protocol.ConnectionLost):
+                nring.detach()
+                self._ring_peers[addr] = False
+                return None
+            rc = RingConnection(
+                nring, self.loop, handler=self._handle_rpc,
+                name=f"ring-{addr[1]}",
+            )
+            self._ring_peers[addr] = rc
+            # Peer-process death is detected by the TCP conn: closing it
+            # closes the ring too (the ring itself has no liveness probe).
+            prev = conn.on_close
+
+            def chained(c, _rc=rc, _prev=prev):
+                _rc._teardown()
+                if _prev is not None:
+                    _prev(c)
+
+            conn.on_close = chained
+            return rc
+
+    async def rpc_ring_attach(self, h, frames, conn):
+        """Peer asks us to serve its shm ring (it created the segment)."""
+        from ray_tpu.native import ring as ring_mod
+
+        if not ring_mod.available():
+            raise protocol.RpcError("native ring unavailable", code="no_ring")
+        from ray_tpu._private.ringconn import RingConnection
+
+        try:
+            nring = ring_mod.NativeRing(h["name"], create=False)
+        except OSError as e:
+            raise protocol.RpcError(f"ring attach failed: {e}")
+        rc = RingConnection(
+            nring, asyncio.get_running_loop(), handler=self._handle_rpc,
+            fast_dispatch=self._ring_fast_dispatch,
+            name=f"ringsrv-{h['name']}",
+        )
+        # keep for teardown; prune dead ones so reconnect churn stays bounded
+        self._served_rings = [
+            r for r in self._served_rings if not r._closed
+        ] + [rc]
+        prev = conn.on_close
+
+        def chained(c, _rc=rc, _prev=prev):
+            _rc._teardown()
+            if _prev is not None:
+                _prev(c)
+
+        conn.on_close = chained
+        return {}, []
+
+    def _ring_fast_dispatch(self, h, frames, rconn) -> bool:
+        """Pump-thread fast path: a plain task whose function is cached and
+        whose args carry no refs executes straight on the task executor —
+        no event loop on either decode, execute, or (small-result) reply.
+        Returns False to route anything non-trivial to the slow path, whose
+        semantics (arg fetch, runtime envs, OOM rejection, streaming) are
+        authoritative."""
+        if h.get("m") != "push_task":
+            return False
+        if (
+            h.get("nret", 1) < 1          # streaming (-1) stays on the loop
+            or h.get("argrefs")
+            or h.get("borrows")
+            or h.get("renv")
+            or h.get("trace")
+        ):
+            return False
+        fn = self.fn_cache.get(h["fkey"])
+        if fn is None:
+            return False
+        if self._memory_monitor.is_pressing():
+            return False  # slow path raises the structured oom rejection
+        ex = self.task_executor
+        if ex is None:
+            return False
+        ex.submit(self._ring_execute_task, fn, h, frames, rconn)
+        return True
+
+    def _ring_execute_task(self, fn, h, frames, rconn):
+        t0 = time.time()
+        try:
+            arg_slots, plain, kwargs = self.ctx.deserialize_frames(frames)
+            args = [plain[i] for _k, i in arg_slots]  # eligibility: no refs
+            self.current_task_id.value = TaskID.from_hex(h["tid"])
+            self.current_actor_id.value = None
+            self.put_counter.value = 0
+            try:
+                ok, result = True, fn(*args, **kwargs)
+            except Exception as e:
+                ok, result = False, (e, traceback.format_exc())
+        except Exception as e:
+            ok, result = False, (e, traceback.format_exc())
+        try:
+            rets, out_frames, big = self._package_result_parts(h, ok, result)
+            if big:
+                # Oversized values: write shm here (sync), but the head
+                # registration is an RPC — finish on the loop, and only
+                # reply once registered (the owner resolves meta via head).
+                tid = TaskID.from_hex(h["tid"])
+                regs = []
+                for i, sobj in big:
+                    oid = ObjectID.for_return(tid, i).hex()
+                    meta = self._with_xfer(
+                        self.shm.put_frames(oid, sobj.to_frames(copy=False))
+                    )
+                    rets[i] = {"kind": "shm", "meta": meta}
+                    regs.append((oid, meta))
+
+                async def finish():
+                    for oid, meta in regs:
+                        await self.gcs.call(
+                            "object_register", {"oid": oid, "meta": meta}
+                        )
+                    rconn.send_reply(
+                        {"i": h["i"], "r": 1, "rets": rets}, out_frames
+                    )
+
+                asyncio.run_coroutine_threadsafe(finish(), self.loop)
+            else:
+                rconn.send_reply(
+                    {"i": h["i"], "r": 1, "rets": rets}, out_frames
+                )
+        except Exception:
+            logger.exception("ring task reply failed")
+        self._stats["tasks_executed"] += 1
+        self._record_task_event({
+            "task_id": h["tid"], "name": h.get("name") or h["fkey"],
+            "type": "NORMAL_TASK",
+            "state": "FINISHED" if ok else "FAILED",
+            "start_time": t0, "end_time": time.time(),
+            "node_id": self.node_id,
+        })
+
     # ------------------------------------------------------- function export
 
     def export_function(self, fn) -> str:
@@ -392,11 +649,44 @@ class CoreWorker:
         rec["count"] -= 1
         self._maybe_free(oid)
 
+    def _record_lineage(self, tid_hex, header, frames, resources, strategy,
+                        nret):
+        """Remember a task spec while any of its return refs is alive, so a
+        lost output can be recomputed (deterministic ObjectIDs make the
+        resubmitted task produce the same ids)."""
+        nbytes = sum(len(f) for f in frames) + 512
+        if nbytes > self._LINEAGE_MAX_BYTES:
+            return  # a single huge-arg task never evicts everyone else
+        self._lineage[tid_hex] = {
+            "header": header, "frames": frames, "resources": resources,
+            "strategy": strategy, "bytes": nbytes, "live": nret,
+        }
+        self._lineage_bytes += nbytes
+        while self._lineage_bytes > self._LINEAGE_MAX_BYTES and self._lineage:
+            old = next(iter(self._lineage))
+            if old == tid_hex:
+                break
+            self._lineage_bytes -= self._lineage.pop(old)["bytes"]
+
+    def _drop_lineage_for(self, oid: str):
+        """Last live ref to a return object died → its slot no longer needs
+        the producing-task spec."""
+        if len(oid) != 56 or int(oid[48:56], 16) & 0x80000000:
+            return  # put object (or foreign id): no lineage
+        rec = self._lineage.get(oid[:48])
+        if rec is None:
+            return
+        rec["live"] -= 1
+        if rec["live"] <= 0:
+            self._lineage_bytes -= rec["bytes"]
+            self._lineage.pop(oid[:48], None)
+
     def _maybe_free(self, oid: str):
         rec = self.owned.get(oid)
         if rec is None or rec["count"] > 0 or rec["borrows"] > 0:
             return
         self.owned.pop(oid, None)
+        self._drop_lineage_for(oid)
         entry = self.memory_store.pop(oid, None)
         self.store_events.pop(oid, None)
         if entry is not None and entry[0] == "shm":
@@ -420,6 +710,8 @@ class CoreWorker:
         count mutations never race task-reply releases; call_soon_threadsafe
         is FIFO, so the increment always lands before the dispatch that could
         release it."""
+        if not entries:
+            return  # hot path: no-ref tasks must not pay a loop wakeup
 
         def apply():
             for oid, owner in entries:
@@ -530,6 +822,64 @@ class CoreWorker:
         return out
 
     async def _get_one(self, ref: ObjectRef, timeout: Optional[float] = None):
+        value = await self._get_one_attempt(ref, timeout)
+        if isinstance(value, exc.ObjectLostError):
+            initiated = self._try_reconstruct(ref)
+            if initiated:
+                tid_hex = ref.id().hex()[:48]
+                try:
+                    value = await self._get_one_attempt(ref, timeout)
+                finally:
+                    # Only the getter that STARTED the resubmission clears
+                    # the in-flight guard; a waiter clearing it early would
+                    # let a third getter double-submit the task.
+                    if initiated == 2:
+                        self._reconstructing.discard(tid_hex)
+        return value
+
+    def _try_reconstruct(self, ref: ObjectRef) -> int:
+        """Resubmit the task that produced a lost owned object (reference:
+        ``object_recovery_manager.h:41`` — recovery via deterministic object
+        ids + lineage resubmit). Returns 0 when reconstruction is
+        impossible, 1 when a resubmission by another getter is in flight
+        (wait for it), 2 when THIS call started one (caller owns the
+        guard)."""
+        hex_ = ref.id().hex()
+        if tuple(ref.owner_address or ()) != tuple(self.addr or ()):
+            return 0  # only the owner reconstructs
+        if len(hex_) != 56 or int(hex_[48:56], 16) & 0x80000000:
+            return 0  # puts have no producing task
+        tid_hex = hex_[:48]
+        rec = self._lineage.get(tid_hex)
+        if rec is None:
+            return 0
+        if tid_hex in self._reconstructing:
+            return 1  # another get already resubmitted; just wait
+        self._reconstructing.add(tid_hex)
+        logger.warning(
+            "object %s lost; reconstructing by resubmitting its task",
+            hex_[:12],
+        )
+        tid = TaskID.from_hex(tid_hex)
+        nret = rec["header"].get("nret", 1)
+        for i in range(max(nret, 1)):
+            o = ObjectID.for_return(tid, i).hex()
+            self.memory_store.pop(o, None)
+            ev = self.store_events.get(o)
+            if ev is not None:
+                ev.clear()
+        # Borrows were already released when the first execution replied; a
+        # second release would corrupt the counts.
+        header = dict(rec["header"], borrows=[])
+        self._enqueue_dispatch(
+            self._dispatch_task_fast,
+            (header, rec["frames"], rec["resources"], rec["strategy"], 2),
+        )
+        return 2
+
+    async def _get_one_attempt(
+        self, ref: ObjectRef, timeout: Optional[float] = None
+    ):
         hex_ = ref.id().hex()
         deadline = None if timeout is None else time.monotonic() + timeout
         entry = self.memory_store.get(hex_)
@@ -546,14 +896,36 @@ class CoreWorker:
         if kind == "shm":
             frames = self.shm.get_frames(hex_, entry[1])
             if frames is None:
+                # Our meta may be stale — e.g. another process spilled the
+                # object to disk under memory pressure. The head's directory
+                # entry is authoritative; refresh and retry locally.
+                try:
+                    hh, _ = await self.gcs.call(
+                        "object_lookup", {"oid": hex_}
+                    )
+                except protocol.ConnectionLost:
+                    hh = {}
+                if hh.get("found") and hh["meta"] != entry[1]:
+                    entry = ("shm", hh["meta"])
+                    self.memory_store[hex_] = entry
+                    frames = self.shm.get_frames(hex_, hh["meta"])
+            if frames is None:
                 # Not mappable here: bulk-fetch through the native transfer
                 # plane into a local segment (C++ end to end).
                 frames = await self._native_fetch(hex_, entry[1], deadline)
             if frames is None:
                 # Native plane unavailable (or object lost): fall back to
-                # pulling the bytes from the owner over RPC.
+                # pulling the bytes over RPC — from the worker that spilled
+                # the object (its meta carries that addr) or the owner.
+                meta = entry[1] if isinstance(entry[1], dict) else {}
+                spill_addr = meta.get("addr") if "spill" in meta else None
+                if spill_addr and tuple(spill_addr) == tuple(self.addr or ()):
+                    spill_addr = None  # we ARE the spiller; file is gone
                 try:
-                    entry = await self._pull_from_owner(ref, deadline, inline=True)
+                    entry = await self._pull_from_owner(
+                        ref, deadline, inline=True,
+                        addr=tuple(spill_addr) if spill_addr else None,
+                    )
                 except exc.RayTpuError as e:
                     return e
                 if entry[0] == "err":
@@ -635,18 +1007,24 @@ class CoreWorker:
         # 2) pull from the owner
         return await self._pull_from_owner(ref, deadline)
 
-    async def _pull_from_owner(self, ref: ObjectRef, deadline, inline=False):
+    async def _pull_from_owner(self, ref: ObjectRef, deadline, inline=False,
+                               addr=None):
         """Fetch from the owning worker. inline=True forces the owner to send
         the bytes over the wire even for shm-backed objects (used when this
-        process cannot map the shared store)."""
+        process cannot map the shared store). ``addr`` overrides the target
+        (e.g. the worker that spilled the object holds its disk copy); such
+        direct pulls do not long-poll ownership."""
         hex_ = ref.id().hex()
-        owner = tuple(ref.owner_address or ())
+        owner = tuple(addr or ref.owner_address or ())
         if not owner:
             raise exc.ObjectLostError(hex_, "no owner address on ref")
         try:
             conn = await self.get_peer(owner)
             timeout = None if deadline is None else max(deadline - time.monotonic(), 0)
-            call = conn.call("pull_object", {"oid": hex_, "inline": inline})
+            call = conn.call(
+                "pull_object",
+                {"oid": hex_, "inline": inline, "direct": addr is not None},
+            )
             hh, frames = await (
                 asyncio.wait_for(call, timeout) if timeout is not None else call
             )
@@ -740,9 +1118,22 @@ class CoreWorker:
 
     # -------------------------------------------------------- task submission
 
+    # Serialized ((), [], {}) — the no-arg call shape — computed once. Tasks
+    # and actor calls with no arguments are the dominant control-plane shape
+    # (reference microbenchmark shapes are all no-arg), and re-pickling an
+    # empty tuple per call costs more than the whole wire framing.
+    _EMPTY_ARGS_FRAMES: Optional[List[bytes]] = None
+
     def _serialize_args(self, args, kwargs):
         """Top-level ObjectRef args are passed by reference and materialized by
         the executor (reference semantics); nested refs ride along as borrows."""
+        if not args and not kwargs:
+            frames = CoreWorker._EMPTY_ARGS_FRAMES
+            if frames is None:
+                frames = CoreWorker._EMPTY_ARGS_FRAMES = self.ctx.serialize(
+                    ((), [], {})
+                ).to_frames()
+            return list(frames), [], []
         arg_slots = []
         ref_ids = []
         plain = []
@@ -808,11 +1199,14 @@ class CoreWorker:
                 oid = ObjectID.for_return(task_id, i)
                 self._register_owned(oid.hex())
                 refs.append(ObjectRef(oid, tuple(self.addr)))
-        self._stats["tasks_submitted"] += 1
-        self.loop.call_soon_threadsafe(
-            lambda: self.loop.create_task(
-                self._dispatch_task(header, frames, resources, strategy, max_retries)
+            self._record_lineage(
+                task_id.hex(), header, frames, resources, strategy,
+                num_returns,
             )
+        self._stats["tasks_submitted"] += 1
+        self._enqueue_dispatch(
+            self._dispatch_task_fast, (header, frames, resources, strategy,
+                                       max_retries)
         )
         if streaming:
             from ray_tpu.object_ref import StreamingObjectRefGenerator
@@ -820,49 +1214,115 @@ class CoreWorker:
             return StreamingObjectRefGenerator(self, task_id, tuple(self.addr))
         return refs
 
-    def _sched_key(self, resources, strategy):
-        return (
-            tuple(sorted(resources.items())),
-            tuple(sorted((k, str(v)) for k, v in strategy.items())),
-        )
+    def _enqueue_dispatch(self, coro_fn, args: tuple):
+        """Queue (coro_fn, args) for task creation on the core loop, waking
+        the loop at most once per burst of submissions."""
+        with self._submit_lock:
+            self._submit_buf.append((coro_fn, args))
+            if self._submit_scheduled:
+                return
+            self._submit_scheduled = True
+        self.loop.call_soon_threadsafe(self._drain_submits)
 
-    async def _dispatch_task(self, header, frames, resources, strategy, retries):
+    def _drain_submits(self):
         try:
-            await self._dispatch_task_inner(header, frames, resources, strategy, retries)
-        except Exception as e:
-            self._fail_task(
-                header, e if isinstance(e, exc.RayTpuError) else exc.RayTpuError(repr(e))
-            )
+            while True:
+                with self._submit_lock:
+                    batch, self._submit_buf = self._submit_buf, []
+                    if not batch:
+                        self._submit_scheduled = False
+                        return
+                for coro_fn, args in batch:
+                    try:
+                        # NB: bound methods are re-created per attribute
+                        # access: compare the underlying function.
+                        if getattr(coro_fn, "__func__", None) is (
+                            CoreWorker._dispatch_task_fast
+                        ):
+                            # Hot path: plain enqueue + callback; a retry
+                            # coroutine is built only on failure.
+                            coro_fn(*args)
+                        else:
+                            self.loop.create_task(coro_fn(*args))
+                    except Exception as e:
+                        # One bad submission fails ITS task; it must not
+                        # wedge the drain (a stuck _submit_scheduled flag
+                        # would silently stop all future submissions).
+                        try:
+                            self._fail_task(
+                                args[0], exc.RayTpuError(repr(e))
+                            )
+                        except Exception:
+                            logger.exception("submit drain failure")
+        except BaseException:
+            with self._submit_lock:
+                self._submit_scheduled = False
+            raise
 
-    async def _dispatch_task_inner(self, header, frames, resources, strategy, retries):
+    def _dispatch_task_fast(self, header, frames, resources, strategy,
+                            retries):
         key = self._sched_key(resources, strategy)
         lease_set = self.leases.get(key)
         if lease_set is None:
             lease_set = _LeaseSet(resources, strategy)
             self.leases[key] = lease_set
-        fut = asyncio.get_running_loop().create_future()
+        fut = self.loop.create_future()
         lease_set.pending.append((header, frames, fut))
         self._pump_leases(key, lease_set)
-        err = None
-        for attempt in range(max(retries, 0) + 1):
-            try:
-                await fut
+
+        def done(f):
+            if f.cancelled():
                 return
-            except exc.WorkerCrashedError as e:
-                err = e
-                if attempt >= retries:
-                    break
-                if isinstance(e, exc.OutOfMemoryError):
-                    # give memory pressure a chance to clear before burning
-                    # the retry budget (admission caches pressure ~0.5s)
+            e = f.exception()
+            if e is not None:
+                self.loop.create_task(
+                    self._dispatch_retry(
+                        header, frames, resources, strategy, retries, e
+                    )
+                )
+
+        fut.add_done_callback(done)
+
+    async def _dispatch_retry(self, header, frames, resources, strategy,
+                              retries, first_err):
+        """Continue a failed first dispatch attempt: same retry policy as
+        _dispatch_task_inner, entered only on failure."""
+        try:
+            err = first_err
+            attempt = 0
+            while (
+                isinstance(err, exc.WorkerCrashedError) and attempt < retries
+            ):
+                if isinstance(err, exc.OutOfMemoryError):
                     await asyncio.sleep(min(0.5 * 2 ** attempt, 5.0))
-                fut = asyncio.get_running_loop().create_future()
+                attempt += 1
+                key = self._sched_key(resources, strategy)
+                lease_set = self.leases.get(key)
+                if lease_set is None:
+                    lease_set = _LeaseSet(resources, strategy)
+                    self.leases[key] = lease_set
+                fut = self.loop.create_future()
                 lease_set.pending.append((header, frames, fut))
                 self._pump_leases(key, lease_set)
-            except exc.RayTpuError as e:
-                err = e
-                break
-        self._fail_task(header, err or exc.WorkerCrashedError("task failed"))
+                try:
+                    await fut
+                    return
+                except exc.RayTpuError as e:
+                    err = e
+            raise err
+        except Exception as e:
+            self._fail_task(
+                header,
+                e if isinstance(e, exc.RayTpuError) else exc.RayTpuError(
+                    repr(e)
+                ),
+            )
+
+    def _sched_key(self, resources, strategy):
+        return (
+            tuple(sorted(resources.items())),
+            tuple(sorted((k, str(v)) for k, v in strategy.items())),
+        )
 
     def _fail_task(self, header, err: Exception):
         tid = TaskID.from_hex(header["tid"])
@@ -891,7 +1351,7 @@ class CoreWorker:
     # hiding the push RPC latency. Depth 1 caps throughput at
     # slots/round-trip; real parallelism stays bounded by the worker's own
     # task slots (reference: pipelined task submission on leased workers).
-    _PUSH_PIPELINE = 2
+    _PUSH_PIPELINE = 16
 
     def _pump_leases(self, key, lease_set: _LeaseSet):
         lease_set.last_active = time.monotonic()
@@ -914,7 +1374,11 @@ class CoreWorker:
             slot.busy += 1
             spawn_budget -= 1
             self.loop.create_task(self._slot_pusher(key, lease_set, slot))
-        need = len(lease_set.pending)
+        # Only the items NOT covered by a pusher spawned this pass warrant
+        # new leases (requesting one per queued item would strand surplus
+        # slots at the head until the reaper returns them — an idle surplus
+        # slot pins a CPU and starves e.g. a nested task's lease).
+        need = spawn_budget
         if need > 0 and not lease_set.requesting:
             lease_set.requesting = True
             self.loop.create_task(self._request_leases(key, lease_set, min(need, 64)))
@@ -958,60 +1422,167 @@ class CoreWorker:
             lease_set.requesting = False
             self._pump_leases(key, lease_set)
 
+    # Tasks per wire message on the ring transport: one encode/send/wakeup
+    # amortizes the whole chunk (each sub-task still replies, fails, and
+    # retries individually).
+    _PUSH_BATCH = 16
+
+    def _pusher_node_lost(self, lease_set, slot, futs):
+        """Node died mid-push: drop its slots, fail the affected futures so
+        their dispatch retries elsewhere."""
+        lease_set.slots = [
+            s for s in lease_set.slots if s.node_id != slot.node_id
+        ]
+        for fut in futs:
+            if not fut.done():
+                fut.set_exception(
+                    exc.WorkerCrashedError(f"node {slot.node_id[:8]} lost")
+                )
+
+    def _pusher_rpc_error(self, lease_set, slot, fut, e) -> bool:
+        """Handle a per-task RpcError; True when the slot must stop (oom)."""
+        if fut.done():
+            return False
+        if getattr(e, "code", None) == "oom":
+            # Memory-pressure rejection: retriable, and this node's slots
+            # are RETURNED to the head (the node is alive — dropping them
+            # silently would leak its resource accounting). Idle slots
+            # release now; in-flight ones drain first (releasing a busy
+            # slot would double-book the node).
+            lease_set.avoid[slot.node_id] = time.monotonic() + 10.0
+            keep = []
+            for s in lease_set.slots:
+                if s.node_id != slot.node_id:
+                    keep.append(s)
+                elif s.busy > 0:
+                    s.draining = True
+                    keep.append(s)
+                else:
+                    self._release_slot(lease_set, s)
+            lease_set.slots = keep
+            fut.set_exception(exc.OutOfMemoryError(str(e)))
+            return True
+        fut.set_exception(exc.RayTpuError(str(e)))
+        return False
+
     async def _slot_pusher(self, key, lease_set, slot):
         """Drains pending tasks onto one leased slot until the queue (or the
-        slot) is gone; many tasks amortize one coroutine."""
+        slot) is gone; many tasks amortize one coroutine. On the ring
+        transport a chunk of pending tasks rides one wire message."""
         try:
             while (lease_set.pending and slot in lease_set.slots
                    and not slot.draining):
-                header, frames, fut = lease_set.pending.pop(0)
+                chunk: List[tuple] = []
+                fut = None
                 try:
-                    conn = await self.get_peer(slot.addr)
-                    h, rframes = await conn.call("push_task", header, frames)
-                    self._handle_task_reply(header, h, rframes)
-                    if not fut.done():
-                        fut.set_result(None)
+                    ring = await self.get_ring(slot.addr)
+                    if not lease_set.pending:
+                        break  # drained by a sibling pusher during the await
+                    if ring is None:
+                        conn = await self.get_peer(slot.addr)
+                        if not lease_set.pending:
+                            break
+                        chunk = [lease_set.pending.pop(0)]
+                    else:
+                        conn = ring
+                        # Pack tasks up to the batch count and the ring's
+                        # message budget; a task too big for the ring rides
+                        # TCP instead (same node, same semantics).
+                        budget = ring.max_msg - 65536
+                        size = 0
+                        while (lease_set.pending
+                               and len(chunk) < self._PUSH_BATCH):
+                            sz = sum(
+                                len(fr) for fr in lease_set.pending[0][1]
+                            ) + 4096
+                            if sz > budget:
+                                if not chunk:
+                                    conn = await self.get_peer(slot.addr)
+                                    if lease_set.pending:
+                                        chunk = [lease_set.pending.pop(0)]
+                                break
+                            if size + sz > budget and chunk:
+                                break
+                            size += sz
+                            chunk.append(lease_set.pending.pop(0))
+                    if not chunk:
+                        continue
+                    if len(chunk) == 1:
+                        header, frames, fut = chunk[0]
+                        h, rframes = await conn.call(
+                            "push_task", header, frames
+                        )
+                        self._handle_task_reply(header, h, rframes)
+                        if not fut.done():
+                            fut.set_result(None)
+                        continue
+                    from ray_tpu._private.ringconn import MessageTooBig
+
+                    try:
+                        rfuts = conn.call_batch(
+                            "push_task", [(h, f) for h, f, _ in chunk]
+                        )
+                    except MessageTooBig:
+                        # Frame-size estimate missed (oversized headers):
+                        # push each task alone; singles that still exceed
+                        # the ring ride TCP. Futures must never be dropped.
+                        for header, frames, fut in chunk:
+                            try:
+                                try:
+                                    h, rframes = await conn.call(
+                                        "push_task", header, frames
+                                    )
+                                except MessageTooBig:
+                                    tcp = await self.get_peer(slot.addr)
+                                    h, rframes = await tcp.call(
+                                        "push_task", header, frames
+                                    )
+                                self._handle_task_reply(header, h, rframes)
+                                if not fut.done():
+                                    fut.set_result(None)
+                            except protocol.RpcError as e:
+                                if self._pusher_rpc_error(
+                                    lease_set, slot, fut, e
+                                ):
+                                    return
+                        continue
+                    stop = False
+                    for i, ((header, frames, fut), rf) in enumerate(
+                        zip(chunk, rfuts)
+                    ):
+                        try:
+                            h, rframes = await rf
+                        except protocol.ConnectionLost:
+                            self._pusher_node_lost(
+                                lease_set, slot, [c[2] for c in chunk[i:]]
+                            )
+                            return
+                        except protocol.RpcError as e:
+                            if self._pusher_rpc_error(
+                                lease_set, slot, fut, e
+                            ):
+                                stop = True
+                            continue
+                        self._handle_task_reply(header, h, rframes)
+                        if not fut.done():
+                            fut.set_result(None)
+                    if stop:
+                        return
                 except (protocol.ConnectionLost, ConnectionRefusedError,
                         OSError):
-                    # node died: drop its slots, retry via the future
-                    lease_set.slots = [
-                        s for s in lease_set.slots
-                        if s.node_id != slot.node_id
-                    ]
-                    if not fut.done():
-                        fut.set_exception(
-                            exc.WorkerCrashedError(
-                                f"node {slot.node_id[:8]} lost"
-                            )
-                        )
+                    self._pusher_node_lost(
+                        lease_set, slot, [c[2] for c in chunk]
+                    )
                     return
                 except protocol.RpcError as e:
-                    if not fut.done():
-                        if getattr(e, "code", None) == "oom":
-                            # Memory-pressure rejection: retriable, and this
-                            # node's slots are RETURNED to the head (the node
-                            # is alive — dropping them silently would leak
-                            # its resource accounting). Idle slots release
-                            # now; in-flight ones drain first (releasing a
-                            # busy slot would double-book the node).
-                            lease_set.avoid[slot.node_id] = (
-                                time.monotonic() + 10.0
-                            )
-                            keep = []
-                            for s in lease_set.slots:
-                                if s.node_id != slot.node_id:
-                                    keep.append(s)
-                                elif s.busy > 0:
-                                    s.draining = True
-                                    keep.append(s)
-                                else:
-                                    self._release_slot(lease_set, s)
-                            lease_set.slots = keep
-                            fut.set_exception(exc.OutOfMemoryError(str(e)))
-                            return
-                        fut.set_exception(exc.RayTpuError(str(e)))
+                    if fut is not None and self._pusher_rpc_error(
+                        lease_set, slot, fut, e
+                    ):
+                        return
         finally:
             slot.busy = max(slot.busy - 1, 0)
+            if slot.busy == 0:
+                slot.idle_since = time.monotonic()
             if slot.draining and slot.busy == 0:
                 if slot in lease_set.slots:
                     lease_set.slots.remove(slot)
@@ -1022,23 +1593,29 @@ class CoreWorker:
 
     async def _lease_reaper(self, key, lease_set: _LeaseSet):
         """Return idle leases to the head (reference: lease idle timeout in
-        NormalTaskSubmitter). One reaper per lease set; polls until the set
-        has been idle >0.5s, then releases every slot."""
+        NormalTaskSubmitter). One reaper per lease set. Release is
+        PER-SLOT: a slot idle >0.5s goes back even while a sibling slot
+        runs a long task — an idle surplus slot pins node resources the
+        head could grant to someone else (nested tasks deadlock otherwise)."""
         try:
             while True:
                 await asyncio.sleep(0.25)
                 if not lease_set.slots and not lease_set.pending:
                     return
-                if (
-                    lease_set.pending
-                    or any(s.busy for s in lease_set.slots)
-                    or time.monotonic() - lease_set.last_active < 0.5
-                ):
+                if lease_set.pending:
                     continue
-                slots, lease_set.slots = lease_set.slots, []
-                for s in slots:
-                    self._release_slot(lease_set, s)
-                return
+                now = time.monotonic()
+                keep = []
+                for s in lease_set.slots:
+                    if (
+                        s.busy == 0
+                        and now - s.idle_since > 0.5
+                        and now - lease_set.last_active > 0.5
+                    ):
+                        self._release_slot(lease_set, s)
+                    else:
+                        keep.append(s)
+                lease_set.slots = keep
         finally:
             lease_set.reaper_running = False
 
@@ -1188,10 +1765,8 @@ class CoreWorker:
             self._register_owned(oid.hex())
             refs.append(ObjectRef(oid, tuple(self.addr)))
         self._stats["tasks_submitted"] += 1
-        self.loop.call_soon_threadsafe(
-            lambda: self.loop.create_task(
-                self._dispatch_actor_task(header, frames, max_task_retries)
-            )
+        self._enqueue_dispatch(
+            self._dispatch_actor_task, (header, frames, max_task_retries)
         )
         return refs
 
@@ -1225,6 +1800,14 @@ class CoreWorker:
                     # reconnect starts a fresh contiguous seq stream and the
                     # server must not mix it with the old stream's cursor.
                     header["caller"] = f"{self.worker_id.hex()}:{ch.epoch}"
+                max_msg = getattr(conn, "max_msg", None)
+                if (
+                    max_msg is not None
+                    and sum(len(f) for f in frames) + 4096 > max_msg
+                ):
+                    # Oversized for the ring: this call rides TCP. Server-side
+                    # seq admission keeps ordering across the two transports.
+                    conn = await self.get_peer(ch.addr)
                 h, rframes = await conn.call("push_actor_task", header, frames)
                 self._handle_task_reply(header, h, rframes)
                 return
@@ -1286,7 +1869,10 @@ class CoreWorker:
         if ch.addr is None:
             if not await self._await_actor_alive(ch):
                 raise exc.ActorDiedError(ch.actor_id, ch.death_reason)
-        ch.conn = await self.get_peer(ch.addr)
+        # One transport per ordering epoch: the ring (when available) or TCP,
+        # never a mix — actor ordering rides the transport's FIFO.
+        ring = await self.get_ring(ch.addr)
+        ch.conn = ring if ring is not None else await self.get_peer(ch.addr)
         # New connection = new ordering domain for this caller. Callers hold
         # ch.lock across this reset and their own seq assignment.
         ch.seq = 0
@@ -1339,10 +1925,17 @@ class CoreWorker:
         return {}, []
 
     async def rpc_pull_object(self, h, frames, conn):
-        """Serve an object we own (blocks until ready — long-poll pull)."""
+        """Serve an object we own (blocks until ready — long-poll pull).
+        ``direct`` pulls target a non-owner holding a copy (e.g. this worker
+        spilled it to its local disk): serve from the head's directory meta
+        without waiting on ownership."""
         hex_ = h["oid"]
         entry = self.memory_store.get(hex_)
-        if entry is None:
+        if entry is None and h.get("direct"):
+            hh, _ = await self.gcs.call("object_lookup", {"oid": hex_})
+            if hh.get("found"):
+                entry = ("shm", hh["meta"])
+        elif entry is None:
             entry = await self._wait_local(hex_, None)
         if entry is None:
             raise protocol.RpcError(f"object {hex_} unknown to owner")
@@ -1352,6 +1945,15 @@ class CoreWorker:
         if kind == "shm":
             if h.get("inline"):
                 frames = self.shm.get_frames(hex_, entry[1])
+                if frames is None:
+                    # Possibly spilled by another process since we recorded
+                    # the meta: the head has the authoritative copy.
+                    hh, _ = await self.gcs.call(
+                        "object_lookup", {"oid": hex_}
+                    )
+                    if hh.get("found"):
+                        self.memory_store[hex_] = entry = ("shm", hh["meta"])
+                        frames = self.shm.get_frames(hex_, hh["meta"])
                 if frames is None:
                     raise protocol.RpcError(f"object {hex_} lost at owner")
                 return {"kind": "mem"}, [bytes(f) for f in frames]
@@ -1818,9 +2420,13 @@ class CoreWorker:
             sev.set()
         return {}, []
 
-    async def _package_result(self, h, ok, result):
+    def _package_result_parts(self, h, ok, result):
+        """Sync result packaging. Returns (rets, out_frames, big) where
+        ``big`` holds (index, serialized) for values too large to inline —
+        their rets entries are placeholders the caller must fill after the
+        shm write + head registration."""
         nret = h.get("nret", 1)
-        rets = []
+        rets: List[Any] = []
         out_frames: List[bytes] = []
         if not ok:
             e, tb = result
@@ -1833,7 +2439,7 @@ class CoreWorker:
             for _ in range(nret):
                 rets.append({"kind": "err", "nframes": len(fr)})
                 out_frames.extend(fr)
-            return {"rets": rets}, out_frames
+            return rets, out_frames, []
         values = (
             list(result)
             if nret > 1 and isinstance(result, (tuple, list))
@@ -1847,8 +2453,8 @@ class CoreWorker:
             for _ in range(nret):
                 rets.append({"kind": "err", "nframes": len(fr)})
                 out_frames.extend(fr)
-            return {"rets": rets}, out_frames
-        tid = TaskID.from_hex(h["tid"])
+            return rets, out_frames, []
+        big = []
         for i, v in enumerate(values[:nret]):
             sobj = self.ctx.serialize(v)
             if sobj.total_bytes() <= INLINE_OBJECT_MAX:
@@ -1856,13 +2462,21 @@ class CoreWorker:
                 rets.append({"kind": "mem", "nframes": len(fr)})
                 out_frames.extend(fr)
             else:
-                oid = ObjectID.for_return(tid, i).hex()
-                # written into shm before this call returns: zero-copy safe
-                meta = self._with_xfer(
-                    self.shm.put_frames(oid, sobj.to_frames(copy=False))
-                )
-                await self.gcs.call("object_register", {"oid": oid, "meta": meta})
-                rets.append({"kind": "shm", "meta": meta})
+                rets.append(None)  # placeholder: filled after shm write
+                big.append((i, sobj))
+        return rets, out_frames, big
+
+    async def _package_result(self, h, ok, result):
+        rets, out_frames, big = self._package_result_parts(h, ok, result)
+        tid = TaskID.from_hex(h["tid"])
+        for i, sobj in big:
+            oid = ObjectID.for_return(tid, i).hex()
+            # written into shm before this call returns: zero-copy safe
+            meta = self._with_xfer(
+                self.shm.put_frames(oid, sobj.to_frames(copy=False))
+            )
+            await self.gcs.call("object_register", {"oid": oid, "meta": meta})
+            rets[i] = {"kind": "shm", "meta": meta}
         return {"rets": rets}, out_frames
 
     # actor hosting ---------------------------------------------------------
@@ -2087,6 +2701,11 @@ class CoreWorker:
 
         async def _close():
             try:
+                for rc in list(self._ring_peers.values()):
+                    if rc is not False:
+                        rc._teardown()
+                for rc in self._served_rings:
+                    rc._teardown()
                 for c in list(self.peers.values()):
                     await c.close()
                 if self.gcs is not None:

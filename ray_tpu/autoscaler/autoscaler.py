@@ -64,8 +64,13 @@ class Autoscaler:
         # node_ids this autoscaler has ever seen alive: a provider instance
         # whose node registered and later vanished from the head's view is a
         # phantom even if its dead-node tombstone was evicted from the
-        # head's bounded cache (gcs.py dead_nodes).
+        # head's bounded cache (gcs.py dead_nodes). Absence must persist for
+        # several passes before termination — a restarting head briefly
+        # reports nothing while nodes re-register, and killing healthy
+        # instances on that window would be self-inflicted failure.
         self._ever_alive: set = set()
+        self._missing_counts: Dict[str, int] = {}
+        self._MISSING_PASSES = 3
 
     # ---------------------------------------------------------------- update
 
@@ -104,11 +109,23 @@ class Autoscaler:
         by_type: Dict[str, int] = {}
         for n in provider_nodes:
             node_id = n.get("node_id")
-            if node_id in dead_ids or (
+            missing = (
                 node_id in self._ever_alive and node_id not in alive_ids
+                and node_id not in dead_ids
+            )
+            if missing:
+                self._missing_counts[node_id] = (
+                    self._missing_counts.get(node_id, 0) + 1
+                )
+            else:
+                self._missing_counts.pop(node_id, None)
+            if node_id in dead_ids or (
+                missing
+                and self._missing_counts[node_id] >= self._MISSING_PASSES
             ):
                 # registered then died: phantom — reclaim, never credit.
-                # The _ever_alive check survives tombstone-cache eviction.
+                # The _ever_alive path survives tombstone-cache eviction but
+                # requires sustained absence (head-restart tolerance).
                 self.provider.terminate_node(n["provider_node_id"])
                 continue
             by_type[n["node_type"]] = by_type.get(n["node_type"], 0) + 1

@@ -29,6 +29,11 @@ class LLMConfig:
     num_kv_heads: Optional[int] = None  # llama GQA; None = num_heads (MHA)
     embed_dim: int = 256
     dtype: str = "bfloat16"
+    # Mixture-of-Experts (Mixtral-style when model_family="llama"): number
+    # of routed experts; 0 = dense. Decode routes each token through its
+    # top-k experts (parallel/moe.py).
+    moe_num_experts: int = 0
+    moe_top_k: int = 2
 
     # Engine knobs (reference: engine_kwargs tensor_parallel_size etc.)
     max_batch_slots: int = 8
@@ -52,6 +57,17 @@ class LLMConfig:
         import jax.numpy as jnp
 
         dtype = jnp.bfloat16 if self.dtype == "bfloat16" else jnp.float32
+        moe = None
+        if self.moe_num_experts:
+            from ray_tpu.parallel.moe import MoEConfig
+
+            moe = MoEConfig(
+                num_experts=self.moe_num_experts,
+                top_k=self.moe_top_k,
+                activation=(
+                    "swiglu" if self.model_family == "llama" else "gelu"
+                ),
+            )
         common = dict(
             vocab_size=self.vocab_size,
             max_seq_len=self.max_seq_len,
@@ -60,6 +76,7 @@ class LLMConfig:
             embed_dim=self.embed_dim,
             dtype=dtype,
             attention_impl="xla",
+            moe=moe,
         )
         if self.model_family == "llama":
             from ray_tpu.models.llama import LlamaConfig

@@ -75,7 +75,15 @@ class DecodeEngine:
 
                     self.model_config = GPT2Config(**bundle["config"])
         if getattr(self.model_config, "moe", None) is not None:
-            raise NotImplementedError("decode engine: dense models only")
+            # Inference must route dropless: capacity-queue drops depend on
+            # the rest of the batch, so prefill and per-step decode would
+            # disagree (and with the full forward) on dropped tokens.
+            import dataclasses
+
+            self.model_config = dataclasses.replace(
+                self.model_config,
+                moe=dataclasses.replace(self.model_config.moe, dropless=True),
+            )
         model = module_for(self.model_config)
         self.tokenizer = load_tokenizer(config)
         if params is None:

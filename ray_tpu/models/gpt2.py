@@ -369,13 +369,9 @@ def forward_pipelined(
 ) -> jax.Array:
     """Pipeline-parallel forward: blocks run under the GPipe microbatch loop
     (``parallel.pipeline.pipeline_apply``) over the "stage" mesh axis;
-    embedding/head run outside the pipe."""
-    if config.moe is not None:
-        raise NotImplementedError(
-            "MoE + pipeline parallelism: the microbatch loop would silently "
-            "drop the router's load-balancing aux loss (experts could "
-            "collapse unnoticed); train MoE models without the stage axis"
-        )
+    embedding/head run outside the pipe. MoE models accumulate the router's
+    load-balancing aux loss across the microbatch loop
+    (``pipeline_apply(collect_aux=True)``)."""
     from jax.sharding import PartitionSpec as P
 
     from ray_tpu.parallel.pipeline import pipeline_apply
@@ -387,19 +383,23 @@ def forward_pipelined(
     body = functools.partial(_block, config, mesh)
     if config.remat:
         body = jax.checkpoint(body)
+    collect_aux = config.moe is not None
 
     def apply_stage(local_blocks, mb):
-        def scan_fn(x, layer):
-            y, _ = body(x, layer)
-            return y, None
+        def scan_fn(carry, layer):
+            x, aux = carry
+            y, a = body(x, layer)
+            return (y, aux + a.astype(jnp.float32)), None
 
-        out, _ = jax.lax.scan(scan_fn, mb, local_blocks)
-        return out
+        (out, aux), _ = jax.lax.scan(
+            scan_fn, (mb, jnp.float32(0.0)), local_blocks
+        )
+        return (out, aux) if collect_aux else out
 
     # Manual spec covers only the stage dim; tensor/fsdp dims of the weights
     # remain auto-sharded by XLA inside the stage program.
     params_spec = jax.tree.map(lambda _: P("stage"), params["blocks"])
-    x = pipeline_apply(
+    res = pipeline_apply(
         params["blocks"],
         x,
         mesh=mesh,
@@ -407,7 +407,9 @@ def forward_pipelined(
         num_microbatches=num_microbatches,
         params_spec=params_spec,
         x_spec=P(),
+        collect_aux=collect_aux,
     )
+    x, aux = res if collect_aux else (res, jnp.float32(0.0))
     x = _layer_norm(x, params["ln_f_g"], params["ln_f_b"])
     logits = jnp.einsum("bte,ve->btv", x, params["wte"].astype(x.dtype))
-    return logits.astype(jnp.float32), jnp.float32(0.0)
+    return logits.astype(jnp.float32), aux

@@ -295,12 +295,9 @@ def forward_cached(
 ) -> tuple:
     """Incremental forward with RoPE at absolute positions; same contract as
     :func:`ray_tpu.models.gpt2.forward_cached` (static shapes; per-sequence
-    offsets via vmapped dynamic_update_slice)."""
-    if config.moe is not None:
-        raise NotImplementedError(
-            "forward_cached: dense llama only (the decode engine gates "
-            "MoE models the same way)"
-        )
+    offsets via vmapped dynamic_update_slice). MoE configs route each
+    decoded token through its top-k experts (aux loss is a training-only
+    concern and is discarded here)."""
     B, T = tokens.shape
     S = cache["k"].shape[2]
     pos = start[:, None] + jnp.arange(T)[None, :]            # [B, T]
@@ -334,10 +331,18 @@ def forward_cached(
         attn = attn.reshape(B, T, config.num_heads, config.head_dim)
         x = x + jnp.einsum("bthd,hde->bte", attn, layer["wo"].astype(x.dtype))
         h = _rms_norm(x, layer["mlp_norm"], config.rms_eps)
-        gate = jnp.einsum("bte,em->btm", h, layer["w_gate"].astype(h.dtype))
-        up = jnp.einsum("bte,em->btm", h, layer["w_up"].astype(h.dtype))
-        h = jax.nn.silu(gate) * up
-        x = x + jnp.einsum("btm,me->bte", h, layer["w_down"].astype(h.dtype))
+        if config.moe is not None:
+            routed, _aux = moe_layer(layer["moe"], h, config.moe)
+            x = x + routed
+        else:
+            gate = jnp.einsum(
+                "bte,em->btm", h, layer["w_gate"].astype(h.dtype)
+            )
+            up = jnp.einsum("bte,em->btm", h, layer["w_up"].astype(h.dtype))
+            h = jax.nn.silu(gate) * up
+            x = x + jnp.einsum(
+                "btm,me->bte", h, layer["w_down"].astype(h.dtype)
+            )
         return x, (ck, cv)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -385,13 +390,9 @@ def forward_pipelined(
     num_microbatches: int = 4,
 ) -> Tuple[jax.Array, jax.Array]:
     """Pipeline-parallel forward over the "stage" mesh axis (GPipe microbatch
-    loop, ``parallel.pipeline.pipeline_apply``); embedding/head outside."""
-    if config.moe is not None:
-        raise NotImplementedError(
-            "MoE + pipeline parallelism: the microbatch loop would silently "
-            "drop the router's load-balancing aux loss (experts could "
-            "collapse unnoticed); train MoE models without the stage axis"
-        )
+    loop, ``parallel.pipeline.pipeline_apply``); embedding/head outside.
+    MoE models accumulate the router's load-balancing aux loss across the
+    microbatch loop (``pipeline_apply(collect_aux=True)``)."""
     from jax.sharding import PartitionSpec as P
 
     from ray_tpu.parallel.pipeline import pipeline_apply
@@ -403,29 +404,32 @@ def forward_pipelined(
     body = functools.partial(_block, config, mesh)
     if config.remat:
         body = jax.checkpoint(body)
+    collect_aux = config.moe is not None
 
     def apply_stage(local_blocks, mb):
         # Microbatches split the batch dim; positions are batch-invariant.
-        # MoE aux loss is not accumulated in the pipelined path
-        # (stage-local scalars; same TODO as gpt2.forward_pipelined).
         mb_pos = pos[: mb.shape[0]]
 
-        def scan_fn(x, layer):
-            y, _ = body(x, layer, mb_pos)
-            return y, None
+        def scan_fn(carry, layer):
+            x, aux = carry
+            y, a = body(x, layer, mb_pos)
+            return (y, aux + a.astype(jnp.float32)), None
 
-        out, _ = jax.lax.scan(scan_fn, mb, local_blocks)
-        return out
+        (out, aux), _ = jax.lax.scan(
+            scan_fn, (mb, jnp.float32(0.0)), local_blocks
+        )
+        return (out, aux) if collect_aux else out
 
     params_spec = jax.tree.map(lambda _: P("stage"), params["blocks"])
-    x = pipeline_apply(
+    res = pipeline_apply(
         params["blocks"], x, mesh=mesh, apply_stage=apply_stage,
         num_microbatches=num_microbatches, params_spec=params_spec,
-        x_spec=P(),
+        x_spec=P(), collect_aux=collect_aux,
     )
+    x, aux = res if collect_aux else (res, jnp.float32(0.0))
     x = _rms_norm(x, params["norm_f"], config.rms_eps)
     logits = jnp.einsum("bte,ve->btv", x, params["lm_head"].astype(x.dtype))
-    return logits.astype(jnp.float32), jnp.float32(0.0)
+    return logits.astype(jnp.float32), aux
 
 
 def count_params(params) -> int:

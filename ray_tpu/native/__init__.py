@@ -143,5 +143,9 @@ def load_library():
         lib.rt_arena_num_tombs.restype = ctypes.c_uint64
         lib.rt_arena_scrub.argtypes = [ctypes.c_int]
         lib.rt_arena_scrub.restype = ctypes.c_int
+        lib.rt_memcpy_parallel.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+        ]
+        lib.rt_memcpy_parallel.restype = None
         _lib = lib
         return _lib

@@ -36,6 +36,28 @@ DEFAULT_CAPACITY = int(os.environ.get("RT_ARENA_BYTES", 1 << 30))
 INDEX_SLOTS = 1 << 15
 
 
+# Frames at/above this size take the multi-threaded native copy path.
+_PARALLEL_COPY_MIN = 8 * 1024 * 1024
+
+
+def _buffer_address(b) -> Optional[int]:
+    """Stable address of a bytes/writable-buffer payload for the duration of
+    the copy (the caller keeps ``b`` alive); None when not obtainable
+    zero-copy (e.g. a read-only non-bytes view)."""
+    if isinstance(b, bytes):
+        return ctypes.cast(ctypes.c_char_p(b), ctypes.c_void_p).value
+    try:
+        mv = memoryview(b)
+        if not mv.c_contiguous:
+            return None
+        if mv.readonly:
+            return None
+        arr = (ctypes.c_char * mv.nbytes).from_buffer(mv)
+        return ctypes.addressof(arr)
+    except (TypeError, ValueError):
+        return None
+
+
 def _align(n: int) -> int:
     return (n + _ALIGN - 1) & ~(_ALIGN - 1)
 
@@ -54,11 +76,15 @@ class NativeArenaStore:
     """ctypes client for one named arena. Raises RuntimeError if the native
     library is unavailable or the arena cannot be created/attached."""
 
-    def __init__(self, name: str, capacity: int = DEFAULT_CAPACITY,
+    def __init__(self, name: str, capacity: Optional[int] = None,
                  create: bool = True, index_slots: int = INDEX_SLOTS):
         lib = _native.load_library()
         if lib is None:
             raise RuntimeError("native library unavailable")
+        if capacity is None:
+            # resolved at call time so tests/env can size a fresh session's
+            # arena without re-importing the module
+            capacity = int(os.environ.get("RT_ARENA_BYTES", DEFAULT_CAPACITY))
         self._lib = lib
         self.name = name
         self.created_arena = False
@@ -85,7 +111,9 @@ class NativeArenaStore:
         # Reader pins are owned by the buffers themselves: get_frames attaches
         # a finalizer to the mapping window so the pin drops only when the
         # last zero-copy view dies (plasma client-buffer semantics).
-        self._created: set = set()
+        # Insertion-ordered (dict): creation order doubles as the
+        # spill-eviction order (oldest first).
+        self._created: dict = {}
 
     # -- store interface ----------------------------------------------------
 
@@ -109,11 +137,19 @@ class NativeArenaStore:
             _HDR_LEN.pack_into(buf, pos, len(f))
             pos += _HDR_LEN.size
         for o, f in zip(offsets, frames):
-            buf[o : o + len(f)] = f
+            n = len(f)
+            if n >= _PARALLEL_COPY_MIN:
+                src = _buffer_address(f)
+                if src is not None:
+                    # multi-threaded memcpy: a single-thread copy caps put
+                    # throughput well below DRAM bandwidth
+                    self._lib.rt_memcpy_parallel(self._base + off + o, src, n)
+                    continue
+            buf[o : o + n] = f
         rc = self._lib.rt_obj_seal(self._h, object_hex.encode())
         if rc != 0:
             raise RuntimeError(f"obj_seal({object_hex}): errno {-rc}")
-        self._created.add(object_hex)
+        self._created[object_hex] = True
         return {"arena": self.name, "size": total}
 
     def get_frames(self, object_hex: str, meta: dict) -> Optional[List[memoryview]]:
@@ -151,7 +187,7 @@ class NativeArenaStore:
     def free(self, object_hex: str, meta: Optional[dict] = None):
         enc = object_hex.encode()
         if object_hex in self._created:
-            self._created.discard(object_hex)
+            self._created.pop(object_hex, None)
             self._lib.rt_obj_delete(self._h, enc)
         elif meta is not None:
             # Owner-side free of an object this process didn't create (e.g.
@@ -203,6 +239,14 @@ class HybridShmStore:
     def __init__(self, arena_name: Optional[str], prefix: str = "rt"):
         self.fallback = LocalShmStore(prefix=prefix)
         self.arena: Optional[NativeArenaStore] = None
+        # Disk spilling (reference: local_object_manager SpillObjects /
+        # AsyncRestoreSpilledObject). spill_handler is installed by the
+        # CoreWorker: called with the byte count needed, returns bytes it
+        # freed from the arena by spilling sealed objects to disk.
+        from ray_tpu._private.spill import SpillManager
+
+        self.spill = SpillManager(session=(arena_name or "anon").strip("/"))
+        self.spill_handler = None
         if arena_name and os.environ.get("RT_DISABLE_NATIVE_STORE") != "1":
             try:
                 self.arena = NativeArenaStore(arena_name)
@@ -216,11 +260,26 @@ class HybridShmStore:
     def put_frames(self, object_hex: str, frames: List[bytes]) -> dict:
         if self.arena is not None:
             meta = self.arena.put_frames(object_hex, frames)
+            if meta is None and self.spill_handler is not None:
+                # Arena full: spill cold sealed objects to disk, retry once.
+                need = sum(len(f) for f in frames) + 4096
+                try:
+                    freed = self.spill_handler(need)
+                except Exception:
+                    logger.exception("spill handler failed")
+                    freed = 0
+                if freed > 0:
+                    meta = self.arena.put_frames(object_hex, frames)
             if meta is not None:
                 return meta
         return self.fallback.put_frames(object_hex, frames)
 
     def get_frames(self, object_hex: str, meta: dict) -> Optional[List[memoryview]]:
+        if "spill" in meta:
+            frames = self.spill.read(meta)
+            return (
+                [memoryview(f) for f in frames] if frames is not None else None
+            )
         if "arena" in meta:
             if self.arena is None:
                 return None
@@ -233,15 +292,28 @@ class HybridShmStore:
         return self.fallback.contains(object_hex)
 
     def free(self, object_hex: str, meta: Optional[dict] = None):
+        if meta is not None and "spill" in meta:
+            self.spill.delete(meta)
+            return
         if meta is not None and "seg" in meta:
             self.fallback.free(object_hex, meta)
             return
         if self.arena is not None:
             self.arena.free(object_hex, meta)
+            # The owner's meta can be stale (a sibling process spilled the
+            # object after the owner cached the arena meta): also drop any
+            # disk copy, or frees leak spill files for the session's life.
+            self.spill.delete(
+                {"spill": os.path.join(self.spill.root, object_hex)}
+            )
         if meta is None:
             self.fallback.free(object_hex)
 
     def close_all(self):
         if self.arena is not None:
+            if self.arena.created_arena:
+                # Session teardown (we created the arena → we are the
+                # session's first process): remove the spill directory too.
+                self.spill.cleanup()
             self.arena.close_all()
         self.fallback.close_all()

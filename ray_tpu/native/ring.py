@@ -82,6 +82,14 @@ class RingClosed(Exception):
     pass
 
 
+class RingFull(Exception):
+    """Non-blocking send found no space (caller queues and retries)."""
+
+
+class RingMessageTooBig(Exception):
+    """Message exceeds ring capacity; caller must use another transport."""
+
+
 class NativeRing:
     """One endpoint of a ring channel. Thread-safe sends; single receiver."""
 
@@ -95,6 +103,10 @@ class NativeRing:
         self._lib = lib
         self.name = name
         self.created = create
+        self.capacity = capacity
+        # Largest message this transport accepts; bigger payloads must ride
+        # TCP (half the ring so one message can never deadlock the pipe).
+        self.max_msg = capacity // 2
         err = ctypes.c_int(0)
         if create:
             self._h = lib.rt_ring_create(
@@ -118,6 +130,12 @@ class NativeRing:
             return
         if rc == -32:  # EPIPE
             raise RingClosed(f"ring {self.name}: peer closed")
+        if rc == -110:  # ETIMEDOUT
+            raise RingFull(f"ring {self.name}: full")
+        if rc == -90:  # EMSGSIZE
+            raise RingMessageTooBig(
+                f"ring {self.name}: {len(data)}B message exceeds capacity"
+            )
         raise OSError(-rc, os.strerror(-rc), f"ring send {self.name}")
 
     def recv_many(self, timeout_ms: int) -> Optional[List[bytes]]:
@@ -154,6 +172,13 @@ class NativeRing:
         self._closed = True
         if self._h is not None:
             self._lib.rt_ring_close(self._h)
+
+    def unlink_name(self):
+        """Remove the /dev/shm name (creator side). Safe while mapped — the
+        segment lives until the last mapping drops; without this, dead
+        sessions leak tmpfs until reboot."""
+        if self.created:
+            self._lib.rt_ring_unlink(self.name.encode())
 
     def detach(self):
         """Unmap the segment. The receiver pump must have exited (close()

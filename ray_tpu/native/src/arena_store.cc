@@ -35,6 +35,8 @@
 
 #include <atomic>
 #include <mutex>
+#include <thread>
+#include <vector>
 
 namespace {
 
@@ -868,6 +870,34 @@ void rt_arena_stats(int handle, uint64_t* bytes_in_use, uint64_t* num_objects,
   if (num_objects) *num_objects = h->num_objects;
   if (capacity) *capacity = h->heap_end - h->heap_off;
   if (peak_bytes) *peak_bytes = h->peak_bytes;
+}
+
+// Multi-threaded memcpy for large object-payload writes into the arena
+// (single-threaded memcpy tops out well below DRAM bandwidth on server
+// parts; plasma splits large copies across threads the same way). Chunks
+// are cache-line aligned; below the threshold a plain memcpy wins.
+void rt_memcpy_parallel(void* dst, const void* src, uint64_t len) {
+  constexpr uint64_t kParallelMin = 8ull << 20;
+  unsigned hw = std::thread::hardware_concurrency();
+  unsigned nthreads =
+      (len >= kParallelMin && hw > 1) ? (hw < 8 ? hw : 8) : 1;
+  if (nthreads <= 1) {
+    memcpy(dst, src, len);
+    return;
+  }
+  uint64_t chunk = (len / nthreads + 63) & ~63ull;
+  std::vector<std::thread> ts;
+  ts.reserve(nthreads);
+  for (unsigned i = 0; i < nthreads; i++) {
+    uint64_t off = static_cast<uint64_t>(i) * chunk;
+    if (off >= len) break;
+    uint64_t n = len - off < chunk ? len - off : chunk;
+    ts.emplace_back([dst, src, off, n] {
+      memcpy(static_cast<uint8_t*>(dst) + off,
+             static_cast<const uint8_t*>(src) + off, n);
+    });
+  }
+  for (auto& t : ts) t.join();
 }
 
 }  // extern "C"

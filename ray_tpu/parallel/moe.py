@@ -26,6 +26,13 @@ class MoEConfig:
     router_jitter: float = 0.0
     # "gelu" (Switch-style experts) | "swiglu" (Mixtral-style gated experts)
     activation: str = "gelu"
+    # Dropless routing (Mixtral-style inference): every token reaches its
+    # top-k experts, no capacity queues. Required for KV-cache decode to
+    # reproduce full-forward outputs — capacity drops depend on the other
+    # tokens in the batch, which differ between prefill and per-step decode.
+    # The decode engine flips this on; training defaults to capacity
+    # (bounded per-expert work => static shapes for the all-to-alls).
+    dropless: bool = False
 
     def __post_init__(self):
         if self.activation not in ("gelu", "swiglu"):
@@ -103,6 +110,30 @@ def moe_layer(
         )
     probs = jax.nn.softmax(router_logits, axis=-1)  # [T, E]
     topk_mask = _top_k_mask(probs, config.top_k)    # [T, E] 0/1
+
+    if config.dropless:
+        # Per-token routing with no cross-token capacity interaction: the
+        # dense-all-experts formulation (every expert runs on every token,
+        # combine masks to top-k). FLOP cost is E/k of the capacity path —
+        # the right trade at decode batch sizes; with "expert" sharded the
+        # combine contraction psums over the expert axis.
+        gates = probs * topk_mask
+        gates = gates / jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
+        h = jnp.einsum("td,edm->tem", tokens,
+                       params["expert_fc"].astype(x.dtype))
+        if config.activation == "swiglu":
+            g = jnp.einsum("td,edm->tem", tokens,
+                           params["expert_gate"].astype(x.dtype))
+            h = jax.nn.silu(g) * h
+        else:
+            h = jax.nn.gelu(h)
+        y = jnp.einsum("tem,emd->ted", h,
+                       params["expert_out"].astype(x.dtype))
+        out = jnp.einsum("te,ted->td", gates.astype(x.dtype), y)
+        me = probs.mean(axis=0)
+        ce = topk_mask.mean(axis=0) / config.top_k
+        aux = config.aux_loss_weight * E * jnp.sum(me * ce)
+        return out.reshape(B, T, D), aux
 
     # Position of each token within its expert's queue; drop overflow.
     pos = jnp.cumsum(topk_mask, axis=0) * topk_mask          # [T, E] 1-based

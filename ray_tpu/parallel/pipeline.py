@@ -30,13 +30,19 @@ def pipeline_apply(
     axis: str = "stage",
     params_spec: Optional[Any] = None,
     x_spec: P = P(),
+    collect_aux: bool = False,
 ):
     """Run ``x`` through S pipeline stages.
 
     stage_params: pytree whose leaves have leading dim [L] sharded over
     ``axis`` (each stage sees its [L/S] slice).
     x: [B, ...] activations (batch first). B % num_microbatches == 0.
-    apply_stage(local_params, mb) applies one stage's layers to a microbatch.
+    apply_stage(local_params, mb) applies one stage's layers to a microbatch;
+    with ``collect_aux`` it returns (y, aux_scalar) and pipeline_apply
+    returns (out, aux) where aux is the microbatch-mean of the per-stage
+    scalars psum'd over the stage axis — the MoE load-balancing loss
+    survives the microbatch loop instead of being dropped (bubble steps,
+    which compute on zero/garbage activations, are masked out).
 
     Schedule: M + S - 1 steps; stage 0 injects microbatch i at step i; the
     last stage's result for microbatch i appears at step i + S - 1. Output is
@@ -60,12 +66,22 @@ def pipeline_apply(
         perm = [(i, (i + 1) % S) for i in range(S)]
         out0 = jnp.zeros_like(mbs)
         recv0 = jnp.zeros((mb,) + x_local.shape[1:], x_local.dtype)
+        aux0 = jnp.float32(0.0)
 
         def step(carry, i):
-            recv, outs = carry
+            recv, outs, aux_acc = carry
             inject = mbs[jnp.minimum(i, M - 1)]
             cur = jnp.where(sidx == 0, inject, recv)
-            y = apply_stage(params_local, cur)
+            if collect_aux:
+                y, aux = apply_stage(params_local, cur)
+                # Stage s sees real microbatches only during its window
+                # [s, s + M): bubble-step routing statistics are garbage.
+                valid = jnp.logical_and(i >= sidx, i < sidx + M)
+                aux_acc = aux_acc + jnp.where(
+                    valid, aux.astype(jnp.float32), 0.0
+                )
+            else:
+                y = apply_stage(params_local, cur)
             # collect on the last stage once the pipe is full
             oidx = jnp.maximum(i - (S - 1), 0)
             updated = jax.lax.dynamic_update_slice(
@@ -75,17 +91,23 @@ def pipeline_apply(
             take = jnp.logical_and(i >= S - 1, sidx == S - 1)
             outs = jnp.where(take, updated, outs)
             recv_next = jax.lax.ppermute(y, axis, perm)
-            return (recv_next, outs), None
+            return (recv_next, outs, aux_acc), None
 
-        (_, outs), _ = jax.lax.scan(
-            step, (recv0, out0), jnp.arange(M + S - 1)
+        (_, outs, aux_acc), _ = jax.lax.scan(
+            step, (recv0, out0, aux0), jnp.arange(M + S - 1)
         )
         # Broadcast the last stage's buffer to every stage.
         outs = jax.lax.psum(
             jnp.where(sidx == S - 1, outs, jnp.zeros_like(outs)), axis
         )
+        if collect_aux:
+            # Sum over stages (each holds distinct layers), mean over the M
+            # microbatches each stage processed.
+            aux_total = jax.lax.psum(aux_acc, axis) / M
+            return outs.reshape(x_local.shape), aux_total
         return outs.reshape(x_local.shape)
 
+    out_specs = (x_spec, P()) if collect_aux else x_spec
     # Manual only over the stage axis: batch/tensor/fsdp shardings of the
     # activations and weights stay under XLA's automatic propagation.
     return shard_map(
@@ -93,6 +115,6 @@ def pipeline_apply(
         mesh=mesh,
         axis_names={axis},
         in_specs=(params_spec, x_spec),
-        out_specs=x_spec,
+        out_specs=out_specs,
         check_vma=False,
     )(stage_params, x)

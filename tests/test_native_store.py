@@ -81,7 +81,7 @@ def test_pinned_object_survives_delete(arena):
     # Creator deletes while the reader view is live: memory must not be
     # reused until the view dies (plasma pin semantics).
     in_use = arena.stats()["bytes_in_use"]
-    arena._created.discard(oid)  # simulate owner in another process
+    arena._created.pop(oid, None)  # simulate owner in another process
     arena._lib.rt_obj_delete(arena._h, oid.encode())
     assert arena.stats()["bytes_in_use"] == in_use  # still held by pin
     assert bytes(view) == b"z" * 1000

@@ -1,0 +1,131 @@
+"""Object spilling + lineage reconstruction (reference:
+``raylet/local_object_manager.h`` spill/restore,
+``core_worker/object_recovery_manager.h`` lineage resubmit)."""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as rt_exc
+from ray_tpu._private import worker as worker_mod
+
+
+@pytest.fixture
+def small_arena_cluster(monkeypatch, tmp_path):
+    """Cluster whose arena is small enough to force spilling, with the
+    spill dir under tmp_path."""
+    from ray_tpu.native import arena as arena_mod
+
+    monkeypatch.setattr(arena_mod, "DEFAULT_CAPACITY", 48 * 1024 * 1024)
+    monkeypatch.setenv("RT_ARENA_BYTES", str(48 * 1024 * 1024))
+    monkeypatch.setenv("RT_SPILL_DIR", str(tmp_path / "spill"))
+    ray_tpu.init(num_cpus=2, num_nodes=1)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def rt_two_nodes():
+    ray_tpu.init(num_cpus=2, num_nodes=2)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_spill_under_pressure_and_restore(small_arena_cluster):
+    """Puts beyond arena capacity spill old objects to disk; gets read them
+    back (restore-on-get)."""
+    w = worker_mod.global_worker
+    if not w.shm.native_enabled:
+        pytest.skip("native arena unavailable")
+    chunks = [np.full(1_000_000, i, np.float64) for i in range(12)]  # 8MB ea
+    refs = [ray_tpu.put(c) for c in chunks]  # ~96MB > 48MB arena
+    spill_root = w.shm.spill.root
+    assert os.path.isdir(spill_root) and os.listdir(spill_root), (
+        "expected spilled objects on disk"
+    )
+    for i, r in enumerate(refs):
+        got = ray_tpu.get(r)
+        assert np.array_equal(got, chunks[i]), f"object {i} corrupted"
+
+
+def test_spilled_object_readable_by_worker_task(small_arena_cluster):
+    """A task arg whose object was spilled is restored transparently."""
+    w = worker_mod.global_worker
+    if not w.shm.native_enabled:
+        pytest.skip("native arena unavailable")
+    first = ray_tpu.put(np.full(1_000_000, 7.0))
+    # Push enough data through to force `first` out to disk.
+    pressure = [ray_tpu.put(np.random.rand(1_000_000)) for _ in range(10)]
+
+    @ray_tpu.remote
+    def total(x):
+        return float(x.sum())
+
+    assert total.remote(first) is not None
+    assert ray_tpu.get(total.remote(first)) == pytest.approx(7e6)
+    del pressure
+
+
+def test_lineage_reconstruction_on_loss(rt_two_nodes, tmp_path):
+    """Losing the only copy of a task output is repaired by re-executing the
+    producing task (deterministic ObjectIDs)."""
+    marker = tmp_path / "runs"
+
+    @ray_tpu.remote
+    def produce():
+        with open(marker, "a") as f:
+            f.write("x")
+        return np.arange(200_000, dtype=np.float64)  # >INLINE: shm-backed
+
+    ref = produce.remote()
+    got = ray_tpu.get(ref)
+    assert got.shape == (200_000,)
+    first = np.array(got)  # materialized copy: the original is a zero-copy
+    del got                # arena view whose pin would block real deletion
+    import gc
+
+    gc.collect()
+    assert marker.read_text() == "x"
+
+    # Simulate node loss of the only copy: force-delete the backing object
+    # (on one machine the arena outlives simulated nodes, so deletion is the
+    # honest stand-in for a remote node death).
+    w = worker_mod.global_worker
+    hex_ = ref.id().hex()
+    h, _ = w.run_sync(w.gcs.call("object_lookup", {"oid": hex_}))
+    assert h.get("found")
+    w.shm.free(hex_, h["meta"])
+    entry = w.memory_store.get(hex_)
+    assert entry is not None and entry[0] == "shm"
+
+    got = ray_tpu.get(ref)
+    assert np.array_equal(got, first)
+    assert marker.read_text() == "xx", "producing task should run again"
+
+
+def test_get_survives_node_death(rt_two_nodes):
+    """Kill a node mid-workload; outstanding refs still resolve (arena
+    survival or reconstruction — either way the user sees the value)."""
+    cluster = ray_tpu._internal_cluster()
+    node = cluster.add_node({"CPU": 2, "pin": 1})
+    time.sleep(0.5)
+
+    @ray_tpu.remote(resources={"pin": 0.1}, max_retries=3)
+    def produce(i):
+        return np.full(100_000, float(i))
+
+    refs = [produce.remote(i) for i in range(4)]
+    ray_tpu.get(refs[0])
+    cluster.kill_node(node)
+    # refs either completed (value survives in the machine-wide arena) or
+    # retry on other nodes... but "pin" only existed on the dead node, so
+    # in-flight ones fail over only after it returns. Give the retry path a
+    # moment, then expect either values or a clean WorkerCrashedError.
+    try:
+        vals = ray_tpu.get(refs, timeout=30)
+        for i, v in enumerate(vals):
+            assert np.array_equal(v, np.full(100_000, float(i)))
+    except rt_exc.RayTpuError:
+        pass  # acceptable: no capacity remained for the pinned resource
