@@ -17,6 +17,33 @@ import time
 BASELINE_TASKS_ASYNC = 7096.8  # reference release/perf_metrics/microbenchmark.json
 
 
+def measure_achievable_tflops() -> float:
+    """Measured matmul roof of the local accelerator (bf16 8k x 8k).
+
+    MFU against the nominal datasheet peak can be misleading: shared or
+    tunneled devices execute well below it (observed: a clean matmul at
+    ~28% of nominal on a tunneled v5e). Reporting the measured roof lets
+    `gpt2_train_mfu_vs_achievable` say how close the train step is to what
+    this device can actually do."""
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+
+    n = 8192
+    a = jnp.ones((n, n), jnp.bfloat16)
+    mm = jax.jit(lambda a: a @ a)
+    out = mm(a)
+    float(jnp.sum(out[:1, :1]))  # real device->host sync
+    steps = 30
+    t0 = _t.perf_counter()
+    for _ in range(steps):
+        out = mm(out)
+    float(jnp.sum(out[:1, :1]))
+    dt = _t.perf_counter() - t0
+    return 2 * n ** 3 * steps / dt
+
+
 def bench_train_tokens_per_sec(quick: bool = False):
     import jax
     import jax.numpy as jnp
@@ -35,7 +62,9 @@ def bench_train_tokens_per_sec(quick: bool = False):
             vocab_size=50304, max_seq_len=1024, num_layers=12, num_heads=12,
             embed_dim=768,
         )
-        B, T = 16, 1024  # B=16 amortizes per-step overhead (~23% MFU v5e)
+        # B=32 + vocab-chunked loss + dots-remat: bigger batch amortizes
+        # per-step overhead without the old [B,T,V] fp32 logits blowup.
+        B, T = 32, 1024
         steps = 20
     else:
         config = gpt2.GPT2Config(
@@ -83,12 +112,22 @@ def bench_train_tokens_per_sec(quick: bool = False):
             dt = time.perf_counter() - t0
             tokens_per_sec = steps * B * T / dt
             mfu = gpt2.flops_per_token(config) * tokens_per_sec / peak
-    return {
+    out = {
         "gpt2_train_tokens_per_sec_per_chip": tokens_per_sec,
         "gpt2_train_loss": float(m["loss"]),
         "gpt2_train_mfu_est": mfu,
         "train_backend": jax.default_backend(),
     }
+    if on_tpu:
+        try:
+            roof = measure_achievable_tflops()
+            out["tpu_matmul_tflops_measured"] = roof / 1e12
+            out["gpt2_train_mfu_vs_achievable"] = (
+                gpt2.flops_per_token(config) * tokens_per_sec / roof
+            )
+        except Exception:
+            pass
+    return out
 
 
 def main():

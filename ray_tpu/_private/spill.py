@@ -37,9 +37,13 @@ class SpillManager:
     """
 
     def __init__(self, root: Optional[str] = None, session: str = ""):
-        self.root = root or os.environ.get("RT_SPILL_DIR") or os.path.join(
+        env_root = os.environ.get("RT_SPILL_DIR")
+        self.root = root or env_root or os.path.join(
             tempfile.gettempdir(), f"rt_spill_{session or os.getpid()}"
         )
+        # A user-supplied directory (env or arg) may be shared by other
+        # sessions (e.g. NFS): never rmtree it wholesale at teardown.
+        self._owns_root = root is None and env_root is None
         self._lock = threading.Lock()
         self._made = False
 
@@ -85,6 +89,8 @@ class SpillManager:
                 pass
 
     def cleanup(self):
+        if not self._owns_root:
+            return  # shared directory: other sessions' spills live here
         try:
             import shutil
 

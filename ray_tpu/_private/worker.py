@@ -585,10 +585,20 @@ class CoreWorker:
                     regs.append((oid, meta))
 
                 async def finish():
-                    for oid, meta in regs:
-                        await self.gcs.call(
-                            "object_register", {"oid": oid, "meta": meta}
+                    # Any failure must still produce a reply — a silent
+                    # drop leaves the submitter's future hanging forever.
+                    try:
+                        for oid, meta in regs:
+                            await self.gcs.call(
+                                "object_register", {"oid": oid, "meta": meta}
+                            )
+                    except Exception as e:
+                        rconn.send_reply(
+                            {"i": h["i"], "r": 1,
+                             "e": f"result registration failed: {e!r}"},
+                            [],
                         )
+                        return
                     rconn.send_reply(
                         {"i": h["i"], "r": 1, "rets": rets}, out_frames
                     )
@@ -598,8 +608,12 @@ class CoreWorker:
                 rconn.send_reply(
                     {"i": h["i"], "r": 1, "rets": rets}, out_frames
                 )
-        except Exception:
+        except Exception as e:
             logger.exception("ring task reply failed")
+            rconn.send_reply(
+                {"i": h["i"], "r": 1, "e": f"reply packaging failed: {e!r}"},
+                [],
+            )
         self._stats["tasks_executed"] += 1
         self._record_task_event({
             "task_id": h["tid"], "name": h.get("name") or h["fkey"],
@@ -1526,7 +1540,7 @@ class CoreWorker:
                         # Frame-size estimate missed (oversized headers):
                         # push each task alone; singles that still exceed
                         # the ring ride TCP. Futures must never be dropped.
-                        for header, frames, fut in chunk:
+                        for i, (header, frames, fut) in enumerate(chunk):
                             try:
                                 try:
                                     h, rframes = await conn.call(
@@ -1544,6 +1558,12 @@ class CoreWorker:
                                 if self._pusher_rpc_error(
                                     lease_set, slot, fut, e
                                 ):
+                                    # This slot is done (e.g. OOM eviction);
+                                    # the rest of the chunk goes back to the
+                                    # queue for other slots — their futures
+                                    # must not be abandoned.
+                                    lease_set.pending.extend(chunk[i + 1:])
+                                    self._pump_leases(key, lease_set)
                                     return
                         continue
                     stop = False

@@ -46,6 +46,10 @@ class GPT2Config:
     param_dtype: Any = jnp.float32
     attention_impl: str = "auto"     # auto | xla | flash | flash_interpret | ring | ulysses
     remat: bool = True
+    # "dots": save matmul outputs, recompute elementwise (cheap recompute,
+    # moderate memory — the right default below memory pressure). "full":
+    # save only block boundaries (max memory savings, ~1 extra forward).
+    remat_policy: str = "dots"
     seq_axis: str = "seq"            # mesh axis for ring/ulysses
     moe: Optional[MoEConfig] = None  # replace MLPs with MoE when set (EP)
 
@@ -152,6 +156,15 @@ def param_axes(config: GPT2Config) -> Dict[str, Any]:
     return axes
 
 
+def _remat_policy(config):
+    """Checkpoint policy for the block body. Full remat costs ~33% extra
+    FLOPs re-running every matmul in backward; "dots" keeps matmul outputs
+    resident and recomputes only the cheap elementwise work."""
+    if getattr(config, "remat_policy", "dots") == "full":
+        return None
+    return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+
+
 def _layer_norm(x, g, b, eps=1e-5):
     x32 = x.astype(jnp.float32)
     mean = x32.mean(-1, keepdims=True)
@@ -210,14 +223,16 @@ def _block(config: GPT2Config, mesh: Optional[Mesh], x, layer, rng=None):
     return _mlp_residual(config, layer, x, rng=rng)
 
 
-def forward(
+def forward_features(
     params: Dict[str, Any],
     tokens: jax.Array,
     config: GPT2Config,
     mesh: Optional[Mesh] = None,
     rng: Optional[jax.Array] = None,
-) -> jax.Array:
-    """tokens [B, T] int32 → (logits [B, T, V] f32, moe aux loss scalar).
+) -> tuple:
+    """tokens [B, T] int32 → (final-trunk features [B, T, E], aux loss).
+    The loss path consumes features directly (vocab-chunked cross entropy,
+    ``ops/xent.py``) so the [B, T, V] logits tensor never materializes.
     ``rng``: optional key enabling stochastic layers (MoE router jitter)."""
     B, T = tokens.shape
     x = params["wte"][tokens].astype(config.dtype)
@@ -225,7 +240,7 @@ def forward(
 
     body = functools.partial(_block, config, mesh)
     if config.remat:
-        body = jax.checkpoint(body)
+        body = jax.checkpoint(body, policy=_remat_policy(config))
 
     if rng is not None:
         layer_rngs = jax.random.split(rng, config.num_layers)
@@ -250,6 +265,18 @@ def forward(
             scan_fn, (x, jnp.float32(0.0)), params["blocks"]
         )
     x = _layer_norm(x, params["ln_f_g"], params["ln_f_b"])
+    return x, aux
+
+
+def forward(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    config: GPT2Config,
+    mesh: Optional[Mesh] = None,
+    rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """tokens [B, T] int32 → (logits [B, T, V] f32, moe aux loss scalar)."""
+    x, aux = forward_features(params, tokens, config, mesh, rng=rng)
     logits = jnp.einsum("bte,ve->btv", x, params["wte"].astype(x.dtype))
     return logits.astype(jnp.float32), aux
 
@@ -339,14 +366,18 @@ def loss_fn(
         logits, aux = forward_pipelined(
             params, inputs, config, mesh, pipeline_microbatches
         )
-    else:
-        logits, aux = forward(params, inputs, config, mesh, rng=rng)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    mask = batch.get("mask")
-    if mask is None:
-        return -ll.mean() + aux
-    return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1) + aux
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        mask = batch.get("mask")
+        if mask is None:
+            return -ll.mean() + aux
+        return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1) + aux
+    from ray_tpu.ops.xent import chunked_softmax_xent
+
+    x, aux = forward_features(params, inputs, config, mesh, rng=rng)
+    return chunked_softmax_xent(
+        x, params["wte"], targets, batch.get("mask")
+    ) + aux
 
 
 def count_params(params) -> int:
@@ -382,7 +413,7 @@ def forward_pipelined(
 
     body = functools.partial(_block, config, mesh)
     if config.remat:
-        body = jax.checkpoint(body)
+        body = jax.checkpoint(body, policy=_remat_policy(config))
     collect_aux = config.moe is not None
 
     def apply_stage(local_blocks, mb):

@@ -47,6 +47,9 @@ class LlamaConfig:
     param_dtype: Any = jnp.float32
     attention_impl: str = "auto"     # auto | xla | flash | ring | ulysses
     remat: bool = True
+    # "dots": save matmul outputs, recompute elementwise; "full": save only
+    # block boundaries (max memory savings, ~1 extra forward of FLOPs).
+    remat_policy: str = "dots"
     seq_axis: str = "seq"
     # Mixtral-style MoE: replaces the SwiGLU MLP with routed experts (use
     # MoEConfig(activation="swiglu") for the Mixtral shape).
@@ -162,6 +165,14 @@ def param_axes(config: LlamaConfig) -> Dict[str, Any]:
     return axes
 
 
+def _remat_policy(config):
+    """See gpt2._remat_policy: "dots" saves matmul outputs, "full" saves
+    only block boundaries."""
+    if getattr(config, "remat_policy", "dots") == "full":
+        return None
+    return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+
+
 def _rms_norm(x, g, eps):
     x32 = x.astype(jnp.float32)
     scale = jax.lax.rsqrt((x32 * x32).mean(-1, keepdims=True) + eps)
@@ -233,21 +244,23 @@ def _block(config: LlamaConfig, mesh: Optional[Mesh], x, layer,
     return _ffn(config, layer, x, rng=rng)
 
 
-def forward(
+def forward_features(
     params: Dict[str, Any],
     tokens: jax.Array,
     config: LlamaConfig,
     mesh: Optional[Mesh] = None,
     rng: Optional[jax.Array] = None,  # feeds MoE router jitter
 ) -> Tuple[jax.Array, jax.Array]:
-    """tokens [B, T] int32 -> (logits [B, T, V] f32, moe aux loss)."""
+    """tokens [B, T] int32 -> (final-trunk features [B, T, E], aux loss).
+    The loss path consumes features directly (vocab-chunked cross entropy)
+    so the [B, T, V] logits tensor never materializes."""
     B, T = tokens.shape
     x = params["wte"][tokens].astype(config.dtype)
     pos = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T))
 
     body = functools.partial(_block, config, mesh)
     if config.remat:
-        body = jax.checkpoint(body)
+        body = jax.checkpoint(body, policy=_remat_policy(config))
 
     if rng is not None:
         layer_rngs = jax.random.split(rng, config.num_layers)
@@ -272,6 +285,18 @@ def forward(
             scan_fn, (x, jnp.float32(0.0)), params["blocks"]
         )
     x = _rms_norm(x, params["norm_f"], config.rms_eps)
+    return x, aux
+
+
+def forward(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    config: LlamaConfig,
+    mesh: Optional[Mesh] = None,
+    rng: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """tokens [B, T] int32 -> (logits [B, T, V] f32, moe aux loss)."""
+    x, aux = forward_features(params, tokens, config, mesh, rng=rng)
     logits = jnp.einsum("bte,ve->btv", x, params["lm_head"].astype(x.dtype))
     return logits.astype(jnp.float32), aux
 
@@ -372,14 +397,18 @@ def loss_fn(
         logits, aux = forward_pipelined(
             params, inputs, config, mesh, pipeline_microbatches
         )
-    else:
-        logits, aux = forward(params, inputs, config, mesh, rng=rng)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    mask = batch.get("mask")
-    if mask is None:
-        return -ll.mean() + aux
-    return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1) + aux
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        mask = batch.get("mask")
+        if mask is None:
+            return -ll.mean() + aux
+        return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1) + aux
+    from ray_tpu.ops.xent import chunked_softmax_xent
+
+    x, aux = forward_features(params, inputs, config, mesh, rng=rng)
+    return chunked_softmax_xent(
+        x, params["lm_head"], targets, batch.get("mask")
+    ) + aux
 
 
 def forward_pipelined(
@@ -403,7 +432,7 @@ def forward_pipelined(
 
     body = functools.partial(_block, config, mesh)
     if config.remat:
-        body = jax.checkpoint(body)
+        body = jax.checkpoint(body, policy=_remat_policy(config))
     collect_aux = config.moe is not None
 
     def apply_stage(local_blocks, mb):

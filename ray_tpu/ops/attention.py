@@ -192,13 +192,38 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
+_PALLAS_OK = None
+
+
+def pallas_available() -> bool:
+    """Whether pallas kernels can actually lower on this backend. A backend
+    may report "tpu" yet lack mosaic lowering (e.g. remote-tunnel device
+    plugins); "auto" must then fall back to XLA attention rather than fail
+    at compile time. Probed once with a tiny kernel."""
+    global _PALLAS_OK
+    if _PALLAS_OK is None:
+        try:
+            q = jnp.zeros((1, 128, 1, 128), jnp.float32)
+            jax.jit(
+                lambda q: flash_attention(q, q, q, True, 128, 128, False)
+            )(q).block_until_ready()
+            _PALLAS_OK = True
+        except Exception:
+            _PALLAS_OK = False
+    return _PALLAS_OK
+
+
 def attention(
     q, k, v, *, causal: bool = True, impl: str = "auto",
     block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
 ):
     """Dispatcher. impl: auto | xla | flash | flash_interpret."""
     if impl == "auto":
-        impl = "flash" if jax.default_backend() == "tpu" else "xla"
+        impl = (
+            "flash"
+            if jax.default_backend() == "tpu" and pallas_available()
+            else "xla"
+        )
     if impl == "xla":
         return attention_xla(q, k, v, causal=causal)
     if impl == "flash":
