@@ -1,0 +1,37 @@
+"""Per-task/actor runtime environments.
+
+Reference behavior being reproduced (not copied):
+``python/ray/_private/runtime_env/`` — pip/uv create cached virtualenvs
+(``pip.py``, ``uv.py``), ``py_modules``/``working_dir`` are packaged,
+content-addressed, uploaded, and downloaded to per-node caches
+(``packaging.py``), and workers start inside the prepared env (the per-node
+runtime-env agent, ``agent/runtime_env_agent.py``).
+
+TPU-era design differences: there is no separate env agent process — the
+node's worker prepares environments lazily on first use (creation happens on
+the task executor thread, which already represents the task's slot), venvs
+are content-hashed and shared machine-wide, and pip/uv tasks execute in a
+dedicated per-env subprocess (``executor.py``) instead of re-launching the
+whole worker: the process-per-host worker owns the TPU and must not be
+recycled per env.
+
+Supported plugins: env_vars, working_dir, py_modules, pip, uv.
+Anything else fails loudly at execution time — silent degradation hid real
+capability gaps (round-1 review finding).
+"""
+from __future__ import annotations
+
+KNOWN_PLUGINS = ("env_vars", "working_dir", "py_modules", "pip", "uv")
+
+
+def validate(renv: dict):
+    """Raise on unknown plugins — a task must not silently run without the
+    environment it asked for."""
+    from ray_tpu import exceptions as exc
+
+    unknown = [k for k in (renv or {}) if k not in KNOWN_PLUGINS]
+    if unknown:
+        raise exc.RayTpuError(
+            f"runtime_env plugins {unknown!r} are not supported "
+            f"(supported: {list(KNOWN_PLUGINS)})"
+        )

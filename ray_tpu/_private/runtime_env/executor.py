@@ -1,0 +1,182 @@
+"""Subprocess executor for tasks that run inside a runtime-env venv.
+
+Reference analog: the worker-pool-per-runtime-env model (raylet worker pool
+keyed by env hash; ``agent/runtime_env_agent.py`` prepares, workers launch
+inside). Our worker is process-per-host and owns the TPU, so instead of
+recycling whole workers per env, each distinct venv gets a lightweight
+executor subprocess: the parent ships cloudpickled (fn, args, kwargs) over a
+pipe, the child (running the venv's python) executes and ships back the
+cloudpickled result. The child sees the venv's packages; numpy-style args
+flow both ways because the venv uses --system-site-packages.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import struct
+import subprocess
+import sys
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+_U32 = struct.Struct("<I")
+
+# The child loop. Kept dependency-minimal: cloudpickle comes from the
+# parent's site-packages (venvs are created with --system-site-packages).
+_CHILD_SRC = r"""
+import os, struct, sys, traceback
+# The protocol channel is the ORIGINAL stdout fd, dup'd away before any
+# user code runs; fd 1 is then pointed at stderr so task print() output
+# cannot corrupt the length-prefixed wire framing.
+_proto_fd = os.dup(1)
+os.dup2(2, 1)
+out = os.fdopen(_proto_fd, "wb")
+# The parent's site-packages ride along as a FALLBACK (appended, so venv
+# installs take precedence): `python -m venv` from a venv interpreter
+# points system-site at the BASE prefix, losing the parent venv's packages
+# (cloudpickle, numpy) that result shipping depends on.
+for _p in os.environ.get("RT_PARENT_SITE", "").split(os.pathsep):
+    if _p and _p not in sys.path:
+        sys.path.append(_p)
+import cloudpickle
+
+_U32 = struct.Struct("<I")
+inp = sys.stdin.buffer
+
+def read_exact(n):
+    data = b""
+    while len(data) < n:
+        chunk = inp.read(n - len(data))
+        if not chunk:
+            raise SystemExit(0)
+        data += chunk
+    return data
+
+while True:
+    (n,) = _U32.unpack(read_exact(4))
+    blob = read_exact(n)
+    old_env, old_cwd = {}, None
+    try:
+        fn, args, kwargs, env_vars, cwd = cloudpickle.loads(blob)
+        for k, v in (env_vars or {}).items():
+            old_env[k] = os.environ.get(k)
+            os.environ[k] = str(v)
+        if cwd:
+            old_cwd = os.getcwd()
+            os.chdir(cwd)
+        result = (True, fn(*args, **kwargs))
+    except BaseException as e:
+        result = (False, (repr(e), traceback.format_exc()))
+    finally:
+        if old_cwd is not None:
+            try:
+                os.chdir(old_cwd)
+            except OSError:
+                pass
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    try:
+        reply = cloudpickle.dumps(result)
+    except BaseException as e:
+        # unpicklable return value: a task failure, not an executor crash
+        reply = cloudpickle.dumps(
+            (False, (f"task result not serializable: {e!r}",
+                     traceback.format_exc()))
+        )
+    out.write(_U32.pack(len(reply)))
+    out.write(reply)
+    out.flush()
+"""
+
+
+class EnvExecutor:
+    """One venv subprocess; tasks run serially per executor (the parent's
+    task-slot accounting still bounds concurrency — one slot drives one
+    executor call at a time)."""
+
+    def __init__(self, python: str, path_entries: Optional[List[str]] = None):
+        self.python = python
+        env = dict(os.environ)
+        # The child must import ray_tpu's deps (cloudpickle) and any staged
+        # py_modules; prepend rather than replace.
+        extra = list(path_entries or [])
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)
+            )))
+        )
+        extra.append(repo_root)
+        prev = env.get("PYTHONPATH")
+        env["PYTHONPATH"] = os.pathsep.join(
+            extra + ([prev] if prev else [])
+        )
+        # Parent site-packages (appended by the child AFTER its own): see
+        # _CHILD_SRC. sys.path is the honest source — site.getsitepackages
+        # misses venv layouts.
+        env["RT_PARENT_SITE"] = os.pathsep.join(
+            p for p in sys.path if "site-packages" in p
+        )
+        self._lock = threading.Lock()
+        self.proc = subprocess.Popen(
+            [python, "-u", "-c", _CHILD_SRC],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            env=env,
+        )
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def run(self, fn, args, kwargs, env_vars: Optional[dict] = None,
+            cwd: Optional[str] = None) -> Tuple[bool, Any]:
+        """Returns (ok, result-or-(err_repr, traceback)). env_vars/cwd are
+        applied PER CALL inside the child (executors are cached per venv, so
+        per-task env differences must not bake into the process). Raises
+        RuntimeError if the child died (caller treats as task failure and
+        discards the executor)."""
+        import cloudpickle
+
+        blob = cloudpickle.dumps((fn, args, kwargs, env_vars, cwd))
+        with self._lock:
+            if not self.alive():
+                raise RuntimeError("runtime-env executor process died")
+            try:
+                self.proc.stdin.write(_U32.pack(len(blob)))
+                self.proc.stdin.write(blob)
+                self.proc.stdin.flush()
+                hdr = self.proc.stdout.read(4)
+                if len(hdr) < 4:
+                    raise RuntimeError(
+                        "runtime-env executor exited mid-task"
+                    )
+                (n,) = _U32.unpack(hdr)
+                data = b""
+                while len(data) < n:
+                    chunk = self.proc.stdout.read(n - len(data))
+                    if not chunk:
+                        raise RuntimeError(
+                            "runtime-env executor exited mid-reply"
+                        )
+                    data += chunk
+            except (BrokenPipeError, OSError) as e:
+                raise RuntimeError(f"runtime-env executor pipe: {e}")
+        return cloudpickle.loads(data)
+
+    def close(self):
+        try:
+            self.proc.stdin.close()
+        except Exception:
+            pass
+        try:
+            self.proc.terminate()
+            self.proc.wait(timeout=3)
+        except Exception:
+            try:
+                self.proc.kill()
+            except Exception:
+                pass

@@ -244,6 +244,11 @@ class CoreWorker:
         # Byte-bounded; eviction disables reconstruction for old tasks.
         self._lineage: Dict[str, dict] = {}
         self._lineage_bytes = 0
+        # runtime-env venv executors: (env key, py_modules) -> subprocess;
+        # builds serialize per key so cold installs don't stall other envs
+        self._env_executors: Dict[tuple, Any] = {}
+        self._env_exec_keylocks: Dict[tuple, threading.Lock] = {}
+        self._env_exec_lock = threading.Lock()
         self._LINEAGE_MAX_BYTES = int(
             os.environ.get("RT_LINEAGE_BYTES", 256 * 1024 * 1024)
         )
@@ -1197,7 +1202,7 @@ class CoreWorker:
             "borrows": borrow_ids,
             "owner": list(self.addr),
             "name": name or getattr(fn, "__name__", "task"),
-            "renv": runtime_env or {},
+            "renv": self._prepare_runtime_env(runtime_env),
         }
         from ray_tpu.util.tracing import tracing_helper
 
@@ -1331,6 +1336,26 @@ class CoreWorker:
                     repr(e)
                 ),
             )
+
+    def _prepare_runtime_env(self, runtime_env: Optional[dict]) -> dict:
+        """Submit-side runtime-env preparation: local py_modules paths are
+        zipped and staged in the head KV once (content-addressed) so every
+        executor fetches the same bits (reference: packaging.py upload)."""
+        if not runtime_env:
+            return {}
+        from ray_tpu._private import runtime_env as renv_mod
+
+        renv_mod.validate(runtime_env)
+        if runtime_env.get("py_modules"):
+            from ray_tpu._private.runtime_env import packaging
+
+            runtime_env = dict(
+                runtime_env,
+                py_modules=packaging.stage_modules(
+                    self, runtime_env["py_modules"]
+                ),
+            )
+        return runtime_env
 
     def _sched_key(self, resources, strategy):
         return (
@@ -1716,7 +1741,11 @@ class CoreWorker:
             "name": name,
             "namespace": namespace,
             "get_if_exists": get_if_exists,
-            "renv": runtime_env or {},
+            # env_vars/working_dir/py_modules apply to the hosted actor;
+            # pip/uv actor isolation (a dedicated venv-worker per actor)
+            # is not supported — validate() rejects unknown plugins and
+            # construct() raises on pip/uv below.
+            "renv": self._prepare_runtime_env(runtime_env),
         }
         # creation_frames replayed on restart: [spec-pickle, arg frames...].
         # argrefs live in the spec so restart replays resolve them again.
@@ -2027,7 +2056,59 @@ class CoreWorker:
             args.append(fetched[idx] if kind == "ref" else plain[idx])
         return args, kwargs
 
-    _warned_renv_plugins: set = set()
+    def _run_in_env(self, renv: dict, fn, args, kwargs):
+        """Execute a pip/uv task inside its cached venv subprocess
+        (reference: worker-pool-per-runtime-env; here a per-env executor
+        child — see runtime_env/executor.py). Runs on the executor thread;
+        a cold venv build blocks only tasks of the SAME env (per-key lock),
+        and per-task env_vars/working_dir apply inside the child."""
+        from ray_tpu._private import runtime_env as renv_mod
+        from ray_tpu._private.runtime_env import packaging, venv
+        from ray_tpu._private.runtime_env.executor import EnvExecutor
+
+        renv_mod.validate(renv)
+        use_uv = bool(renv.get("uv"))
+        packages = list(renv.get("uv") or renv.get("pip") or ())
+        entries = []
+        if renv.get("py_modules"):
+            entries = packaging.fetch_modules(self, renv["py_modules"])
+        key = (venv.env_key(packages, use_uv), tuple(entries))
+        with self._env_exec_lock:
+            ex = self._env_executors.get(key)
+            if ex is not None and not ex.alive():
+                ex.close()
+                ex = None
+                self._env_executors.pop(key, None)
+            key_lock = self._env_exec_keylocks.setdefault(
+                key, threading.Lock()
+            )
+        if ex is None:
+            # Build under the PER-KEY lock: a minutes-long pip install of
+            # one env must not stall tasks whose env is already built.
+            with key_lock:
+                with self._env_exec_lock:
+                    ex = self._env_executors.get(key)
+                if ex is None or not ex.alive():
+                    python = venv.ensure_venv(packages, use_uv=use_uv)
+                    ex = EnvExecutor(python, path_entries=entries)
+                    with self._env_exec_lock:
+                        self._env_executors[key] = ex
+        try:
+            ok, result = ex.run(
+                fn, args, kwargs,
+                env_vars=renv.get("env_vars"),
+                cwd=renv.get("working_dir"),
+            )
+        except RuntimeError as e:
+            with self._env_exec_lock:
+                if self._env_executors.get(key) is ex:
+                    self._env_executors.pop(key, None)
+            ex.close()
+            raise exc.WorkerCrashedError(f"runtime-env executor: {e}")
+        if ok:
+            return True, result
+        err_repr, tb = result
+        return False, (exc.TaskError(err_repr, tb), tb)
     # Serializes tasks that use working_dir: cwd is process-global, so two
     # concurrent chdir'ing tasks would corrupt each other's view (and the
     # restore). Tasks without working_dir never touch cwd and skip the lock.
@@ -2035,17 +2116,30 @@ class CoreWorker:
 
     def _apply_runtime_env(self, renv: dict):
         """Per-task environment (reference: _private/runtime_env/ plugins).
-        Supported: env_vars, working_dir (chdir for the task — NOTE: cwd is
-        process-global, so tasks from different working_dirs must not share
-        a worker concurrently). pip/uv/conda/container isolation needs
-        worker-pool-per-env support and is declined loudly, not silently."""
+        Applied on the executor thread: env_vars, working_dir (cwd is
+        process-global, so working_dir tasks serialize on _cwd_lock),
+        py_modules (content-addressed fetch + sys.path). pip/uv route the
+        EXECUTION into a venv subprocess (see _run_in_env); unknown plugins
+        raise — a task must not silently run without the environment it
+        asked for."""
+        from ray_tpu._private import runtime_env as renv_mod
+
         renv = renv or {}
-        for plugin in ("pip", "uv", "conda", "container", "py_modules"):
-            if renv.get(plugin) and plugin not in self._warned_renv_plugins:
-                self._warned_renv_plugins.add(plugin)
-                logger.warning(
-                    "runtime_env[%r] is not supported yet; ignoring", plugin
-                )
+        renv_mod.validate(renv)
+        inserted = []
+        if renv.get("py_modules"):
+            from ray_tpu._private.runtime_env import packaging
+
+            entries = packaging.fetch_modules(self, renv["py_modules"])
+            import sys as _sys
+
+            for e in reversed(entries):
+                # scoped per task (removed in _restore_env): permanent
+                # entries would let an older staged version shadow a newer
+                # one on re-staged module updates
+                if e not in _sys.path:
+                    _sys.path.insert(0, e)
+                    inserted.append(e)
         envs = renv.get("env_vars") or {}
         old = {}
         for k, v in envs.items():
@@ -2062,9 +2156,18 @@ class CoreWorker:
             except OSError as e:
                 logger.warning("working_dir %r: %s", renv["working_dir"], e)
                 cwd = None
-        return {"env": old, "cwd": cwd, "locked": locked}
+        return {"env": old, "cwd": cwd, "locked": locked,
+                "sys_path": inserted}
 
     def _restore_env(self, old):
+        if old.get("sys_path"):
+            import sys as _sys
+
+            for e in old["sys_path"]:
+                try:
+                    _sys.path.remove(e)
+                except ValueError:
+                    pass
         if old.get("cwd") is not None:
             try:
                 os.chdir(old["cwd"])
@@ -2131,11 +2234,27 @@ class CoreWorker:
         def run():
             from ray_tpu.util.tracing import tracing_helper
 
-            old = self._apply_runtime_env(h.get("renv"))
+            renv = h.get("renv") or {}
             tid = TaskID.from_hex(h["tid"])
             self.current_task_id.value = tid
             self.current_actor_id.value = None
             self.put_counter.value = 0
+            if renv.get("pip") or renv.get("uv"):
+                # Whole env (incl. env_vars/working_dir/py_modules) applies
+                # inside the venv child — the parent process must stay
+                # unpolluted.
+                try:
+                    with tracing_helper.span(
+                        f"task::{h.get('name', 'task')}", h.get("trace"),
+                        {"task_id": h["tid"], "node_id": self.node_id},
+                    ):
+                        return self._run_in_env(renv, fn, args, kwargs)
+                except Exception as e:
+                    return False, (e, traceback.format_exc())
+            try:
+                old = self._apply_runtime_env(renv)
+            except Exception as e:
+                return False, (e, traceback.format_exc())
             try:
                 with tracing_helper.span(
                     f"task::{h.get('name', 'task')}", h.get("trace"),
@@ -2512,7 +2631,20 @@ class CoreWorker:
         loop = asyncio.get_running_loop()
 
         def construct():
-            old = self._apply_runtime_env(spec.get("renv"))
+            renv = spec.get("renv") or {}
+            if renv.get("pip") or renv.get("uv"):
+                return False, (
+                    exc.RayTpuError(
+                        "actors with pip/uv runtime envs are not supported: "
+                        "the actor would live outside the TPU-owning worker "
+                        "process (use py_modules, or run a task instead)"
+                    ),
+                    "",
+                )
+            try:
+                old = self._apply_runtime_env(renv)
+            except Exception as e:
+                return False, (e, traceback.format_exc())
             self.current_actor_id.value = h["actor_id"]
             try:
                 return True, real_cls(*args, **kwargs)
@@ -2708,6 +2840,10 @@ class CoreWorker:
     def shutdown(self):
         self._shutdown = True
         ObjectRef._release_hook = None
+        with self._env_exec_lock:
+            for ex in self._env_executors.values():
+                ex.close()
+            self._env_executors.clear()
         if self.xfer_addr is not None:
             try:
                 from ray_tpu.native import xfer as native_xfer

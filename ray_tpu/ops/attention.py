@@ -208,7 +208,14 @@ def pallas_available() -> bool:
                 lambda q: flash_attention(q, q, q, True, 128, 128, False)
             )(q).block_until_ready()
             _PALLAS_OK = True
-        except Exception:
+        except Exception as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "pallas unavailable on this backend (%s: %s); "
+                "attention_impl='auto' falls back to XLA for this process",
+                type(e).__name__, e,
+            )
             _PALLAS_OK = False
     return _PALLAS_OK
 

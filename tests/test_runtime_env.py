@@ -1,0 +1,152 @@
+"""Runtime environments: pip venvs, py_modules packaging, plugin validation
+(reference: ``python/ray/_private/runtime_env/`` pip/uv/packaging +
+worker-pool-per-env)."""
+import os
+import subprocess
+import sys
+import zipfile
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as rt_exc
+
+
+@pytest.fixture
+def rt(tmp_path, monkeypatch):
+    monkeypatch.setenv("RT_RUNTIME_ENV_DIR", str(tmp_path / "renv"))
+    ray_tpu.init(num_cpus=2, num_nodes=1)
+    yield
+    ray_tpu.shutdown()
+
+
+def _make_wheel(tmp_path, name="rt_envtest_pkg", version="0.1",
+                body="MAGIC = 'wheel-born'\n"):
+    """Hand-rolled wheel (a zip + dist-info): fully offline."""
+    whl = tmp_path / f"{name}-{version}-py3-none-any.whl"
+    dist = f"{name}-{version}.dist-info"
+    with zipfile.ZipFile(whl, "w") as z:
+        z.writestr(f"{name}/__init__.py", body)
+        z.writestr(
+            f"{dist}/METADATA",
+            f"Metadata-Version: 2.1\nName: {name}\nVersion: {version}\n",
+        )
+        z.writestr(
+            f"{dist}/WHEEL",
+            "Wheel-Version: 1.0\nGenerator: test\nRoot-Is-Purelib: true\n"
+            "Tag: py3-none-any\n",
+        )
+        z.writestr(f"{dist}/RECORD", "")
+    return str(whl)
+
+
+def test_pip_env_task_runs_with_absent_package(rt, tmp_path):
+    """VERDICT round-1 item: a task runs with a package the parent env does
+    not have (installed into a cached venv from a local wheel)."""
+    wheel = _make_wheel(tmp_path)
+
+    @ray_tpu.remote(runtime_env={"pip": [wheel]})
+    def probe():
+        import rt_envtest_pkg
+
+        return rt_envtest_pkg.MAGIC
+
+    # the parent interpreter must NOT see the package
+    r = subprocess.run(
+        [sys.executable, "-c", "import rt_envtest_pkg"], capture_output=True
+    )
+    assert r.returncode != 0, "package unexpectedly present in parent env"
+    assert ray_tpu.get(probe.remote(), timeout=180) == "wheel-born"
+
+
+def test_pip_env_venv_is_cached(rt, tmp_path):
+    wheel = _make_wheel(tmp_path)
+
+    @ray_tpu.remote(runtime_env={"pip": [wheel]})
+    def pyexe():
+        import sys as s
+
+        return s.executable
+
+    first = ray_tpu.get(pyexe.remote(), timeout=180)
+    second = ray_tpu.get(pyexe.remote(), timeout=60)
+    assert first == second, "same env spec must reuse the cached venv"
+    assert first != sys.executable
+
+
+def test_py_modules_ships_local_module(rt, tmp_path):
+    mod = tmp_path / "shipped_mod"
+    mod.mkdir()
+    (mod / "__init__.py").write_text("VALUE = 41\n")
+    (mod / "extra.py").write_text("def f():\n    return 1\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(mod)]})
+    def use():
+        import shipped_mod
+        from shipped_mod.extra import f
+
+        return shipped_mod.VALUE + f()
+
+    assert ray_tpu.get(use.remote(), timeout=60) == 42
+
+
+def test_unknown_plugin_fails_loudly(rt):
+    @ray_tpu.remote(runtime_env={"conda": ["something"]})
+    def nope():
+        return 1
+
+    with pytest.raises(rt_exc.RayTpuError):
+        ray_tpu.get(nope.remote(), timeout=60)
+
+
+def test_env_vars_still_work(rt):
+    @ray_tpu.remote(runtime_env={"env_vars": {"RT_TEST_FLAG": "on"}})
+    def read():
+        return os.environ.get("RT_TEST_FLAG")
+
+    assert ray_tpu.get(read.remote(), timeout=60) == "on"
+
+
+def test_pip_task_print_does_not_corrupt_protocol(rt, tmp_path):
+    """Task prints ride stderr in the venv child; the result pipe stays
+    clean."""
+    wheel = _make_wheel(tmp_path)
+
+    @ray_tpu.remote(runtime_env={"pip": [wheel]})
+    def chatty():
+        print("this goes to stderr, not the protocol pipe")
+        import rt_envtest_pkg
+
+        return rt_envtest_pkg.MAGIC
+
+    assert ray_tpu.get(chatty.remote(), timeout=180) == "wheel-born"
+
+
+def test_pip_env_vars_apply_per_call(rt, tmp_path):
+    """Cached executors must not bake in the first task's env_vars."""
+    wheel = _make_wheel(tmp_path)
+
+    def read_flag():
+        return os.environ.get("RT_PIP_FLAG")
+
+    a = ray_tpu.remote(
+        runtime_env={"pip": [wheel], "env_vars": {"RT_PIP_FLAG": "A"}}
+    )(read_flag)
+    b = ray_tpu.remote(
+        runtime_env={"pip": [wheel], "env_vars": {"RT_PIP_FLAG": "B"}}
+    )(read_flag)
+    assert ray_tpu.get(a.remote(), timeout=180) == "A"
+    assert ray_tpu.get(b.remote(), timeout=60) == "B"
+
+
+def test_pip_unpicklable_result_is_task_error(rt, tmp_path):
+    wheel = _make_wheel(tmp_path)
+
+    @ray_tpu.remote(runtime_env={"pip": [wheel]})
+    def bad():
+        import threading
+
+        return threading.Lock()  # not serializable
+
+    with pytest.raises(rt_exc.RayTpuError, match="serializable"):
+        ray_tpu.get(bad.remote(), timeout=120)
