@@ -98,6 +98,7 @@ class Connection:
         self.writer = writer
         self.handler = handler
         self.name = name
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._next_id = 0
         self._pending: Dict[int, asyncio.Future] = {}
         self._closed = False
@@ -119,7 +120,8 @@ class Connection:
     FLUSH_BYTES = 256 * 1024
 
     def start(self):
-        self._recv_task = asyncio.get_running_loop().create_task(self._recv_loop())
+        self._loop = asyncio.get_running_loop()
+        self._recv_task = self._loop.create_task(self._recv_loop())
 
     async def _recv_loop(self):
         try:
@@ -194,13 +196,31 @@ class Connection:
         if self._closed:
             raise ConnectionLost(f"connection {self.name} closed")
         data = encode_message(header, frames)
+        # Off-loop callers (e.g. a notify() from a task-executor thread)
+        # marshal the WHOLE enqueue onto the loop: an off-loop append would
+        # race _flush's buffer swap and silently drop the message.
+        try:
+            on_loop = asyncio.get_running_loop() is self._loop
+        except RuntimeError:
+            on_loop = False
+        if not on_loop:
+            if self._loop is None:
+                raise ConnectionLost(f"connection {self.name} not started")
+            self._loop.call_soon_threadsafe(self._enqueue_on_loop, data)
+            return
+        self._enqueue_on_loop(data)
+
+    def _enqueue_on_loop(self, data: bytes):
+        """Append + flush scheduling; loop thread only."""
+        if self._closed:
+            return
         self._out_buf.append(data)
         self._out_bytes += len(data)
         if self._out_bytes >= self.FLUSH_BYTES:
             self._flush()  # bulk payloads reach the transport before drain()
         elif not self._flush_scheduled:
             self._flush_scheduled = True
-            asyncio.get_running_loop().call_soon(self._flush)
+            self._loop.call_soon(self._flush)
 
     def _flush(self):
         self._flush_scheduled = False

@@ -794,6 +794,20 @@ class CoreWorker:
         self._register_owned(hex_, nested=nested)
         return ObjectRef(oid, tuple(self.addr))
 
+    def put_raw_frames(self, frames: List[Any]) -> Tuple[str, dict]:
+        """Store raw frames (no serialization envelope) in the shm store and
+        register the location with the head; returns (oid hex, meta).
+
+        Lifetime is the CALLER's to manage (e.g. the DAG device channels
+        free via object_free once consumed) — no ownership record is
+        created. Callable from any thread."""
+        oid = self._next_put_id().hex()
+        meta = self._with_xfer(self.shm.put_frames(oid, frames))
+        self.run_sync(
+            self.gcs.call("object_register", {"oid": oid, "meta": meta})
+        )
+        return oid, meta
+
     def put_serialized(self, frames: List[bytes], total_bytes: int) -> ObjectRef:
         """Store pre-serialized frames as a new owned object (skips the
         second serialization a put(value) would do). Caller guarantees the

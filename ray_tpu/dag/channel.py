@@ -116,7 +116,11 @@ class Channel:
             if deadline is not None and time.monotonic() > deadline:
                 raise ChannelTimeoutError(f"channel {self.name} wait timed out")
             time.sleep(delay)
-            delay = min(delay * 2, 0.002)
+            # Back off to a deep sleep: a driver-side spin at sub-ms cadence
+            # can starve the SAME process's event-loop thread (the in-process
+            # head) of the GIL on small hosts — observed as worker→head RPCs
+            # stalling for exactly as long as the spin runs.
+            delay = min(delay * 2, 0.02)
 
     # -- value ops -----------------------------------------------------------
 
@@ -200,3 +204,148 @@ class Channel:
             except Exception:
                 pass
         # keep the mapping (readers may be mid-read); dies with the process
+
+
+class DeviceChannel:
+    """Accelerator-array channel: the 1-slot mailbox carries descriptors;
+    array payloads ride the object store as RAW buffers — the shm arena on
+    one machine, the native C++ xfer plane (DCN) across hosts — and land
+    with ``jax.device_put`` on the reader's default device.
+
+    Reference analog (behavior, not code):
+    ``python/ray/experimental/channel/torch_tensor_accelerator_channel.py``
+    + ``communicator.py:18`` — tensor-carrying channels selected by type
+    hint (``with_tensor_transport()``), transported out-of-band (NCCL
+    there; arena/xfer here — TPU DCN transfers are host-mediated, there is
+    no NCCL peer plane) while the control message stays tiny. Array bytes
+    are never pickled; non-array pytree leaves ride inline.
+    """
+
+    def __init__(self, name: str, capacity: int = DEFAULT_CAPACITY,
+                 create: bool = False):
+        # The mailbox carries descriptors + non-array pytree leaves; the
+        # configured capacity is honored so big non-array leaves keep their
+        # inline headroom (array payloads always ride the object store).
+        self._ctl = Channel(name, capacity=capacity, create=create)
+        self.name = name
+        self.created = create
+        # Writer-side record of the newest payload: freed at close if the
+        # reader never consumed it. Consumed payloads are freed by the
+        # READER after the fetch — the mailbox-consumed signal fires before
+        # the payload fetch, so a writer-side free would race it.
+        self._last_oid: Optional[str] = None
+
+    # channel-protocol surface used by the exec loops / teardown
+    def set_stop(self):
+        self._ctl.set_stop()
+
+    @property
+    def stopped(self) -> bool:
+        return self._ctl.stopped
+
+    def write(self, value: Any, ctx=None, timeout: Optional[float] = None):
+        import jax
+        import numpy as np
+
+        from ray_tpu._private.worker import get_global_worker
+
+        w = get_global_worker()
+        leaves, treedef = jax.tree_util.tree_flatten(value)
+        descs = []
+        frames: List[Any] = []
+        others: List[Any] = []
+        for leaf in leaves:
+            if isinstance(leaf, jax.Array) or isinstance(leaf, np.ndarray):
+                host = np.asarray(leaf)  # device→host
+                # shape recorded BEFORE ascontiguousarray: it promotes 0-d
+                # scalars to shape (1,), which must not leak to the reader
+                shape = host.shape
+                arr = np.ascontiguousarray(host)
+                descs.append((str(arr.dtype), shape))
+                # byte-format view: the store copies via memoryview slices
+                frames.append(memoryview(arr).cast("B"))
+            else:
+                descs.append(None)
+                others.append(leaf)
+        oid = None
+        meta = None
+        if frames:
+            oid, meta = w.put_raw_frames(frames)
+        try:
+            self._ctl.write(
+                {"descs": descs, "tree": treedef, "others": others,
+                 "oid": oid, "meta": meta},
+                ctx=ctx, timeout=timeout,
+            )
+        except BaseException:
+            # Never published: nobody will ever consume (and free) it.
+            if oid is not None:
+                try:
+                    w.shm.free(oid)
+                    w.gcs.notify("object_free", {"oids": [oid]})
+                except Exception:
+                    pass
+            raise
+        self._last_oid = oid
+
+    def read(self, ctx=None, timeout: Optional[float] = None) -> Any:
+        import jax
+        import numpy as np
+
+        from ray_tpu._private.worker import get_global_worker
+
+        w = get_global_worker()
+        msg = self._ctl.read(ctx=ctx, timeout=timeout)
+        arrays = []
+        if msg["oid"] is not None:
+            raw = w.shm.get_frames(msg["oid"], msg["meta"])
+            if raw is None:
+                # other host: bulk-fetch through the native transfer plane
+                raw = w.run_sync(
+                    w._native_fetch(msg["oid"], msg["meta"])
+                )
+            if raw is None:
+                raise ChannelClosedError(
+                    f"device payload {msg['oid'][:12]} unavailable"
+                )
+            host = [
+                np.frombuffer(buf, dtype=np.dtype(dt)).reshape(shape)
+                for buf, (dt, shape) in zip(
+                    raw, [d for d in msg["descs"] if d is not None]
+                )
+            ]
+            # one transfer call for all leaves; lands on the default device
+            arrays = jax.device_put(host)
+        out_leaves = []
+        ai = oi = 0
+        for d in msg["descs"]:
+            if d is None:
+                out_leaves.append(msg["others"][oi])
+                oi += 1
+            else:
+                out_leaves.append(arrays[ai])
+                ai += 1
+        if msg["oid"] is not None:
+            # Reader owns the free: arrays are on-device now, every cached
+            # copy (incl. the writer's arena block, via the object_free
+            # fan-out) can go.
+            try:
+                w.gcs.notify("object_free", {"oids": [msg["oid"]]})
+            except Exception:
+                pass
+        return jax.tree_util.tree_unflatten(msg["tree"], out_leaves)
+
+    def close(self):
+        if self._last_oid is not None:
+            try:
+                hdr = self._ctl._hdr()
+                if hdr[2] > hdr[3]:  # final payload never consumed
+                    from ray_tpu._private.worker import get_global_worker
+
+                    get_global_worker().gcs.notify(
+                        "object_free", {"oids": [self._last_oid]}
+                    )
+            except Exception:
+                pass
+            self._last_oid = None
+        self._ctl.close()

@@ -24,7 +24,9 @@ from ray_tpu.dag.channel import (
     ChannelClosedError,
     ChannelTimeoutError,
     DEFAULT_CAPACITY,
+    DeviceChannel,
 )
+
 from ray_tpu.dag.nodes import (
     ClassMethodNode,
     CollectiveOutputNode,
@@ -34,6 +36,17 @@ from ray_tpu.dag.nodes import (
     reduce_values,
 )
 
+_DEV_PREFIX = "/rt_dch_"
+
+
+def open_channel(name: str, capacity: int = DEFAULT_CAPACITY,
+                 create: bool = False):
+    """Channel kind rides the name: device channels (tensor-transport
+    edges) vs plain shm mailboxes."""
+    if name.startswith(_DEV_PREFIX):
+        return DeviceChannel(name, capacity=capacity, create=create)
+    return Channel(name, capacity=capacity, create=create)
+
 
 def _dag_actor_loop(instance, plan: dict):
     """Runs ON the actor (via __rt_apply__): the compiled exec loop."""
@@ -42,7 +55,7 @@ def _dag_actor_loop(instance, plan: dict):
     from ray_tpu._private.worker import get_global_worker
 
     ctx = get_global_worker().ctx
-    chans = {name: Channel(name) for name in plan["channels"]}
+    chans = {name: open_channel(name) for name in plan["channels"]}
     try:
         while True:
             for task in plan["tasks"]:
@@ -162,9 +175,10 @@ class CompiledDAG:
         in_ch: Dict[Tuple[int, Any], str] = {}
         self._input_chs: List[str] = []
 
-        def new_channel() -> str:
-            name = f"/rt_ch_{uuid.uuid4().hex[:16]}"
-            self._channels[name] = Channel(
+        def new_channel(device: bool = False) -> str:
+            prefix = _DEV_PREFIX if device else "/rt_ch_"
+            name = f"{prefix}{uuid.uuid4().hex[:16]}"
+            self._channels[name] = open_channel(
                 name, capacity=self._capacity, create=True
             )
             return name
@@ -182,7 +196,7 @@ class CompiledDAG:
                     in_ch[(id(n), pos)] = ch
                     has_upstream = True
                 elif isinstance(a, producer_types):
-                    ch = new_channel()
+                    ch = new_channel(device=a._tensor_transport)
                     out_chs.setdefault(id(a), []).append(ch)
                     in_ch[(id(n), pos)] = ch
                     has_upstream = True
@@ -193,7 +207,7 @@ class CompiledDAG:
                     in_ch[(id(n), k)] = ch
                     has_upstream = True
                 elif isinstance(v, producer_types):
-                    ch = new_channel()
+                    ch = new_channel(device=v._tensor_transport)
                     out_chs.setdefault(id(v), []).append(ch)
                     in_ch[(id(n), k)] = ch
                     has_upstream = True
@@ -208,7 +222,7 @@ class CompiledDAG:
                 trigger_ch[id(n)] = ch
         self._output_chs: List[str] = []
         for out in self._outputs:
-            ch = new_channel()
+            ch = new_channel(device=out._tensor_transport)
             out_chs.setdefault(id(out), []).append(ch)
             self._output_chs.append(ch)
 

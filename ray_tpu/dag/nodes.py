@@ -12,6 +12,21 @@ from typing import Any, Dict, List, Optional, Tuple
 
 
 class DAGNode:
+    # Set by with_tensor_transport(): edges FROM this node carry
+    # accelerator arrays out-of-band (DeviceChannel) instead of pickling
+    # through the shm mailbox.
+    _tensor_transport = False
+
+    def with_tensor_transport(self, transport: str = "auto"):
+        """Mark this node's outputs as device-array traffic (reference:
+        ``experimental/channel/torch_tensor_type.py`` type hints +
+        accelerator channels). On TPU the transport is the shm arena on one
+        host and the native xfer plane (DCN) across hosts, landing with
+        ``jax.device_put`` — there is no NCCL analog on the hosts."""
+        del transport  # one transport plane; signature kept for parity
+        self._tensor_transport = True
+        return self
+
     def __init__(self, upstream_args: Tuple, upstream_kwargs: Dict[str, Any]):
         self.args = upstream_args
         self.kwargs = upstream_kwargs

