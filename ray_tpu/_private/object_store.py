@@ -71,13 +71,17 @@ class LocalShmStore:
         # freed; readers may hold zero-copy views into them.
         self._segments: Dict[str, shared_memory.SharedMemory] = {}
         self._created: Dict[str, bool] = {}
+        self._transient: set = set()  # safe to unmap fully on free
 
     def seg_name(self, object_hex: str) -> str:
         # shm names are limited (~255); object hex is 56 chars.
         return f"{self.prefix}_{object_hex}"
 
-    def put_frames(self, object_hex: str, frames: List[bytes]) -> dict:
-        """Write frames into a fresh segment; returns directory metadata."""
+    def put_frames(self, object_hex: str, frames: List[bytes],
+                   transient: bool = False) -> dict:
+        """Write frames into a fresh segment; returns directory metadata.
+        ``transient``: the producer guarantees no zero-copy views escape
+        (readers copy on consume), so free() may fully unmap."""
         total = _HDR_COUNT.size + _HDR_LEN.size * len(frames)
         offsets = []
         for f in frames:
@@ -97,7 +101,11 @@ class LocalShmStore:
             buf[off : off + len(f)] = f
         self._segments[object_hex] = shm
         self._created[object_hex] = True
-        return {"seg": name, "size": total}
+        meta = {"seg": name, "size": total}
+        if transient:
+            self._transient.add(object_hex)
+            meta["transient"] = 1
+        return meta
 
     def get_frames(self, object_hex: str, meta: dict) -> Optional[List[memoryview]]:
         """Attach and return zero-copy frame views (None if segment is gone)."""
@@ -144,6 +152,21 @@ class LocalShmStore:
                 _safe_unlink(shm)
         except FileNotFoundError:
             pass
+        transient = (
+            (meta is not None and meta.get("transient"))
+            or object_hex in self._transient
+        )
+        self._transient.discard(object_hex)
+        if transient:
+            # The producer declared no zero-copy views escape this segment
+            # (e.g. DAG device-channel payloads — readers device_put a
+            # copy): unmap now. Without this, per-step channel payloads
+            # would grow resident memory for the process's lifetime.
+            try:
+                shm.close()
+            except Exception:
+                pass
+            return
         # We do NOT shm.close(): readers may still hold zero-copy views into
         # the mapping. Unlink removes the name; the mapping dies with us.
         _graveyard.append(shm)

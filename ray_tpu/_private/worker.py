@@ -794,15 +794,19 @@ class CoreWorker:
         self._register_owned(hex_, nested=nested)
         return ObjectRef(oid, tuple(self.addr))
 
-    def put_raw_frames(self, frames: List[Any]) -> Tuple[str, dict]:
+    def put_raw_frames(self, frames: List[Any],
+                       transient: bool = False) -> Tuple[str, dict]:
         """Store raw frames (no serialization envelope) in the shm store and
         register the location with the head; returns (oid hex, meta).
 
         Lifetime is the CALLER's to manage (e.g. the DAG device channels
         free via object_free once consumed) — no ownership record is
-        created. Callable from any thread."""
+        created. ``transient``: consumers copy on read, so frees may fully
+        unmap. Callable from any thread."""
         oid = self._next_put_id().hex()
-        meta = self._with_xfer(self.shm.put_frames(oid, frames))
+        meta = self._with_xfer(
+            self.shm.put_frames(oid, frames, transient=transient)
+        )
         self.run_sync(
             self.gcs.call("object_register", {"oid": oid, "meta": meta})
         )

@@ -270,11 +270,13 @@ class DeviceChannel:
         oid = None
         meta = None
         if frames:
-            oid, meta = w.put_raw_frames(frames)
+            # transient: readers device_put a copy, so frees fully unmap
+            oid, meta = w.put_raw_frames(frames, transient=True)
         try:
             self._ctl.write(
                 {"descs": descs, "tree": treedef, "others": others,
-                 "oid": oid, "meta": meta},
+                 "oid": oid, "meta": meta,
+                 "addr": list(w.addr) if w.addr else None},
                 ctx=ctx, timeout=timeout,
             )
         except BaseException:
@@ -304,6 +306,21 @@ class DeviceChannel:
                 raw = w.run_sync(
                     w._native_fetch(msg["oid"], msg["meta"])
                 )
+            if raw is None and msg.get("addr"):
+                # native plane unavailable: pull the bytes from the writer
+                # over RPC (slower, but the hint must not break the DAG)
+                from ray_tpu._private.ids import ObjectID
+                from ray_tpu.object_ref import ObjectRef
+
+                try:
+                    entry = w.run_sync(w._pull_from_owner(
+                        ObjectRef(ObjectID.from_hex(msg["oid"]), None),
+                        None, inline=True, addr=tuple(msg["addr"]),
+                    ))
+                    if entry[0] == "mem":
+                        raw = entry[1]
+                except Exception:
+                    raw = None
             if raw is None:
                 raise ChannelClosedError(
                     f"device payload {msg['oid'][:12]} unavailable"

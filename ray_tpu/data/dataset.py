@@ -122,7 +122,25 @@ class Dataset:
 
     def map_batches(self, fn, *, batch_size: Optional[int] = 1024,
                     batch_format: str = "numpy",
-                    fn_kwargs: Optional[dict] = None, **_) -> "Dataset":
+                    fn_kwargs: Optional[dict] = None,
+                    concurrency: int = 2,
+                    fn_constructor_args: tuple = (),
+                    fn_constructor_kwargs: Optional[dict] = None,
+                    resources: Optional[dict] = None, **_) -> "Dataset":
+        """Batch transform. A callable CLASS runs on a pool of stateful
+        actors (constructed once per actor, reused across blocks —
+        reference: actor_pool_map_operator); a plain function fuses into
+        per-block tasks."""
+        import inspect
+
+        if inspect.isclass(fn):
+            from ray_tpu.data.executor import ActorStage
+
+            return self._with(ActorStage(
+                fn, fn_constructor_args, fn_constructor_kwargs,
+                batch_size, batch_format, fn_kwargs, concurrency,
+                resources=resources,
+            ))
         return self._with(_map_batches_fn(fn, batch_size, batch_format, fn_kwargs))
 
     def add_column(self, name: str, fn, **_) -> "Dataset":

@@ -142,6 +142,37 @@ def read_numpy(paths, **kw) -> Dataset:
     return _read_files(paths, read_one)
 
 
+IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp", ".tiff")
+
+
+def read_images(paths, *, size=None, mode: str = "RGB", **kw) -> Dataset:
+    """Image files → rows {"image": HWC uint8 array, "path": str}
+    (reference: ``ray.data.read_images`` / ``datasource/image_datasource``).
+    ``size=(h, w)`` resizes on read — the data-layer place to normalize
+    shapes before batching onto static-shape accelerator programs."""
+
+    def read_one(path: str) -> pa.Table:
+        from PIL import Image
+
+        with Image.open(path) as im:
+            im = im.convert(mode)
+            if size is not None:
+                im = im.resize((size[1], size[0]))
+            arr = np.asarray(im)
+        return batch_to_block({
+            "image": arr[None],  # [1, H, W, C]
+            "path": np.array([path]),
+        })
+
+    files = [
+        p for p in _expand_paths(paths)
+        if p.lower().endswith(IMAGE_EXTS)
+    ]
+    if not files:
+        raise FileNotFoundError(f"no image files match {paths}")
+    return _read_files(files, read_one)
+
+
 def read_binary_files(paths, **kw) -> Dataset:
     def read_one(path: str) -> pa.Table:
         with open(path, "rb") as f:

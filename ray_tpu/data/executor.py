@@ -43,6 +43,58 @@ def _apply_fused(block: Block, fns: List[Callable[[Block], Block]]) -> Block:
     return block
 
 
+class ActorStage:
+    """Plan marker: run this transform on a pool of stateful actors
+    (reference: ``data/_internal/execution/operators/actor_pool_map_operator
+    .py`` — callable-class UDFs construct ONCE per actor and serve many
+    blocks; per-task construction would pay model-load per block)."""
+
+    def __init__(self, cls, ctor_args, ctor_kwargs, batch_size, batch_format,
+                 fn_kwargs, concurrency, resources=None):
+        import cloudpickle
+
+        self.payload = cloudpickle.dumps(
+            (cls, tuple(ctor_args or ()), dict(ctor_kwargs or {}),
+             batch_size, batch_format, dict(fn_kwargs or {}))
+        )
+        self.concurrency = max(int(concurrency), 1)
+        self.resources = resources
+
+    def build_local(self):
+        """Local-mode transform: one instance, applied inline."""
+        import cloudpickle
+
+        cls, args, kwargs, bs, fmt, fkw = cloudpickle.loads(self.payload)
+        inst = cls(*args, **kwargs)
+
+        def apply(block: Block) -> Block:
+            return _apply_batched(block, inst, bs, fmt, fkw)
+
+        return apply
+
+
+def _apply_batched(block: Block, fn, batch_size, batch_format, fn_kwargs):
+    # One batching implementation for function AND actor stages.
+    from ray_tpu.data.dataset import _map_batches_fn
+
+    return _map_batches_fn(fn, batch_size, batch_format, fn_kwargs)(block)
+
+
+class _BatchPoolWorker:
+    """Actor body for ActorStage pools: the UDF instance lives for the
+    actor's lifetime."""
+
+    def __init__(self, payload):
+        import cloudpickle
+
+        cls, args, kwargs, bs, fmt, fkw = cloudpickle.loads(payload)
+        self.fn = cls(*args, **kwargs)
+        self.bs, self.fmt, self.fkw = bs, fmt, fkw
+
+    def apply(self, block: Block) -> Block:
+        return _apply_batched(block, self.fn, self.bs, self.fmt, self.fkw)
+
+
 def _remote_apply(serialized_fns, block: Block) -> Block:
     """Task body: run the fused transform chain on one block."""
     import cloudpickle
@@ -63,11 +115,14 @@ class StreamingExecutor:
     def execute(
         self,
         in_refs: List[Any],
-        fns: List[Callable[[Block], Block]],
+        fns: List[Any],
         name: str = "map",
     ) -> Iterator[Any]:
         """in_refs: ObjectRefs of input blocks (or local Blocks when running
-        without a cluster). Yields refs/blocks of transformed output."""
+        without a cluster). ``fns`` may mix plain block transforms (fused
+        into one task per block) and ActorStage markers (stateful pools);
+        the whole chain streams — no barrier between sub-stages. Yields
+        refs/blocks of transformed output."""
         import time
 
         t0 = time.monotonic()
@@ -76,30 +131,57 @@ class StreamingExecutor:
             return
         from ray_tpu._private import worker as worker_mod
 
-        if worker_mod.global_worker is None:
-            # Local mode: run inline (reference local_testing_mode analog).
-            for b in in_refs:
-                out = _apply_fused(_resolve_local(b), fns)
-                self.stats.blocks_produced += 1
-                self.stats.rows_produced += BlockAccessor(out).num_rows()
-                yield out
-            self.stats.wall_time_s += time.monotonic() - t0
-            return
+        local = worker_mod.global_worker is None
+        # Split into alternating fused-fn groups and actor stages.
+        groups: List[tuple] = []
+        for fn in fns:
+            if isinstance(fn, ActorStage):
+                groups.append(("actor", fn))
+            elif groups and groups[-1][0] == "fns":
+                groups[-1][1].append(fn)
+            else:
+                groups.append(("fns", [fn]))
+        stream: Iterator[Any] = iter(in_refs)
+        for kind, payload in groups:
+            if kind == "fns":
+                if local:
+                    stream = self._fused_local(stream, payload)
+                else:
+                    stream = self._fused_tasks(stream, payload)
+            else:
+                if local:
+                    stream = self._fused_local(
+                        stream, [payload.build_local()]
+                    )
+                else:
+                    stream = self._actor_pool(stream, payload)
+        for out in stream:
+            self.stats.blocks_produced += 1
+            yield out
+        self.stats.per_stage[name] = (
+            self.stats.per_stage.get(name, 0.0) + time.monotonic() - t0
+        )
+        self.stats.wall_time_s += time.monotonic() - t0
 
+    def _fused_local(self, stream, fns):
+        for b in stream:
+            out = _apply_fused(_resolve_local(b), fns)
+            self.stats.rows_produced += BlockAccessor(out).num_rows()
+            yield out
+
+    def _fused_tasks(self, stream, fns):
         import cloudpickle
 
         import ray_tpu
 
         payload = cloudpickle.dumps(fns)
         apply_task = ray_tpu.remote(_remote_apply)
-
         pending = collections.deque()
-        it = iter(in_refs)
         exhausted = False
         while pending or not exhausted:
             while not exhausted and len(pending) < self.max_in_flight:
                 try:
-                    ref = next(it)
+                    ref = next(stream)
                 except StopIteration:
                     exhausted = True
                     break
@@ -108,12 +190,62 @@ class StreamingExecutor:
             if pending:
                 # Pop in order: preserves block order; completed later tasks
                 # simply wait in the store (streaming window gives overlap).
-                out = pending.popleft()
-                yield out
-        self.stats.per_stage[name] = (
-            self.stats.per_stage.get(name, 0.0) + time.monotonic() - t0
+                yield pending.popleft()
+
+    def _actor_pool(self, stream, stage: ActorStage):
+        """Bounded-in-flight round-robin over a pool of stateful actors;
+        the pool dies with the stage (reference: actor_pool_map_operator
+        autoscaling pool — fixed size here)."""
+        import ray_tpu
+
+        opts = {}
+        if stage.resources:
+            opts["resources"] = stage.resources
+        worker_cls = ray_tpu.remote(**opts)(_BatchPoolWorker) if opts else (
+            ray_tpu.remote(_BatchPoolWorker)
         )
-        self.stats.wall_time_s += time.monotonic() - t0
+        actors = [
+            worker_cls.remote(stage.payload)
+            for _ in range(stage.concurrency)
+        ]
+        produced: List[Any] = []
+        try:
+            pending = collections.deque()
+            exhausted = False
+            i = 0
+            window = max(2 * stage.concurrency, 2)
+            while pending or not exhausted:
+                while not exhausted and len(pending) < window:
+                    try:
+                        ref = next(stream)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    actor = actors[i % len(actors)]
+                    i += 1
+                    pending.append(actor.apply.remote(ref))
+                    self.stats.tasks_submitted += 1
+                if pending:
+                    out = pending.popleft()
+                    produced.append(out)
+                    yield out
+        finally:
+            # A consumer may hold yielded refs unresolved (e.g. list() then
+            # resolve later): wait for every produced task BEFORE killing
+            # the pool, or the kill cancels their in-flight execution.
+            try:
+                if produced:
+                    ray_tpu.wait(
+                        produced, num_returns=len(produced), timeout=300,
+                        fetch_local=False,
+                    )
+            except Exception:
+                pass
+            for a in actors:
+                try:
+                    ray_tpu.kill(a)
+                except Exception:
+                    pass
 
 
 def _resolve_local(b):

@@ -68,13 +68,22 @@ class Processor:
     def __call__(self, dataset):
         if self._pre is not None:
             dataset = dataset.map(self._pre)
-        stage = _EngineStage(
-            self._config.to_dict(),
-            dict(self._sampling.__dict__),
-            self._prompt_column,
-            self._output_column,
+        # Class-based map_batches: the engine stage runs on a stateful actor
+        # pool, so the engine is constructed once per actor and reused
+        # across every block (reference: vllm_engine_stage on the actor-pool
+        # map operator; per-task construction would pay model load + jit
+        # compile per block).
+        dataset = dataset.map_batches(
+            _EngineStage,
+            batch_size=self._batch_size,
+            concurrency=1,
+            fn_constructor_args=(
+                self._config.to_dict(),
+                dict(self._sampling.__dict__),
+                self._prompt_column,
+                self._output_column,
+            ),
         )
-        dataset = dataset.map_batches(stage, batch_size=self._batch_size)
         if self._post is not None:
             dataset = dataset.map(self._post)
         return dataset

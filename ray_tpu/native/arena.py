@@ -257,8 +257,11 @@ class HybridShmStore:
     def native_enabled(self) -> bool:
         return self.arena is not None
 
-    def put_frames(self, object_hex: str, frames: List[bytes]) -> dict:
+    def put_frames(self, object_hex: str, frames: List[bytes],
+                   transient: bool = False) -> dict:
         if self.arena is not None:
+            # arena blocks reclaim for real on delete: transient is only
+            # meaningful for the per-segment fallback store
             meta = self.arena.put_frames(object_hex, frames)
             if meta is None and self.spill_handler is not None:
                 # Arena full: spill cold sealed objects to disk, retry once.
@@ -272,7 +275,8 @@ class HybridShmStore:
                     meta = self.arena.put_frames(object_hex, frames)
             if meta is not None:
                 return meta
-        return self.fallback.put_frames(object_hex, frames)
+        return self.fallback.put_frames(object_hex, frames,
+                                        transient=transient)
 
     def get_frames(self, object_hex: str, meta: dict) -> Optional[List[memoryview]]:
         if "spill" in meta:

@@ -1,0 +1,50 @@
+"""APPO: asynchronous PPO.
+
+Reference analog: ``rllib/algorithms/appo/appo.py`` — IMPALA's
+actor-learner architecture (runners sample under stale weights, V-trace
+corrects the off-policyness) with PPO's clipped-surrogate loss for stable
+updates. Our env-runner group is the natural fit: runners keep producing
+fragments between the periodic weight broadcasts, and the learner's jitted
+APPO update (``learner.py make_appo_update``) absorbs the staleness.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+
+
+class APPOConfig(AlgorithmConfig):
+    algo_name = "appo"
+
+    def __init__(self):
+        super().__init__()
+        self.training(
+            lr=5e-4, clip_param=0.2, vf_coeff=0.5, entropy_coeff=0.01,
+            vtrace_rho_clip=1.0, vtrace_c_clip=1.0,
+        )
+        self.broadcast_interval = 2  # learner updates between weight syncs
+
+    def build_algo(self) -> "APPO":
+        return APPO(self)
+
+
+class APPO(Algorithm):
+    def __init__(self, config: APPOConfig):
+        super().__init__(config)
+        self._since_broadcast = 0
+
+    def training_step(self) -> Dict[str, float]:
+        fragments = self.runner_group.sample()
+        if not fragments:
+            self._last_step_count = 0
+            return {"num_healthy_runners": 0}
+        batch = self._build_batch(fragments)
+        metrics = self.learner.update(batch)
+        self._record_env_steps(batch)
+        self._since_broadcast += 1
+        if self._since_broadcast >= getattr(self.config,
+                                            "broadcast_interval", 1):
+            self.runner_group.sync_weights(self.learner.get_weights())
+            self._since_broadcast = 0
+        return metrics

@@ -239,6 +239,73 @@ def make_impala_update(config: rl_module.RLModuleConfig,
     return jax.jit(update)
 
 
+def make_appo_update(config: rl_module.RLModuleConfig,
+                     hp: LearnerHyperparams,
+                     optimizer: optax.GradientTransformation,
+                     mesh: Optional[Mesh] = None):
+    """Jitted APPO update (reference: ``rllib/algorithms/appo/appo.py`` —
+    asynchronous PPO): IMPALA's actor-learner decoupling with V-trace
+    off-policy correction, but the policy loss is PPO's clipped surrogate
+    (ratio vs the BEHAVIOR policy) on the V-trace advantages instead of the
+    plain importance-weighted gradient — stale fragments update stably
+    without the synchronous on-policy barrier."""
+
+    def loss_fn(params, batch):
+        obs, actions = batch["obs"], batch["actions"]
+        T, N = obs.shape[:2]
+        logp, entropy, value = rl_module.logp_entropy_value(
+            params, config, obs.reshape((T * N,) + obs.shape[2:]),
+            actions.reshape((T * N,) + actions.shape[2:]),
+        )
+        logp = logp.reshape(T, N)
+        value = value.reshape(T, N)
+        entropy = entropy.reshape(T, N)
+        vs, pg_advs = vtrace(
+            jax.lax.stop_gradient(logp), batch["logp"], batch["rewards"],
+            batch["dones"], jax.lax.stop_gradient(value),
+            batch["bootstrap_value"], hp.gamma, hp.vtrace_rho_clip,
+            hp.vtrace_c_clip,
+        )
+        advs = jax.lax.stop_gradient(pg_advs)
+        advs = (advs - advs.mean()) / (advs.std() + 1e-8)
+        ratio = jnp.exp(logp - batch["logp"])
+        pg1 = ratio * advs
+        pg2 = jnp.clip(ratio, 1 - hp.clip_param, 1 + hp.clip_param) * advs
+        pg_loss = -jnp.mean(jnp.minimum(pg1, pg2))
+        vf_loss = 0.5 * jnp.mean((value - jax.lax.stop_gradient(vs)) ** 2)
+        ent = jnp.mean(entropy)
+        kl = jnp.mean(batch["logp"] - logp)
+        total = pg_loss + hp.vf_coeff * vf_loss - hp.entropy_coeff * ent
+        return total, {
+            "policy_loss": pg_loss, "vf_loss": vf_loss, "entropy": ent,
+            "kl": kl,
+        }
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def update(params, opt_state, batch, rng):
+        (l, aux), grads = grad_fn(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {"total_loss": l, **aux}
+
+    if mesh is not None:
+        sh = lambda spec: NamedSharding(mesh, spec)
+        batch_sharding = {
+            "obs": sh(P(None, "data")), "actions": sh(P(None, "data")),
+            "rewards": sh(P(None, "data")), "dones": sh(P(None, "data")),
+            "logp": sh(P(None, "data")), "values": sh(P(None, "data")),
+            "bootstrap_value": sh(P("data")),
+        }
+        repl = sh(P())
+        return jax.jit(
+            update,
+            in_shardings=(repl, repl, batch_sharding, repl),
+            out_shardings=(repl, repl, repl),
+        )
+    return jax.jit(update)
+
+
 class Learner:
     """Owns params + optimizer state and applies jitted updates.
 
@@ -261,7 +328,10 @@ class Learner:
         self.rng, k = jax.random.split(self.rng)
         self.params = rl_module.init_params(module_config, k)
         self.opt_state = self.optimizer.init(self.params)
-        make = make_ppo_update if algo == "ppo" else make_impala_update
+        make = {
+            "ppo": make_ppo_update,
+            "appo": make_appo_update,
+        }.get(algo, make_impala_update)
         self._update = make(module_config, hp, self.optimizer, mesh)
         self.steps = 0
 

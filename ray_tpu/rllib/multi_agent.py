@@ -1,0 +1,406 @@
+"""Multi-agent episodes: per-agent policies over one shared environment.
+
+Reference analogs: ``rllib/env/multi_agent_env.py`` (the env protocol:
+dict-keyed obs/action/reward/termination per agent plus ``__all__``),
+``rllib/env/multi_agent_env_runner.py`` (episode collection splitting
+per-agent transitions to their mapped policies), and the multi-policy
+learner group. TPU-first shape: each policy's fragment is a dense
+[T, n_agents_of_policy] struct-of-arrays — agents that terminate early are
+masked via dones (their tail steps carry zero reward), so every learner
+update stays one static-shaped XLA program.
+
+Env protocol (duck-typed, gymnasium-flavored):
+    reset(seed=...) -> (obs_dict, info)
+    step(action_dict) -> (obs_dict, rew_dict, term_dict, trunc_dict, info)
+        where term_dict/trunc_dict carry per-agent flags + "__all__"
+    possible_agents: list of agent ids (fixed)
+    observation_space(agent) / action_space(agent) (or shared
+    observation_space/action_space attributes)
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib import module as rl_module
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.learner import Learner
+
+
+def _space_for(env, agent, name):
+    attr = getattr(env, name)
+    return attr(agent) if callable(attr) else attr
+
+
+def module_config_for_agent(env, agent) -> rl_module.RLModuleConfig:
+    import gymnasium as gym
+
+    obs_space = _space_for(env, agent, "observation_space")
+    act_space = _space_for(env, agent, "action_space")
+    obs_dim = int(np.prod(obs_space.shape))
+    if isinstance(act_space, gym.spaces.Discrete):
+        return rl_module.RLModuleConfig(
+            obs_dim=obs_dim, action_dim=int(act_space.n), discrete=True
+        )
+    return rl_module.RLModuleConfig(
+        obs_dim=obs_dim, action_dim=int(np.prod(act_space.shape)),
+        discrete=False,
+    )
+
+
+class MultiAgentEnvRunner:
+    """Collects per-policy fragments from one multi-agent env.
+
+    All agents step together; a per-agent done masks its remaining steps in
+    the fragment (obs frozen, reward 0) until the episode ends for all.
+    """
+
+    def __init__(self, env_creator: Callable[[], Any], fragment_len: int,
+                 policy_module_configs: Dict[str, dict],
+                 agent_to_policy: Dict[str, str], seed: int = 0):
+        import jax
+
+        self.env = env_creator()
+        self.fragment_len = fragment_len
+        self.agents: List[str] = list(self.env.possible_agents)
+        self.agent_to_policy = dict(agent_to_policy)
+        self.policies = sorted(policy_module_configs)
+        self.configs = {
+            p: rl_module.RLModuleConfig(**c)
+            for p, c in policy_module_configs.items()
+        }
+        # agents grouped per policy, in stable order: column layout of the
+        # per-policy fragment arrays
+        self.policy_agents = {
+            p: [a for a in self.agents if self.agent_to_policy[a] == p]
+            for p in self.policies
+        }
+        self.params: Dict[str, Any] = {}
+        self.rng = jax.random.PRNGKey(seed)
+        self._sample_fns = {
+            p: jax.jit(
+                lambda prm, obs, rng, c=self.configs[p]:
+                rl_module.sample_action(prm, c, obs, rng)
+            )
+            for p in self.policies
+        }
+        self._value_fns = {
+            p: jax.jit(
+                lambda prm, obs, c=self.configs[p]:
+                rl_module.forward_value(prm, c, obs)
+            )
+            for p in self.policies
+        }
+        self._seed = seed
+        self._episode_seed = seed
+        self._reset_episode()
+        self._completed: List[tuple] = []
+        self._total_steps = 0
+
+    def _reset_episode(self):
+        self._episode_seed += 1
+        obs, _ = self.env.reset(seed=self._episode_seed)
+        self.obs = {a: np.asarray(obs[a], np.float32).ravel()
+                    for a in self.agents}
+        self.alive = {a: True for a in self.agents}
+        self._ep_return = 0.0
+        self._ep_len = 0
+
+    def set_weights(self, params: Dict[str, Any]):
+        self.params = params
+
+    def ping(self) -> bool:
+        return True
+
+    def sample(self) -> Dict[str, Dict[str, np.ndarray]]:
+        """Returns {policy_id: fragment} with arrays [T, A_p, ...]."""
+        import jax
+
+        assert self.params, "set_weights before sample"
+        T = self.fragment_len
+        bufs: Dict[str, Dict[str, np.ndarray]] = {}
+        for p in self.policies:
+            A = len(self.policy_agents[p])
+            cfg = self.configs[p]
+            act_shape = (T, A) if cfg.discrete else (T, A, cfg.action_dim)
+            bufs[p] = {
+                "obs": np.zeros((T, A, cfg.obs_dim), np.float32),
+                "actions": np.zeros(
+                    act_shape, np.int32 if cfg.discrete else np.float32
+                ),
+                "rewards": np.zeros((T, A), np.float32),
+                "dones": np.ones((T, A), np.float32),
+                "truncateds": np.zeros((T, A), np.float32),
+                "logp": np.zeros((T, A), np.float32),
+                "values": np.zeros((T, A), np.float32),
+            }
+        for t in range(T):
+            actions: Dict[str, Any] = {}
+            for p in self.policies:
+                agents = self.policy_agents[p]
+                obs_mat = np.stack([self.obs[a] for a in agents])
+                self.rng, k = jax.random.split(self.rng)
+                act, logp, value = self._sample_fns[p](
+                    self.params[p], obs_mat, k
+                )
+                act = np.asarray(act)
+                b = bufs[p]
+                b["obs"][t] = obs_mat
+                b["actions"][t] = act
+                b["logp"][t] = np.asarray(logp)
+                b["values"][t] = np.asarray(value)
+                for j, a in enumerate(agents):
+                    if self.alive[a]:
+                        actions[a] = (
+                            int(act[j]) if self.configs[p].discrete
+                            else act[j]
+                        )
+            nobs, rews, terms, truncs, _ = self.env.step(actions)
+            self._ep_len += 1
+            all_done = bool(terms.get("__all__")) or bool(
+                truncs.get("__all__")
+            )
+            for p in self.policies:
+                b = bufs[p]
+                for j, a in enumerate(self.policy_agents[p]):
+                    if not self.alive[a]:
+                        continue  # masked: done stays 1, reward stays 0
+                    r = float(rews.get(a, 0.0))
+                    self._ep_return += r
+                    b["rewards"][t, j] = r
+                    done = bool(terms.get(a)) or bool(truncs.get(a)) \
+                        or all_done
+                    b["dones"][t, j] = float(done)
+                    if truncs.get(a) and not terms.get(a):
+                        b["truncateds"][t, j] = 1.0
+                    if a in nobs:
+                        self.obs[a] = np.asarray(
+                            nobs[a], np.float32
+                        ).ravel()
+                    if done:
+                        self.alive[a] = False
+            if all_done or not any(self.alive.values()):
+                self._completed.append((self._ep_return, self._ep_len))
+                self._reset_episode()
+        out = {}
+        for p in self.policies:
+            agents = self.policy_agents[p]
+            obs_mat = np.stack([self.obs[a] for a in agents])
+            boot = np.asarray(self._value_fns[p](self.params[p], obs_mat))
+            # a freshly reset episode bootstraps its value; mid-episode
+            # dead agents contribute 0 via their done mask anyway
+            out[p] = {**bufs[p], "bootstrap_value": boot}
+        self._total_steps += T * len(self.agents)
+        return out
+
+    def metrics(self) -> Dict[str, Any]:
+        completed, self._completed = self._completed, []
+        return {
+            "num_episodes": len(completed),
+            "episode_returns": [r for r, _ in completed],
+            "episode_lengths": [l for _, l in completed],
+            "total_steps": self._total_steps,
+        }
+
+
+class MultiAgentPPOConfig(AlgorithmConfig):
+    """PPO over per-policy learners (reference:
+    ``AlgorithmConfig.multi_agent(policies=..., policy_mapping_fn=...)``)."""
+
+    algo_name = "ppo"
+
+    def __init__(self):
+        super().__init__()
+        self.policies: Optional[List[str]] = None
+        self.policy_mapping_fn: Callable[[str], str] = lambda agent: "default"
+        self.training(
+            lr=3e-4, clip_param=0.2, vf_coeff=0.5, entropy_coeff=0.01,
+            num_sgd_epochs=4, minibatch_count=4, gae_lambda=0.95,
+        )
+
+    def multi_agent(self, *, policies: List[str],
+                    policy_mapping_fn: Callable[[str], str]):
+        self.policies = list(policies)
+        self.policy_mapping_fn = policy_mapping_fn
+        return self
+
+    def build_algo(self) -> "MultiAgentPPO":
+        return MultiAgentPPO(self)
+
+
+class MultiAgentPPO(Algorithm):
+    """One PPO learner per policy; runners split episodes per policy."""
+
+    def __init__(self, config: MultiAgentPPOConfig):
+        import ray_tpu
+
+        self.config = config
+        creator = config.get_env_creator()
+        probe = creator()
+        agents = list(probe.possible_agents)
+        self.agent_to_policy = {
+            a: config.policy_mapping_fn(a) for a in agents
+        }
+        if config.policies is None:
+            config.policies = sorted(set(self.agent_to_policy.values()))
+        missing = set(self.agent_to_policy.values()) - set(config.policies)
+        if missing:
+            raise ValueError(f"policy_mapping_fn produced unknown {missing}")
+        self.module_configs = {}
+        for p in config.policies:
+            agent = next(
+                a for a in agents if self.agent_to_policy[a] == p
+            )
+            self.module_configs[p] = module_config_for_agent(probe, agent)
+        if hasattr(probe, "close"):
+            probe.close()
+        self.learners = {
+            p: Learner("ppo", self.module_configs[p], config.hp,
+                       seed=config.seed + i, mesh=config.mesh)
+            for i, p in enumerate(sorted(config.policies))
+        }
+        cfg_dicts = {
+            p: dict(c.__dict__) for p, c in self.module_configs.items()
+        }
+        self._make_runner = lambda idx: ray_tpu.remote(
+            MultiAgentEnvRunner
+        ).options(
+            name=f"ma_runner_{idx}_{time.monotonic_ns()}", num_cpus=1
+        ).remote(
+            creator, config.rollout_fragment_length, cfg_dicts,
+            self.agent_to_policy, config.seed + 1000 * idx,
+        )
+        self.runners = [
+            self._make_runner(i) for i in range(config.num_env_runners)
+        ]
+        self.iteration = 0
+        self._total_env_steps = 0
+        self._last_step_count = 0
+        self._recent_returns: List[float] = []
+        self._sync_weights()
+
+    # runner group (inline: per-policy weight dict) ------------------------
+
+    def _sync_weights(self):
+        import ray_tpu
+
+        weights = {p: l.get_weights() for p, l in self.learners.items()}
+        ray_tpu.get([r.set_weights.remote(weights) for r in self.runners])
+
+    def _sample_all(self):
+        import ray_tpu
+
+        out = []
+        dead = []
+        for i, r in enumerate(self.runners):
+            try:
+                out.append(ray_tpu.get(r.sample.remote(), timeout=120))
+            except Exception:
+                dead.append(i)
+        for i in dead:
+            self.runners[i] = self._make_runner(i)
+            try:
+                weights = {
+                    p: l.get_weights() for p, l in self.learners.items()
+                }
+                ray_tpu.get(
+                    self.runners[i].set_weights.remote(weights), timeout=60
+                )
+            except Exception:
+                pass
+        return out
+
+    def training_step(self) -> Dict[str, float]:
+        fragments = self._sample_all()
+        if not fragments:
+            self._last_step_count = 0
+            return {"num_healthy_runners": 0}
+        metrics: Dict[str, float] = {}
+        steps = 0
+        for p, learner in self.learners.items():
+            frags = [f[p] for f in fragments]
+            batch = self._build_batch(frags)
+            m = learner.update(batch)
+            steps += batch["rewards"].shape[0] * batch["rewards"].shape[1]
+            metrics.update({f"{p}/{k}": v for k, v in m.items()})
+            metrics.setdefault("total_loss", 0.0)
+            metrics["total_loss"] += m.get("total_loss", 0.0)
+        self._total_env_steps += steps
+        self._last_step_count = steps
+        self._sync_weights()
+        return metrics
+
+    def _record_env_steps(self, batch):  # steps counted in training_step
+        pass
+
+    def metrics_runner_group(self):
+        import ray_tpu
+
+        out = []
+        for r in self.runners:
+            try:
+                out.append(ray_tpu.get(r.metrics.remote(), timeout=30))
+            except Exception:
+                pass
+        return out
+
+    # Algorithm.train() calls self.runner_group.metrics(); provide a shim.
+    @property
+    def runner_group(self):
+        algo = self
+
+        class _Shim:
+            def metrics(self):
+                return algo.metrics_runner_group()
+
+            def stop(self):
+                import ray_tpu
+
+                for r in algo.runners:
+                    try:
+                        ray_tpu.kill(r)
+                    except Exception:
+                        pass
+
+        return _Shim()
+
+    def get_policy_weights(self, policy_id: str):
+        return self.learners[policy_id].get_weights()
+
+    # per-policy checkpointing (the base save/restore assume one learner)
+
+    def save(self, path: str) -> str:
+        import os
+        import pickle
+
+        os.makedirs(path, exist_ok=True)
+        state = {
+            "learners": {p: l.state() for p, l in self.learners.items()},
+            "iteration": self.iteration,
+            "total_env_steps": self._total_env_steps,
+            "module_configs": {
+                p: dict(c.__dict__) for p, c in self.module_configs.items()
+            },
+            "agent_to_policy": self.agent_to_policy,
+            "algo": "multi_agent_ppo",
+        }
+        with open(os.path.join(path, "algorithm_state.pkl"), "wb") as f:
+            pickle.dump(state, f)
+        return path
+
+    def restore(self, path: str):
+        import os
+        import pickle
+
+        with open(os.path.join(path, "algorithm_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        for p, lstate in state["learners"].items():
+            self.learners[p].restore(lstate)
+        self.iteration = state["iteration"]
+        self._total_env_steps = state["total_env_steps"]
+        self._sync_weights()
+
+    def stop(self):
+        self.runner_group.stop()

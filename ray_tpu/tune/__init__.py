@@ -24,6 +24,7 @@ from ray_tpu.tune.schedulers import (
     ASHAScheduler,
     FIFOScheduler,
     MedianStoppingRule,
+    PB2,
     PopulationBasedTraining,
     TrialScheduler,
 )
@@ -55,6 +56,7 @@ __all__ = [
     "Domain",
     "FIFOScheduler",
     "MedianStoppingRule",
+    "PB2",
     "PopulationBasedTraining",
     "ResultGrid",
     "Searcher",

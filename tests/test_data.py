@@ -216,3 +216,59 @@ def test_join_inner_and_left(rt_start):
     assert any(r["name"] == "bob" and r["amount"] is None for r in left)
     with pytest.raises(ValueError):
         users.join(orders, on="uid", how="cross")
+
+
+def test_actor_pool_map_batches(rt):
+    """Callable-class UDFs run on a stateful actor pool: constructed once
+    per actor, reused across blocks (reference: actor_pool_map_operator)."""
+    rtd = rd
+
+    class AddConst:
+        def __init__(self, c):
+            self.c = c
+            self.constructions = getattr(AddConst, "_n", 0) + 1
+
+        def __call__(self, batch):
+            return {"x": batch["x"] + self.c}
+
+    ds = rtd.from_items([{"x": i} for i in range(100)], parallelism=10)
+    out = ds.map_batches(
+        AddConst, batch_size=16, concurrency=2, fn_constructor_args=(5,)
+    )
+    vals = sorted(r["x"] for r in out.take_all())
+    assert vals == [i + 5 for i in range(100)]
+
+
+def test_actor_pool_state_reused_across_blocks(rt):
+    """The pool has `concurrency` instances total — NOT one per block."""
+    rtd = rd
+
+    class Tagger:
+        def __init__(self):
+            import os
+            import random
+
+            self.tag = f"{os.getpid()}-{random.random()}"
+
+        def __call__(self, batch):
+            return {**batch, "tag": np.array([self.tag] * len(batch["x"]))}
+
+    ds = rtd.from_items([{"x": i} for i in range(60)], parallelism=12)
+    rows = ds.map_batches(Tagger, batch_size=5, concurrency=2).take_all()
+    tags = {r["tag"] for r in rows}
+    assert 1 <= len(tags) <= 2, tags  # 12 blocks, but at most 2 instances
+
+
+def test_read_images(rt, tmp_path):
+    rtd = rd
+    from PIL import Image
+
+    for i in range(4):
+        Image.fromarray(
+            (np.ones((8, 6, 3)) * (i * 40)).astype(np.uint8)
+        ).save(tmp_path / f"img_{i}.png")
+    ds = rtd.read_images(str(tmp_path), size=(4, 4))
+    batches = list(ds.iter_batches(batch_size=4, batch_format="numpy"))
+    imgs = np.concatenate([b["image"] for b in batches])
+    assert imgs.shape == (4, 4, 4, 3)  # tensor shape survives via metadata
+    assert imgs.dtype == np.uint8
