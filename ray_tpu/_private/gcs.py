@@ -154,6 +154,7 @@ class HeadService:
         self.pending_demands: Dict[int, dict] = {}
         self.job_procs: Dict[str, object] = {}  # submission_id -> Popen
         self.worker_metrics: Dict[str, list] = {}  # worker -> metric snapshot
+        self._task_state_counts: Dict[str, int] = {}  # FINISHED/FAILED/...
         # Native C++ scheduler (reference: the C++ ClusterResourceScheduler,
         # ``raylet/scheduling/cluster_resource_scheduler.cc:155``): fixed-point
         # resource accounting + best-node policies in ray_tpu/native/src/sched.cc.
@@ -970,11 +971,36 @@ class HeadService:
             {"events": [h["event"]]}, frames, conn
         )
 
+    def builtin_metrics(self) -> Dict[str, float]:
+        """Head-derived cluster series for /metrics (reference: the GCS-side
+        series the reference dashboard's Grafana panels graph)."""
+        counters = self._task_state_counts
+        return {
+            "rt_nodes_alive": float(
+                sum(1 for n in self.nodes.values() if n.alive)
+            ),
+            "rt_nodes_dead": float(len(self.dead_nodes)),
+            "rt_actors_alive": float(
+                sum(1 for a in self.actors.values() if a.state == "ALIVE")
+            ),
+            "rt_placement_groups": float(len(self.pgs)),
+            "rt_pending_demands": float(len(self.pending_demands)),
+            "rt_tasks_finished_total": float(counters.get("FINISHED", 0)),
+            "rt_tasks_failed_total": float(counters.get("FAILED", 0)),
+        }
+
     async def rpc_task_events(self, h, frames, conn):
         """Task-event sink (reference: GcsTaskManager fed by the per-worker
         ``task_event_buffer.h`` in 4Hz batches); bounded ring for the state
         API."""
-        self.task_events.extend(h.get("events", []))
+        events = h.get("events", [])
+        for e in events:
+            s = e.get("state")
+            if s:
+                self._task_state_counts[s] = (
+                    self._task_state_counts.get(s, 0) + 1
+                )
+        self.task_events.extend(events)
         if len(self.task_events) > 10000:
             del self.task_events[: len(self.task_events) - 10000]
         return {}, []

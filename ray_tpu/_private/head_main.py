@@ -41,6 +41,9 @@ def main(argv=None):
     parser.add_argument("--resources", default="{}")
     parser.add_argument("--dashboard-port", type=int, default=-1,
                         help="-1 disables the dashboard; 0 picks a port")
+    parser.add_argument("--info-file", default=None,
+                        help="also write the startup info JSON here "
+                             "(atomic; for cluster launchers)")
     parser.add_argument("--state-file", default=None,
                         help="persist durable head state (KV, jobs) here; "
                              "restored on restart (GCS fault tolerance)")
@@ -103,6 +106,13 @@ def main(argv=None):
     }
     with open(address_file_path(), "w") as f:
         json.dump(info, f)
+    if args.info_file:
+        # atomic publish for launchers polling a private path (a cluster
+        # launcher must not read another cluster's global address file)
+        tmp = args.info_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(info, f)
+        os.replace(tmp, args.info_file)
     # parseable by the CLI parent
     print(json.dumps(info), flush=True)
 

@@ -1,0 +1,329 @@
+"""Cluster launcher: a YAML file → a running cluster.
+
+Reference analogs: ``python/ray/scripts/scripts.py:799`` (``ray up``) +
+``python/ray/autoscaler/_private/commands.py`` (create_or_update_cluster /
+teardown_cluster) and the cluster-YAML schema (provider section, available
+node types with min/max workers). TPU-era differences: the "monitor"
+(autoscaler) runs as a plain subprocess next to the head rather than inside
+it, providers are the thin ABC in ``node_provider.py`` (local subprocess
+nodes for dev boxes/CI, gcloud TPU VMs for real pods), and cluster state is
+one JSON file per cluster name under ``~/.ray_tpu``.
+
+YAML schema::
+
+    cluster_name: demo
+    provider:
+      type: local            # local | gce_tpu
+      # gce_tpu: project, zone, accelerator_type, version
+    head:
+      num_cpus: 4
+      port: 0                # 0 = pick a free port
+      dashboard_port: -1     # -1 = disabled
+    node_types:
+      worker:
+        resources: {CPU: 4}
+        min_workers: 1
+        max_workers: 8
+    idle_timeout_s: 60
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Any, Dict, Optional
+
+from ray_tpu.autoscaler.autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+    AutoscalerMonitor,
+    NodeTypeConfig,
+)
+from ray_tpu.autoscaler.node_provider import (
+    GCETPUNodeProvider,
+    LocalNodeProvider,
+    NodeProvider,
+)
+
+
+def _state_dir() -> str:
+    d = os.environ.get("RT_CLUSTER_STATE_DIR") or os.path.join(
+        os.path.expanduser("~"), ".ray_tpu"
+    )
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _state_path(name: str) -> str:
+    return os.path.join(_state_dir(), f"cluster_{name}.json")
+
+
+def load_cluster_config(path: str) -> Dict[str, Any]:
+    import yaml
+
+    with open(path) as f:
+        cfg = yaml.safe_load(f) or {}
+    if "cluster_name" not in cfg:
+        raise ValueError("cluster YAML needs cluster_name")
+    cfg.setdefault("provider", {"type": "local"})
+    cfg.setdefault("head", {})
+    cfg.setdefault("node_types", {})
+    for name, nt in cfg["node_types"].items():
+        if "resources" not in nt:
+            raise ValueError(f"node_type {name!r} needs resources")
+    return cfg
+
+
+def build_provider(cfg: Dict[str, Any], head_address: str) -> NodeProvider:
+    p = cfg["provider"]
+    kind = p.get("type", "local")
+    if kind == "local":
+        return LocalNodeProvider(head_address)
+    if kind == "gce_tpu":
+        return GCETPUNodeProvider(
+            head_address,
+            project=p["project"], zone=p["zone"],
+            # per-node-type config (accelerator_type etc.) comes from the
+            # YAML node_types section — the provider maps each type to a
+            # TPU slice shape
+            node_types={
+                name: dict(nt) for name, nt in cfg["node_types"].items()
+            },
+            version=p.get("version", "tpu-ubuntu2204-base"),
+        )
+    raise ValueError(f"unknown provider type {kind!r}")
+
+
+def autoscaler_config(cfg: Dict[str, Any]) -> AutoscalerConfig:
+    return AutoscalerConfig(
+        node_types={
+            name: NodeTypeConfig(
+                resources={k: float(v) for k, v in nt["resources"].items()},
+                min_workers=int(nt.get("min_workers", 0)),
+                max_workers=int(nt.get("max_workers", 10)),
+                labels=nt.get("labels", {}) or {},
+            )
+            for name, nt in cfg["node_types"].items()
+        },
+        idle_timeout_s=float(cfg.get("idle_timeout_s", 60.0)),
+        upscaling_speed=int(cfg.get("upscaling_speed", 100)),
+    )
+
+
+def up(path: str, *, wait_for_min_workers: float = 0.0) -> Dict[str, Any]:
+    """Start head + autoscaler monitor for the YAML cluster; returns the
+    recorded cluster state {address, head_pid, monitor_pid, ...}."""
+    cfg = load_cluster_config(path)
+    name = cfg["cluster_name"]
+    state_file = _state_path(name)
+    if os.path.exists(state_file):
+        prev = json.load(open(state_file))
+        if _pid_alive(prev.get("head_pid")):
+            raise RuntimeError(
+                f"cluster {name!r} already running at {prev['address']} "
+                f"(use `rt down {path}` first)"
+            )
+        os.unlink(state_file)
+    head = cfg["head"]
+    log_dir = os.path.join(_state_dir(), "logs")
+    os.makedirs(log_dir, exist_ok=True)
+    info_file = os.path.join(_state_dir(), f"cluster_{name}.info.json")
+    try:
+        os.unlink(info_file)
+    except OSError:
+        pass
+    cmd = [
+        sys.executable, "-m", "ray_tpu._private.head_main",
+        "--host", str(head.get("host", "127.0.0.1")),
+        "--port", str(head.get("port", 0)),
+        "--num-cpus", str(head.get("num_cpus", os.cpu_count() or 1)),
+        "--resources", json.dumps(head.get("resources", {})),
+        "--dashboard-port", str(head.get("dashboard_port", -1)),
+        "--info-file", info_file,
+    ]
+    # Daemon children must NOT inherit the caller's stdio (an `rt up` whose
+    # parent captures output would never see EOF on its pipes), and tasks
+    # scheduled on the head-local node print through the inherited fds —
+    # everything goes to the per-cluster log; the startup info arrives via
+    # the atomically-published info file.
+    head_log = open(os.path.join(log_dir, f"{name}-head.log"), "ab")
+    proc = subprocess.Popen(
+        cmd, stdout=head_log, stderr=head_log, stdin=subprocess.DEVNULL,
+    )
+    deadline = time.monotonic() + 60
+    info = None
+    while time.monotonic() < deadline:
+        if os.path.exists(info_file):
+            try:
+                info = json.load(open(info_file))
+                break
+            except json.JSONDecodeError:
+                pass  # partially visible; retry
+        if proc.poll() is not None:
+            break
+        time.sleep(0.05)
+    if info is None:
+        proc.kill()
+        raise RuntimeError(
+            f"head failed to start (see {head_log.name})"
+        )
+    address = info["address"]
+    mon_log = open(os.path.join(log_dir, f"{name}-monitor.log"), "ab")
+    monitor = subprocess.Popen(
+        [
+            sys.executable, "-m", "ray_tpu.autoscaler.launcher",
+            "--monitor", "--config", os.path.abspath(path),
+            "--address", address,
+        ],
+        stdout=mon_log, stderr=mon_log, stdin=subprocess.DEVNULL,
+    )
+    state = {
+        "cluster_name": name,
+        "address": address,
+        "head_pid": proc.pid,
+        "monitor_pid": monitor.pid,
+        "config_path": os.path.abspath(path),
+        "started_at": time.time(),
+    }
+    with open(state_file, "w") as f:
+        json.dump(state, f)
+    if wait_for_min_workers > 0:
+        _wait_min_workers(cfg, address, timeout=wait_for_min_workers)
+    return state
+
+
+def _wait_min_workers(cfg, address, timeout: float):
+    from ray_tpu._private.sync_client import SyncHeadClient
+
+    # The head-local node (spawned when head.num_cpus > 0, the default)
+    # registers too and must not count toward min_workers.
+    head_nodes = 1 if int(
+        cfg["head"].get("num_cpus", os.cpu_count() or 1)
+    ) > 0 else 0
+    want = head_nodes + sum(
+        int(nt.get("min_workers", 0)) for nt in cfg["node_types"].values()
+    )
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            client = SyncHeadClient(address)
+            h, _ = client.call("get_nodes", {})
+            client.close()
+            alive = sum(1 for n in h["nodes"] if n.get("alive"))
+            if alive >= want:
+                return
+        except Exception:
+            pass
+        time.sleep(0.5)
+
+
+def _pid_alive(pid) -> bool:
+    if not pid:
+        return False
+    try:
+        # Reap first when it's our child: a kill'd-but-unreaped zombie
+        # still answers kill(pid, 0).
+        os.waitpid(pid, os.WNOHANG)
+    except (ChildProcessError, OSError):
+        pass
+    try:
+        os.kill(pid, 0)
+        return True
+    except (ProcessLookupError, PermissionError):
+        return False
+
+
+def down(path_or_name: str) -> bool:
+    """Tear the cluster down: provider nodes, monitor, head."""
+    if os.path.exists(path_or_name):
+        name = load_cluster_config(path_or_name)["cluster_name"]
+    else:
+        name = path_or_name
+    state_file = _state_path(name)
+    if not os.path.exists(state_file):
+        return False
+    state = json.load(open(state_file))
+    # The MONITOR owns provider-node cleanup (its SIGTERM handler tears the
+    # launched nodes down — only its provider instance tracks them). Stop
+    # it first and give it time to finish before touching the head.
+    mon_pid = state.get("monitor_pid")
+    if _pid_alive(mon_pid):
+        try:
+            os.kill(mon_pid, signal.SIGTERM)
+        except OSError:
+            pass
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and _pid_alive(mon_pid):
+            time.sleep(0.1)
+    head_pid = state.get("head_pid")
+    if _pid_alive(head_pid):
+        try:
+            os.kill(head_pid, signal.SIGTERM)
+        except OSError:
+            pass
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and (
+        _pid_alive(state.get("head_pid"))
+        or _pid_alive(state.get("monitor_pid"))
+    ):
+        time.sleep(0.1)
+    for key in ("monitor_pid", "head_pid"):
+        pid = state.get(key)
+        if _pid_alive(pid):
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+    os.unlink(state_file)
+    return True
+
+
+def cluster_state(name: str) -> Optional[Dict[str, Any]]:
+    p = _state_path(name)
+    if not os.path.exists(p):
+        return None
+    return json.load(open(p))
+
+
+def _monitor_main(config_path: str, address: str):
+    """The autoscaler monitor process (reference: monitor.py next to the
+    GCS): reconcile loop until SIGTERM, then terminate every provider node
+    — the monitor's provider instance is the only holder of the launched
+    node handles, so teardown MUST happen here (a fresh provider in
+    ``down()`` would see an empty node table)."""
+    cfg = load_cluster_config(config_path)
+    provider = build_provider(cfg, address)
+    autoscaler = Autoscaler(address, autoscaler_config(cfg), provider)
+    runner = AutoscalerMonitor(autoscaler, interval_s=2.0)
+    stop = {"flag": False}
+
+    def term(*_):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, term)
+    runner.start()
+    try:
+        while not stop["flag"]:
+            time.sleep(0.2)
+    finally:
+        runner.stop()
+        for n in provider.non_terminated_nodes():
+            try:
+                provider.terminate_node(n["provider_node_id"])
+            except Exception:
+                pass
+
+
+if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--monitor", action="store_true")
+    ap.add_argument("--config", required=True)
+    ap.add_argument("--address", required=True)
+    a = ap.parse_args()
+    if a.monitor:
+        _monitor_main(a.config, a.address)

@@ -63,6 +63,29 @@ def cmd_start(args):
         print(f"node started (pid {node.proc.pid}) -> {address}")
 
 
+def cmd_up(args):
+    """Reference: ``ray up cluster.yaml`` (scripts.py:799)."""
+    from ray_tpu.autoscaler import launcher
+
+    state = launcher.up(
+        args.config, wait_for_min_workers=args.wait_min_workers
+    )
+    print(f"cluster {state['cluster_name']!r} up at {state['address']}")
+    print(f"  head pid {state['head_pid']}, monitor pid "
+          f"{state['monitor_pid']}")
+    print(f"connect with: ray_tpu.init(address='{state['address']}')")
+    print(f"tear down with: rt down {args.config}")
+
+
+def cmd_down(args):
+    from ray_tpu.autoscaler import launcher
+
+    if launcher.down(args.config):
+        print("cluster torn down")
+    else:
+        print("no recorded cluster state; nothing to do")
+
+
 def cmd_stop(args):
     from ray_tpu._private.head_main import address_file_path, read_address_file
 
@@ -208,6 +231,18 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("stop", help="stop the local head + nodes")
     sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser(
+        "up", help="start a cluster from a YAML config (head + autoscaler)"
+    )
+    sp.add_argument("config", help="cluster YAML path")
+    sp.add_argument("--wait-min-workers", type=float, default=0.0,
+                    help="seconds to wait for min_workers to register")
+    sp.set_defaults(fn=cmd_up)
+
+    sp = sub.add_parser("down", help="tear down a YAML-launched cluster")
+    sp.add_argument("config", help="cluster YAML path or cluster name")
+    sp.set_defaults(fn=cmd_down)
 
     sp = sub.add_parser("status", help="cluster resource status")
     sp.add_argument("--address", default=None)

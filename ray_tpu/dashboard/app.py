@@ -26,6 +26,7 @@ class DashboardApp:
 
         app = web.Application()
         r = app.router
+        r.add_get("/", self._index)
         r.add_get("/api/version", self._version)
         r.add_get("/api/nodes", self._nodes)
         r.add_get("/api/actors", self._actors)
@@ -150,14 +151,31 @@ class DashboardApp:
         h, _ = await self._head("cluster_stacks", {})
         return web.json_response(h)
 
+    async def _index(self, request):
+        """The web UI (reference: dashboard/client React app — here a
+        dependency-free page over the same JSON API)."""
+        from aiohttp import web
+
+        from ray_tpu.dashboard.ui import INDEX_HTML
+
+        return web.Response(text=INDEX_HTML, content_type="text/html")
+
     async def _metrics(self, request):
-        """Prometheus exposition (reference: metrics agent scrape target)."""
+        """Prometheus exposition (reference: metrics agent scrape target):
+        user-defined series pushed by workers plus head-derived cluster
+        series (nodes/actors/demands/task counters)."""
         from aiohttp import web
 
         from ray_tpu.util.metrics import render_prometheus
 
         h, _ = await self._head("metrics_snapshot", {})
+        text = render_prometheus(h["snapshots"])
+        builtin = []
+        for name, value in self.head.builtin_metrics().items():
+            kind = "counter" if name.endswith("_total") else "gauge"
+            builtin.append(f"# TYPE {name} {kind}")
+            builtin.append(f"{name} {value}")
         return web.Response(
-            text=render_prometheus(h["snapshots"]),
+            text=text + "\n" + "\n".join(builtin) + "\n",
             content_type="text/plain",
         )

@@ -1,0 +1,127 @@
+"""Single-page dashboard UI (reference: ``python/ray/dashboard/client`` —
+the reference ships a built React app; this is a dependency-free HTML page
+that polls the same JSON API the CLI/SDK use, rendering live cluster state:
+nodes, resource utilization, actors, placement groups, jobs, and task
+summary)."""
+
+INDEX_HTML = """<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>ray_tpu dashboard</title>
+<style>
+  :root { color-scheme: dark; }
+  body { font-family: ui-monospace, Menlo, Consolas, monospace;
+         background: #101318; color: #d7dce2; margin: 0; }
+  header { padding: 10px 18px; background: #161b23;
+           border-bottom: 1px solid #242b36; display: flex; gap: 18px;
+           align-items: baseline; }
+  h1 { font-size: 15px; margin: 0; color: #7fd1b9; }
+  header span { color: #8b95a4; font-size: 12px; }
+  main { padding: 14px 18px; display: grid; gap: 18px; }
+  section h2 { font-size: 13px; color: #9fb6d0; margin: 0 0 6px;
+               text-transform: uppercase; letter-spacing: .08em; }
+  table { border-collapse: collapse; width: 100%; font-size: 12px; }
+  th, td { text-align: left; padding: 4px 10px 4px 0;
+           border-bottom: 1px solid #1d232d; }
+  th { color: #6f7a89; font-weight: normal; }
+  .ok { color: #7fd1b9; } .bad { color: #e07a7a; }
+  .bar { display: inline-block; height: 8px; background: #2c6d5c;
+         vertical-align: middle; border-radius: 2px; }
+  .barbg { display: inline-block; width: 120px; height: 8px;
+           background: #20262f; border-radius: 2px; margin-right: 6px; }
+  a { color: #7fb3d1; }
+  footer { color: #525c68; font-size: 11px; padding: 8px 18px; }
+</style>
+</head>
+<body>
+<header>
+  <h1>ray_tpu</h1>
+  <span id="meta">loading…</span>
+  <span><a href="/metrics">prometheus /metrics</a></span>
+  <span><a href="/api/cluster_status">cluster_status.json</a></span>
+</header>
+<main>
+  <section><h2>Nodes</h2><div id="nodes"></div></section>
+  <section><h2>Actors</h2><div id="actors"></div></section>
+  <section><h2>Placement groups</h2><div id="pgs"></div></section>
+  <section><h2>Jobs</h2><div id="jobs"></div></section>
+  <section><h2>Tasks (recent)</h2><div id="tasks"></div></section>
+</main>
+<footer>auto-refreshes every 2s · JSON API under /api/*</footer>
+<script>
+const $ = id => document.getElementById(id);
+const esc = s => String(s ?? "").replace(/[&<>]/g,
+  c => ({"&":"&amp;","<":"&lt;",">":"&gt;"}[c]));
+function table(rows, cols) {
+  if (!rows.length) return "<i>none</i>";
+  let h = "<table><tr>" + cols.map(c => `<th>${c[0]}</th>`).join("") +
+          "</tr>";
+  for (const r of rows)
+    h += "<tr>" + cols.map(c => `<td>${c[1](r)}</td>`).join("") + "</tr>";
+  return h + "</table>";
+}
+function util(res, avail) {
+  return Object.keys(res || {}).sort().map(k => {
+    const total = res[k], free = (avail || {})[k] ?? total;
+    const used = Math.max(total - free, 0);
+    const pct = total > 0 ? Math.round(100 * used / total) : 0;
+    return `${esc(k)} <span class=barbg><span class=bar style="width:` +
+           `${1.2 * pct}px"></span></span>${used.toFixed(1)}/${total}`;
+  }).join("<br>");
+}
+async function j(url) { const r = await fetch(url); return r.json(); }
+async function refresh() {
+  try {
+    const [nodes, actors, pgs, jobs, tasks] = await Promise.all([
+      j("/api/nodes"), j("/api/actors"), j("/api/placement_groups"),
+      j("/api/jobs"), j("/api/tasks")]);
+    const ns = nodes.nodes || [];
+    $("meta").textContent =
+      `${ns.filter(n => n.alive).length} alive node(s), ` +
+      `${(actors.actors || []).length} actor(s)`;
+    $("nodes").innerHTML = table(ns, [
+      ["node", n => esc(n.node_id.slice(0, 10))],
+      ["state", n => n.alive ? '<span class=ok>ALIVE</span>'
+                             : '<span class=bad>DEAD</span>'],
+      ["addr", n => esc((n.addr || []).join(":"))],
+      ["utilization", n => util(n.resources, n.available)],
+      ["labels", n => esc(JSON.stringify(n.labels || {}))]]);
+    $("actors").innerHTML = table(actors.actors || [], [
+      ["actor", a => esc(a.actor_id.slice(0, 10))],
+      ["class", a => esc(a.class_name)],
+      ["name", a => esc(a.name || "")],
+      ["state", a => a.state === "ALIVE"
+        ? '<span class=ok>ALIVE</span>' : esc(a.state)],
+      ["restarts", a => a.restarts_used],
+      ["node", a => esc((a.node_id || "").slice(0, 10))]]);
+    $("pgs").innerHTML = table(pgs.placement_groups || [], [
+      ["pg", p => esc(p.placement_group_id.slice(0, 10))],
+      ["name", p => esc(p.name || "")],
+      ["strategy", p => esc(p.strategy)],
+      ["state", p => esc(p.state)],
+      ["bundles", p => esc(JSON.stringify(p.bundles))]]);
+    $("jobs").innerHTML = table(jobs.jobs || [], [
+      ["job", x => esc(x.job_id || x.submission_id || "")],
+      ["state", x => esc(x.state || x.status || "")],
+      ["started", x => x.start_time
+        ? new Date(x.start_time * 1000).toLocaleTimeString() : ""]]);
+    const ts = (tasks.tasks || []).slice(-25).reverse();
+    $("tasks").innerHTML = table(ts, [
+      ["task", t => esc((t.task_id || "").slice(0, 10))],
+      ["name", t => esc(t.name || "")],
+      ["type", t => esc(t.type || "")],
+      ["state", t => t.state === "FINISHED"
+        ? '<span class=ok>FINISHED</span>'
+        : (t.state === "FAILED" ? '<span class=bad>FAILED</span>'
+                                : esc(t.state))],
+      ["node", t => esc((t.node_id || "").slice(0, 10))]]);
+  } catch (e) {
+    $("meta").textContent = "refresh failed: " + e;
+  }
+}
+refresh(); setInterval(refresh, 2000);
+</script>
+</body>
+</html>
+"""

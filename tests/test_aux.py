@@ -172,14 +172,17 @@ def test_runtime_env_working_dir(tmp_path):
         ray_tpu.shutdown()
 
 
-def test_runtime_env_unsupported_plugin_ignored():
+def test_runtime_env_unknown_plugin_fails_loudly():
+    """Round-2 contract change: unknown plugins raise instead of being
+    silently dropped (pip/uv/py_modules are now real — test_runtime_env)."""
     ray_tpu.init(num_cpus=2)
     try:
-        @ray_tpu.remote(runtime_env={"pip": ["something"]})
+        @ray_tpu.remote(runtime_env={"container": {"image": "x"}})
         def f():
-            return "ran anyway"
+            return "should not run"
 
-        assert ray_tpu.get(f.remote()) == "ran anyway"
+        with pytest.raises(ray_tpu.exceptions.RayTpuError):
+            ray_tpu.get(f.remote(), timeout=60)
     finally:
         ray_tpu.shutdown()
 

@@ -324,3 +324,33 @@ def test_gce_tpu_node_provider_fake_gcloud():
     pid2 = p.create_node("v5e-16", {"TPU": 16.0})
     p.terminate_node(pid2)
     assert p.non_terminated_nodes() == []
+
+
+def test_dashboard_ui_and_builtin_metrics(standalone_head):
+    """The dashboard serves a web UI at / and head-derived cluster series
+    on /metrics (reference: dashboard client + metrics_head provisioning)."""
+    port = standalone_head["dashboard_port"]
+    base = f"http://127.0.0.1:{port}"
+    with urllib.request.urlopen(base + "/", timeout=30) as r:
+        html = r.read().decode()
+    assert "ray_tpu dashboard" in html and "/api/nodes" in html
+    with urllib.request.urlopen(base + "/metrics", timeout=30) as r:
+        text = r.read().decode()
+    assert "rt_nodes_alive" in text
+    assert "rt_tasks_finished_total" in text
+
+
+def test_metrics_provisioning_files(tmp_path):
+    from ray_tpu.dashboard.provision import write_provision_files
+
+    paths = write_provision_files(
+        str(tmp_path), ["127.0.0.1:8265"], cluster_name="c1"
+    )
+    prom = open(paths["prometheus"]).read()
+    assert "127.0.0.1:8265" in prom and "ray_tpu" in prom
+    import json as _json
+
+    dash = _json.load(open(paths["grafana_dashboard"]))
+    exprs = [t["expr"] for p in dash["panels"] for t in p["targets"]]
+    assert any("rt_nodes_alive" in e for e in exprs)
+    assert open(paths["grafana_datasource"]).read().startswith("apiVersion")
