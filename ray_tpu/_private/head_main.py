@@ -41,6 +41,10 @@ def main(argv=None):
     parser.add_argument("--resources", default="{}")
     parser.add_argument("--dashboard-port", type=int, default=-1,
                         help="-1 disables the dashboard; 0 picks a port")
+    parser.add_argument("--no-address-file", action="store_true",
+                        help="skip the global head_address file (cluster "
+                             "launchers manage per-cluster info files; two "
+                             "clusters must not fight over one global file)")
     parser.add_argument("--info-file", default=None,
                         help="also write the startup info JSON here "
                              "(atomic; for cluster launchers)")
@@ -104,8 +108,9 @@ def main(argv=None):
         "head_pid": os.getpid(),
         "node_pids": [node.proc.pid] if node else [],
     }
-    with open(address_file_path(), "w") as f:
-        json.dump(info, f)
+    if not args.no_address_file:
+        with open(address_file_path(), "w") as f:
+            json.dump(info, f)
     if args.info_file:
         # atomic publish for launchers polling a private path (a cluster
         # launcher must not read another cluster's global address file)
@@ -140,10 +145,11 @@ def main(argv=None):
                 loop.run_until_complete(asyncio.wait_for(coro, timeout=3))
             except Exception:
                 pass
-        try:
-            os.remove(address_file_path())
-        except OSError:
-            pass
+        if not args.no_address_file:
+            try:
+                os.remove(address_file_path())
+            except OSError:
+                pass
         os._exit(exit_code)  # no lingering non-daemon threads may block exit
 
 

@@ -49,7 +49,9 @@ def bench_actor_calls_async(n: int = 2000) -> float:
     ray_tpu.get(a.m.remote())
     t0 = time.perf_counter()
     ray_tpu.get([a.m.remote() for _ in range(n)])
-    return _rate(n, time.perf_counter() - t0)
+    rate = _rate(n, time.perf_counter() - t0)
+    ray_tpu.kill(a)  # release the actor's CPU for the later benches
+    return rate
 
 
 def bench_actor_calls_sync(n: int = 300) -> float:
@@ -63,7 +65,9 @@ def bench_actor_calls_sync(n: int = 300) -> float:
     t0 = time.perf_counter()
     for _ in range(n):
         ray_tpu.get(a.m.remote())
-    return _rate(n, time.perf_counter() - t0)
+    rate = _rate(n, time.perf_counter() - t0)
+    ray_tpu.kill(a)
+    return rate
 
 
 def bench_put_gigabytes(total_gb: float = 2.0) -> float:
@@ -170,9 +174,12 @@ def _run_clients(target, args_list, timeout=300.0):
         return out, wall
     finally:
         for p in procs:
-            p.join(timeout=5)
-            if p.is_alive():
-                p.kill()
+            try:
+                p.join(timeout=5)
+                if p.is_alive():
+                    p.kill()
+            except (ValueError, AssertionError):
+                pass  # never started (start() itself raised)
 
 
 def bench_multi_client_tasks_async(clients: int = 4, n: int = 1000) -> float:
@@ -183,10 +190,13 @@ def bench_multi_client_tasks_async(clients: int = 4, n: int = 1000) -> float:
 
     w = worker_mod.get_global_worker()
     addr = f"{w.gcs_addr[0]}:{w.gcs_addr[1]}"
-    _, wall = _run_clients(
-        _client_task_burst, [(addr, n) for _ in range(clients)]
+    rates, _ = _run_clients(
+        _client_task_burst, [(addr, n) for _ in range(clients)],
+        timeout=900.0,
     )
-    return clients * n / wall
+    # Sum of per-client rates (reference semantics): client process startup
+    # (jax import etc.) must not dilute the steady-state number.
+    return float(sum(rates))
 
 
 def bench_multi_client_put(clients: int = 4, total_mb: int = 500) -> float:
@@ -195,10 +205,11 @@ def bench_multi_client_put(clients: int = 4, total_mb: int = 500) -> float:
 
     w = worker_mod.get_global_worker()
     addr = f"{w.gcs_addr[0]}:{w.gcs_addr[1]}"
-    _, wall = _run_clients(
-        _client_put_burst, [(addr, total_mb) for _ in range(clients)]
+    rates, _ = _run_clients(
+        _client_put_burst, [(addr, total_mb) for _ in range(clients)],
+        timeout=900.0,
     )
-    return clients * total_mb / 1024 / wall
+    return float(sum(rates))
 
 
 def bench_pg_churn(n: int = 50) -> float:
@@ -244,25 +255,45 @@ def bench_many_nodes_tasks(target_nodes: int = 32, n: int = 500) -> float:
     return rate
 
 
+def _progress(name: str):
+    import sys
+
+    print(f"[bench] {name}...", file=sys.stderr, flush=True)
+
+
 def run_core_benchmarks(quick: bool = False) -> Dict[str, float]:
     scale = 0.25 if quick else 1.0
-    out = {
-        "single_client_tasks_async_per_s": bench_single_client_tasks_async(
-            int(2000 * scale)
-        ),
-        "single_client_tasks_sync_per_s": bench_single_client_tasks_sync(
-            int(300 * scale)
-        ),
-        "actor_calls_async_per_s": bench_actor_calls_async(int(2000 * scale)),
-        "actor_calls_sync_per_s": bench_actor_calls_sync(int(300 * scale)),
-        "single_client_put_gb_per_s": bench_put_gigabytes(0.5 if quick else 2.0),
-        "single_client_get_calls_per_s": bench_get_calls(int(2000 * scale)),
-        "pg_create_remove_per_s": bench_pg_churn(20 if quick else 50),
-    }
+    out = {}
+    _progress("single_client_tasks_async")
+    out["single_client_tasks_async_per_s"] = bench_single_client_tasks_async(
+        int(2000 * scale)
+    )
+    _progress("single_client_tasks_sync")
+    out["single_client_tasks_sync_per_s"] = bench_single_client_tasks_sync(
+        int(300 * scale)
+    )
+    _progress("actor_calls_async")
+    out["actor_calls_async_per_s"] = bench_actor_calls_async(
+        int(2000 * scale)
+    )
+    _progress("actor_calls_sync")
+    out["actor_calls_sync_per_s"] = bench_actor_calls_sync(int(300 * scale))
+    _progress("put_gigabytes")
+    out["single_client_put_gb_per_s"] = bench_put_gigabytes(
+        0.5 if quick else 2.0
+    )
+    _progress("get_calls")
+    out["single_client_get_calls_per_s"] = bench_get_calls(
+        int(2000 * scale)
+    )
+    _progress("pg_churn")
+    out["pg_create_remove_per_s"] = bench_pg_churn(20 if quick else 50)
     try:
+        _progress("multi_client_tasks_async")
         out["multi_client_tasks_async_per_s"] = bench_multi_client_tasks_async(
             clients=2 if quick else 4, n=int(1000 * scale)
         )
+        _progress("multi_client_put")
         out["multi_client_put_gb_per_s"] = bench_multi_client_put(
             clients=2 if quick else 4, total_mb=200 if quick else 500
         )
@@ -272,6 +303,7 @@ def run_core_benchmarks(quick: bool = False) -> Dict[str, float]:
 
         logging.getLogger(__name__).warning("multi-client bench failed: %s", e)
     try:
+        _progress("many_nodes_tasks")
         out["many_nodes_tasks_per_s"] = bench_many_nodes_tasks(
             8 if quick else 32, int(500 * scale)
         )

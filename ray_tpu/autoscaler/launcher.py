@@ -126,6 +126,23 @@ def up(path: str, *, wait_for_min_workers: float = 0.0) -> Dict[str, Any]:
                 f"cluster {name!r} already running at {prev['address']} "
                 f"(use `rt down {path}` first)"
             )
+        # Head died but a monitor may survive: stop it (it tears down its
+        # provider nodes on SIGTERM) before discarding the state — unlinking
+        # first would orphan the monitor and every node it launched.
+        mon = prev.get("monitor_pid")
+        if _pid_alive(mon):
+            try:
+                os.kill(mon, signal.SIGTERM)
+            except OSError:
+                pass
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline and _pid_alive(mon):
+                time.sleep(0.1)
+            if _pid_alive(mon):
+                try:
+                    os.kill(mon, signal.SIGKILL)
+                except OSError:
+                    pass
         os.unlink(state_file)
     head = cfg["head"]
     log_dir = os.path.join(_state_dir(), "logs")
@@ -143,6 +160,7 @@ def up(path: str, *, wait_for_min_workers: float = 0.0) -> Dict[str, Any]:
         "--resources", json.dumps(head.get("resources", {})),
         "--dashboard-port", str(head.get("dashboard_port", -1)),
         "--info-file", info_file,
+        "--no-address-file",
     ]
     # Daemon children must NOT inherit the caller's stdio (an `rt up` whose
     # parent captures output would never see EOF on its pipes), and tasks
@@ -191,7 +209,15 @@ def up(path: str, *, wait_for_min_workers: float = 0.0) -> Dict[str, Any]:
     with open(state_file, "w") as f:
         json.dump(state, f)
     if wait_for_min_workers > 0:
-        _wait_min_workers(cfg, address, timeout=wait_for_min_workers)
+        if not _wait_min_workers(cfg, address, timeout=wait_for_min_workers):
+            import sys as _sys
+
+            print(
+                f"WARNING: min_workers did not register within "
+                f"{wait_for_min_workers:.0f}s (see "
+                f"{os.path.join(log_dir, name + '-monitor.log')})",
+                file=_sys.stderr,
+            )
     return state
 
 
@@ -214,10 +240,11 @@ def _wait_min_workers(cfg, address, timeout: float):
             client.close()
             alive = sum(1 for n in h["nodes"] if n.get("alive"))
             if alive >= want:
-                return
+                return True
         except Exception:
             pass
         time.sleep(0.5)
+    return False
 
 
 def _pid_alive(pid) -> bool:
@@ -277,6 +304,14 @@ def down(path_or_name: str) -> bool:
                 os.kill(pid, signal.SIGKILL)
             except OSError:
                 pass
+    # SIGKILL delivery + reaping are asynchronous: wait until both pids are
+    # really gone so `down()` returning means the cluster is down.
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and (
+        _pid_alive(state.get("head_pid"))
+        or _pid_alive(state.get("monitor_pid"))
+    ):
+        time.sleep(0.05)
     os.unlink(state_file)
     return True
 

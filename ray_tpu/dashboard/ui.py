@@ -95,7 +95,7 @@ async function refresh() {
         ? '<span class=ok>ALIVE</span>' : esc(a.state)],
       ["restarts", a => a.restarts_used],
       ["node", a => esc((a.node_id || "").slice(0, 10))]]);
-    $("pgs").innerHTML = table(pgs.placement_groups || [], [
+    $("pgs").innerHTML = table(pgs.pgs || [], [
       ["pg", p => esc(p.placement_group_id.slice(0, 10))],
       ["name", p => esc(p.name || "")],
       ["strategy", p => esc(p.strategy)],
@@ -106,7 +106,7 @@ async function refresh() {
       ["state", x => esc(x.state || x.status || "")],
       ["started", x => x.start_time
         ? new Date(x.start_time * 1000).toLocaleTimeString() : ""]]);
-    const ts = (tasks.tasks || []).slice(-25).reverse();
+    const ts = (tasks.events || []).slice(-25).reverse();
     $("tasks").innerHTML = table(ts, [
       ["task", t => esc((t.task_id || "").slice(0, 10))],
       ["name", t => esc(t.name || "")],
