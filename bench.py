@@ -156,10 +156,22 @@ def main():
 
     extra = {}
     if not args.no_train:
+        import signal
+
+        def _timeout(*_):
+            raise TimeoutError("train bench watchdog expired")
+
+        # Watchdog: a wedged accelerator transport (observed on tunneled
+        # TPU plugins) must degrade to train_error, not hang the whole
+        # round's bench run.
+        signal.signal(signal.SIGALRM, _timeout)
+        signal.alarm(1800)
         try:
             extra = bench_train_tokens_per_sec(quick=args.quick)
         except Exception as e:  # keep the headline metric even if jax breaks
             extra = {"train_error": f"{type(e).__name__}: {e}"}
+        finally:
+            signal.alarm(0)
 
     value = core["single_client_tasks_async_per_s"]
     result = {

@@ -257,6 +257,14 @@ def _pid_alive(pid) -> bool:
     except (ChildProcessError, OSError):
         pass
     try:
+        # An unreapable zombie (child of some OTHER live process) still
+        # answers kill(pid, 0); for liveness purposes it is dead.
+        with open(f"/proc/{pid}/stat") as f:
+            if f.read().rsplit(")", 1)[-1].split()[0] == "Z":
+                return False
+    except OSError:
+        pass
+    try:
         os.kill(pid, 0)
         return True
     except (ProcessLookupError, PermissionError):

@@ -17,8 +17,10 @@
 // [u32 nframes][u64 len]*n | frames layout — so the fetching side writes it
 // into a local segment verbatim and reads it with the normal store code.
 //
-// Server: one accept thread + one detached thread per connection (transfers
-// are long, connections few). Arena attachments are cached per arena name.
+// Server: one accept thread feeding a bounded fd queue drained by a fixed
+// worker pool (2x cores, max 32) — bulk transfers keep the blocking write
+// loop, but thread count and per-connection churn stay bounded at the
+// many-node envelope. Arena attachments are cached per arena name.
 // Serving pins arena objects via rt_obj_get/rt_obj_release; plain segments
 // stay readable through the mmap even if unlinked mid-transfer.
 
@@ -37,6 +39,8 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <condition_variable>
+#include <deque>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -192,6 +196,68 @@ void HandleConn(int fd) {
   close(fd);
 }
 
+// Fixed worker pool draining a bounded fd queue. Transfers are bulk (the
+// blocking write loop IS the right IO model for GB/s payloads); the pool
+// bounds thread count and removes per-connection thread churn — a 250-node
+// fetch storm costs queueing, not 250 thread spawns. Queue overflow sheds
+// load by closing the connection: the fetcher falls back to the RPC pull
+// path, which is the correct behavior under overload.
+struct ServePool {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<int> fds;
+  uint64_t epoch = 0;  // bumped on stop: workers of older epochs drain+exit
+  unsigned workers = 0;
+};
+
+constexpr size_t kServeQueueMax = 256;
+
+ServePool& pool() {
+  static ServePool p;
+  return p;
+}
+
+void PoolWorker(uint64_t my_epoch) {
+  ServePool& p = pool();
+  for (;;) {
+    int fd;
+    {
+      std::unique_lock<std::mutex> lock(p.mu);
+      p.cv.wait(lock, [&] {
+        return p.epoch != my_epoch || !p.fds.empty();
+      });
+      if (p.fds.empty()) return;  // epoch advanced and nothing queued
+      fd = p.fds.front();
+      p.fds.pop_front();
+    }
+    HandleConn(fd);
+  }
+}
+
+void EnsurePoolStarted() {
+  ServePool& p = pool();
+  std::lock_guard<std::mutex> lock(p.mu);
+  if (p.workers > 0) return;
+  unsigned hw = std::thread::hardware_concurrency();
+  unsigned n = hw > 1 ? (hw * 2 < 32 ? hw * 2 : 32) : 2;
+  for (unsigned i = 0; i < n; i++) {
+    std::thread(PoolWorker, p.epoch).detach();
+  }
+  p.workers = n;
+}
+
+void StopPoolIfIdleListeners() {
+  // Called with g_serve_mu held and g_listeners empty: quiesce the worker
+  // pool (workers drain the queue, then exit); a later serve restarts it.
+  ServePool& p = pool();
+  {
+    std::lock_guard<std::mutex> lock(p.mu);
+    p.epoch++;
+    p.workers = 0;
+  }
+  p.cv.notify_all();
+}
+
 void AcceptLoop(int listen_fd) {
   for (;;) {
     int fd = accept(listen_fd, nullptr, nullptr);
@@ -201,7 +267,16 @@ void AcceptLoop(int listen_fd) {
     }
     int one = 1;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-    std::thread(HandleConn, fd).detach();
+    ServePool& p = pool();
+    {
+      std::lock_guard<std::mutex> lock(p.mu);
+      if (p.fds.size() >= kServeQueueMax) {
+        close(fd);  // shed load; fetcher falls back to the RPC pull
+        continue;
+      }
+      p.fds.push_back(fd);
+    }
+    p.cv.notify_one();
   }
 }
 
@@ -307,6 +382,7 @@ int rt_xfer_serve(const char* host, int port) {
     std::lock_guard<std::mutex> lock(g_serve_mu);
     g_listeners[bound] = fd;
   }
+  EnsurePoolStarted();
   std::thread(AcceptLoop, fd).detach();
   return bound;
 }
@@ -316,15 +392,18 @@ int rt_xfer_serve(const char* host, int port) {
 // A worker shutdown must not leave a listener serving this host's shm.
 int rt_xfer_stop(int port) {
   int fd = -1;
+  bool last = false;
   {
     std::lock_guard<std::mutex> lock(g_serve_mu);
     auto it = g_listeners.find(port);
     if (it == g_listeners.end()) return -ENOENT;
     fd = it->second;
     g_listeners.erase(it);
+    last = g_listeners.empty();
   }
   shutdown(fd, SHUT_RDWR);
   close(fd);
+  if (last) StopPoolIfIdleListeners();
   return 0;
 }
 
