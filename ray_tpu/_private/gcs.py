@@ -148,6 +148,8 @@ class HeadService:
         self.task_events: List[dict] = []  # bounded task-event buffer for state API
         self.jobs: Dict[str, dict] = {}
         self._schedule_rr = 0  # round-robin cursor
+        self._shutting_down = False
+        self._death_tasks: set = set()  # in-flight _on_node_dead tasks
         # Unsatisfied lease demands, keyed by waiter id — the autoscaler's
         # scale-up signal (reference: GcsAutoscalerStateManager feeding
         # autoscaler v2 with pending resource demands).
@@ -179,8 +181,14 @@ class HeadService:
         return self.addr
 
     async def close(self):
+        self._shutting_down = True
         if self.server:
             await self.server.close()
+        # Settle in-flight node-death handlers so none outlive the loop.
+        if self._death_tasks:
+            await asyncio.gather(
+                *list(self._death_tasks), return_exceptions=True
+            )
 
     # -------------------------------------------------------- persistence
     # Reference analog: GCS fault tolerance via Redis-backed store +
@@ -331,16 +339,23 @@ class HeadService:
         loop = asyncio.get_running_loop()
 
         def _spawn():
-            if loop.is_closed():
+            # During shutdown every node connection closes at once; spawning
+            # death handlers then races loop.stop (tasks created but never
+            # run → "coroutine was never awaited" warnings) and does no
+            # useful work — the cluster is going away.
+            if loop.is_closed() or self._shutting_down:
                 return
             coro = self._on_node_dead(node_id)
             try:
-                loop.create_task(coro)
+                t = loop.create_task(coro)
             except RuntimeError:
                 coro.close()  # loop torn down between check and create
+            else:
+                self._death_tasks.add(t)
+                t.add_done_callback(self._death_tasks.discard)
 
         def _on_close(conn):
-            if not loop.is_closed():
+            if not loop.is_closed() and not self._shutting_down:
                 try:
                     loop.call_soon_threadsafe(_spawn)
                 except RuntimeError:

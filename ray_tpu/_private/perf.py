@@ -3,6 +3,7 @@ run by ``release/microbenchmark/run_microbenchmark.py`` — same workload shapes
 so numbers are directly comparable to BASELINE.md)."""
 from __future__ import annotations
 
+import os
 import time
 from typing import Dict
 
@@ -111,7 +112,7 @@ def _client_task_burst(addr: str, n: int, q):
     rt.get([noop.remote() for _ in range(50)])
     t0 = _time.perf_counter()
     rt.get([noop.remote() for _ in range(n)])
-    q.put(n / (_time.perf_counter() - t0))
+    q.put((os.getpid(), n / (_time.perf_counter() - t0)))
     rt.shutdown()
 
 
@@ -131,14 +132,15 @@ def _client_put_burst(addr: str, total_mb: int, q):
     for _ in range(n):
         r = rt.put(chunk)
         del r
-    q.put(n * chunk.nbytes / (1024 ** 3) / (_time.perf_counter() - t0))
+    q.put((os.getpid(), n * chunk.nbytes / (1024 ** 3) / (_time.perf_counter() - t0)))
     rt.shutdown()
 
 
 def _run_clients(target, args_list, timeout=300.0):
     """Run client subprocesses concurrently; returns (results, wall_s).
-    A crashed client aborts the wait promptly (no 5-minute stall) and the
-    survivors are always reaped."""
+    Reports are (pid, value) pairs, so a client that exits without ever
+    reporting aborts the wait promptly, while one that reported and then
+    exited nonzero (e.g. an error inside rt.shutdown) is still counted."""
     import multiprocessing as mp
     import queue as queue_mod
 
@@ -152,23 +154,26 @@ def _run_clients(target, args_list, timeout=300.0):
         for p in procs:
             p.start()
         out = []
+        reported = set()
         deadline = time.perf_counter() + timeout
         while len(out) < len(procs):
             try:
-                out.append(q.get(timeout=1.0))
+                pid, val = q.get(timeout=1.0)
+                reported.add(pid)
+                out.append(val)
                 continue
             except queue_mod.Empty:
                 pass
             if time.perf_counter() > deadline:
                 raise RuntimeError("bench clients timed out")
-            missing = len(procs) - len(out)
-            dead = sum(
-                1 for p in procs
-                if not p.is_alive() and p.exitcode not in (0, None)
-            )
-            if dead >= missing:
+            silent_dead = [
+                p for p in procs
+                if not p.is_alive() and p.pid not in reported
+            ]
+            if silent_dead and q.empty():
                 raise RuntimeError(
-                    f"{dead} bench client(s) crashed before reporting"
+                    f"{len(silent_dead)} bench client(s) exited "
+                    "before reporting"
                 )
         wall = time.perf_counter() - t0
         return out, wall

@@ -43,6 +43,14 @@ logger = logging.getLogger(__name__)
 # the peer is considered wedged and the connection is torn down.
 SEND_TIMEOUT_MS = 30_000
 
+# Loop-side sends queue here while the ring is full. A peer that stops
+# draining (but keeps its TCP conn up) would otherwise grow this without
+# bound while the drainer moves one message per SEND_TIMEOUT_MS — cap the
+# backlog at a few ring capacities (derived per-connection from the ring's
+# geometry so one max-size message always fits) and treat overflow like a
+# wedged peer.
+BACKLOG_RING_CAPACITIES = 4
+
 
 class MessageTooBig(protocol.RpcError):
     """Payload exceeds the ring; caller should retry over TCP. NOT fatal to
@@ -81,6 +89,9 @@ class RingConnection:
         # encoded message joins this FIFO backlog and a drainer task pushes
         # it from an executor thread (order preserved; the loop stays live).
         self._backlog: List[bytes] = []
+        self._backlog_bytes = 0
+        # max_msg is half the ring capacity; cap ≈ 4 capacities.
+        self._backlog_max = BACKLOG_RING_CAPACITIES * 2 * ring.max_msg
         self._drainer_running = False
         self._pump = threading.Thread(
             target=self._pump_loop, daemon=True,
@@ -138,7 +149,14 @@ class RingConnection:
                 raise protocol.ConnectionLost(
                     f"ring {self.name}: {e}"
                 ) from None
+        if self._backlog_bytes + len(data) > self._backlog_max:
+            self._teardown()
+            raise protocol.ConnectionLost(
+                f"ring {self.name}: peer not draining "
+                f"({self._backlog_bytes}B backlogged)"
+            )
         self._backlog.append(data)
+        self._backlog_bytes += len(data)
         if not self._drainer_running:
             self._drainer_running = True
             self.loop.create_task(self._drain_backlog())
@@ -157,6 +175,7 @@ class RingConnection:
                     self._teardown()
                     return
                 self._backlog.pop(0)
+                self._backlog_bytes -= len(data)
         finally:
             self._drainer_running = False
 

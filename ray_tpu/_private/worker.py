@@ -1522,6 +1522,19 @@ class CoreWorker:
         fut.set_exception(exc.RayTpuError(str(e)))
         return False
 
+    async def _call_with_tcp_fallback(self, conn, addr, method, header, frames):
+        """Issue an RPC on ``conn`` (usually a ring); when the encoded
+        message exceeds the ring limit despite the caller's size
+        pre-estimate, retry once over TCP to the same address. Server-side
+        seq admission tolerates mixed transports."""
+        from ray_tpu._private.ringconn import MessageTooBig
+
+        try:
+            return await conn.call(method, header, frames)
+        except MessageTooBig:
+            tcp = await self.get_peer(addr)
+            return await tcp.call(method, header, frames)
+
     async def _slot_pusher(self, key, lease_set, slot):
         """Drains pending tasks onto one leased slot until the queue (or the
         slot) is gone; many tasks amortize one coroutine. On the ring
@@ -1564,16 +1577,17 @@ class CoreWorker:
                             chunk.append(lease_set.pending.pop(0))
                     if not chunk:
                         continue
+                    from ray_tpu._private.ringconn import MessageTooBig
+
                     if len(chunk) == 1:
                         header, frames, fut = chunk[0]
-                        h, rframes = await conn.call(
-                            "push_task", header, frames
+                        h, rframes = await self._call_with_tcp_fallback(
+                            conn, slot.addr, "push_task", header, frames
                         )
                         self._handle_task_reply(header, h, rframes)
                         if not fut.done():
                             fut.set_result(None)
                         continue
-                    from ray_tpu._private.ringconn import MessageTooBig
 
                     try:
                         rfuts = conn.call_batch(
@@ -1585,15 +1599,12 @@ class CoreWorker:
                         # the ring ride TCP. Futures must never be dropped.
                         for i, (header, frames, fut) in enumerate(chunk):
                             try:
-                                try:
-                                    h, rframes = await conn.call(
-                                        "push_task", header, frames
+                                h, rframes = (
+                                    await self._call_with_tcp_fallback(
+                                        conn, slot.addr, "push_task",
+                                        header, frames,
                                     )
-                                except MessageTooBig:
-                                    tcp = await self.get_peer(slot.addr)
-                                    h, rframes = await tcp.call(
-                                        "push_task", header, frames
-                                    )
+                                )
                                 self._handle_task_reply(header, h, rframes)
                                 if not fut.done():
                                     fut.set_result(None)
@@ -1875,7 +1886,9 @@ class CoreWorker:
                     # Oversized for the ring: this call rides TCP. Server-side
                     # seq admission keeps ordering across the two transports.
                     conn = await self.get_peer(ch.addr)
-                h, rframes = await conn.call("push_actor_task", header, frames)
+                h, rframes = await self._call_with_tcp_fallback(
+                    conn, ch.addr, "push_actor_task", header, frames
+                )
                 self._handle_task_reply(header, h, rframes)
                 return
             except (protocol.ConnectionLost, ConnectionRefusedError, OSError):
