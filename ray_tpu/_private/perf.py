@@ -219,6 +219,54 @@ def bench_multi_client_put(clients: int = 4, total_mb: int = 500) -> float:
     return float(sum(rates))
 
 
+def bench_put_calls(n: int = 1000) -> float:
+    """Small-object put ops/s through the shm store (reference:
+    single_client_put_calls_Plasma_Store in ray_perf — per-put fixed cost:
+    create/seal/register, not bandwidth). 1MB payloads clear the inline
+    threshold so every put exercises the arena."""
+    chunk = np.random.rand(1024 * 1024 // 8)  # 1MB > INLINE_OBJECT_MAX
+    ref = ray_tpu.put(chunk)
+    del ref
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ref = ray_tpu.put(chunk)
+        del ref
+    return _rate(n, time.perf_counter() - t0)
+
+
+def bench_get_10k_refs(k: int = 10_000) -> float:
+    """ops/s for getting one object that contains 10k nested ObjectRefs
+    (reference: single_client_get_object_containing_10k_refs — stresses
+    borrow registration and nested-ref resolution)."""
+    vals = [ray_tpu.put(i) for i in range(k)]
+    container = ray_tpu.put(vals)
+    n = 5
+    ray_tpu.get(container)  # warm
+    t0 = time.perf_counter()
+    for _ in range(n):
+        inner = ray_tpu.get(container)
+    dt = time.perf_counter() - t0
+    del inner, vals, container
+    return _rate(n, dt)
+
+
+def bench_wait_1k_refs(k: int = 1000) -> float:
+    """ops/s for ray.wait over 1k pending refs (reference:
+    single_client_wait_1k_refs)."""
+    @ray_tpu.remote
+    def quick():
+        return None
+
+    refs = [quick.remote() for _ in range(k)]
+    ray_tpu.get(refs)  # all ready: wait() measures bookkeeping, not tasks
+    n = 5
+    ray_tpu.wait(refs, num_returns=len(refs), timeout=10)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ray_tpu.wait(refs, num_returns=len(refs), timeout=10)
+    return _rate(n, time.perf_counter() - t0)
+
+
 def bench_pg_churn(n: int = 50) -> float:
     """Placement-group create/ready/remove cycles per second (reference
     baseline: placement_group create/removal rate in BASELINE.md)."""
@@ -292,6 +340,18 @@ def run_core_benchmarks(quick: bool = False) -> Dict[str, float]:
     _progress("get_calls")
     out["single_client_get_calls_per_s"] = bench_get_calls(
         int(2000 * scale)
+    )
+    _progress("put_calls")
+    out["single_client_put_calls_per_s"] = bench_put_calls(
+        int(1000 * scale)
+    )
+    _progress("get_10k_refs")
+    out["get_10k_refs_per_s"] = bench_get_10k_refs(
+        2000 if quick else 10_000
+    )
+    _progress("wait_1k_refs")
+    out["wait_1k_refs_per_s"] = bench_wait_1k_refs(
+        250 if quick else 1000
     )
     _progress("pg_churn")
     out["pg_create_remove_per_s"] = bench_pg_churn(20 if quick else 50)

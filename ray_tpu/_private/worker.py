@@ -52,6 +52,7 @@ from ray_tpu._private.ids import (
     WorkerID,
 )
 from ray_tpu.native.arena import HybridShmStore
+from ray_tpu._private.ringconn import MessageTooBig
 from ray_tpu._private.serialization import SerializationContext
 from ray_tpu.object_ref import ObjectRef, collect_refs_during
 
@@ -826,7 +827,17 @@ class CoreWorker:
         if size <= INLINE_OBJECT_MAX:
             self.memory_store[hex_] = ("mem", frames)
         else:
-            meta = self._with_xfer(self.shm.put_frames(hex_, frames))
+            if size >= 8 * 1024 * 1024:
+                # Big payload: copy on an executor thread so the event loop
+                # keeps serving RPCs during the multi-ms memcpy (the native
+                # arena's create/copy/seal are mutex'd and safe off-loop).
+                loop = asyncio.get_running_loop()
+                meta = await loop.run_in_executor(
+                    None, self.shm.put_frames, hex_, frames
+                )
+                meta = self._with_xfer(meta)
+            else:
+                meta = self._with_xfer(self.shm.put_frames(hex_, frames))
             self.memory_store[hex_] = ("shm", meta)
             await self.gcs.call("object_register", {"oid": hex_, "meta": meta})
         ev = self.store_events.get(hex_)
@@ -1527,8 +1538,6 @@ class CoreWorker:
         message exceeds the ring limit despite the caller's size
         pre-estimate, retry once over TCP to the same address. Server-side
         seq admission tolerates mixed transports."""
-        from ray_tpu._private.ringconn import MessageTooBig
-
         try:
             return await conn.call(method, header, frames)
         except MessageTooBig:
@@ -1577,8 +1586,6 @@ class CoreWorker:
                             chunk.append(lease_set.pending.pop(0))
                     if not chunk:
                         continue
-                    from ray_tpu._private.ringconn import MessageTooBig
-
                     if len(chunk) == 1:
                         header, frames, fut = chunk[0]
                         h, rframes = await self._call_with_tcp_fallback(

@@ -147,5 +147,9 @@ def load_library():
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
         ]
         lib.rt_memcpy_parallel.restype = None
+        lib.rt_arena_copy.argtypes = [
+            ctypes.c_int, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64,
+        ]
+        lib.rt_arena_copy.restype = ctypes.c_int
         _lib = lib
         return _lib

@@ -36,8 +36,10 @@ DEFAULT_CAPACITY = int(os.environ.get("RT_ARENA_BYTES", 1 << 30))
 INDEX_SLOTS = 1 << 15
 
 
-# Frames at/above this size take the multi-threaded native copy path.
-_PARALLEL_COPY_MIN = 8 * 1024 * 1024
+# Frames at/above this size take the native copy path (GIL released; NT
+# streaming stores from 16MB by auto-probe — RT_STREAM_MIN_MB overrides —
+# and one extra copy thread per 4MB up to the coordinated budget).
+_PARALLEL_COPY_MIN = 1024 * 1024
 
 
 def _buffer_address(b) -> Optional[int]:
@@ -141,9 +143,15 @@ class NativeArenaStore:
             if n >= _PARALLEL_COPY_MIN:
                 src = _buffer_address(f)
                 if src is not None:
-                    # multi-threaded memcpy: a single-thread copy caps put
-                    # throughput well below DRAM bandwidth
-                    self._lib.rt_memcpy_parallel(self._base + off + o, src, n)
+                    # native streaming copy, thread budget shared across
+                    # every process putting into this arena concurrently
+                    rc = self._lib.rt_arena_copy(self._h, off + o, src, n)
+                    if rc != 0:
+                        # never seal an unwritten payload (e.g. -EBADF from
+                        # a concurrent detach): readers would get garbage
+                        raise RuntimeError(
+                            f"arena_copy({object_hex}): errno {-rc}"
+                        )
                     continue
             buf[o : o + n] = f
         rc = self._lib.rt_obj_seal(self._h, object_hex.encode())

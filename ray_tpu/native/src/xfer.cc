@@ -213,8 +213,13 @@ struct ServePool {
 constexpr size_t kServeQueueMax = 256;
 
 ServePool& pool() {
-  static ServePool p;
-  return p;
+  // Intentionally leaked: pool workers block on the condvar, and a static
+  // ServePool's destructor would run pthread_cond_destroy at process exit,
+  // which blocks until all waiters wake — wedging interpreter shutdown for
+  // any process that ever served a transfer. Detached workers die with the
+  // process; the kernel reclaims the memory.
+  static ServePool* p = new ServePool();
+  return *p;
 }
 
 void PoolWorker(uint64_t my_epoch) {

@@ -348,3 +348,51 @@ def test_scrub_triggers_on_allocation_pressure():
         store.free(oid2)
     finally:
         store.close_all()
+
+
+def _child_multithread_putter(name, oid, n, q):
+    try:
+        # RT_COPY_THREADS was set by the parent BEFORE spawn: the budget is
+        # cached on first use, so it must be in the env at process start.
+        store = NativeArenaStore(name, create=False)
+        payload = bytes(range(256)) * (n // 256) + b"Z" * (n % 256)
+        store.put_frames(oid, [payload])
+        q.put(("ok", len(payload)))
+    except Exception as e:  # pragma: no cover
+        q.put(("err", repr(e)))
+
+
+@pytest.mark.parametrize("extra", [1, 63, 65, 4097])
+def test_parallel_copy_covers_tail(extra):
+    """Multi-threaded payload copies must cover every byte: chunk rounding
+    that floors len/nthreads before 64-aligning used to drop the tail when
+    the floor was already aligned (silent corruption on multi-core hosts)."""
+    name = f"/rt_test_tail_{os.getpid()}_{secrets.token_hex(4)}"
+    store = NativeArenaStore(name, capacity=1 << 25)
+    n = (8 << 20) + extra  # >= 2 x 4MB per-thread chunks, never divisible
+    try:
+        oid = _hex()
+        ctx = mp.get_context("spawn")
+        q = ctx.Queue()
+        env_backup = os.environ.get("RT_COPY_THREADS")
+        os.environ["RT_COPY_THREADS"] = "4"
+        try:
+            p = ctx.Process(
+                target=_child_multithread_putter, args=(name, oid, n, q)
+            )
+            p.start()
+            status, detail = q.get(timeout=60)
+            p.join(timeout=10)
+        finally:
+            if env_backup is None:
+                os.environ.pop("RT_COPY_THREADS", None)
+            else:
+                os.environ["RT_COPY_THREADS"] = env_backup
+        assert status == "ok", detail
+        got = store.get_frames(oid, {})[0]
+        expect = bytes(range(256)) * (n // 256) + b"Z" * (n % 256)
+        assert len(got) == n
+        assert bytes(got[-4096:]) == expect[-4096:]  # the dropped region
+        assert bytes(got) == expect
+    finally:
+        store.close_all()
