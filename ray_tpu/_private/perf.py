@@ -71,6 +71,98 @@ def bench_actor_calls_sync(n: int = 300) -> float:
     return rate
 
 
+def bench_actor_calls_1_n(n: int = 2000, n_actors: int = 0) -> float:
+    """One caller fanning async calls across N actors (reference:
+    1_n_actor_calls_async in ray_perf)."""
+    if n_actors <= 0:
+        n_actors = max(min((os.cpu_count() or 1), 8), 2)
+
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return None
+
+    actors = [A.remote() for _ in range(n_actors)]
+    ray_tpu.get([a.m.remote() for a in actors])
+    t0 = time.perf_counter()
+    refs = [actors[i % n_actors].m.remote() for i in range(n)]
+    ray_tpu.get(refs)
+    rate = _rate(n, time.perf_counter() - t0)
+    for a in actors:
+        ray_tpu.kill(a)
+    return rate
+
+
+def bench_actor_calls_concurrent(n: int = 1000) -> float:
+    """Async calls against one max_concurrency=10 actor (reference:
+    1_1_actor_calls_concurrent)."""
+    @ray_tpu.remote
+    class A:
+        def m(self):
+            return None
+
+    a = A.options(max_concurrency=10).remote()
+    ray_tpu.get(a.m.remote())
+    t0 = time.perf_counter()
+    ray_tpu.get([a.m.remote() for _ in range(n)])
+    rate = _rate(n, time.perf_counter() - t0)
+    ray_tpu.kill(a)
+    return rate
+
+
+def bench_async_actor_calls(n: int = 1000) -> float:
+    """Async (coroutine-method) actor throughput (reference:
+    1_1_async_actor_calls_async)."""
+    @ray_tpu.remote
+    class A:
+        async def m(self):
+            return None
+
+    a = A.remote()
+    ray_tpu.get(a.m.remote())
+    t0 = time.perf_counter()
+    ray_tpu.get([a.m.remote() for _ in range(n)])
+    rate = _rate(n, time.perf_counter() - t0)
+    ray_tpu.kill(a)
+    return rate
+
+
+def _client_actor_burst(addr: str, n: int, q):
+    """Subprocess body for n_n actor calls: each client owns one actor."""
+    import time as _time
+
+    import ray_tpu as rt
+
+    rt.init(address=addr)
+
+    @rt.remote
+    class A:
+        def m(self):
+            return None
+
+    a = A.remote()
+    rt.get([a.m.remote() for _ in range(50)])
+    t0 = _time.perf_counter()
+    rt.get([a.m.remote() for _ in range(n)])
+    q.put((os.getpid(), n / (_time.perf_counter() - t0)))
+    rt.shutdown()
+
+
+def bench_actor_calls_n_n(clients: int = 4, n: int = 1000) -> float:
+    """Aggregate actor-call throughput across N driver processes, each with
+    its own actor (reference: n_n_actor_calls_async). Sum of per-client
+    steady-state rates."""
+    from ray_tpu._private import worker as worker_mod
+
+    w = worker_mod.get_global_worker()
+    addr = f"{w.gcs_addr[0]}:{w.gcs_addr[1]}"
+    rates, _ = _run_clients(
+        _client_actor_burst, [(addr, n) for _ in range(clients)],
+        timeout=900.0,
+    )
+    return float(sum(rates))
+
+
 def bench_put_gigabytes(total_gb: float = 2.0) -> float:
     """Large-object put throughput (reference shape: ray_perf puts numpy
     arrays; zero-copy serialization means one memcpy into the arena). Refs
@@ -333,6 +425,16 @@ def run_core_benchmarks(quick: bool = False) -> Dict[str, float]:
     )
     _progress("actor_calls_sync")
     out["actor_calls_sync_per_s"] = bench_actor_calls_sync(int(300 * scale))
+    _progress("actor_calls_1_n")
+    out["actor_calls_1_n_per_s"] = bench_actor_calls_1_n(int(2000 * scale))
+    _progress("actor_calls_concurrent")
+    out["actor_calls_concurrent_per_s"] = bench_actor_calls_concurrent(
+        int(1000 * scale)
+    )
+    _progress("async_actor_calls")
+    out["async_actor_calls_per_s"] = bench_async_actor_calls(
+        int(1000 * scale)
+    )
     _progress("put_gigabytes")
     out["single_client_put_gb_per_s"] = bench_put_gigabytes(
         0.5 if quick else 2.0
@@ -382,6 +484,15 @@ def run_core_benchmarks(quick: bool = False) -> Dict[str, float]:
         import logging
 
         logging.getLogger(__name__).warning("multi-client put failed: %s", e)
+    try:
+        _progress("actor_calls_n_n")
+        out["actor_calls_n_n_per_s"] = bench_actor_calls_n_n(
+            clients=clients, n=mc_n
+        )
+    except Exception as e:
+        import logging
+
+        logging.getLogger(__name__).warning("n_n actor bench failed: %s", e)
     try:
         _progress("many_nodes_tasks")
         out["many_nodes_tasks_per_s"] = bench_many_nodes_tasks(

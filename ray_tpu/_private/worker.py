@@ -155,7 +155,10 @@ class _ActorInstance:
             max_workers=max_concurrency, thread_name_prefix=f"actor-{actor_id[:8]}"
         )
         self.sem = asyncio.Semaphore(max_concurrency)
-        # per-caller ordered admission
+        # per-caller ordered admission; seq_lock makes the cursor safe to
+        # read/advance from the ring pump thread (fast dispatch) as well as
+        # the event loop (slow path)
+        self.seq_lock = threading.Lock()
         self.next_seq: Dict[str, int] = {}
         self.buffered: Dict[str, Dict[int, Any]] = {}
         self.num_executed = 0
@@ -538,7 +541,10 @@ class CoreWorker:
         no event loop on either decode, execute, or (small-result) reply.
         Returns False to route anything non-trivial to the slow path, whose
         semantics (arg fetch, runtime envs, OOM rejection, streaming) are
-        authoritative."""
+        authoritative. Actor pushes get the same treatment when they are
+        the caller's next in-order call (``_ring_actor_fast_dispatch``)."""
+        if h.get("m") == "push_actor_task":
+            return self._ring_actor_fast_dispatch(h, frames, rconn)
         if h.get("m") != "push_task":
             return False
         if (
@@ -574,6 +580,19 @@ class CoreWorker:
                 ok, result = False, (e, traceback.format_exc())
         except Exception as e:
             ok, result = False, (e, traceback.format_exc())
+        self._ring_reply_result(h, ok, result, rconn)
+        self._stats["tasks_executed"] += 1
+        self._record_task_event({
+            "task_id": h["tid"], "name": h.get("name") or h["fkey"],
+            "type": "NORMAL_TASK",
+            "state": "FINISHED" if ok else "FAILED",
+            "start_time": t0, "end_time": time.time(),
+            "node_id": self.node_id,
+        })
+
+    def _ring_reply_result(self, h, ok, result, rconn):
+        """Package + send an execution result from an executor thread
+        (shared by the task and actor ring fast paths)."""
         try:
             rets, out_frames, big = self._package_result_parts(h, ok, result)
             if big:
@@ -620,10 +639,90 @@ class CoreWorker:
                 {"i": h["i"], "r": 1, "e": f"reply packaging failed: {e!r}"},
                 [],
             )
-        self._stats["tasks_executed"] += 1
+
+    def _ring_actor_fast_dispatch(self, h, frames, rconn) -> bool:
+        """Pump-thread fast path for actor calls: a plain (non-async) method
+        with ref-free args on a serial actor, arriving as the caller's next
+        in-order sequence, is queued straight onto the actor's executor —
+        FIFO pool order IS the admission order, so the seq cursor can
+        advance immediately and the event loop never sees the call.
+        Anything else (out-of-order arrival, refs, async methods,
+        max_concurrency > 1) routes to the slow path, whose semantics are
+        authoritative."""
+        inst = self.hosted_actors.get(h.get("aid"))
+        if inst is None or inst.exiting:
+            return False
+        if (
+            h.get("nret", 1) != 1
+            or h.get("argrefs")
+            or h.get("borrows")
+            or h.get("trace")
+            or inst.max_concurrency != 1
+            or h.get("method") == "__rt_apply__"
+        ):
+            return False
+        method = getattr(inst.instance, h.get("method", ""), None)
+        if method is None or asyncio.iscoroutinefunction(method):
+            return False
+        if self._memory_monitor.is_pressing():
+            return False
+        caller, seq = h.get("caller", ""), h.get("seq", 0)
+        with inst.seq_lock:
+            if seq > 0 and seq != inst.next_seq.setdefault(caller, 1):
+                return False  # not next (or a retry duplicate): slow path
+            try:
+                inst.pool.submit(
+                    self._ring_execute_actor_task, inst, method, h, frames,
+                    rconn,
+                )
+            except RuntimeError:
+                return False  # pool shut down (actor being killed)
+            # Queued in order: admit the caller's next call right away.
+            if seq > 0:
+                inst.next_seq[caller] = seq + 1
+                ev = inst.buffered.get(caller, {}).pop(seq + 1, None)
+            else:
+                ev = None
+        if ev is not None:
+            try:
+                self.loop.call_soon_threadsafe(ev.set)
+            except RuntimeError:
+                pass
+        return True
+
+    def _ring_execute_actor_task(self, inst, method, h, frames, rconn):
+        t0 = time.time()
+        try:
+            arg_slots, plain, kwargs = self.ctx.deserialize_frames(frames)
+            args = [plain[i] for _k, i in arg_slots]  # eligibility: no refs
+            self.current_task_id.value = TaskID.from_hex(h["tid"])
+            self.current_actor_id.value = h["aid"]
+            self.put_counter.value = 0
+            try:
+                ok, result = True, method(*args, **kwargs)
+            except SystemExit:
+                # exit_actor(): mirror the slow path's clean-exit protocol.
+                self.hosted_actors.pop(h["aid"], None)
+                inst.exiting = True
+                self.gcs.notify(
+                    "actor_exited",
+                    {"actor_id": h["aid"], "clean": True,
+                     "reason": "exit_actor"},
+                )
+                rconn.send_reply(
+                    {"i": h["i"], "r": 1, "e": "ActorMissing: actor exited"},
+                    [],
+                )
+                return
+            except Exception as e:
+                ok, result = False, (e, traceback.format_exc())
+        except Exception as e:
+            ok, result = False, (e, traceback.format_exc())
+        self._ring_reply_result(h, ok, result, rconn)
+        inst.num_executed += 1
         self._record_task_event({
-            "task_id": h["tid"], "name": h.get("name") or h["fkey"],
-            "type": "NORMAL_TASK",
+            "task_id": h["tid"], "name": h["method"], "type": "ACTOR_TASK",
+            "actor_id": h["aid"],
             "state": "FINISHED" if ok else "FAILED",
             "start_time": t0, "end_time": time.time(),
             "node_id": self.node_id,
@@ -2716,22 +2815,31 @@ class CoreWorker:
     async def _admit_in_order(self, inst: _ActorInstance, caller: str, seq: int):
         if seq <= 0:
             return
-        nxt = inst.next_seq.setdefault(caller, 1)
-        if seq <= nxt:
-            return
-        waiters = inst.buffered.setdefault(caller, {})
-        ev = asyncio.Event()
-        waiters[seq] = ev
+        with inst.seq_lock:
+            nxt = inst.next_seq.setdefault(caller, 1)
+            if seq <= nxt:
+                return
+            waiters = inst.buffered.setdefault(caller, {})
+            ev = asyncio.Event()
+            waiters[seq] = ev
         await ev.wait()
 
     def _advance_seq(self, inst: _ActorInstance, caller: str, seq: int):
         if seq <= 0:
             return
-        if inst.next_seq.get(caller, 1) == seq:
+        with inst.seq_lock:
+            if inst.next_seq.get(caller, 1) != seq:
+                return
             inst.next_seq[caller] = seq + 1
             ev = inst.buffered.get(caller, {}).pop(seq + 1, None)
-            if ev is not None:
-                ev.set()
+        if ev is not None:
+            # asyncio.Event.set is loop-affine and the fast path advances
+            # from the ring pump thread; call_soon_threadsafe is legal from
+            # the loop thread too, so use it unconditionally.
+            try:
+                self.loop.call_soon_threadsafe(ev.set)
+            except RuntimeError:
+                pass  # loop closing; waiter is being cancelled anyway
 
     async def rpc_push_actor_task(self, h, frames, conn):
         """Execute an actor method (reference: direct PushActorTask gRPC +

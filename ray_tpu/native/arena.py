@@ -147,8 +147,10 @@ class NativeArenaStore:
                     # every process putting into this arena concurrently
                     rc = self._lib.rt_arena_copy(self._h, off + o, src, n)
                     if rc != 0:
-                        # never seal an unwritten payload (e.g. -EBADF from
-                        # a concurrent detach): readers would get garbage
+                        # Never seal an unwritten payload (readers would get
+                        # garbage) — and delete the created entry so the id
+                        # isn't wedged in kCreated holding its allocation.
+                        self._lib.rt_obj_delete(self._h, object_hex.encode())
                         raise RuntimeError(
                             f"arena_copy({object_hex}): errno {-rc}"
                         )

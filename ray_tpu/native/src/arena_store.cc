@@ -948,11 +948,14 @@ static bool decide_stream(uint64_t len) {
   static const uint64_t env_min = [] {
     const char* s = getenv("RT_STREAM_MIN_MB");
     if (s && *s) {
-      long v = strtol(s, nullptr, 10);
-      if (v > 0) return (uint64_t)v << 20;
-      if (v == 0) return (uint64_t)-1;  // 0 = never stream
+      char* end = nullptr;
+      long v = strtol(s, &end, 10);
+      if (end != s && *end == '\0') {  // unparseable input → auto, not "0"
+        if (v > 0) return (uint64_t)v << 20;
+        if (v == 0) return (uint64_t)-1;  // explicit 0 = never stream
+      }
     }
-    return (uint64_t)0;  // unset = auto-calibrate
+    return (uint64_t)0;  // unset/invalid = auto-calibrate
   }();
   if (env_min) return len >= env_min;
   constexpr uint64_t kAutoMin = 16ull << 20;
