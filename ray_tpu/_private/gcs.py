@@ -72,6 +72,7 @@ class ActorInfo:
     class_name: str = ""
     pg_id: Optional[str] = None
     bundle_index: int = -1
+    detached: bool = False  # lifetime="detached": survives its owner
 
     def to_public(self) -> dict:
         return {
@@ -145,6 +146,10 @@ class HeadService:
         self.server: Optional[protocol.RpcServer] = None
         self.addr: Optional[Tuple[str, int]] = None
         self._pending_waiters: List[asyncio.Future] = []  # resource-wait futures
+        self._last_reclaim = 0.0  # lease_reclaim publish rate limit
+        # conn-id -> actor ids whose owner is that connection (non-detached
+        # actors are destroyed when their owner disconnects)
+        self._conn_actors: Dict[int, set] = {}
         self.task_events: List[dict] = []  # bounded task-event buffer for state API
         self.jobs: Dict[str, dict] = {}
         self._schedule_rr = 0  # round-robin cursor
@@ -561,6 +566,10 @@ class HeadService:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 break
+            # Ask workers to return cached idle leases before blocking:
+            # a recent task burst can leave every CPU pinned by slots that
+            # are idle but inside their reaper window.
+            self._maybe_reclaim_leases([need])
             fut = asyncio.get_running_loop().create_future()
             self._pending_waiters.append(fut)
             self.pending_demands[id(fut)] = {
@@ -607,6 +616,25 @@ class HeadService:
         self._wake_waiters()
         return {}, []
 
+    def _maybe_reclaim_leases(self, needs: List[Dict[str, float]]):
+        """Publish lease_reclaim only when it could actually help and at
+        most ~4x/s: an infeasible request (bundle bigger than any node's
+        TOTAL capacity) must not flush every worker's lease cache once per
+        wait iteration for its whole timeout — that would disable the
+        cache cluster-wide for concurrent workloads."""
+        now = time.monotonic()
+        if now - self._last_reclaim < 0.25:
+            return
+        alive = [n for n in self.nodes.values() if n.alive]
+        for need in needs:
+            if not any(
+                all(n.resources.get(k, 0.0) >= v for k, v in need.items())
+                for n in alive
+            ):
+                return  # can't fit even on an empty node: reclaim won't help
+        self._last_reclaim = now
+        self.publish("lease_reclaim", {})
+
     def _wake_waiters(self):
         waiters, self._pending_waiters = self._pending_waiters, []
         for fut in waiters:
@@ -645,10 +673,17 @@ class HeadService:
             class_name=h.get("class_name", ""),
             pg_id=(h.get("strategy") or {}).get("pg_id"),
             bundle_index=(h.get("strategy") or {}).get("bundle_index", -1),
+            detached=h.get("lifetime") == "detached",
         )
         self.actors[actor_id] = info
         if name:
             self.named_actors[(ns, name)] = actor_id
+        if not info.detached:
+            # Non-detached actors die with their owner (reference:
+            # GcsActorManager destroys an actor when its owner worker/job
+            # exits — ``gcs_actor_manager.cc OnWorkerDead/OnJobFinished``).
+            # The owner is whoever issued create_actor on this connection.
+            self._track_actor_owner(conn, actor_id)
         ok = await self._schedule_actor(info, h.get("strategy") or {})
         if not ok:
             info.state = "DEAD"
@@ -659,6 +694,11 @@ class HeadService:
     async def _schedule_actor(self, info: ActorInfo, strategy: dict) -> bool:
         deadline = time.monotonic() + 30.0
         while time.monotonic() < deadline:
+            if info.state == "DEAD":
+                # Killed while pending (e.g. owner disconnected mid-wait):
+                # placing it now would orphan an ALIVE actor whose cleanup
+                # already ran and permanently leak its node resources.
+                return False
             node = self._pick_node(info.resources, strategy)
             if node is None:
                 fut = asyncio.get_running_loop().create_future()
@@ -693,6 +733,19 @@ class HeadService:
                 raise
             except protocol.ConnectionLost:
                 continue  # node died mid-create; try another
+            if info.state == "DEAD":
+                # Owner disconnected during the create RPC: its cleanup saw
+                # PENDING (nothing to kill yet), so undo the placement here.
+                try:
+                    await node.conn.call(
+                        "kill_actor", {"actor_id": info.actor_id}
+                    )
+                except (protocol.RpcError, protocol.ConnectionLost):
+                    pass
+                if not strategy.get("pg_id"):
+                    self._node_release(node, info.resources)
+                    self._wake_waiters()
+                return False
             info.node_id = node.node_id
             info.addr = node.addr
             info.state = "ALIVE"
@@ -770,6 +823,53 @@ class HeadService:
         await self._on_actor_dead(actor, h.get("reason", "actor exited"))
         return {}, []
 
+    def _track_actor_owner(self, conn, actor_id: str):
+        owned = self._conn_actors.setdefault(id(conn), set())
+        owned.add(actor_id)
+        if getattr(conn, "_rt_actor_cleanup", False):
+            return
+        conn._rt_actor_cleanup = True
+        prev = conn.on_close
+        loop = asyncio.get_event_loop()
+        key = id(conn)
+
+        def _on_close(c):
+            if prev is not None:
+                try:
+                    prev(c)
+                except Exception:
+                    logger.exception("chained on_close failed")
+            if self._shutting_down or loop.is_closed():
+                self._conn_actors.pop(key, None)
+                return
+            try:
+                loop.call_soon_threadsafe(
+                    lambda: loop.create_task(self._on_actor_owner_closed(key))
+                )
+            except RuntimeError:
+                pass
+
+        conn.on_close = _on_close
+
+    async def _on_actor_owner_closed(self, key: int):
+        """Owner connection gone: kill its non-detached actors (they may be
+        ALIVE on some node, or PENDING). Named entries are dropped so the
+        name becomes reusable."""
+        for actor_id in self._conn_actors.pop(key, set()):
+            actor = self.actors.get(actor_id)
+            if actor is None or actor.state == "DEAD":
+                continue
+            actor.max_restarts = 0
+            node = self.nodes.get(actor.node_id) if actor.node_id else None
+            if node is not None and node.conn is not None and actor.state == "ALIVE":
+                try:
+                    await node.conn.call(
+                        "kill_actor", {"actor_id": actor.actor_id}
+                    )
+                except (protocol.RpcError, protocol.ConnectionLost):
+                    pass
+            await self._on_actor_dead(actor, "owner disconnected")
+
     async def rpc_kill_actor(self, h, frames, conn):
         actor = self.actors.get(h["actor_id"])
         if actor is None:
@@ -827,6 +927,10 @@ class HeadService:
                 pg.state = "CREATED"
                 self.publish(f"pg:{pg_id}", pg.to_public())
                 return {"state": "CREATED", "bundle_nodes": pg.bundle_nodes}, []
+            # Same demand-driven reclaim as rpc_lease: idle cached slots on
+            # workers are the usual reason an otherwise-free cluster can't
+            # place a bundle.
+            self._maybe_reclaim_leases(bundles)
             fut = asyncio.get_running_loop().create_future()
             self._pending_waiters.append(fut)
             try:

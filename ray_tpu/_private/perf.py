@@ -145,7 +145,8 @@ def _client_actor_burst(addr: str, n: int, q):
     t0 = _time.perf_counter()
     rt.get([a.m.remote() for _ in range(n)])
     q.put((os.getpid(), n / (_time.perf_counter() - t0)))
-    rt.shutdown()
+    rt.kill(a)  # return the actor's CPU before exiting — leaked actors
+    rt.shutdown()  # would starve every later bench leg
 
 
 def bench_actor_calls_n_n(clients: int = 4, n: int = 1000) -> float:

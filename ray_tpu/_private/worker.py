@@ -36,6 +36,7 @@ from concurrent.futures import (
     ThreadPoolExecutor,
     TimeoutError as SyncTimeoutError,
 )
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -258,6 +259,11 @@ class CoreWorker:
         )
         self._reconstructing: set = set()
         self._task_events_buf: List[dict] = []
+        # GC'd ObjectRef ids awaiting a refcount decrement on the core loop
+        # (deque: appends are thread-safe under the GIL; drained in one
+        # callback per burst — see _install_ref_hooks).
+        self._release_queue: deque = deque()
+        self._release_drain_scheduled = False
         from ray_tpu._private.memory_monitor import MemoryMonitor
 
         self._memory_monitor = MemoryMonitor()
@@ -389,6 +395,14 @@ class CoreWorker:
             lambda data, frames: self._evict_freed(data.get("oids", []))
         )
         await self.gcs.call("subscribe", {"channel": "object_free"})
+        # Demand-driven lease return: the head asks when a placement can't
+        # fit; cached idle slots go back NOW instead of after the reaper's
+        # idle window (otherwise a task burst pins node CPUs for ~1s and a
+        # placement-group create right behind it stalls).
+        self.pubsub_handlers.setdefault("lease_reclaim", []).append(
+            lambda data, frames: self._reclaim_idle_leases()
+        )
+        await self.gcs.call("subscribe", {"channel": "lease_reclaim"})
         self.loop.create_task(self._task_event_flusher())
         if self.is_driver:
             await self.gcs.call("register_job", {"job_id": self.job_id.hex()})
@@ -407,14 +421,22 @@ class CoreWorker:
         worker = self
 
         def release(object_id: ObjectID):
+            # Coalesce: a container GC can drop 10k+ refs back-to-back (one
+            # __del__ per element); one loop callback per ref floods the
+            # event loop for seconds and starves control RPCs (observed:
+            # 150x pg-churn collapse right after a 10k-ref get). Queue the
+            # hex and schedule a single drain per burst instead.
             if worker._shutdown or worker.loop is None:
                 return
+            q = worker._release_queue
+            q.append(object_id.hex())
+            if worker._release_drain_scheduled:
+                return
+            worker._release_drain_scheduled = True
             try:
-                worker.loop.call_soon_threadsafe(
-                    worker._dec_ref_local, object_id.hex()
-                )
+                worker.loop.call_soon_threadsafe(worker._drain_releases)
             except RuntimeError:
-                pass
+                worker._release_drain_scheduled = False
 
         def on_deserialize(ref: ObjectRef):
             # A ref materialized in this process counts as a local reference;
@@ -768,6 +790,29 @@ class CoreWorker:
         rec["count"] -= 1
         self._maybe_free(oid)
 
+    def _drain_releases(self):
+        """Process every queued ObjectRef release in one loop callback.
+
+        Shm frees are announced to the head as ONE grouped object_free
+        notify instead of one per object (reference batches refcount
+        traffic the same way: ``core_worker/reference_counter`` flushes
+        deltas, not per-ref RPCs)."""
+        self._release_drain_scheduled = False
+        q = self._release_queue
+        freed: List[str] = []
+        while q:
+            oid = q.popleft()
+            rec = self.owned.get(oid)
+            if rec is None:
+                continue
+            rec["count"] -= 1
+            self._maybe_free(oid, free_sink=freed)
+        if freed:
+            try:
+                self.gcs.notify("object_free", {"oids": freed})
+            except protocol.ConnectionLost:
+                pass
+
     def _record_lineage(self, tid_hex, header, frames, resources, strategy,
                         nret):
         """Remember a task spec while any of its return refs is alive, so a
@@ -800,7 +845,7 @@ class CoreWorker:
             self._lineage_bytes -= rec["bytes"]
             self._lineage.pop(oid[:48], None)
 
-    def _maybe_free(self, oid: str):
+    def _maybe_free(self, oid: str, free_sink: Optional[List[str]] = None):
         rec = self.owned.get(oid)
         if rec is None or rec["count"] > 0 or rec["borrows"] > 0:
             return
@@ -811,10 +856,13 @@ class CoreWorker:
         if entry is not None and entry[0] == "shm":
             meta = entry[1]
             self.shm.free(oid, meta)
-            try:
-                self.gcs.notify("object_free", {"oids": [oid]})
-            except protocol.ConnectionLost:
-                pass
+            if free_sink is not None:
+                free_sink.append(oid)  # caller sends one grouped notify
+            else:
+                try:
+                    self.gcs.notify("object_free", {"oids": [oid]})
+                except protocol.ConnectionLost:
+                    pass
         # Refs nested inside this value were pinned for its lifetime.
         if rec.get("nested"):
             self._release_borrows(rec["nested"])
@@ -1799,6 +1847,22 @@ class CoreWorker:
         finally:
             lease_set.reaper_running = False
 
+    def _reclaim_idle_leases(self):
+        """Head-requested lease reclamation (reference: raylet returns
+        leased workers on demand when the cluster is resource-starved).
+        Every cached slot with no in-flight task goes back immediately;
+        sets with queued work keep theirs."""
+        for lease_set in self.leases.values():
+            if lease_set.pending:
+                continue
+            keep = []
+            for s in lease_set.slots:
+                if s.busy == 0:
+                    self._release_slot(lease_set, s)
+                else:
+                    keep.append(s)
+            lease_set.slots = keep
+
     def _release_slot(self, lease_set: _LeaseSet, slot: _LeaseSlot):
         try:
             self.gcs.notify(
@@ -1861,7 +1925,12 @@ class CoreWorker:
         namespace: str = "default",
         get_if_exists: bool = False,
         runtime_env: Optional[dict] = None,
+        lifetime: Optional[str] = None,
     ):
+        if lifetime not in (None, "detached"):
+            raise ValueError(
+                f"lifetime must be None or 'detached', got {lifetime!r}"
+            )
         actor_id = ActorID.of(self.job_id)
         cls_key = self.export_function(cls)
         frames, ref_ids, borrows = self._serialize_args(args, kwargs)
@@ -1876,6 +1945,7 @@ class CoreWorker:
             "name": name,
             "namespace": namespace,
             "get_if_exists": get_if_exists,
+            "lifetime": lifetime,
             # env_vars/working_dir/py_modules apply to the hosted actor;
             # pip/uv actor isolation (a dedicated venv-worker per actor)
             # is not supported — validate() rejects unknown plugins and

@@ -135,6 +135,7 @@ class ActorClass:
             namespace=opts.get("namespace", "default"),
             get_if_exists=opts.get("get_if_exists", False),
             runtime_env=opts.get("runtime_env"),
+            lifetime=opts.get("lifetime"),
         )
         return ActorHandle(
             actor_id if isinstance(actor_id, str) else actor_id.hex(),

@@ -158,6 +158,9 @@ class NativeArenaStore:
             buf[o : o + n] = f
         rc = self._lib.rt_obj_seal(self._h, object_hex.encode())
         if rc != 0:
+            # Same leak class as a failed copy: never leave the id wedged
+            # in kCreated holding its allocation.
+            self._lib.rt_obj_delete(self._h, object_hex.encode())
             raise RuntimeError(f"obj_seal({object_hex}): errno {-rc}")
         self._created[object_hex] = True
         return {"arena": self.name, "size": total}

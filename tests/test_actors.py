@@ -171,3 +171,53 @@ def test_async_actor_blocking_get(rt_start):
 
     a = AsyncGetter.remote()
     assert ray_tpu.get(a.fetch.remote(), timeout=30) == 8
+
+
+def test_owner_disconnect_kills_actor_detached_survives(rt_start):
+    """Non-detached actors die when their owner driver disconnects; a
+    lifetime="detached" actor survives it (reference: GcsActorManager
+    destroys non-detached actors on owner death, gcs_actor_manager.cc)."""
+    import subprocess
+    import sys
+
+    from ray_tpu._private.worker import get_global_worker
+
+    addr = "%s:%d" % get_global_worker().gcs_addr
+    script = f"""
+import ray_tpu
+ray_tpu.init(address="{addr}")
+
+@ray_tpu.remote
+class P:
+    def ping(self):
+        return "ok"
+
+a = P.options(name="goner").remote()
+b = P.options(name="keeper", lifetime="detached").remote()
+assert ray_tpu.get(a.ping.remote(), timeout=30) == "ok"
+assert ray_tpu.get(b.ping.remote(), timeout=30) == "ok"
+import os; os._exit(0)  # hard exit: no clean shutdown, conn just drops
+"""
+    r = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=120,
+    )
+    assert r.returncode == 0, r.stderr
+
+    # detached actor is still reachable by name from this (other) driver
+    keeper = ray_tpu.get_actor("keeper")
+    assert ray_tpu.get(keeper.ping.remote(), timeout=30) == "ok"
+
+    # non-detached actor was destroyed when its owner's connection dropped
+    deadline = time.time() + 30
+    gone = False
+    while time.time() < deadline:
+        try:
+            g = ray_tpu.get_actor("goner")
+            ray_tpu.get(g.ping.remote(), timeout=5)
+        except Exception:
+            gone = True
+            break
+        time.sleep(0.2)
+    assert gone, "non-detached actor survived owner disconnect"
+    ray_tpu.kill(keeper)
