@@ -157,12 +157,18 @@ def param_axes(config: GPT2Config) -> Dict[str, Any]:
 
 
 def _remat_policy(config):
-    """Checkpoint policy for the block body. Full remat costs ~33% extra
-    FLOPs re-running every matmul in backward; "dots" keeps matmul outputs
-    resident and recomputes only the cheap elementwise work."""
+    """Checkpoint policy for the block body. "full" recomputes everything;
+    the default keeps the flash-attention forward's named outputs (out +
+    logsumexp — the residuals its pallas backward consumes) so the backward
+    pass never re-runs the attention kernel, while everything else remats."""
     if getattr(config, "remat_policy", "dots") == "full":
         return None
-    return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    return jax.checkpoint_policies.save_from_both_policies(
+        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        jax.checkpoint_policies.save_only_these_names(
+            "flash_out", "flash_lse"
+        ),
+    )
 
 
 def _layer_norm(x, g, b, eps=1e-5):

@@ -8,8 +8,9 @@ ours. Design:
   it is the autodiff path.
 - ``flash_attention``: blockwise online-softmax pallas kernel (VMEM-resident
   q/k/v blocks, f32 accumulators, causal short-circuit per block row).
-  Forward = pallas; backward = recompute via the XLA path (custom_vjp), so
-  training gets flash's forward memory profile with correct grads.
+  Forward AND backward are pallas (FlashAttention-2-style tiling): the
+  forward saves per-row logsumexp; the backward streams K/V (dq) and Q/dO
+  (dk/dv) blocks and never materializes the [Tq, Tk] score matrix.
 - ``attention``: dispatcher — pallas on TPU, interpret-mode pallas or XLA
   elsewhere (tests run the same kernel code on the CPU mesh).
 
@@ -69,10 +70,12 @@ DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 256
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                  scale: float, seq_k: int):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_k: int,
+                  causal: bool, scale: float, seq_k: int):
     """One (batch*head, q_block) program: stream K/V blocks with online
-    softmax. Block shapes: q/o [1, Bq, D], k/v [1, Tk, D]."""
+    softmax. Block shapes: q/o [1, Bq, D], k/v [1, Tk, D], lse [1, 8, Bq].
+    The logsumexp row statistics (written only when the training path asks
+    for them) feed the pallas backward."""
     q_idx = pl.program_id(1)
     block_q = q_ref.shape[1]
     d = q_ref.shape[2]
@@ -81,7 +84,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
     num_k_blocks = pl.cdiv(seq_k, block_k)
     if causal:
         # Highest K block this Q block row can see (short-circuits the rest).
-        last_block = jax.lax.div((q_idx + 1) * block_q - 1, block_k) + 1
+        last_block = ((q_idx + 1) * block_q - 1) // block_k + 1
         num_iter = jnp.minimum(num_k_blocks, last_block)
     else:
         num_iter = num_k_blocks
@@ -118,10 +121,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     o_acc, m, l = jax.lax.fori_loop(0, num_iter, body, (o0, m0, l0))
     o_ref[0] = (o_acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    if lse_ref is not None:
+        # lse = m + log(l). Stored 8x-replicated on the sublane dim: mosaic
+        # requires block shapes (8, 128)-divisible, so a [Bq]-vector per
+        # program rides as an [8, Bq] tile (negligible bytes, legal layout).
+        lse = jnp.maximum(m, NEG_INF) + jnp.log(jnp.maximum(l, 1e-30))
+        lse_ref[0] = jnp.broadcast_to(lse[:, 0][None, :], (8, block_q))
 
 
 def _flash_fwd_impl(q, k, v, *, causal: bool, block_q: int, block_k: int,
-                    interpret: bool) -> jax.Array:
+                    interpret: bool, with_lse: bool = False):
     B, Tq, H, D = q.shape
     _, Tk, Hkv, _ = k.shape
     if Hkv != H:
@@ -145,22 +154,237 @@ def _flash_fwd_impl(q, k, v, *, causal: bool, block_q: int, block_k: int,
     kf = k.transpose(0, 2, 1, 3).reshape(B * H, Tk_p, D)
     vf = v.transpose(0, 2, 1, 3).reshape(B * H, Tk_p, D)
     grid = (B * H, Tq_p // block_q)
-    out = pl.pallas_call(
+    kernel = functools.partial(
+        _flash_kernel, block_k=block_k, causal=causal, scale=scale, seq_k=Tk
+    )
+    in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
+        pl.BlockSpec((1, Tk_p, D), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, Tk_p, D), lambda b, i: (b, 0, 0)),
+    ]
+    o_shape = jax.ShapeDtypeStruct((B * H, Tq_p, D), q.dtype)
+    o_spec = pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0))
+    if with_lse:
+        out, lse = pl.pallas_call(
+            kernel,
+            out_shape=(
+                o_shape,
+                jax.ShapeDtypeStruct((B * H, 8, Tq_p), jnp.float32),
+            ),
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=(
+                o_spec,
+                pl.BlockSpec((1, 8, block_q), lambda b, i: (b, 0, i)),
+            ),
+            interpret=interpret,
+        )(qf, kf, vf)
+    else:
+        # Inference/no-grad path: skip the LSE output entirely (it would be
+        # pure wasted write bandwidth on every serving forward).
+        out = pl.pallas_call(
+            kernel,
+            out_shape=o_shape,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=o_spec,
+            interpret=interpret,
+        )(qf, kf, vf)
+        lse = None
+    out = out.reshape(B, H, Tq_p, D).transpose(0, 2, 1, 3)
+    if Tq_p != Tq:
+        out = out[:, :Tq]
+    if with_lse:
+        return out, lse  # lse stays in [B*H, Tq_p] layout for the backward
+    return out
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, block_q: int, causal: bool,
+                          scale: float, seq_q: int, seq_k: int):
+    """One (batch*head, k_block) program: accumulate dK/dV for this key
+    block by streaming Q/dO blocks. Shapes: k/v/dk/dv [1, Bk, D];
+    q/do [1, Tq, D]; lse/delta [1, 8, Tq] (row 0 is the data; the 8 rows
+    are sublane replication for mosaic's block-shape rules)."""
+    k_idx = pl.program_id(1)
+    block_k = k_ref.shape[1]
+    d = k_ref.shape[2]
+    k = k_ref[0].astype(jnp.float32)  # [Bk, D]
+    v = v_ref[0].astype(jnp.float32)
+
+    num_q_blocks = pl.cdiv(seq_q, block_q)
+    if causal:
+        # Lowest Q block that can see this K block (earlier ones are fully
+        # masked): first q with q_pos >= k_idx*block_k.
+        start = (k_idx * block_k) // block_q
+    else:
+        start = 0
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        q_blk = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)]
+        s = scale * jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32)
+        q_pos = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = k_idx * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = (q_pos < seq_q) & (k_pos < seq_k)
+        if causal:
+            mask = mask & (q_pos >= k_pos)
+        # exp(NEG_INF - lse) underflows to 0 for masked/pad rows; force it
+        # for bit-exact zeros.
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)  # [Bq, Bk]
+        dv_new = dv_acc + jnp.dot(p.T, do_blk,
+                                  preferred_element_type=jnp.float32)
+        dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk_new = dk_acc + jnp.dot(ds.T, q_blk,
+                                  preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start, num_q_blocks, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, block_k: int, causal: bool, scale: float,
+                         seq_k: int):
+    """One (batch*head, q_block) program: accumulate dQ for this query block
+    by streaming K/V blocks. Shapes: q/do/dq [1, Bq, D]; k/v [1, Tk, D];
+    lse/delta [1, 8, Bq] (row 0 is the data)."""
+    q_idx = pl.program_id(1)
+    block_q = q_ref.shape[1]
+    d = q_ref.shape[2]
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, 0]
+    delta = delta_ref[0, 0]
+
+    num_k_blocks = pl.cdiv(seq_k, block_k)
+    if causal:
+        last_block = ((q_idx + 1) * block_q - 1) // block_k + 1
+        num_iter = jnp.minimum(num_k_blocks, last_block)
+    else:
+        num_iter = num_k_blocks
+
+    def body(i, dq_acc):
+        k_blk = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = scale * jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = i * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = k_pos < seq_k
+        if causal:
+            mask = mask & (q_pos >= k_pos)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq_acc + jnp.dot(ds, k_blk,
+                                preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(
+        0, num_iter, body, jnp.zeros((block_q, d), jnp.float32)
+    )
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_impl(q, k, v, out, lse, g, *, causal: bool, block_q: int,
+                    block_k: int, interpret: bool):
+    """Pallas flash backward: no [Tq, Tk] materialization (reference-free
+    design; same tiling as FlashAttention-2). Returns (dq, dk, dv) with
+    GQA head-group reduction applied."""
+    B, Tq, H, D = q.shape
+    _, Tk, Hkv, _ = k.shape
+    rep = H // Hkv
+    if rep != 1:
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    block_q = min(block_q, Tq)
+    block_k = min(block_k, Tk)
+    scale = D ** -0.5
+    Tq_p = block_q * ((Tq + block_q - 1) // block_q)
+    Tk_p = block_k * ((Tk + block_k - 1) // block_k)
+    if Tq_p != Tq:
+        q = jnp.pad(q, ((0, 0), (0, Tq_p - Tq), (0, 0), (0, 0)))
+        g = jnp.pad(g, ((0, 0), (0, Tq_p - Tq), (0, 0), (0, 0)))
+        out = jnp.pad(out, ((0, 0), (0, Tq_p - Tq), (0, 0), (0, 0)))
+    if Tk_p != Tk:
+        k = jnp.pad(k, ((0, 0), (0, Tk_p - Tk), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, Tk_p - Tk), (0, 0), (0, 0)))
+    qf = q.transpose(0, 2, 1, 3).reshape(B * H, Tq_p, D)
+    kf = k.transpose(0, 2, 1, 3).reshape(B * H, Tk_p, D)
+    vf = v.transpose(0, 2, 1, 3).reshape(B * H, Tk_p, D)
+    dof = g.transpose(0, 2, 1, 3).reshape(B * H, Tq_p, D)
+    of = out.transpose(0, 2, 1, 3).reshape(B * H, Tq_p, D)
+    # delta_i = rowsum(dO_i * O_i) — cheap elementwise reduce in XLA,
+    # replicated to the same [B*H, 8, Tq] sublane layout as lse.
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(delta[:, None, :], (B * H, 8, Tq_p))
+
+    dkv = pl.pallas_call(
         functools.partial(
-            _flash_kernel, block_k=block_k, causal=causal, scale=scale, seq_k=Tk
+            _flash_bwd_dkv_kernel, block_q=block_q, causal=causal,
+            scale=scale, seq_q=Tq, seq_k=Tk,
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((B * H, Tk_p, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Tk_p, D), q.dtype),
+        ),
+        grid=(B * H, Tk_p // block_k),
+        in_specs=[
+            pl.BlockSpec((1, Tq_p, D), lambda b, j: (b, 0, 0)),   # q
+            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),  # k
+            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),  # v
+            pl.BlockSpec((1, Tq_p, D), lambda b, j: (b, 0, 0)),   # do
+            pl.BlockSpec((1, 8, Tq_p), lambda b, j: (b, 0, 0)),   # lse
+            pl.BlockSpec((1, 8, Tq_p), lambda b, j: (b, 0, 0)),   # delta
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, j: (b, j, 0)),
+        ),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+    dk, dv = dkv
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, block_k=block_k, causal=causal,
+            scale=scale, seq_k=Tk,
         ),
         out_shape=jax.ShapeDtypeStruct((B * H, Tq_p, D), q.dtype),
-        grid=grid,
+        grid=(B * H, Tq_p // block_q),
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, Tk_p, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, Tk_p, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),  # q
+            pl.BlockSpec((1, Tk_p, D), lambda b, i: (b, 0, 0)),   # k
+            pl.BlockSpec((1, Tk_p, D), lambda b, i: (b, 0, 0)),   # v
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),  # do
+            pl.BlockSpec((1, 8, block_q), lambda b, i: (b, 0, i)),  # lse
+            pl.BlockSpec((1, 8, block_q), lambda b, i: (b, 0, i)),  # delta
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
         interpret=interpret,
-    )(qf, kf, vf)
-    out = out.reshape(B, H, Tq_p, D).transpose(0, 2, 1, 3)
-    return out[:, :Tq] if Tq_p != Tq else out
+    )(qf, kf, vf, dof, lse, delta)
+
+    dq = dq.reshape(B, H, Tq_p, D).transpose(0, 2, 1, 3)[:, :Tq]
+    dk = dk.reshape(B, H, Tk_p, D).transpose(0, 2, 1, 3)[:, :Tk]
+    dv = dv.reshape(B, H, Tk_p, D).transpose(0, 2, 1, 3)[:, :Tk]
+    if rep != 1:
+        dk = dk.reshape(B, Tk, Hkv, rep, D).sum(axis=3)
+        dv = dv.reshape(B, Tk, Hkv, rep, D).sum(axis=3)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -168,7 +392,10 @@ def flash_attention(q, k, v, causal: bool = True,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
                     interpret: bool = False):
-    """Flash attention: pallas forward, recompute-XLA backward."""
+    """Flash attention: pallas forward AND pallas backward (LSE saved by
+    the forward; backward never materializes the [Tq, Tk] score matrix —
+    round 2 recomputed attention in XLA for grads, which put three dense
+    [B, H, Tq, Tk] tensors back into every train step)."""
     return _flash_fwd_impl(
         q, k, v, causal=causal, block_q=block_q, block_k=block_k,
         interpret=interpret,
@@ -176,23 +403,42 @@ def flash_attention(q, k, v, causal: bool = True,
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out = _flash_fwd_impl(
+    out, lse = _flash_fwd_impl(
         q, k, v, causal=causal, block_q=block_q, block_k=block_k,
-        interpret=interpret,
+        interpret=interpret, with_lse=True,
     )
-    return out, (q, k, v)
+    # Named so remat policies can keep them: without this, a jax.checkpoint
+    # around the transformer block re-runs the flash forward a second time
+    # in the backward pass just to rebuild these residuals.
+    from jax.ad_checkpoint import checkpoint_name
+
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: attention_xla(q, k, v, causal=causal), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_bwd_impl(
+        q, k, v, out, lse, g, causal=causal, block_q=block_q,
+        block_k=block_k, interpret=interpret,
+    )
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
 _PALLAS_OK = None
+
+
+def _trace_state_clean() -> bool:
+    """True when no jax trace is ambient (safe to execute eagerly)."""
+    try:
+        from jax._src import core as _core
+
+        return isinstance(_core.trace_ctx.trace, _core.EvalTrace)
+    except Exception:
+        return False
 
 
 def pallas_available() -> bool:
@@ -203,10 +449,21 @@ def pallas_available() -> bool:
     global _PALLAS_OK
     if _PALLAS_OK is None:
         try:
-            q = jnp.zeros((1, 128, 1, 128), jnp.float32)
-            jax.jit(
+            # The dispatcher runs inside model jit traces, where an inner
+            # jit call is inlined and returns a tracer — the round-2 probe
+            # mis-diagnosed every backend as pallas-less (AttributeError on
+            # tracer.block_until_ready; flash silently disabled). AOT
+            # lower+compile traces the kernel fresh, independent of ambient
+            # trace state, and exercises the mosaic lowering that decides
+            # availability. Outside any trace, also run it for real.
+            spec = jax.ShapeDtypeStruct((1, 128, 1, 128), jnp.float32)
+            fn = jax.jit(
                 lambda q: flash_attention(q, q, q, True, 128, 128, False)
-            )(q).block_until_ready()
+            )
+            compiled = fn.lower(spec).compile()
+            if _trace_state_clean():
+                out = compiled(jnp.zeros(spec.shape, spec.dtype))
+                jax.block_until_ready(out)
             _PALLAS_OK = True
         except Exception as e:
             import logging

@@ -25,7 +25,7 @@ def chunked_softmax_xent(
     head_w: jax.Array,
     targets: jax.Array,
     mask: Optional[jax.Array] = None,
-    chunk: int = 128,
+    chunk: int = 512,
 ) -> jax.Array:
     """Mean next-token NLL without a [B, T, V] intermediate.
 
@@ -53,11 +53,24 @@ def chunked_softmax_xent(
     def body(carry, xs):
         s, cnt = carry
         xcb, tcb, mcb = xs
-        logits = jnp.einsum(
-            "bce,ve->bcv", xcb, head_w.astype(xcb.dtype)
-        ).astype(jnp.float32)
-        lse = jax.scipy.special.logsumexp(logits, axis=-1)       # [B, c]
-        gold = jnp.take_along_axis(logits, tcb[..., None], -1)[..., 0]
+        # Keep the [B, c, V] tensor in the activation dtype: a float32 copy
+        # here doubles the chunk's HBM traffic AND gets materialized (it
+        # would have two consumers). The reductions below cast f32 inside
+        # their fusions instead.
+        logits = jnp.einsum("bce,ve->bcv", xcb, head_w.astype(xcb.dtype))
+        m = jnp.max(logits, axis=-1).astype(jnp.float32)          # [B, c]
+        expsum = jnp.sum(
+            jnp.exp((logits.astype(jnp.float32) - m[..., None])), axis=-1
+        )
+        lse = m + jnp.log(expsum)
+        # Gold logit recomputed exactly in f32 as a row dot — cheaper and
+        # more precise than gathering from the low-precision logits.
+        w_gold = head_w[tcb]                                      # [B, c, E]
+        gold = jnp.einsum(
+            "bce,bce->bc",
+            xcb.astype(jnp.float32),
+            w_gold.astype(jnp.float32),
+        )
         s = s + ((lse - gold) * mcb).sum()
         cnt = cnt + mcb.sum()
         return (s, cnt), None

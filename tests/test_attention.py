@@ -105,3 +105,27 @@ def test_flash_ragged_seq_len(causal, T):
     out = flash_attention(q, k, v, causal, 64, 64, True)
     ref = attention_xla(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_bwd_kernel_gqa_and_ragged(causal):
+    """Pallas backward kernel: GQA head-group reduction + pad-row masking
+    (q rows past seq end must contribute nothing to dk/dv)."""
+    key = jax.random.PRNGKey(3)
+    ks = jax.random.split(key, 4)
+    B, T, H, Hkv, D = 1, 100, 4, 2, 32
+    q = jax.random.normal(ks[0], (B, T, H, D), jnp.float32)
+    k = jax.random.normal(ks[1], (B, T, Hkv, D), jnp.float32)
+    v = jax.random.normal(ks[2], (B, T, Hkv, D), jnp.float32)
+    g = jax.random.normal(ks[3], (B, T, H, D), jnp.float32)
+
+    def loss_flash(q, k, v):
+        return jnp.vdot(flash_attention(q, k, v, causal, 64, 64, True), g)
+
+    def loss_xla(q, k, v):
+        return jnp.vdot(attention_xla(q, k, v, causal=causal), g)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4, rtol=2e-4)
