@@ -264,6 +264,15 @@ class CoreWorker:
         # callback per burst — see _install_ref_hooks).
         self._release_queue: deque = deque()
         self._release_drain_scheduled = False
+        # Borrower-side refcounts for refs we deserialized but do not own:
+        # hex -> {"count": local live refs, "owner": addr}. A first
+        # deserialize registers a borrow with the owner; the last local
+        # release returns it (reference: borrow tracking in
+        # ``reference_counter.h`` — the sender's credit only pins the ref
+        # for the CONTAINER's lifetime, so holders must pin their own).
+        self.borrowed: Dict[str, dict] = {}
+        self._borrow_queue: deque = deque()
+        self._borrow_drain_scheduled = False
         from ray_tpu._private.memory_monitor import MemoryMonitor
 
         self._memory_monitor = MemoryMonitor()
@@ -428,20 +437,25 @@ class CoreWorker:
             # hex and schedule a single drain per burst instead.
             if worker._shutdown or worker.loop is None:
                 return
-            q = worker._release_queue
-            q.append(object_id.hex())
-            if worker._release_drain_scheduled:
-                return
-            worker._release_drain_scheduled = True
-            try:
-                worker.loop.call_soon_threadsafe(worker._drain_releases)
-            except RuntimeError:
-                worker._release_drain_scheduled = False
+            worker._enqueue_ref_op(("dec", object_id.hex()))
 
         def on_deserialize(ref: ObjectRef):
-            # A ref materialized in this process counts as a local reference;
-            # the owner was already credited a borrow by the sender.
-            pass
+            # A materialized ref must pin itself: the sender's credit dies
+            # with the containing object, and the user may outlive it
+            # (e.g. shuffle piece refs returned from a map task).
+            if worker._shutdown or worker.loop is None:
+                return
+            owner = tuple(ref.owner_address or ())
+            if not owner:
+                return
+            worker._borrow_queue.append((ref.id().hex(), owner))
+            if worker._borrow_drain_scheduled:
+                return
+            worker._borrow_drain_scheduled = True
+            try:
+                worker.loop.call_soon_threadsafe(worker._drain_borrows)
+            except RuntimeError:
+                worker._borrow_drain_scheduled = False
 
         ObjectRef._release_hook = release
         ObjectRef._deserialize_hook = on_deserialize
@@ -623,12 +637,12 @@ class CoreWorker:
                 # reply once registered (the owner resolves meta via head).
                 tid = TaskID.from_hex(h["tid"])
                 regs = []
-                for i, sobj in big:
+                for i, sobj, ret in big:
                     oid = ObjectID.for_return(tid, i).hex()
                     meta = self._with_xfer(
                         self.shm.put_frames(oid, sobj.to_frames(copy=False))
                     )
-                    rets[i] = {"kind": "shm", "meta": meta}
+                    rets[i] = {**ret, "kind": "shm", "meta": meta}
                     regs.append((oid, meta))
 
                 async def finish():
@@ -790,23 +804,78 @@ class CoreWorker:
         rec["count"] -= 1
         self._maybe_free(oid)
 
+    def _drain_borrows(self):
+        """Register queued deserialize-time borrows (one loop callback per
+        burst; one grouped add_borrow notify per owner)."""
+        self._borrow_drain_scheduled = False
+        q = self._borrow_queue
+        to_notify: Dict[tuple, List[str]] = {}
+        my_addr = tuple(self.addr or ())
+        while q:
+            oid, owner = q.popleft()
+            if owner == my_addr:
+                rec = self.owned.get(oid)
+                if rec is not None:
+                    rec["count"] += 1  # a local materialized copy
+                continue
+            b = self.borrowed.get(oid)
+            if b is None:
+                self.borrowed[oid] = {"count": 1, "owner": owner}
+                to_notify.setdefault(owner, []).append(oid)
+            else:
+                b["count"] += 1
+        for owner, oids in to_notify.items():
+            self.loop.create_task(
+                self._notify_owner_many(owner, "add_borrow", oids)
+            )
+
     def _drain_releases(self):
         """Process every queued ObjectRef release in one loop callback.
 
         Shm frees are announced to the head as ONE grouped object_free
         notify instead of one per object (reference batches refcount
         traffic the same way: ``core_worker/reference_counter`` flushes
-        deltas, not per-ref RPCs)."""
+        deltas, not per-ref RPCs). Borrowed (foreign-owned) refs return
+        their borrow to the owner when the last local copy dies."""
         self._release_drain_scheduled = False
+        # adds queued in the same window must reach the owner first
+        self._drain_borrows()
         q = self._release_queue
         freed: List[str] = []
+        to_release: Dict[tuple, List[str]] = {}
+        to_add: Dict[tuple, List[str]] = {}
+        my_addr = tuple(self.addr or ())
         while q:
-            oid = q.popleft()
+            kind, payload = q.popleft()
+            if kind == "pin":
+                for oid, owner in payload:
+                    rec = self.owned.get(oid)
+                    if rec is not None:
+                        rec["borrows"] += 1
+                    elif owner and tuple(owner) != my_addr:
+                        to_add.setdefault(tuple(owner), []).append(oid)
+                continue
+            oid = payload
+            b = self.borrowed.get(oid)
+            if b is not None:
+                b["count"] -= 1
+                if b["count"] <= 0:
+                    self.borrowed.pop(oid, None)
+                    to_release.setdefault(tuple(b["owner"]), []).append(oid)
+                continue
             rec = self.owned.get(oid)
             if rec is None:
                 continue
             rec["count"] -= 1
             self._maybe_free(oid, free_sink=freed)
+        for owner, oids in to_add.items():
+            self.loop.create_task(
+                self._notify_owner_many(owner, "add_borrow", oids)
+            )
+        for owner, oids in to_release.items():
+            self.loop.create_task(
+                self._notify_owner_many(owner, "release_borrow", oids)
+            )
         if freed:
             try:
                 self.gcs.notify("object_free", {"oids": freed})
@@ -870,43 +939,60 @@ class CoreWorker:
     def _register_owned(self, oid: str, nested: Optional[list] = None):
         self.owned[oid] = {"count": 1, "borrows": 0, "nested": nested or []}
 
+    def _enqueue_ref_op(self, op: tuple):
+        """Append a refcount operation to the SINGLE ordered op queue and
+        make sure one drain is pending. Pins and decrements MUST share a
+        queue: with separate callbacks, a drain scheduled before a pin can
+        consume decrements enqueued after it — freeing an object whose pin
+        is still in flight (observed as vanishing shuffle pieces)."""
+        self._release_queue.append(op)
+        if self._release_drain_scheduled:
+            return
+        self._release_drain_scheduled = True
+        try:
+            self.loop.call_soon_threadsafe(self._drain_releases)
+        except RuntimeError:
+            self._release_drain_scheduled = False
+
     def _add_borrows(self, entries: List[tuple]):
         """entries: [(oid_hex, owner_addr_or_None)]. Local refs increment the
-        owner count; foreign refs notify their owner (reference: borrow
-        registration in ``reference_counter.h``). Runs on the core loop so
-        count mutations never race task-reply releases; call_soon_threadsafe
-        is FIFO, so the increment always lands before the dispatch that could
-        release it."""
+        borrow count; foreign refs notify their owner (reference: borrow
+        registration in ``reference_counter.h``). Ordered through the shared
+        ref-op queue so the pin always applies before any release enqueued
+        after it, regardless of which thread enqueues what."""
         if not entries:
             return  # hot path: no-ref tasks must not pay a loop wakeup
-
-        def apply():
-            for oid, owner in entries:
-                rec = self.owned.get(oid)
-                if rec is not None:
-                    rec["borrows"] += 1
-                elif owner and tuple(owner) != tuple(self.addr or ()):
-                    self.loop.create_task(
-                        self._notify_owner(tuple(owner), "add_borrow", oid)
-                    )
-
-        self.loop.call_soon_threadsafe(apply)
+        self._enqueue_ref_op(("pin", list(entries)))
 
     def _release_borrows(self, entries: List[tuple]):
+        # Pending deserialize-time borrow registrations must land at the
+        # owner before these container-credit releases do.
+        self._drain_borrows()
+        my_addr = tuple(self.addr or ())
+        to_release: Dict[tuple, List[str]] = {}
         for oid, owner in entries:
             rec = self.owned.get(oid)
             if rec is not None:
                 rec["borrows"] -= 1
                 self._maybe_free(oid)
-            elif owner and tuple(owner) != tuple(self.addr or ()):
-                self.loop.create_task(
-                    self._notify_owner(tuple(owner), "release_borrow", oid)
-                )
+            elif owner and tuple(owner) != my_addr:
+                to_release.setdefault(tuple(owner), []).append(oid)
+        for owner, oids in to_release.items():
+            self.loop.create_task(
+                self._notify_owner_many(owner, "release_borrow", oids)
+            )
 
     async def _notify_owner(self, addr, method: str, oid: str):
         try:
             conn = await self.get_peer(addr)
             conn.notify(method, {"oid": oid})
+        except (protocol.ConnectionLost, ConnectionRefusedError, OSError):
+            pass
+
+    async def _notify_owner_many(self, addr, method: str, oids: List[str]):
+        try:
+            conn = await self.get_peer(addr)
+            conn.notify(method, {"oids": oids})
         except (protocol.ConnectionLost, ConnectionRefusedError, OSError):
             pass
 
@@ -1905,6 +1991,24 @@ class CoreWorker:
                 err = self.ctx.deserialize_frames(rframes[cursor : cursor + n])
                 cursor += n
                 self.memory_store[oid] = ("err", err)
+            nested = r.get("nested")
+            if nested:
+                # The executing worker pinned borrows for refs inside this
+                # return value; freeing the return object must release
+                # them (owned[oid]["nested"] rides the same path put()'s
+                # nested refs do).
+                rec = self.owned.get(oid)
+                if rec is not None:
+                    rec.setdefault("nested", [])
+                    rec["nested"] = list(rec["nested"]) + [
+                        (e[0], e[1]) for e in nested
+                    ]
+                else:
+                    # Fire-and-forget: the caller already dropped the
+                    # return ref. Re-registering would resurrect it with a
+                    # count nobody decrements — release the executor's
+                    # borrow credits instead.
+                    self._release_borrows([(e[0], e[1]) for e in nested])
             ev = self.store_events.get(oid)
             if ev is not None:
                 ev.set()
@@ -2221,16 +2325,24 @@ class CoreWorker:
         return {"ready": h["oid"] in self.memory_store}, []
 
     async def rpc_add_borrow(self, h, frames, conn):
-        rec = self.owned.get(h["oid"])
-        if rec is not None:
-            rec["borrows"] += 1
+        for oid in h.get("oids") or [h["oid"]]:
+            rec = self.owned.get(oid)
+            if rec is not None:
+                rec["borrows"] += 1
         return {}, []
 
     async def rpc_release_borrow(self, h, frames, conn):
-        rec = self.owned.get(h["oid"])
-        if rec is not None:
-            rec["borrows"] -= 1
-            self._maybe_free(h["oid"])
+        freed: List[str] = []
+        for oid in h.get("oids") or [h["oid"]]:
+            rec = self.owned.get(oid)
+            if rec is not None:
+                rec["borrows"] -= 1
+                self._maybe_free(oid, free_sink=freed)
+        if freed:
+            try:
+                self.gcs.notify("object_free", {"oids": freed})
+            except protocol.ConnectionLost:
+                pass
         return {}, []
 
     async def rpc_free_object(self, h, frames, conn):
@@ -2802,27 +2914,45 @@ class CoreWorker:
             return rets, out_frames, []
         big = []
         for i, v in enumerate(values[:nret]):
-            sobj = self.ctx.serialize(v)
+            # Refs nested in a return value must be pinned exactly like
+            # put() pins them (reference: borrow registration on value
+            # serialization, reference_counter.h): this worker holds a
+            # borrow until the CALLER frees the return object and sends
+            # release_borrow back. Without this, a task returning
+            # [ray.put(...), ...] frees the pieces the moment its locals
+            # are GC'd — the distributed-shuffle map->reduce handoff.
+            sobj, nested_refs = collect_refs_during(
+                lambda v=v: self.ctx.serialize(v)
+            )
+            nested = [
+                (r.id().hex(), list(r.owner_address or ()))
+                for r in nested_refs
+            ]
+            ret: Dict[str, Any] = {}
+            if nested:
+                self._add_borrows(nested)
+                ret["nested"] = nested
             if sobj.total_bytes() <= INLINE_OBJECT_MAX:
                 fr = sobj.to_frames()
-                rets.append({"kind": "mem", "nframes": len(fr)})
+                rets.append({**ret, "kind": "mem", "nframes": len(fr)})
                 out_frames.extend(fr)
             else:
-                rets.append(None)  # placeholder: filled after shm write
-                big.append((i, sobj))
+                # placeholder: filled after shm write (nested carried over)
+                rets.append(None)
+                big.append((i, sobj, ret))
         return rets, out_frames, big
 
     async def _package_result(self, h, ok, result):
         rets, out_frames, big = self._package_result_parts(h, ok, result)
         tid = TaskID.from_hex(h["tid"])
-        for i, sobj in big:
+        for i, sobj, ret in big:
             oid = ObjectID.for_return(tid, i).hex()
             # written into shm before this call returns: zero-copy safe
             meta = self._with_xfer(
                 self.shm.put_frames(oid, sobj.to_frames(copy=False))
             )
             await self.gcs.call("object_register", {"oid": oid, "meta": meta})
-            rets[i] = {"kind": "shm", "meta": meta}
+            rets[i] = {**ret, "kind": "shm", "meta": meta}
         return {"rets": rets}, out_frames
 
     # actor hosting ---------------------------------------------------------

@@ -178,10 +178,47 @@ class Dataset:
             yield resolve_block(ref)
 
     # ------------------------------------------------------- barrier ops
+    #
+    # On a cluster these run as a distributed map->reduce exchange
+    # (``data/shuffle.py`` — reference: hash_shuffle.py operators): the
+    # driver only ever holds block refs, so datasets far larger than
+    # driver RAM shuffle/sort/join fine. Without a cluster (local mode)
+    # they fall back to in-process arrow ops.
+
+    def _distributed(self) -> bool:
+        from ray_tpu._private import worker as worker_mod
+
+        return worker_mod.global_worker is not None and bool(self._blocks)
+
+    def _plan(self, num_partitions: Optional[int] = None):
+        from ray_tpu.data.shuffle import ShufflePlan
+
+        return ShufflePlan(num_partitions or max(len(self._blocks), 1))
 
     def repartition(self, num_blocks: int, **_) -> "Dataset":
         if num_blocks <= 0:
             raise ValueError("num_blocks must be positive")
+        if self._distributed():
+            # Materialize pending transforms once (blocks stay remote),
+            # then split on GLOBAL contiguous row ranges so the output
+            # preserves row order exactly like the local path.
+            ds = self.materialize()
+            plan = ds._plan(num_blocks)
+            counts = plan.block_row_counts(ds._blocks)
+            total = sum(counts)
+            sizes = [
+                total // num_blocks + (1 if i < total % num_blocks else 0)
+                for i in range(num_blocks)
+            ]
+            cuts = list(np.cumsum(sizes)[:-1])
+            offsets = list(np.cumsum([0] + counts[:-1]))
+            out = plan.exchange(
+                ds._blocks, [],
+                map_spec={"mode": "contig", "cuts": cuts},
+                reduce_spec={"kind": "concat"},
+                per_block=[{"offset": int(o)} for o in offsets],
+            )
+            return Dataset(out, [], self._executor)
         table = BlockAccessor.concat(self._materialized_blocks())
         return Dataset(
             [put_block(t) for t in _split_table(table, num_blocks)],
@@ -189,6 +226,13 @@ class Dataset:
         )
 
     def random_shuffle(self, *, seed: Optional[int] = None, **_) -> "Dataset":
+        if self._distributed():
+            out = self._plan().exchange(
+                self._blocks, self._pending,
+                map_spec={"mode": "random", "seed": seed},
+                reduce_spec={"kind": "shuffle", "seed": seed},
+            )
+            return Dataset(out, [], self._executor)
         blocks = self._materialized_blocks()
         table = BlockAccessor.concat(blocks)
         rng = np.random.default_rng(seed)
@@ -200,8 +244,26 @@ class Dataset:
 
     def sort(self, key: Union[str, List[str]], descending: bool = False,
              **_) -> "Dataset":
-        table = BlockAccessor.concat(self._materialized_blocks())
         keys = [key] if isinstance(key, str) else key
+        if self._distributed():
+            # Materialize once: sampling + partitioning would otherwise
+            # each run the pending transform chain over the whole dataset.
+            ds = self.materialize()
+            plan = ds._plan()
+            bounds = plan.sample_bounds(ds._blocks, [], keys[0])
+            out = plan.exchange(
+                ds._blocks, [],
+                map_spec={"mode": "range", "keys": keys,
+                          "bounds": list(bounds)},
+                reduce_spec={"kind": "sort", "keys": keys,
+                             "descending": descending},
+            )
+            # Range partitions are ascending by construction; descending
+            # output = descending within partitions + reversed partitions.
+            if descending:
+                out = list(reversed(out))
+            return Dataset(out, [], self._executor)
+        table = BlockAccessor.concat(self._materialized_blocks())
         order = "descending" if descending else "ascending"
         idx = pa.compute.sort_indices(
             table, sort_keys=[(k, order) for k in keys]
@@ -211,9 +273,9 @@ class Dataset:
     def join(self, other: "Dataset", on: Union[str, List[str]], *,
              how: str = "inner", suffix: str = "_r", **_) -> "Dataset":
         """Hash join on key column(s) (reference: the join physical operator
-        under ``_internal/execution/operators``). Arrow-native via
-        pyarrow.Table.join; supported ``how``: inner, left outer, right
-        outer, full outer."""
+        under ``_internal/execution/operators``; distributed via two-sided
+        hash partitioning on the key). Arrow-native per partition;
+        supported ``how``: inner, left outer, right outer, full outer."""
         how_map = {
             "inner": "inner", "left": "left outer", "right": "right outer",
             "outer": "full outer", "left outer": "left outer",
@@ -222,6 +284,15 @@ class Dataset:
         if how not in how_map:
             raise ValueError(f"unsupported join type {how!r}")
         keys = [on] if isinstance(on, str) else list(on)
+        if self._distributed() and other._distributed():
+            out = self._plan(
+                max(len(self._blocks), len(other._blocks))
+            ).exchange_join(
+                self._blocks, self._pending,
+                other._blocks, other._pending,
+                keys=keys, how=how_map[how], suffix=suffix,
+            )
+            return Dataset(out, [], self._executor)
         left = BlockAccessor.concat(self._materialized_blocks())
         right = BlockAccessor.concat(other._materialized_blocks())
         joined = left.join(
@@ -419,8 +490,12 @@ class Dataset:
         return GroupedData(self, key)
 
     def unique(self, column: str) -> List[Any]:
-        table = BlockAccessor.concat(self._materialized_blocks())
-        return pa.compute.unique(table.column(column)).to_pylist()
+        # Streaming per-block uniques -> driver set union: the driver sees
+        # only distinct values, never the rows.
+        out: set = set()
+        for b in self._streaming_blocks():
+            out.update(pa.compute.unique(b.column(column)).to_pylist())
+        return sorted(out, key=lambda v: (v is None, v))
 
     # ------------------------------------------------------- inspection
 
@@ -504,34 +579,57 @@ class Dataset:
 
 
 class GroupedData:
-    """Minimal groupby (reference: ``python/ray/data/grouped_data.py``)."""
+    """Groupby over the distributed shuffle plane (reference:
+    ``python/ray/data/grouped_data.py`` + hash_aggregate operators): rows
+    hash-partition by key so each key lives wholly inside one partition,
+    then partitions aggregate independently with arrow group_by. Local mode
+    aggregates in-process."""
 
     def __init__(self, ds: Dataset, key: str):
         self._ds = ds
         self._key = key
 
-    def _grouped(self):
-        table = BlockAccessor.concat(self._ds._materialized_blocks())
-        return table.group_by(self._key)
+    def _agg(self, aggs: List[tuple]) -> Dataset:
+        ds = self._ds
+        if ds._distributed():
+            out = ds._plan().exchange(
+                ds._blocks, ds._pending,
+                map_spec={"mode": "hash", "keys": [self._key]},
+                reduce_spec={"kind": "agg", "key": self._key, "aggs": aggs},
+            )
+            return Dataset(out, [], ds._executor)
+        table = BlockAccessor.concat(ds._materialized_blocks())
+        return Dataset([put_block(table.group_by(self._key).aggregate(aggs))])
 
     def count(self) -> Dataset:
-        out = self._grouped().aggregate([(self._key, "count")])
-        return Dataset([put_block(out)])
+        return self._agg([(self._key, "count")])
 
     def sum(self, on: str) -> Dataset:
-        return Dataset([put_block(self._grouped().aggregate([(on, "sum")]))])
+        return self._agg([(on, "sum")])
 
     def min(self, on: str) -> Dataset:
-        return Dataset([put_block(self._grouped().aggregate([(on, "min")]))])
+        return self._agg([(on, "min")])
 
     def max(self, on: str) -> Dataset:
-        return Dataset([put_block(self._grouped().aggregate([(on, "max")]))])
+        return self._agg([(on, "max")])
 
     def mean(self, on: str) -> Dataset:
-        return Dataset([put_block(self._grouped().aggregate([(on, "mean")]))])
+        return self._agg([(on, "mean")])
 
     def map_groups(self, fn, *, batch_format: str = "numpy") -> Dataset:
-        table = BlockAccessor.concat(self._ds._materialized_blocks())
+        ds = self._ds
+        if ds._distributed():
+            import cloudpickle
+
+            out = ds._plan().exchange(
+                ds._blocks, ds._pending,
+                map_spec={"mode": "hash", "keys": [self._key]},
+                reduce_spec={"kind": "map_groups", "key": self._key,
+                             "fn": cloudpickle.dumps(fn),
+                             "batch_format": batch_format},
+            )
+            return Dataset(out, [], ds._executor)
+        table = BlockAccessor.concat(ds._materialized_blocks())
         keys = pa.compute.unique(table.column(self._key)).to_pylist()
         outs = []
         for k in keys:

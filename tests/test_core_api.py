@@ -360,3 +360,32 @@ def test_streaming_abandonment_cleans_up(rt_start):
             break
         time.sleep(0.05)
     assert tid not in w._task_streams, "stream record leaked"
+
+
+def test_refs_returned_from_task_outlive_container(rt_start):
+    """Distributed refcounting: refs created by ray.put INSIDE a task and
+    returned in a list must stay alive after the task's return object is
+    freed — the holder's deserialize-time borrow pins them (reference:
+    borrow registration in reference_counter.h). Regression for the
+    shuffle map->reduce handoff: pieces vanished when the map's return
+    object was GC'd, and a pending release-drain could consume decrements
+    enqueued after an in-flight pin."""
+    import gc
+    import time
+
+    @ray_tpu.remote
+    def producer():
+        return [ray_tpu.put(i * 11) for i in range(4)]
+
+    @ray_tpu.remote
+    def consumer(a, b):
+        return a + b
+
+    tmp = producer.remote()
+    pieces = ray_tpu.get(tmp, timeout=30)
+    del tmp  # frees the container return object
+    gc.collect()
+    time.sleep(0.3)  # let the release drain land at the owner
+    assert ray_tpu.get(pieces, timeout=15) == [0, 11, 22, 33]
+    # pieces usable as args to downstream tasks (the reduce pattern)
+    assert ray_tpu.get(consumer.remote(pieces[1], pieces[3]), timeout=30) == 44
