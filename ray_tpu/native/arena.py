@@ -32,7 +32,12 @@ _ALIGN = 8
 _HDR_COUNT = struct.Struct("<I")
 _HDR_LEN = struct.Struct("<Q")
 
-DEFAULT_CAPACITY = int(os.environ.get("RT_ARENA_BYTES", 1 << 30))
+# 4 GiB virtual default: pages are faulted on demand by the native
+# prefault watermark, so an idle session costs ~nothing — while put-heavy
+# multi-client workloads stop spilling into cold per-object fallback
+# segments (the round-2 multi_client_put collapse). _shm_budget still caps
+# this below what /dev/shm can actually hold.
+DEFAULT_CAPACITY = int(os.environ.get("RT_ARENA_BYTES", 4 << 30))
 INDEX_SLOTS = 1 << 15
 
 
