@@ -297,7 +297,10 @@ def main():
         "value": round(value, 1),
         "unit": "tasks/s",
         "vs_baseline": round(value / BASELINE_TASKS_ASYNC, 3),
-        **{k: round(v, 2) for k, v in core.items()},
+        **{
+            k: (round(v, 2) if isinstance(v, float) else v)
+            for k, v in core.items()
+        },
         **{
             k: (round(v, 2) if isinstance(v, float) else v)
             for k, v in extra.items()

@@ -412,6 +412,21 @@ def _progress(name: str):
 def run_core_benchmarks(quick: bool = False) -> Dict[str, float]:
     scale = 0.25 if quick else 1.0
     out = {}
+    # Label which store the object-plane legs exercised: fallback-store
+    # numbers are NOT comparable to the native-arena targets, and a silent
+    # native-build failure must be visible in the recorded bench artifact.
+    from ray_tpu import native as rt_native
+    from ray_tpu._private import worker as worker_mod
+
+    out["native_store_active"] = bool(
+        worker_mod.get_global_worker().shm.native_enabled
+    )
+    store_err = rt_native.build_failure("librt_native.so")
+    if not out["native_store_active"] and store_err is not None:
+        raise RuntimeError(
+            "refusing to bench: native store fell back because the native "
+            "build FAILED (compile error):\n" + store_err
+        )
     _progress("single_client_tasks_async")
     out["single_client_tasks_async_per_s"] = bench_single_client_tasks_async(
         int(2000 * scale)

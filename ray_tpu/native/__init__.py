@@ -10,6 +10,7 @@ from __future__ import annotations
 import ctypes
 import logging
 import os
+import shutil
 import subprocess
 import threading
 
@@ -22,6 +23,29 @@ _SRC = os.path.join(_DIR, "src", "arena_store.cc")
 _lock = threading.Lock()
 _lib = None
 _tried = False
+
+# make_target -> compiler stderr for builds that FAILED with a working
+# toolchain. A compile error is a bug in this repo, not an environment
+# limitation — tests must fail (not skip) and bench must label fallback runs.
+_build_errors: dict = {}
+
+
+def toolchain_available() -> bool:
+    return shutil.which("g++") is not None and shutil.which("make") is not None
+
+
+def build_failure(target: str = None):
+    """Compiler output for native targets that failed to COMPILE with the
+    toolchain present, or None. Distinct from toolchain_available() so callers
+    can tell "can't build here" from "the code is broken". Pass a make target
+    (e.g. "librt_native.so") to scope the check to one library."""
+    if target is not None:
+        return _build_errors.get(target)
+    if not _build_errors:
+        return None
+    return "\n".join(
+        "%s:\n%s" % (t, err) for t, err in _build_errors.items()
+    )
 
 
 def _lib_needs_build(lib_path: str, srcs) -> bool:
@@ -55,9 +79,18 @@ def build_lib(make_target: str, lib_path: str, srcs) -> bool:
         logger.warning("native build (%s) unavailable: %s", make_target, e)
         return False
     if res.returncode != 0:
-        logger.warning(
-            "native build (%s) failed:\n%s", make_target, res.stderr[-2000:]
-        )
+        if toolchain_available():
+            _build_errors[make_target] = res.stderr[-2000:]
+            logger.error(
+                "native build (%s) FAILED with the toolchain present — this "
+                "is a compile error in the repo, not a missing toolchain:\n%s",
+                make_target,
+                res.stderr[-2000:],
+            )
+        else:
+            logger.warning(
+                "native build (%s) failed:\n%s", make_target, res.stderr[-2000:]
+            )
         return False
     return True
 
@@ -98,58 +131,67 @@ def load_library():
         except OSError as e:
             logger.warning("native library load failed: %s", e)
             return None
-
-        lib.rt_arena_create.argtypes = [
-            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32,
-        ]
-        lib.rt_arena_create.restype = ctypes.c_int
-        lib.rt_arena_attach.argtypes = [ctypes.c_char_p]
-        lib.rt_arena_attach.restype = ctypes.c_int
-        lib.rt_arena_unlink.argtypes = [ctypes.c_char_p]
-        lib.rt_arena_unlink.restype = ctypes.c_int
-        lib.rt_arena_detach.argtypes = [ctypes.c_int]
-        lib.rt_arena_detach.restype = ctypes.c_int
-        lib.rt_arena_base.argtypes = [ctypes.c_int]
-        lib.rt_arena_base.restype = ctypes.c_void_p
-        lib.rt_arena_capacity.argtypes = [ctypes.c_int]
-        lib.rt_arena_capacity.restype = ctypes.c_uint64
-        lib.rt_obj_create.argtypes = [
-            ctypes.c_int, ctypes.c_char_p, ctypes.c_uint64,
-        ]
-        lib.rt_obj_create.restype = ctypes.c_int64
-        lib.rt_obj_seal.argtypes = [ctypes.c_int, ctypes.c_char_p]
-        lib.rt_obj_seal.restype = ctypes.c_int
-        lib.rt_obj_get.argtypes = [
-            ctypes.c_int, ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
-        ]
-        lib.rt_obj_get.restype = ctypes.c_int64
-        lib.rt_obj_release.argtypes = [ctypes.c_int, ctypes.c_char_p]
-        lib.rt_obj_release.restype = ctypes.c_int
-        lib.rt_obj_delete.argtypes = [ctypes.c_int, ctypes.c_char_p]
-        lib.rt_obj_delete.restype = ctypes.c_int
-        lib.rt_obj_contains.argtypes = [ctypes.c_int, ctypes.c_char_p]
-        lib.rt_obj_contains.restype = ctypes.c_int
-        lib.rt_arena_stats.argtypes = [
-            ctypes.c_int,
-            ctypes.POINTER(ctypes.c_uint64),
-            ctypes.POINTER(ctypes.c_uint64),
-            ctypes.POINTER(ctypes.c_uint64),
-            ctypes.POINTER(ctypes.c_uint64),
-        ]
-        lib.rt_arena_stats.restype = None
-        lib.rt_test_hold_lock.argtypes = [ctypes.c_int]
-        lib.rt_test_hold_lock.restype = ctypes.c_int
-        lib.rt_arena_num_tombs.argtypes = [ctypes.c_int]
-        lib.rt_arena_num_tombs.restype = ctypes.c_uint64
-        lib.rt_arena_scrub.argtypes = [ctypes.c_int]
-        lib.rt_arena_scrub.restype = ctypes.c_int
-        lib.rt_memcpy_parallel.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
-        ]
-        lib.rt_memcpy_parallel.restype = None
-        lib.rt_arena_copy.argtypes = [
-            ctypes.c_int, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64,
-        ]
-        lib.rt_arena_copy.restype = ctypes.c_int
+        try:
+            _bind_symbols(lib)
+        except AttributeError as e:
+            # A stale/mismatched .so (symbol missing) must degrade to the
+            # fallback store, not crash worker startup.
+            logger.error("native library symbol mismatch: %s", e)
+            return None
         _lib = lib
         return _lib
+
+
+def _bind_symbols(lib) -> None:
+    lib.rt_arena_create.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32,
+    ]
+    lib.rt_arena_create.restype = ctypes.c_int
+    lib.rt_arena_attach.argtypes = [ctypes.c_char_p]
+    lib.rt_arena_attach.restype = ctypes.c_int
+    lib.rt_arena_unlink.argtypes = [ctypes.c_char_p]
+    lib.rt_arena_unlink.restype = ctypes.c_int
+    lib.rt_arena_detach.argtypes = [ctypes.c_int]
+    lib.rt_arena_detach.restype = ctypes.c_int
+    lib.rt_arena_base.argtypes = [ctypes.c_int]
+    lib.rt_arena_base.restype = ctypes.c_void_p
+    lib.rt_arena_capacity.argtypes = [ctypes.c_int]
+    lib.rt_arena_capacity.restype = ctypes.c_uint64
+    lib.rt_obj_create.argtypes = [
+        ctypes.c_int, ctypes.c_char_p, ctypes.c_uint64,
+    ]
+    lib.rt_obj_create.restype = ctypes.c_int64
+    lib.rt_obj_seal.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    lib.rt_obj_seal.restype = ctypes.c_int
+    lib.rt_obj_get.argtypes = [
+        ctypes.c_int, ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.rt_obj_get.restype = ctypes.c_int64
+    lib.rt_obj_release.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    lib.rt_obj_release.restype = ctypes.c_int
+    lib.rt_obj_delete.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    lib.rt_obj_delete.restype = ctypes.c_int
+    lib.rt_obj_contains.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    lib.rt_obj_contains.restype = ctypes.c_int
+    lib.rt_arena_stats.argtypes = [
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.rt_arena_stats.restype = None
+    lib.rt_test_hold_lock.argtypes = [ctypes.c_int]
+    lib.rt_test_hold_lock.restype = ctypes.c_int
+    lib.rt_arena_num_tombs.argtypes = [ctypes.c_int]
+    lib.rt_arena_num_tombs.restype = ctypes.c_uint64
+    lib.rt_arena_scrub.argtypes = [ctypes.c_int]
+    lib.rt_arena_scrub.restype = ctypes.c_int
+    lib.rt_memcpy_parallel.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+    ]
+    lib.rt_memcpy_parallel.restype = None
+    lib.rt_arena_copy.argtypes = [
+        ctypes.c_int, ctypes.c_uint64, ctypes.c_void_p, ctypes.c_uint64,
+    ]
+    lib.rt_arena_copy.restype = ctypes.c_int

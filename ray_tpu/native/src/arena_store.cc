@@ -150,6 +150,10 @@ bool handle_ok(int h) {
   return h >= 0 && h < kMaxArenas && g_arenas[h].used;
 }
 
+// Pins taken by the populate thread so it can fault pages without holding
+// g_table_mutex; rt_arena_detach waits for the count to drain before munmap.
+std::atomic<uint32_t> g_arena_pin[kMaxArenas];
+
 // Ask for transparent huge pages on the heap region (tmpfs honors this when
 // /sys/kernel/mm/transparent_hugepage/shmem_enabled is `advise`/`always`):
 // 512x fewer first-touch faults and TLB entries for large-object traffic.
@@ -620,6 +624,86 @@ int claim_client_locked(Arena& a) {
   return -1;
 }
 
+// ---------------------------------------------------------------- prefault
+
+#ifndef MADV_POPULATE_WRITE
+#define MADV_POPULATE_WRITE 23
+#endif
+
+constexpr uint64_t kPopulateChunk = 512ull << 20;  // per background pass
+constexpr uint64_t kPopulateAhead = 256ull << 20;  // slack before re-kick
+
+std::atomic<bool> g_populating[kMaxArenas];
+
+void populate_range(uint8_t* base, uint64_t from, uint64_t to) {
+  if (madvise(base + from, to - from, MADV_POPULATE_WRITE) == 0) return;
+  // Old kernel: write-touch one byte per page (OR 0 dirties without
+  // changing content; the kernel zeroes on first touch either way).
+  for (uint64_t off = from; off < to; off += 4096) {
+    __atomic_fetch_or(base + off, (uint8_t)0, __ATOMIC_RELAXED);
+  }
+}
+
+// How much to fault per unlocked slice. Bounds how long rt_arena_detach can
+// wait on the pin count (one slice of fault time, not the whole pass).
+constexpr uint64_t kPopulateSlice = 64ull << 20;
+
+// Keep the faulted watermark ahead of the allocation frontier. Called
+// WITHOUT the arena mutex; one background thread per process per arena.
+// The thread takes g_table_mutex only to pin the mapping per slice; the
+// page faults themselves run unlocked so attach/create/detach of OTHER
+// arenas (and this one, until detach) never stall behind tmpfs fault rates.
+void maybe_populate(int handle, uint64_t need_to) {
+  Arena& a = g_arenas[handle];
+  ArenaHeader* h = hdr(a);
+  uint64_t cur = __atomic_load_n(&h->populated_to, __ATOMIC_ACQUIRE);
+  if (cur >= h->heap_end) return;
+  if (need_to + kPopulateAhead <= cur) return;
+  bool expect = false;
+  if (!g_populating[handle].compare_exchange_strong(expect, true)) return;
+  std::thread([handle, need_to] {
+    // One bounded pass: the target is fixed up front (cur + chunk, at least
+    // need_to + ahead, capped at heap_end) — NOT recomputed per slice, which
+    // would fault the entire arena eagerly and commit all its tmpfs pages.
+    uint64_t target = 0;
+    for (;;) {
+      uint8_t* base;
+      uint64_t from, to;
+      {
+        // Pin under the table mutex: detach sets used=false first (blocking
+        // new pins), then waits for the pin count to hit zero before munmap.
+        std::lock_guard<std::mutex> tg(g_table_mutex);
+        Arena& a = g_arenas[handle];
+        if (!a.used) break;
+        ArenaHeader* h = hdr(a);
+        uint64_t cur = __atomic_load_n(&h->populated_to, __ATOMIC_ACQUIRE);
+        if (target == 0) {
+          target = cur + kPopulateChunk;
+          if (target < need_to + kPopulateAhead) {
+            target = need_to + kPopulateAhead;
+          }
+          if (target > h->heap_end) target = h->heap_end;
+        }
+        if (cur >= target) break;
+        from = cur;
+        to = from + kPopulateSlice < target ? from + kPopulateSlice : target;
+        base = a.base;
+        g_arena_pin[handle].fetch_add(1, std::memory_order_acquire);
+      }
+      populate_range(base, from, to);
+      ArenaHeader* h = reinterpret_cast<ArenaHeader*>(base);
+      uint64_t prev = from;
+      while (prev < to &&
+             !__atomic_compare_exchange_n(&h->populated_to, &prev, to,
+                                          false, __ATOMIC_RELEASE,
+                                          __ATOMIC_RELAXED)) {
+      }
+      g_arena_pin[handle].fetch_sub(1, std::memory_order_release);
+    }
+    g_populating[handle].store(false);
+  }).detach();
+}
+
 }  // namespace
 
 // ------------------------------- C API --------------------------------------
@@ -734,11 +818,16 @@ int rt_arena_detach(int handle) {
     scrub_client_locked(a, (uint32_t)a.client);
     a.client = -1;
   }
+  // Block new populate pins (the thread checks `used` under g_table_mutex),
+  // then wait out at most one in-flight populate slice before unmapping.
+  a.used = false;
+  while (g_arena_pin[handle].load(std::memory_order_acquire) != 0) {
+    std::this_thread::yield();
+  }
   munmap(a.base, a.capacity);
   a.base = nullptr;
   a.capacity = 0;
   a.name[0] = 0;
-  a.used = false;
   return 0;
 }
 
@@ -755,63 +844,6 @@ uint64_t rt_arena_capacity(int handle) {
 
 // Allocate + register an object. Returns payload offset, or negative errno
 // (-EEXIST id taken, -ENOSPC no contiguous space, -ENFILE index full).
-// ---------------------------------------------------------------- prefault
-
-#ifndef MADV_POPULATE_WRITE
-#define MADV_POPULATE_WRITE 23
-#endif
-
-constexpr uint64_t kPopulateChunk = 512ull << 20;  // per background pass
-constexpr uint64_t kPopulateAhead = 256ull << 20;  // slack before re-kick
-
-std::atomic<bool> g_populating[kMaxArenas];
-
-static void populate_range(uint8_t* base, uint64_t from, uint64_t to) {
-  if (madvise(base + from, to - from, MADV_POPULATE_WRITE) == 0) return;
-  // Old kernel: write-touch one byte per page (OR 0 dirties without
-  // changing content; the kernel zeroes on first touch either way).
-  for (uint64_t off = from; off < to; off += 4096) {
-    __atomic_fetch_or(base + off, (uint8_t)0, __ATOMIC_RELAXED);
-  }
-}
-
-// Keep the faulted watermark ahead of the allocation frontier. Called
-// WITHOUT the arena mutex; one background thread per process per arena.
-static void maybe_populate(int handle, uint64_t need_to) {
-  Arena& a = g_arenas[handle];
-  ArenaHeader* h = hdr(a);
-  uint64_t cur = __atomic_load_n(&h->populated_to, __ATOMIC_ACQUIRE);
-  if (cur >= h->heap_end) return;
-  if (need_to + kPopulateAhead <= cur) return;
-  bool expect = false;
-  if (!g_populating[handle].compare_exchange_strong(expect, true)) return;
-  std::thread([handle, need_to] {
-    // g_table_mutex pins the mapping against a concurrent close/unlink
-    // (populate touches pages; a stale base after munmap would fault).
-    std::lock_guard<std::mutex> tg(g_table_mutex);
-    Arena& a = g_arenas[handle];
-    if (a.used) {
-      ArenaHeader* h = hdr(a);
-      uint64_t cur = __atomic_load_n(&h->populated_to, __ATOMIC_ACQUIRE);
-      uint64_t target = cur + kPopulateChunk;
-      if (target < need_to + kPopulateAhead) {
-        target = need_to + kPopulateAhead;
-      }
-      if (target > h->heap_end) target = h->heap_end;
-      if (target > cur) {
-        populate_range(a.base, cur, target);
-        uint64_t prev = cur;
-        while (prev < target &&
-               !__atomic_compare_exchange_n(&h->populated_to, &prev, target,
-                                            false, __ATOMIC_RELEASE,
-                                            __ATOMIC_RELAXED)) {
-        }
-      }
-    }
-    g_populating[handle].store(false);
-  }).detach();
-}
-
 int64_t rt_obj_create(int handle, const char* id_hex, uint64_t size) {
   if (!handle_ok(handle)) return -EBADF;
   Arena& a = g_arenas[handle];

@@ -10,8 +10,17 @@ import secrets
 
 import pytest
 
+from ray_tpu import native as rt_native
 from ray_tpu.native import load_library
 from ray_tpu.native.arena import HybridShmStore, NativeArenaStore
+
+# A compile error with a working toolchain is a repo bug and must FAIL the
+# suite (collection error), never skip — see test_native_build.py.
+if load_library() is None and rt_native.build_failure() is not None:
+    raise RuntimeError(
+        "native build FAILED (compile error, toolchain present):\n"
+        + rt_native.build_failure()
+    )
 
 pytestmark = pytest.mark.skipif(
     load_library() is None, reason="native toolchain unavailable"

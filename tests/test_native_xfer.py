@@ -11,14 +11,29 @@ import secrets
 
 import pytest
 
+from ray_tpu import native as rt_native
 from ray_tpu._private.object_store import LocalShmStore
 from ray_tpu.native import xfer as native_xfer
+
+# A compile error with a working toolchain is a repo bug and must FAIL the
+# suite (collection error), never skip — see test_native_build.py.
+if rt_native.load_library() is None and rt_native.build_failure() is not None:
+    raise RuntimeError(
+        "native build FAILED (compile error, toolchain present):\n"
+        + rt_native.build_failure()
+    )
 
 
 @pytest.fixture(scope="module")
 def server_port():
     port = native_xfer.start_server("127.0.0.1")
     if port is None:
+        # Compile error with a working toolchain = repo bug = FAIL, not skip.
+        if rt_native.build_failure() is not None:
+            pytest.fail(
+                "native build FAILED (compile error, toolchain present):\n"
+                + rt_native.build_failure()
+            )
         pytest.skip("native toolchain unavailable")
     return port
 
