@@ -22,6 +22,11 @@ from typing import Any, Callable, List, Optional
 
 import cloudpickle
 
+try:
+    import numpy as _np
+except ImportError:  # pragma: no cover - numpy is a hard dep in practice
+    _np = None
+
 
 @dataclass
 class SerializedObject:
@@ -89,7 +94,6 @@ class SerializationContext:
             return SerializedObject(
                 METADATA_RAW, b"", [pickle.PickleBuffer(value)], []
             )
-        value = _stage_jax_arrays(value)
         buffers: List[pickle.PickleBuffer] = []
         contained: List[Any] = []
 
@@ -97,6 +101,18 @@ class SerializationContext:
             buffers.append(buf)
             return False  # out-of-band
 
+        if _np is not None and type(value) is _np.ndarray \
+                and not value.dtype.hasobject:
+            # Fast path: a plain non-object ndarray cannot contain
+            # ObjectRefs or __main__-defined types, so the C pickler is
+            # safe — and ~3x faster than cloudpickle's pure-Python
+            # pickler. The wire format is identical (protocol-5 pickle
+            # with out-of-band buffers), so deserialize is unchanged.
+            inband = pickle.dumps(
+                value, protocol=5, buffer_callback=buffer_cb
+            )
+            return SerializedObject(METADATA_PICKLE5, inband, buffers, [])
+        value = _stage_jax_arrays(value)
         inband = cloudpickle.dumps(value, protocol=5, buffer_callback=buffer_cb)
         return SerializedObject(METADATA_PICKLE5, inband, buffers, contained)
 

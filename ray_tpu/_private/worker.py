@@ -1023,10 +1023,57 @@ class CoreWorker:
         # caller may mutate the source.
         frames = sobj.to_frames(copy=size <= INLINE_OBJECT_MAX)
         hex_ = oid.hex()
-        self._add_borrows(nested)  # pinned until this object is freed
-        self.run_sync(self._store_object(hex_, frames, size))
-        self._register_owned(hex_, nested=nested)
+        # Store on the CALLER's thread: the arena create/copy/seal are
+        # mutex'd native calls, and a run_sync round-trip costs more in
+        # cross-thread handoff than the store itself for small/mid objects.
+        # Concurrent readers are safe: a remote pull that races the dict
+        # write long-polls store_events (rpc_pull_object -> _wait_local),
+        # which the scheduled callback below signals. Ownership/borrow
+        # records are created only after the store succeeds — a failed
+        # store (e.g. /dev/shm exhausted) must not leak an owned record or
+        # borrow pins for a ref that is never returned.
+        if size <= INLINE_OBJECT_MAX:
+            self._add_borrows(nested)  # pinned until this object is freed
+            self._register_owned(hex_, nested=nested)
+            self.memory_store[hex_] = ("mem", frames)
+            self._signal_store_event(hex_)
+        else:
+            meta = self._with_xfer(self.shm.put_frames(hex_, frames))
+            self._add_borrows(nested)  # pinned until this object is freed
+            self._register_owned(hex_, nested=nested)
+            self.memory_store[hex_] = ("shm", meta)
+            self._signal_store_event(hex_)
+
+            def _register():
+                # Fire-and-forget: we are the OWNER, so any later
+                # object_free leaves on the same head connection pipelined
+                # behind this registration; a reader that races the
+                # directory falls back to pull-from-owner (reference
+                # analog: owner-resolved locations,
+                # ownership_object_directory.h).
+                try:
+                    self.gcs.notify(
+                        "object_register", {"oid": hex_, "meta": meta}
+                    )
+                except protocol.ConnectionLost:
+                    pass
+
+            try:
+                self.loop.call_soon_threadsafe(_register)
+            except RuntimeError:
+                pass  # loop shut down mid-put
         return ObjectRef(oid, tuple(self.addr))
+
+    def _signal_store_event(self, hex_: str):
+        """Wake any loop-side waiter (_wait_local) for an object stored from
+        a non-loop thread. asyncio.Event is not thread-safe: the set must
+        run on the loop."""
+        ev = self.store_events.get(hex_)
+        if ev is not None:
+            try:
+                self.loop.call_soon_threadsafe(ev.set)
+            except RuntimeError:
+                pass
 
     def put_raw_frames(self, frames: List[Any],
                        transient: bool = False) -> Tuple[str, dict]:
@@ -1072,7 +1119,15 @@ class CoreWorker:
             else:
                 meta = self._with_xfer(self.shm.put_frames(hex_, frames))
             self.memory_store[hex_] = ("shm", meta)
-            await self.gcs.call("object_register", {"oid": hex_, "meta": meta})
+            # Fire-and-forget: we are the OWNER, so any later object_free for
+            # this oid leaves on the same head connection and is pipelined
+            # behind this registration (in-order per connection). A reader
+            # that races the registration misses the directory and falls back
+            # to pull-from-owner (_fetch_remote), which we can always serve.
+            # This keeps the head RTT out of every put() (reference analog:
+            # plasma seals locally; location updates flow async via the
+            # owner-resolved directory, ownership_object_directory.h).
+            self.gcs.notify("object_register", {"oid": hex_, "meta": meta})
         ev = self.store_events.get(hex_)
         if ev is not None:
             ev.set()

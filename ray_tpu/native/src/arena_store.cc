@@ -46,7 +46,7 @@
 namespace {
 
 constexpr uint64_t kMagic = 0x52545F4152454E41ull;  // "RT_ARENA"
-constexpr uint32_t kVersion = 4;  // v4: +populated_to prefault watermark
+constexpr uint32_t kVersion = 5;  // v5: Entry tracks creator client + pin state
 constexpr uint64_t kAlign = 16;
 constexpr uint64_t kMinBlock = 48;  // hdr(8)+links(16)+ftr(8), padded to 16
 constexpr uint32_t kIdBytes = 28;   // 56 hex chars
@@ -57,7 +57,14 @@ struct Entry {
   uint8_t id[kIdBytes];
   uint8_t state;  // 0 empty, 1 created, 2 sealed, 3 tombstone
   uint8_t deletable;
-  uint16_t _pad;
+  // The creator's pin (pins starts at 1) may be dropped by ANY client's
+  // rt_obj_delete — the owner of an object is often a different process
+  // than its creator (task returns: worker creates, driver owns). Both the
+  // owner's free AND the creator's own free (object_free pubsub fanout)
+  // call delete; without this flag the second call would steal a READER's
+  // pin and let the block be reclaimed under a live zero-copy view.
+  uint8_t creator_client;    // ClientSlot index of the creator (0xFF none)
+  uint8_t creator_unpinned;  // creator pin already dropped
   uint32_t pins;
   uint64_t off;   // payload offset in arena
   uint64_t size;  // payload size requested by the creator
@@ -130,6 +137,9 @@ struct Arena {
   char name[256] = {0};
   bool used = false;
   int client = -1;  // this process's ClientSlot for this arena
+  // Bumped on every claim of this slot: a detached populate thread holding
+  // a stale generation must not touch a NEW arena that reused the slot.
+  uint64_t gen = 0;
 };
 
 constexpr int kMaxArenas = 1024;
@@ -140,6 +150,7 @@ int table_claim_slot() {
   for (int i = 0; i < kMaxArenas; i++) {
     if (!g_arenas[i].used) {
       g_arenas[i].used = true;
+      g_arenas[i].gen += 1;
       return i;
     }
   }
@@ -535,6 +546,8 @@ void entry_reclaim_locked(Arena& a, Entry& e) {
   e.state = kTomb;
   e.pins = 0;
   e.deletable = 0;
+  e.creator_client = 0xFF;
+  e.creator_unpinned = 0;
   h->num_tombs += 1;
   maybe_rehash(a);
 }
@@ -553,6 +566,11 @@ void scrub_client_locked(Arena& a, uint32_t c) {
       if (e.state == kCreated || e.state == kSealed) {
         uint32_t sub = r.count < e.pins ? r.count : e.pins;
         e.pins -= sub;
+        if (e.creator_client == c) {
+          // The subtract just replayed the creator's +1 (if still held):
+          // a later rt_obj_delete must not drop a reader's pin for it.
+          e.creator_unpinned = 1;
+        }
         if (e.state == kCreated) {
           // creator died before seal: the object can never be read
           e.deletable = 1;
@@ -661,7 +679,8 @@ void maybe_populate(int handle, uint64_t need_to) {
   if (need_to + kPopulateAhead <= cur) return;
   bool expect = false;
   if (!g_populating[handle].compare_exchange_strong(expect, true)) return;
-  std::thread([handle, need_to] {
+  uint64_t my_gen = a.gen;
+  std::thread([handle, need_to, my_gen] {
     // One bounded pass: the target is fixed up front (cur + chunk, at least
     // need_to + ahead, capped at heap_end) — NOT recomputed per slice, which
     // would fault the entire arena eagerly and commit all its tmpfs pages.
@@ -672,9 +691,11 @@ void maybe_populate(int handle, uint64_t need_to) {
       {
         // Pin under the table mutex: detach sets used=false first (blocking
         // new pins), then waits for the pin count to hit zero before munmap.
+        // The generation check keeps a thread that outlived its arena from
+        // populating a NEW arena that reused this slot with a stale target.
         std::lock_guard<std::mutex> tg(g_table_mutex);
         Arena& a = g_arenas[handle];
-        if (!a.used) break;
+        if (!a.used || a.gen != my_gen) break;
         ArenaHeader* h = hdr(a);
         uint64_t cur = __atomic_load_n(&h->populated_to, __ATOMIC_ACQUIRE);
         if (target == 0) {
@@ -875,7 +896,9 @@ int64_t rt_obj_create(int handle, const char* id_hex, uint64_t size) {
     memcpy(e2.id, id, kIdBytes);
     e2.state = kCreated;
     e2.deletable = 0;
-    e2.pins = 1;  // creator's pin; dropped by rt_obj_delete
+    e2.creator_client = a.client >= 0 ? (uint8_t)a.client : 0xFF;
+    e2.creator_unpinned = 0;
+    e2.pins = 1;  // creator's pin; dropped (once) by rt_obj_delete
     e2.off = b + 8;
     e2.size = size;
     e2.seq = ++h->create_seq;
@@ -953,8 +976,17 @@ int rt_obj_delete(int handle, const char* id_hex) {
   Entry& e = index_of(a)[s];
   if (e.state != kCreated && e.state != kSealed) return -ENOENT;
   e.deletable = 1;
-  if (e.pins > 0) e.pins -= 1;
-  pin_log_add(a, a.client, id, -1);
+  // Drop the creator pin exactly ONCE, no matter how many clients call
+  // delete (owner free + creator free both land here). The -1 is logged
+  // against the CREATOR's ledger — where the +1 lives — so a dead-client
+  // scrub replays to the same balance.
+  if (!e.creator_unpinned) {
+    e.creator_unpinned = 1;
+    if (e.pins > 0) e.pins -= 1;
+    if (e.creator_client != 0xFF) {
+      pin_log_add(a, (int)e.creator_client, id, -1);
+    }
+  }
   if (e.pins == 0) entry_reclaim_locked(a, e);
   return 0;
 }

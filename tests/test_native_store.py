@@ -99,6 +99,33 @@ def test_pinned_object_survives_delete(arena):
     assert arena.stats()["bytes_in_use"] < in_use
 
 
+def test_double_delete_does_not_steal_reader_pin(arena):
+    """Owner free AND creator free (object_free pubsub fanout) both call
+    rt_obj_delete; the creator pin must drop exactly once, or the second
+    delete steals the READER's pin and the block is reclaimed (and reused)
+    under a live zero-copy view — observed as streamed values swapping."""
+    import gc
+
+    oid = _hex()
+    arena.put_frames(oid, [b"A" * 100_000])
+    view = arena.get_frames(oid, {})[0]  # reader pin rides the view
+    in_use = arena.stats()["bytes_in_use"]
+    # owner-side free (borrower process path: delete via meta)
+    arena._lib.rt_obj_delete(arena._h, oid.encode())
+    # creator-side free (pubsub fanout path) — a second delete
+    arena._created.pop(oid, None)
+    arena._lib.rt_obj_delete(arena._h, oid.encode())
+    assert arena.stats()["bytes_in_use"] == in_use, "reader pin stolen"
+    # A new same-size object must NOT overwrite the pinned block.
+    oid2 = _hex()
+    arena.put_frames(oid2, [b"B" * 100_000])
+    assert bytes(view[:10]) == b"A" * 10
+    del view
+    gc.collect()
+    # Pin released: now the block reclaims.
+    assert arena.stats()["bytes_in_use"] <= in_use
+
+
 def test_coalescing_allows_large_realloc(arena):
     # Fill with small objects, free them all, then allocate one block that
     # only fits if neighbors coalesced back into a single free range.
