@@ -23,7 +23,7 @@ from ray_tpu._private.gcs import HeadService
 from ray_tpu._private.ids import JobID
 from ray_tpu._private.node import LocalCluster, spawn_node
 from ray_tpu._private.worker import CoreWorker, get_global_worker
-from ray_tpu.actor import ActorClass, ActorHandle, exit_actor
+from ray_tpu.actor import ActorClass, ActorHandle, exit_actor, method
 from ray_tpu.object_ref import ObjectRef
 from ray_tpu.remote_function import RemoteFunction
 from ray_tpu.runtime_context import get_runtime_context
@@ -42,6 +42,7 @@ __all__ = [
     "cancel",
     "get_actor",
     "exit_actor",
+    "method",
     "get_runtime_context",
     "cluster_resources",
     "available_resources",

@@ -21,6 +21,7 @@ differences, deliberate (SURVEY.md §7):
 from __future__ import annotations
 
 import asyncio
+import itertools
 import logging
 import os
 import time
@@ -147,9 +148,20 @@ class HeadService:
         self.addr: Optional[Tuple[str, int]] = None
         self._pending_waiters: List[asyncio.Future] = []  # resource-wait futures
         self._last_reclaim = 0.0  # lease_reclaim publish rate limit
-        # conn-id -> actor ids whose owner is that connection (non-detached
-        # actors are destroyed when their owner disconnects)
+        # Monotonic serial per client connection — NOT id(conn): a closed
+        # connection's id() can be reused by a new one before the scheduled
+        # cleanup task runs, which would tear down the new owner's state.
+        self._conn_serial = itertools.count(1)
+        # conn-serial -> actor ids whose owner is that connection
+        # (non-detached actors are destroyed when their owner disconnects)
         self._conn_actors: Dict[int, set] = {}
+        # conn-serial -> outstanding lease grants [(node_id, resources,
+        # strategy)]: a client killed mid-burst (SIGKILL, OOM) can never
+        # send release_lease, so its grants are replayed on disconnect —
+        # otherwise the head's view of node capacity leaks permanently
+        # (reference: raylet returns a dead worker's leased resources via
+        # the worker-failure path, ``cluster_lease_manager.cc``).
+        self._conn_leases: Dict[int, list] = {}
         self.task_events: List[dict] = []  # bounded task-event buffer for state API
         self.jobs: Dict[str, dict] = {}
         self._schedule_rr = 0  # round-robin cursor
@@ -555,11 +567,14 @@ class HeadService:
         grants = []
         deadline = time.monotonic() + timeout
         while len(grants) < count:
+            if getattr(conn, "_rt_conn_dead", False):
+                break  # requester died while waiting; don't grant to a ghost
             node = self._pick_node(need, strategy, avoid)
             if node is not None:
                 if not strategy.get("pg_id"):
                     self._node_acquire(node, need)
                 grants.append({"node_id": node.node_id, "addr": list(node.addr)})
+                self._track_conn_lease(conn, node.node_id, need, strategy)
                 continue
             if grants:
                 break  # return partial grants rather than blocking
@@ -588,6 +603,7 @@ class HeadService:
     async def rpc_release_lease(self, h, frames, conn):
         need = {k: float(v) for k, v in h.get("resources", {}).items()}
         strategy = h.get("strategy", {})
+        self._untrack_conn_lease(conn, h.get("node_id"), need, strategy)
         pg_id = strategy.get("pg_id")
         if pg_id:
             pg = self.pgs.get(pg_id)
@@ -823,17 +839,23 @@ class HeadService:
         await self._on_actor_dead(actor, h.get("reason", "actor exited"))
         return {}, []
 
-    def _track_actor_owner(self, conn, actor_id: str):
-        owned = self._conn_actors.setdefault(id(conn), set())
-        owned.add(actor_id)
-        if getattr(conn, "_rt_actor_cleanup", False):
-            return
-        conn._rt_actor_cleanup = True
+    def _conn_key(self, conn) -> int:
+        """Stable per-connection key + one close hook that tears down ALL
+        connection-scoped state (owned actors, outstanding leases)."""
+        key = getattr(conn, "_rt_serial", None)
+        if key is not None:
+            return key
+        key = conn._rt_serial = next(self._conn_serial)
         prev = conn.on_close
         loop = asyncio.get_event_loop()
-        key = id(conn)
 
         def _on_close(c):
+            # Set BEFORE the async cleanup runs: an rpc_lease that was
+            # still waiting for resources when the client died completes
+            # later on this loop — it must see the flag and return its
+            # grant instead of recording a zombie ledger entry after the
+            # ledger was already drained.
+            c._rt_conn_dead = True
             if prev is not None:
                 try:
                     prev(c)
@@ -841,15 +863,81 @@ class HeadService:
                     logger.exception("chained on_close failed")
             if self._shutting_down or loop.is_closed():
                 self._conn_actors.pop(key, None)
+                self._conn_leases.pop(key, None)
                 return
             try:
                 loop.call_soon_threadsafe(
-                    lambda: loop.create_task(self._on_actor_owner_closed(key))
+                    lambda: loop.create_task(self._on_conn_closed(key))
                 )
             except RuntimeError:
                 pass
 
         conn.on_close = _on_close
+        return key
+
+    async def _on_conn_closed(self, key: int):
+        self._release_conn_leases(key)
+        await self._on_actor_owner_closed(key)
+
+    def _track_actor_owner(self, conn, actor_id: str):
+        self._conn_actors.setdefault(self._conn_key(conn), set()).add(actor_id)
+
+    def _track_conn_lease(self, conn, node_id: str, resources: dict,
+                          strategy: dict):
+        key = self._conn_key(conn)
+        if getattr(conn, "_rt_conn_dead", False):
+            # Granted after (or while) the client's disconnect cleanup
+            # drains its ledger: hand the resources straight back without
+            # touching the ledger (it may hold other not-yet-drained
+            # entries).
+            self._release_lease_entry(node_id, resources, strategy)
+            self._wake_waiters()
+            return
+        self._conn_leases.setdefault(key, []).append(
+            (node_id, resources, strategy)
+        )
+
+    def _untrack_conn_lease(self, conn, node_id: str, resources: dict,
+                            strategy: dict):
+        ledger = self._conn_leases.get(getattr(conn, "_rt_serial", -1))
+        if not ledger:
+            return
+        pg = (strategy or {}).get("pg_id")
+        for i, (nid, res, strat) in enumerate(ledger):
+            if nid == node_id and res == resources \
+                    and (strat or {}).get("pg_id") == pg:
+                del ledger[i]
+                return
+
+    def _release_lease_entry(self, node_id: str, need: dict, strategy: dict):
+        """Return one lease's resources: PG leases to their bundle
+        reservation (or the node if the PG is already gone — mirrors
+        rpc_release_lease), plain leases to the node."""
+        pg_id = (strategy or {}).get("pg_id")
+        if pg_id:
+            pg = self.pgs.get(pg_id)
+            reserved = self.pg_reserved.get(pg_id)
+            if pg is not None and reserved is not None:
+                idx = (strategy or {}).get("bundle_index", -1)
+                indices = [idx] if idx >= 0 else range(len(pg.bundles))
+                for i in indices:
+                    if pg.bundle_nodes[i] == node_id:
+                        _release(reserved[i], need)
+                        break
+            elif pg is not None:
+                node = self.nodes.get(node_id)
+                if node is not None and node.alive:
+                    self._node_release(node, need)
+            return
+        node = self.nodes.get(node_id)
+        if node is not None and node.alive:
+            self._node_release(node, need)
+
+    def _release_conn_leases(self, key: int):
+        """Client connection gone: return every lease it still held."""
+        for node_id, need, strategy in self._conn_leases.pop(key, ()):
+            self._release_lease_entry(node_id, need, strategy)
+        self._wake_waiters()
 
     async def _on_actor_owner_closed(self, key: int):
         """Owner connection gone: kill its non-detached actors (they may be

@@ -145,9 +145,19 @@ class _ActorChannel:
 
 
 class _ActorInstance:
-    """Executor-side state for one hosted actor."""
+    """Executor-side state for one hosted actor.
 
-    def __init__(self, actor_id: str, instance, max_concurrency: int, is_async: bool):
+    Concurrency groups (reference:
+    ``core_worker/task_execution/concurrency_group_manager.h:38``): each
+    named group gets its OWN executor pool and async semaphore, so a slow
+    call in one group (a long "compute" step) cannot block calls routed to
+    another (a "health" ping). The unnamed default group uses
+    max_concurrency. Per-caller ordered admission stays global — order is
+    decided at queue time, isolation at execution time."""
+
+    def __init__(self, actor_id: str, instance, max_concurrency: int,
+                 is_async: bool,
+                 concurrency_groups: Optional[Dict[str, int]] = None):
         self.actor_id = actor_id
         self.instance = instance
         self.is_async = is_async
@@ -156,6 +166,14 @@ class _ActorInstance:
             max_workers=max_concurrency, thread_name_prefix=f"actor-{actor_id[:8]}"
         )
         self.sem = asyncio.Semaphore(max_concurrency)
+        self.groups: Dict[str, ThreadPoolExecutor] = {}
+        self.group_sems: Dict[str, asyncio.Semaphore] = {}
+        for gname, limit in (concurrency_groups or {}).items():
+            self.groups[gname] = ThreadPoolExecutor(
+                max_workers=max(int(limit), 1),
+                thread_name_prefix=f"actor-{actor_id[:8]}-{gname}",
+            )
+            self.group_sems[gname] = asyncio.Semaphore(max(int(limit), 1))
         # per-caller ordered admission; seq_lock makes the cursor safe to
         # read/advance from the ring pump thread (fast dispatch) as well as
         # the event loop (slow path)
@@ -164,6 +182,26 @@ class _ActorInstance:
         self.buffered: Dict[str, Dict[int, Any]] = {}
         self.num_executed = 0
         self.exiting = False
+
+    def resolve_group(self, method, header) -> Optional[str]:
+        """Group for this call: per-call override beats the method's
+        declared group (reference: per-task concurrency_group_name in
+        ``PushTask``). Returns None for the default group; raises KeyError
+        for an unknown name."""
+        gname = header.get("cg") or getattr(
+            method, "_rt_concurrency_group", None
+        )
+        if gname is None:
+            return None
+        if gname not in self.groups:
+            raise KeyError(gname)
+        return gname
+
+    def pool_for(self, gname: Optional[str]) -> ThreadPoolExecutor:
+        return self.pool if gname is None else self.groups[gname]
+
+    def sem_for(self, gname: Optional[str]) -> asyncio.Semaphore:
+        return self.sem if gname is None else self.group_sems[gname]
 
 
 class CoreWorker:
@@ -694,6 +732,8 @@ class CoreWorker:
             or h.get("borrows")
             or h.get("trace")
             or inst.max_concurrency != 1
+            or inst.groups  # concurrency groups route via the slow path
+            or h.get("cg")
             or h.get("method") == "__rt_apply__"
         ):
             return False
@@ -2080,6 +2120,7 @@ class CoreWorker:
         strategy: Optional[dict] = None,
         max_restarts: int = 0,
         max_concurrency: int = 1,
+        concurrency_groups: Optional[Dict[str, int]] = None,
         name: Optional[str] = None,
         namespace: str = "default",
         get_if_exists: bool = False,
@@ -2117,6 +2158,7 @@ class CoreWorker:
             {
                 "class_key": cls_key,
                 "max_concurrency": header["max_concurrency"],
+                "concurrency_groups": concurrency_groups,
                 "renv": header["renv"],
                 "argrefs": ref_ids,
             }
@@ -2153,6 +2195,7 @@ class CoreWorker:
         *,
         num_returns: int = 1,
         max_task_retries: int = 0,
+        concurrency_group: Optional[str] = None,
     ) -> List[ObjectRef]:
         if num_returns == "streaming":
             raise ValueError(
@@ -2172,6 +2215,8 @@ class CoreWorker:
             "owner": list(self.addr),
             "caller": self.worker_id.hex(),
         }
+        if concurrency_group is not None:
+            header["cg"] = concurrency_group
         refs = []
         for i in range(num_returns):
             oid = ObjectID.for_return(task_id, i)
@@ -3055,7 +3100,9 @@ class CoreWorker:
             if not m.startswith("_")
         )
         inst = _ActorInstance(
-            h["actor_id"], result, spec.get("max_concurrency", 1) or 1, is_async
+            h["actor_id"], result, spec.get("max_concurrency", 1) or 1,
+            is_async,
+            concurrency_groups=spec.get("concurrency_groups"),
         )
         self.hosted_actors[h["actor_id"]] = inst
         return {}, []
@@ -3065,6 +3112,8 @@ class CoreWorker:
         if inst is not None:
             inst.exiting = True
             inst.pool.shutdown(wait=False, cancel_futures=True)
+            for pool in inst.groups.values():
+                pool.shutdown(wait=False, cancel_futures=True)
         return {}, []
 
     async def _admit_in_order(self, inst: _ActorInstance, caller: str, seq: int):
@@ -3125,9 +3174,16 @@ class CoreWorker:
                 raise protocol.RpcError(
                     f"TaskError: actor has no method '{h['method']}'"
                 )
+            try:
+                cg = inst.resolve_group(method, h)
+            except KeyError as e:
+                raise protocol.RpcError(
+                    f"TaskError: unknown concurrency group {e.args[0]!r} "
+                    f"(declared: {sorted(inst.groups)})"
+                )
             args, kwargs = await self._materialize_args(h, frames)
             if asyncio.iscoroutinefunction(method):
-                async with inst.sem:
+                async with inst.sem_for(cg):
                     self._advance_seq(inst, caller, seq)
                     # Run on the dedicated async-actor loop, NOT the core
                     # loop: a blocking ray_tpu.get() inside the method would
@@ -3152,7 +3208,7 @@ class CoreWorker:
                     self.put_counter.value = 0
                     return method(*args, **kwargs)
 
-                fut = loop.run_in_executor(inst.pool, run)
+                fut = loop.run_in_executor(inst.pool_for(cg), run)
                 # Pool admission happened in seq order; later seqs may now queue.
                 self._advance_seq(inst, caller, seq)
                 try:

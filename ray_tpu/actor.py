@@ -15,6 +15,7 @@ _ACTOR_OPTIONS = {
     "max_restarts",
     "max_task_retries",
     "max_concurrency",
+    "concurrency_groups",
     "name",
     "namespace",
     "get_if_exists",
@@ -25,11 +26,33 @@ _ACTOR_OPTIONS = {
 }
 
 
+def method(*, concurrency_group: str = None, num_returns: int = None):
+    """Decorator tagging an actor method with execution options (reference:
+    ``ray.method`` — ``python/ray/actor.py``; concurrency groups:
+    ``core_worker/task_execution/concurrency_group_manager.h:38``).
+
+    ``concurrency_group`` routes the method onto the named group's executor
+    declared via ``@remote(concurrency_groups={...})``, isolating it from
+    other groups' slow calls (e.g. health checks vs. work lanes)."""
+
+    def decorate(fn):
+        if concurrency_group is not None:
+            fn._rt_concurrency_group = concurrency_group
+        if num_returns is not None:
+            fn._rt_num_returns = num_returns
+        return fn
+
+    return decorate
+
+
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", method_name: str,
+                 num_returns: int = 1,
+                 concurrency_group: Optional[str] = None):
         self._handle = handle
         self._method_name = method_name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -37,8 +60,15 @@ class ActorMethod:
             f"use .{self._method_name}.remote()."
         )
 
-    def options(self, num_returns: int = 1, **_):
-        return ActorMethod(self._handle, self._method_name, num_returns)
+    def options(self, num_returns: int = None, concurrency_group: str = None,
+                **_):
+        return ActorMethod(
+            self._handle,
+            self._method_name,
+            # None = keep the declared/@method value, don't reset to 1
+            self._num_returns if num_returns is None else num_returns,
+            concurrency_group,
+        )
 
     def bind(self, *args, **kwargs):
         """Build a DAG node instead of submitting (reference:
@@ -61,6 +91,7 @@ class ActorMethod:
             kwargs,
             num_returns=self._num_returns,
             max_task_retries=self._handle._max_task_retries,
+            concurrency_group=self._concurrency_group,
         )
         if self._num_returns == 1:
             return refs[0]
@@ -69,11 +100,14 @@ class ActorMethod:
 
 class ActorHandle:
     def __init__(self, actor_id_hex: str, addr=None, max_task_retries: int = 0,
-                 class_name: str = "Actor"):
+                 class_name: str = "Actor",
+                 method_meta: Optional[Dict[str, int]] = None):
         self._actor_id_hex = actor_id_hex
         self._addr = tuple(addr) if addr else None
         self._max_task_retries = max_task_retries
         self._class_name = class_name
+        # method name -> declared num_returns (@method(num_returns=N))
+        self._method_meta = method_meta or {}
         if addr is not None:
             try:
                 get_global_worker().get_actor_channel(actor_id_hex, addr)
@@ -87,7 +121,9 @@ class ActorHandle:
     def __getattr__(self, item):
         if item.startswith("_"):
             raise AttributeError(item)
-        return ActorMethod(self, item)
+        return ActorMethod(
+            self, item, self._method_meta.get(item, 1)
+        )
 
     def __repr__(self):
         return f"ActorHandle({self._class_name}, {self._actor_id_hex[:16]})"
@@ -95,7 +131,8 @@ class ActorHandle:
     def __reduce__(self):
         return (
             ActorHandle,
-            (self._actor_id_hex, self._addr, self._max_task_retries, self._class_name),
+            (self._actor_id_hex, self._addr, self._max_task_retries,
+             self._class_name, self._method_meta),
         )
 
 
@@ -123,6 +160,17 @@ class ActorClass:
         worker = get_global_worker()
         opts = self._options
         max_restarts = opts.get("max_restarts", 0)
+        cgroups = opts.get("concurrency_groups")
+        if cgroups is not None:
+            if not isinstance(cgroups, dict) or not all(
+                isinstance(k, str) and isinstance(v, int) and v > 0
+                for k, v in cgroups.items()
+            ):
+                raise ValueError(
+                    "concurrency_groups must be a dict of "
+                    "{group_name: positive max_concurrency}, got "
+                    f"{cgroups!r}"
+                )
         actor_id, addr, existing = worker.create_actor(
             self._cls,
             args,
@@ -131,17 +179,26 @@ class ActorClass:
             strategy=_build_strategy(opts),
             max_restarts=max_restarts,
             max_concurrency=opts.get("max_concurrency", 1),
+            concurrency_groups=cgroups,
             name=opts.get("name"),
             namespace=opts.get("namespace", "default"),
             get_if_exists=opts.get("get_if_exists", False),
             runtime_env=opts.get("runtime_env"),
             lifetime=opts.get("lifetime"),
         )
+        # Walk the MRO so @method(num_returns=N) on inherited base-class
+        # methods is honored too (vars() only sees the leaf class).
+        method_meta: Dict[str, int] = {}
+        for klass in reversed(type.mro(self._cls)):
+            for name, fn in vars(klass).items():
+                if callable(fn) and getattr(fn, "_rt_num_returns", None):
+                    method_meta[name] = fn._rt_num_returns
         return ActorHandle(
             actor_id if isinstance(actor_id, str) else actor_id.hex(),
             addr,
             opts.get("max_task_retries", 0),
             self._cls.__name__,
+            method_meta,
         )
 
     @property

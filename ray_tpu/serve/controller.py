@@ -228,6 +228,15 @@ class ServeController:
         st.counter += 1
         opts = dict(spec.get("actor_options") or {})
         opts.setdefault("max_concurrency", max(spec["max_ongoing"], 2))
+        # Health checks / queue-len polls ride their own executor lane so a
+        # replica whose request slots are all busy still answers the
+        # controller and router (reference: Serve replicas run control
+        # methods on a dedicated concurrency group). Merged (not
+        # setdefault): Replica's decorated methods hard-require "control",
+        # so user-supplied groups must not clobber it.
+        opts["concurrency_groups"] = {
+            "control": 2, **(opts.get("concurrency_groups") or {})
+        }
         gang = int(spec.get("gang_size") or 1)
         if gang > 1:
             return await self._start_gang_replica(st, rid, opts, gang)

@@ -12,6 +12,8 @@ import inspect
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from ray_tpu.actor import method as _actor_method
+
 
 class GangContext:
     """Rank/world view for one member of a gang replica (reference:
@@ -71,22 +73,27 @@ class Replica:
         if user_config is not None:
             self.reconfigure(user_config)
 
+    @_actor_method(concurrency_group="control")
     def reconfigure(self, user_config) -> bool:
         if hasattr(self._instance, "reconfigure"):
             self._instance.reconfigure(user_config)
         return True
 
+    @_actor_method(concurrency_group="control")
     def health_check(self) -> bool:
         if hasattr(self._instance, "check_health"):
             self._instance.check_health()
         return True
 
+    @_actor_method(concurrency_group="control")
     def queue_len(self) -> int:
         return self._ongoing
 
+    @_actor_method(concurrency_group="control")
     def stats(self) -> dict:
         return {"ongoing": self._ongoing, "total": self._total}
 
+    @_actor_method(concurrency_group="control")
     def multiplexed_ids(self) -> List[str]:
         """Model ids THIS replica's instance holds (router affinity;
         reference: replica-side model-id reporting in ``serve/multiplex.py``)."""

@@ -221,3 +221,197 @@ import os; os._exit(0)  # hard exit: no clean shutdown, conn just drops
         time.sleep(0.2)
     assert gone, "non-detached actor survived owner disconnect"
     ray_tpu.kill(keeper)
+
+
+def test_concurrency_group_isolation(rt_start):
+    """A slow call in one group must not block another group's calls
+    (reference: concurrency_group_manager.h — per-group executors; the
+    canonical use is Serve isolating health checks from work lanes)."""
+    import time as _t
+
+    @ray_tpu.remote(concurrency_groups={"io": 2})
+    class A:
+        @ray_tpu.method(concurrency_group="io")
+        def ping(self):
+            return "pong"
+
+        def slow(self):
+            _t.sleep(3)
+            return "done"
+
+    a = A.remote()
+    assert ray_tpu.get(a.ping.remote()) == "pong"
+    slow_ref = a.slow.remote()  # occupies the DEFAULT group's single slot
+    t0 = _t.perf_counter()
+    assert ray_tpu.get(a.ping.remote(), timeout=10) == "pong"
+    assert _t.perf_counter() - t0 < 2.0, "io ping blocked behind slow call"
+    assert ray_tpu.get(slow_ref, timeout=20) == "done"
+    ray_tpu.kill(a)
+
+
+def test_concurrency_group_call_override(rt_start):
+    """Per-call .options(concurrency_group=...) beats the method default."""
+    import time as _t
+
+    @ray_tpu.remote(concurrency_groups={"fast": 1})
+    class A:
+        def work(self, n):
+            _t.sleep(n)
+            return n
+
+    a = A.remote()
+    blocker = a.work.remote(3)  # default group busy
+    t0 = _t.perf_counter()
+    out = ray_tpu.get(
+        a.work.options(concurrency_group="fast").remote(0), timeout=10
+    )
+    assert out == 0
+    assert _t.perf_counter() - t0 < 2.0
+    assert ray_tpu.get(blocker, timeout=20) == 3
+    ray_tpu.kill(a)
+
+
+def test_concurrency_group_limit_enforced(rt_start):
+    """Within one group, max_concurrency bounds parallelism."""
+    import time as _t
+
+    @ray_tpu.remote(concurrency_groups={"g": 2})
+    class A:
+        @ray_tpu.method(concurrency_group="g")
+        def work(self):
+            _t.sleep(0.5)
+            return 1
+
+    a = A.remote()
+    t0 = _t.perf_counter()
+    assert sum(ray_tpu.get([a.work.remote() for _ in range(4)])) == 4
+    dt = _t.perf_counter() - t0
+    # 4 calls, 2-wide group: ~2 batches of 0.5s (not 4 serial, not 1 batch)
+    assert dt >= 0.9, f"group limit not enforced ({dt:.2f}s)"
+    ray_tpu.kill(a)
+
+
+def test_concurrency_group_unknown_name_errors(rt_start):
+    @ray_tpu.remote(concurrency_groups={"io": 1})
+    class A:
+        def m(self):
+            return 1
+
+    a = A.remote()
+    with pytest.raises(Exception, match="unknown concurrency group"):
+        ray_tpu.get(
+            a.m.options(concurrency_group="nope").remote(), timeout=10
+        )
+    ray_tpu.kill(a)
+
+
+def test_concurrency_groups_async_actor(rt_start):
+    """Async actors: per-group semaphores isolate coroutine methods too."""
+    import time as _t
+
+    @ray_tpu.remote(concurrency_groups={"io": 1})
+    class A:
+        async def slow(self):
+            import asyncio
+
+            await asyncio.sleep(3)
+            return "done"
+
+        @ray_tpu.method(concurrency_group="io")
+        async def ping(self):
+            return "pong"
+
+    a = A.remote()
+    slow_ref = a.slow.remote()
+    t0 = _t.perf_counter()
+    assert ray_tpu.get(a.ping.remote(), timeout=10) == "pong"
+    assert _t.perf_counter() - t0 < 2.0
+    assert ray_tpu.get(slow_ref, timeout=20) == "done"
+    ray_tpu.kill(a)
+
+
+def test_method_num_returns_declared(rt_start):
+    @ray_tpu.remote
+    class A:
+        @ray_tpu.method(num_returns=2)
+        def pair(self):
+            return 1, 2
+
+    a = A.remote()
+    r1, r2 = a.pair.remote()
+    assert ray_tpu.get([r1, r2]) == [1, 2]
+    ray_tpu.kill(a)
+
+
+@pytest.mark.parametrize("rt_start", [{"num_cpus": 2}], indirect=True)
+def test_killed_client_leases_released(rt_start):
+    """A client SIGKILLed while holding cached idle leases must have them
+    returned on disconnect — otherwise the head's capacity view leaks and
+    later actors are unschedulable (reference: raylet returns a dead
+    worker's leased resources, cluster_lease_manager.cc; observed as the
+    n_n bench leg dying with 'unschedulable: insufficient resources')."""
+    import subprocess
+    import sys
+    import time as _t
+
+    from ray_tpu._private.worker import get_global_worker
+
+    addr = "%s:%d" % get_global_worker().gcs_addr
+    script = f"""
+import sys, time
+import ray_tpu
+ray_tpu.init(address="{addr}")
+
+@ray_tpu.remote
+def noop():
+    return None
+
+# burst of tasks: finished, but their leases stay CACHED client-side
+ray_tpu.get([noop.remote() for _ in range(20)])
+print("READY", flush=True)
+time.sleep(300)
+"""
+    p = subprocess.Popen(
+        [sys.executable, "-c", script], stdout=subprocess.PIPE, text=True,
+    )
+    try:
+        assert p.stdout.readline().strip() == "READY"
+    finally:
+        p.kill()
+        p.wait(timeout=30)
+
+    # Both CPUs must come back: two 1-CPU actors get placed promptly.
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "ok"
+
+    t0 = _t.perf_counter()
+    actors = [A.remote() for _ in range(2)]
+    assert ray_tpu.get(
+        [a.ping.remote() for a in actors], timeout=25
+    ) == ["ok", "ok"]
+    assert _t.perf_counter() - t0 < 25
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_method_num_returns_via_options_and_inheritance(rt_start):
+    """options() must not reset a declared num_returns; @method tags on
+    base classes are honored through the MRO."""
+
+    class Base:
+        @ray_tpu.method(num_returns=2)
+        def pair(self):
+            return 1, 2
+
+    @ray_tpu.remote(concurrency_groups={"g": 1})
+    class Sub(Base):
+        pass
+
+    a = Sub.remote()
+    r1, r2 = a.pair.remote()
+    assert ray_tpu.get([r1, r2]) == [1, 2]
+    q1, q2 = a.pair.options(concurrency_group="g").remote()
+    assert ray_tpu.get([q1, q2]) == [1, 2]
+    ray_tpu.kill(a)
