@@ -285,6 +285,7 @@ def get_actor(name: str, namespace: str = "default") -> ActorHandle:
     return ActorHandle(
         info["actor_id"], tuple(info["addr"]) if info["addr"] else None,
         0, info.get("class_name", "Actor"),
+        info.get("method_meta") or {},
     )
 
 

@@ -45,6 +45,9 @@ class NodeInfo:
     conn: Optional[protocol.Connection] = None  # head<->node control conn
     alive: bool = True
     start_time: float = field(default_factory=time.time)
+    # Registration epoch: a stale close event from a connection this node
+    # already replaced (re-register after a blip) must not kill the node.
+    epoch: int = 0
 
     def to_public(self) -> dict:
         return {
@@ -74,6 +77,9 @@ class ActorInfo:
     pg_id: Optional[str] = None
     bundle_index: int = -1
     detached: bool = False  # lifetime="detached": survives its owner
+    # method name -> declared num_returns (@method(num_returns=N)); rides
+    # the actor table so get_actor() handles honor declarations too.
+    method_meta: Dict[str, int] = field(default_factory=dict)
 
     def to_public(self) -> dict:
         return {
@@ -86,6 +92,7 @@ class ActorInfo:
             "class_name": self.class_name,
             "restarts_used": self.restarts_used,
             "death_reason": self.death_reason,
+            "method_meta": dict(self.method_meta),
         }
 
 
@@ -224,6 +231,11 @@ class HeadService:
         }
         return pickle.dumps({
             "version": 1,
+            # The listen address rides the snapshot so a restarted head can
+            # REBIND the same port — live nodes/drivers reconnect to the
+            # address they already hold (reference: GCS restarts behind a
+            # stable address and raylets reconnect, gcs_init_data replay).
+            "addr": list(self.addr) if self.addr else None,
             "kv": {ns: dict(kvs) for ns, kvs in self.kv.items()},
             "jobs": jobs,
         })
@@ -232,6 +244,10 @@ class HeadService:
         import pickle
 
         state = pickle.loads(blob)
+        # Surfaced for head_main: rebind this port so live clients rejoin.
+        self.restored_addr = (
+            tuple(state["addr"]) if state.get("addr") else None
+        )
         for ns, kvs in state.get("kv", {}).items():
             self.kv[ns].update(kvs)
         for jid, info in state.get("jobs", {}).items():
@@ -346,13 +362,71 @@ class HeadService:
         self.dead_nodes.pop(info.node_id, None)
         if self._nsched is not None:
             self._nsched.add_node(info.node_id, info.resources, info.labels)
+        # Epoch guards the close handler: the OLD connection of a node that
+        # just re-registered (blip + reconnect) must not tear down the NEW
+        # registration when its queued close event finally runs.
+        info.epoch = next(self._conn_serial)
         conn.peer_info["node_id"] = info.node_id
-        conn.on_close = self._make_node_close_handler(info.node_id)
+        conn.on_close = self._make_node_close_handler(info.node_id, info.epoch)
+        # Live rejoin after a head restart: the node re-reports the actors
+        # it is still hosting; adopt them as ALIVE so handles (and names)
+        # keep resolving. Owner tracking died with the old head — adopted
+        # actors behave as detached until explicitly killed (reference:
+        # GcsInitData replay rebuilding the actor table).
+        for a in h.get("hosted_actors", ()):
+            existing = self.actors.get(a["actor_id"])
+            if existing is not None and existing.state != "DEAD":
+                # Same-head re-register (connection blip): the fresh
+                # NodeInfo reset availability, so re-deduct what this
+                # still-ALIVE actor occupies (PG-backed actors draw from
+                # their bundle reservation instead).
+                if existing.node_id == info.node_id and not existing.pg_id \
+                        and existing.resources:
+                    self._node_acquire(info, existing.resources)
+                continue
+            ainfo = ActorInfo(
+                actor_id=a["actor_id"],
+                name=a.get("name"),
+                namespace=a.get("namespace", "default"),
+                state="ALIVE",
+                node_id=info.node_id,
+                addr=tuple(h["addr"]),
+                resources={
+                    k: float(v) for k, v in (a.get("resources") or {}).items()
+                },
+                max_restarts=0,
+                creation_frames=[],
+                class_name=a.get("class_name", ""),
+                detached=True,
+                method_meta=dict(a.get("method_meta") or {}),
+            )
+            self.actors[a["actor_id"]] = ainfo
+            if ainfo.name:
+                self.named_actors[(ainfo.namespace, ainfo.name)] = (
+                    ainfo.actor_id
+                )
+            # The adopted actor still occupies its slot on the node.
+            if ainfo.resources:
+                self._node_acquire(info, ainfo.resources)
+        # PG bundles reserved on this node also still occupy capacity —
+        # re-deduct them from the fresh NodeInfo (same-head re-register;
+        # a restarted head has no pgs and this is a no-op).
+        for pg_id, pg in self.pgs.items():
+            if pg.state != "CREATED":
+                continue
+            for i, nid in enumerate(pg.bundle_nodes):
+                if nid == info.node_id:
+                    self._node_acquire(info, pg.bundles[i])
+        # Likewise plain leases other (still-connected) clients hold here.
+        for ledger in self._conn_leases.values():
+            for nid, need, strategy in ledger:
+                if nid == info.node_id and not (strategy or {}).get("pg_id"):
+                    self._node_acquire(info, need)
         self._wake_waiters()
         self.publish("nodes", {"event": "node_added", "node": info.to_public()})
         return {"ok": True}, []
 
-    def _make_node_close_handler(self, node_id):
+    def _make_node_close_handler(self, node_id, epoch: int = 0):
         loop = asyncio.get_running_loop()
 
         def _spawn():
@@ -362,7 +436,7 @@ class HeadService:
             # useful work — the cluster is going away.
             if loop.is_closed() or self._shutting_down:
                 return
-            coro = self._on_node_dead(node_id)
+            coro = self._on_node_dead(node_id, epoch=epoch)
             try:
                 t = loop.create_task(coro)
             except RuntimeError:
@@ -379,9 +453,14 @@ class HeadService:
                     pass  # loop torn down concurrently
         return _on_close
 
-    async def _on_node_dead(self, node_id: str, reason: str = "connection lost"):
+    async def _on_node_dead(self, node_id: str, reason: str = "connection lost",
+                            epoch: int = 0):
         info = self.nodes.get(node_id)
         if info is None or not info.alive:
+            return
+        if epoch and getattr(info, "epoch", 0) != epoch:
+            # Stale close event from a connection the node already replaced
+            # by re-registering: the live registration stays up.
             return
         info.alive = False
         if self._nsched is not None:
@@ -484,6 +563,12 @@ class HeadService:
 
     def _node_release(self, node: NodeInfo, need: Dict[str, float]):
         _release(node.available, need)
+        # Invariant clamp: a release the (possibly restarted) head never
+        # granted — e.g. a worker finishing a pre-restart busy lease — must
+        # not inflate availability past the node's physical total.
+        for k, total in node.resources.items():
+            if node.available.get(k, 0.0) > total:
+                node.available[k] = total
         if self._nsched is not None:
             self._nsched.release(node.node_id, need)
 
@@ -690,6 +775,7 @@ class HeadService:
             pg_id=(h.get("strategy") or {}).get("pg_id"),
             bundle_index=(h.get("strategy") or {}).get("bundle_index", -1),
             detached=h.get("lifetime") == "detached",
+            method_meta=dict(h.get("method_meta") or {}),
         )
         self.actors[actor_id] = info
         if name:
@@ -736,7 +822,20 @@ class HeadService:
             try:
                 await node.conn.call(
                     "create_actor",
-                    {"actor_id": info.actor_id},
+                    {
+                        "actor_id": info.actor_id,
+                        # Public metadata the hosting worker re-reports if
+                        # the head restarts and it re-registers (live
+                        # rejoin; reference: gcs_init_data replay).
+                        "meta": {
+                            "name": info.name,
+                            "namespace": info.namespace,
+                            "class_name": info.class_name,
+                            "resources": info.resources,
+                            "detached": info.detached,
+                            "method_meta": info.method_meta,
+                        },
+                    },
                     info.creation_frames,
                 )
             except protocol.RpcError as e:

@@ -66,9 +66,24 @@ def main(argv=None):
     loop = asyncio.new_event_loop()
     asyncio.set_event_loop(loop)
     head = HeadService()
+    port = args.port
     if args.state_file:
         head.load_from_file(args.state_file)
-    addr = loop.run_until_complete(head.start(args.host, args.port))
+        # Rebind the previous port (unless one was given explicitly) so
+        # live nodes/drivers holding the old address can rejoin — the
+        # worker side retries its head connection on loss (live-cluster
+        # rejoin; reference: GCS restarts behind a stable address).
+        restored = getattr(head, "restored_addr", None)
+        if port == 0 and restored:
+            port = restored[1]
+    try:
+        addr = loop.run_until_complete(head.start(args.host, port))
+    except OSError:
+        if port == args.port:
+            raise
+        # Restored port taken (e.g. another service grabbed it while the
+        # head was down): fall back to an ephemeral port rather than die.
+        addr = loop.run_until_complete(head.start(args.host, args.port))
 
     if args.state_file:
         async def _persist_loop():

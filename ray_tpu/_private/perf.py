@@ -403,6 +403,65 @@ def bench_many_nodes_tasks(target_nodes: int = 32, n: int = 500) -> float:
     return rate
 
 
+def bench_many_actors(n: int = 1000) -> float:
+    """Actor creation throughput at scale: create N cheap actors, wait for
+    all to answer, kill them (reference:
+    ``release/benchmarks/many_actors.json`` — 528.8 actors/s creating 10k
+    actors across a cluster). Zero-CPU actors ride the node:slot marker so
+    N isn't capped by cores."""
+    @ray_tpu.remote(num_cpus=0)
+    class A:
+        def ping(self):
+            return None
+
+    t0 = time.perf_counter()
+    actors = [A.remote() for _ in range(n)]
+    ray_tpu.get([a.ping.remote() for a in actors])
+    rate = _rate(n, time.perf_counter() - t0)
+    for a in actors:
+        ray_tpu.kill(a)
+    return rate
+
+
+def bench_many_pgs(n: int = 200) -> float:
+    """Placement-group creation throughput: burst-create N single-bundle
+    PGs, wait all ready, then remove (reference:
+    ``release/benchmarks/many_pgs.json`` — 80.95 PGs/s). Rate covers
+    create+ready; removal is off the clock like the reference."""
+    from ray_tpu.util.placement_group import (
+        placement_group,
+        remove_placement_group,
+    )
+
+    t0 = time.perf_counter()
+    pgs = [placement_group([{"CPU": 0.001}]) for _ in range(n)]
+    for pg in pgs:
+        pg.ready(timeout=60)
+    rate = _rate(n, time.perf_counter() - t0)
+    for pg in pgs:
+        remove_placement_group(pg)
+    return rate
+
+
+def bench_queued_tasks(n: int = 1_000_000) -> float:
+    """Seconds to submit-and-drain N queued noop tasks (reference:
+    ``release/perf_metrics/scalability/single_node.json`` — 1M queued tasks
+    in 140.07s). Returns elapsed SECONDS (lower is better), reported as
+    ``queued_{n}_tasks_s``."""
+    @ray_tpu.remote(num_cpus=0)
+    def noop():
+        return None
+
+    ray_tpu.get([noop.remote() for _ in range(100)])  # warm
+    t0 = time.perf_counter()
+    refs = [noop.remote() for _ in range(n)]
+    # Drain in windows: one get() holding N futures peaks memory; the
+    # reference benchmark also consumes results incrementally.
+    for i in range(0, n, 10_000):
+        ray_tpu.get(refs[i : i + 10_000])
+    return time.perf_counter() - t0
+
+
 def _progress(name: str):
     import sys
 
@@ -518,4 +577,19 @@ def run_core_benchmarks(quick: bool = False) -> Dict[str, float]:
         import logging
 
         logging.getLogger(__name__).warning("many-nodes bench failed: %s", e)
+    # Scale envelope (reference: release/benchmarks/*.json +
+    # scalability/single_node.json). Failures are recorded, not swallowed:
+    # a missing number in the bench artifact hides a regression.
+    for key, fn in (
+        ("many_actors_per_s",
+         lambda: bench_many_actors(200 if quick else 1000)),
+        ("many_pgs_per_s", lambda: bench_many_pgs(50 if quick else 200)),
+        ("queued_5k_tasks_s" if quick else "queued_1m_tasks_s",
+         lambda: bench_queued_tasks(5_000 if quick else 1_000_000)),
+    ):
+        try:
+            _progress(key)
+            out[key] = fn()
+        except Exception as e:
+            out[key + "_error"] = f"{type(e).__name__}: {e}"
     return out

@@ -434,14 +434,10 @@ class CoreWorker:
                     self.xfer_addr = (self.addr[0], port)
             except Exception:
                 logger.debug("native xfer server unavailable", exc_info=True)
-        self.gcs = await protocol.connect(
-            self.gcs_addr, self._handle_rpc, name="gcs-client"
-        )
         # Object-free fan-out: evict borrowed copies when the owner frees.
         self.pubsub_handlers.setdefault("object_free", []).append(
             lambda data, frames: self._evict_freed(data.get("oids", []))
         )
-        await self.gcs.call("subscribe", {"channel": "object_free"})
         # Demand-driven lease return: the head asks when a placement can't
         # fit; cached idle slots go back NOW instead of after the reaper's
         # idle window (otherwise a task burst pins node CPUs for ~1s and a
@@ -449,11 +445,28 @@ class CoreWorker:
         self.pubsub_handlers.setdefault("lease_reclaim", []).append(
             lambda data, frames: self._reclaim_idle_leases()
         )
-        await self.gcs.call("subscribe", {"channel": "lease_reclaim"})
+        await self._connect_gcs()
         self.loop.create_task(self._task_event_flusher())
+
+    async def _connect_gcs(self):
+        """Connect + subscribe + (re-)register with the head. Shared by
+        startup and the head-restart rejoin path (reference: raylets
+        reconnect to a restarted GCS and re-register,
+        ``gcs_init_data.cc`` replay)."""
+        self.gcs = await protocol.connect(
+            self.gcs_addr, self._handle_rpc, name="gcs-client"
+        )
+        self.gcs.on_close = self._on_gcs_lost
+        await self.gcs.call("subscribe", {"channel": "object_free"})
+        await self.gcs.call("subscribe", {"channel": "lease_reclaim"})
         if self.is_driver:
             await self.gcs.call("register_job", {"job_id": self.job_id.hex()})
         else:
+            hosted = [
+                {"actor_id": aid, **getattr(inst, "public_meta", {})}
+                for aid, inst in self.hosted_actors.items()
+                if not inst.exiting
+            ]
             await self.gcs.call(
                 "register_node",
                 {
@@ -461,7 +474,62 @@ class CoreWorker:
                     "addr": list(self.addr),
                     "resources": self.node_resources,
                     "labels": self.node_labels,
+                    "hosted_actors": hosted,
                 },
+            )
+
+    def _on_gcs_lost(self, conn):
+        if self._shutdown or self.loop is None:
+            return
+        try:
+            self.loop.call_soon_threadsafe(
+                lambda: self.loop.create_task(self._reconnect_gcs())
+            )
+        except RuntimeError:
+            pass
+
+    async def _reconnect_gcs(self):
+        """Head connection lost: retry with backoff so a restarted head
+        re-adopts this process (live-cluster rejoin). Cached leases are
+        dropped first — a restarted head has no memory of granting them,
+        and using them would dispatch onto capacity the new head already
+        counts as free."""
+        if self._shutdown:
+            return
+        # Single reconnect loop at a time: a connect that succeeds but dies
+        # during subscribe fires on_close again; a second loop would race
+        # this one and leak a registered connection.
+        if getattr(self, "_gcs_reconnecting", False):
+            return
+        if self.gcs is not None and not self.gcs._closed:
+            return  # already reconnected
+        self._gcs_reconnecting = True
+        try:
+            await self._reconnect_gcs_inner()
+        finally:
+            self._gcs_reconnecting = False
+
+    async def _reconnect_gcs_inner(self):
+        for lease_set in self.leases.values():
+            lease_set.slots = [s for s in lease_set.slots if s.busy > 0]
+        deadline = time.monotonic() + float(
+            os.environ.get("RT_HEAD_RECONNECT_S", "60")
+        )
+        delay = 0.25
+        while not self._shutdown and time.monotonic() < deadline:
+            try:
+                await self._connect_gcs()
+                logger.info(
+                    "reconnected to head at %s:%d", *self.gcs_addr
+                )
+                return
+            except (OSError, protocol.ConnectionLost, protocol.RpcError):
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, 2.0)
+        if not self._shutdown:
+            logger.warning(
+                "head at %s:%d did not come back within the rejoin window",
+                *self.gcs_addr,
             )
 
     def _install_ref_hooks(self):
@@ -2121,6 +2189,7 @@ class CoreWorker:
         max_restarts: int = 0,
         max_concurrency: int = 1,
         concurrency_groups: Optional[Dict[str, int]] = None,
+        method_meta: Optional[Dict[str, int]] = None,
         name: Optional[str] = None,
         namespace: str = "default",
         get_if_exists: bool = False,
@@ -2146,6 +2215,7 @@ class CoreWorker:
             "namespace": namespace,
             "get_if_exists": get_if_exists,
             "lifetime": lifetime,
+            "method_meta": method_meta or {},
             # env_vars/working_dir/py_modules apply to the hosted actor;
             # pip/uv actor isolation (a dedicated venv-worker per actor)
             # is not supported — validate() rejects unknown plugins and
@@ -3104,6 +3174,9 @@ class CoreWorker:
             is_async,
             concurrency_groups=spec.get("concurrency_groups"),
         )
+        # Re-reported to a restarted head so live actors survive head loss
+        # (see _reconnect_gcs / rpc_register_node hosted_actors).
+        inst.public_meta = dict(h.get("meta") or {})
         self.hosted_actors[h["actor_id"]] = inst
         return {}, []
 

@@ -171,6 +171,13 @@ class ActorClass:
                     "{group_name: positive max_concurrency}, got "
                     f"{cgroups!r}"
                 )
+        # Walk the MRO so @method(num_returns=N) on inherited base-class
+        # methods is honored too (vars() only sees the leaf class).
+        method_meta: Dict[str, int] = {}
+        for klass in reversed(type.mro(self._cls)):
+            for name, fn in vars(klass).items():
+                if callable(fn) and getattr(fn, "_rt_num_returns", None):
+                    method_meta[name] = fn._rt_num_returns
         actor_id, addr, existing = worker.create_actor(
             self._cls,
             args,
@@ -180,19 +187,13 @@ class ActorClass:
             max_restarts=max_restarts,
             max_concurrency=opts.get("max_concurrency", 1),
             concurrency_groups=cgroups,
+            method_meta=method_meta,
             name=opts.get("name"),
             namespace=opts.get("namespace", "default"),
             get_if_exists=opts.get("get_if_exists", False),
             runtime_env=opts.get("runtime_env"),
             lifetime=opts.get("lifetime"),
         )
-        # Walk the MRO so @method(num_returns=N) on inherited base-class
-        # methods is honored too (vars() only sees the leaf class).
-        method_meta: Dict[str, int] = {}
-        for klass in reversed(type.mro(self._cls)):
-            for name, fn in vars(klass).items():
-                if callable(fn) and getattr(fn, "_rt_num_returns", None):
-                    method_meta[name] = fn._rt_num_returns
         return ActorHandle(
             actor_id if isinstance(actor_id, str) else actor_id.hex(),
             addr,

@@ -158,13 +158,21 @@ def param_axes(config: GPT2Config) -> Dict[str, Any]:
 
 def _remat_policy(config):
     """Checkpoint policy for the block body. "full" recomputes everything;
-    the default keeps the flash-attention forward's named outputs (out +
-    logsumexp — the residuals its pallas backward consumes) so the backward
-    pass never re-runs the attention kernel, while everything else remats."""
-    if getattr(config, "remat_policy", "dots") == "full":
+    "dots" (default) keeps matmul outputs + the flash-attention forward's
+    named residuals (out + logsumexp, so the backward never re-runs the
+    attention kernel) and recomputes elementwise ops; "dots_all"
+    additionally keeps batched dots — least recompute short of remat=False,
+    for chips with HBM headroom."""
+    policy = getattr(config, "remat_policy", "dots")
+    if policy == "full":
         return None
+    base = (
+        jax.checkpoint_policies.dots_saveable
+        if policy == "dots_all"
+        else jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    )
     return jax.checkpoint_policies.save_from_both_policies(
-        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        base,
         jax.checkpoint_policies.save_only_these_names(
             "flash_out", "flash_lse"
         ),

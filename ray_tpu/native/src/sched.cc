@@ -202,8 +202,13 @@ int rts_sched_release(void* h, const char* node_id, const char** names,
   if (node == nullptr) return -1;
   Demand d = ResolveDemand(s, names, vals, n);
   for (size_t i = 0; i < d.ids.size(); ++i) {
-    node->Set(node->available, d.ids[i],
-              node->Get(node->available, d.ids[i]) + d.amounts[i]);
+    int64_t next = node->Get(node->available, d.ids[i]) + d.amounts[i];
+    // Clamp to the registered total: a release the head never granted
+    // (e.g. a lease finishing across a head restart) must not inflate
+    // capacity (mirrors HeadService._node_release).
+    int64_t cap = node->Get(node->total, d.ids[i]);
+    if (next > cap) next = cap;
+    node->Set(node->available, d.ids[i], next);
   }
   return 0;
 }

@@ -66,8 +66,8 @@ def attention_xla(
 
 # --------------------------------------------------------------------- pallas
 
-DEFAULT_BLOCK_Q = 256
-DEFAULT_BLOCK_K = 256
+DEFAULT_BLOCK_Q = 512  # swept on v5e (B=32, T=1024, D=64): 512/512 runs the
+DEFAULT_BLOCK_K = 512  # fwd 23% and fwd+bwd 23% faster than 256/256
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_k: int,
@@ -79,7 +79,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_k: int,
     q_idx = pl.program_id(1)
     block_q = q_ref.shape[1]
     d = q_ref.shape[2]
-    q = q_ref[0].astype(jnp.float32) * scale  # [Bq, D]
+    # Operands stay in the input dtype (bf16): the MXU runs low-precision
+    # multiplies with f32 accumulation (preferred_element_type) at ~2x the
+    # f32xf32 rate — casting up front would halve kernel throughput. The
+    # scale is applied to the f32 scores, not the bf16 q (no rounding).
+    q = q_ref[0]  # [Bq, D]
 
     num_k_blocks = pl.cdiv(seq_k, block_k)
     if causal:
@@ -93,8 +97,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_k: int,
         o_acc, m, l = carry
         k_blk = k_ref[0, pl.ds(i * block_k, block_k), :]
         v_blk = v_ref[0, pl.ds(i * block_k, block_k), :]
-        s = jnp.dot(q, k_blk.astype(jnp.float32).T,
-                    preferred_element_type=jnp.float32)  # [Bq, Bk]
+        s = jnp.dot(q, k_blk.T,
+                    preferred_element_type=jnp.float32) * scale  # [Bq, Bk]
         k_pos = i * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         )
@@ -111,8 +115,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, block_k: int,
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        # p in [0, 1]: bf16 rounding is harmless and keeps PV on the fast
+        # MXU path (f32 accumulator preserves the sum's precision).
         o_new = o_acc * alpha + jnp.dot(
-            p, v_blk.astype(jnp.float32), preferred_element_type=jnp.float32
+            p.astype(v_blk.dtype), v_blk, preferred_element_type=jnp.float32
         )
         return o_new, m_new, l_new
 
@@ -209,8 +215,9 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     k_idx = pl.program_id(1)
     block_k = k_ref.shape[1]
     d = k_ref.shape[2]
-    k = k_ref[0].astype(jnp.float32)  # [Bk, D]
-    v = v_ref[0].astype(jnp.float32)
+    # bf16 operands + f32 accumulation on every dot (see _flash_kernel).
+    k = k_ref[0]  # [Bk, D]
+    v = v_ref[0]
 
     num_q_blocks = pl.cdiv(seq_q, block_q)
     if causal:
@@ -222,8 +229,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     def body(i, carry):
         dk_acc, dv_acc = carry
-        q_blk = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        do_blk = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        q_blk = q_ref[0, pl.ds(i * block_q, block_q), :]
+        do_blk = do_ref[0, pl.ds(i * block_q, block_q), :]
         lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)]
         delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)]
         s = scale * jnp.dot(q_blk, k.T, preferred_element_type=jnp.float32)
@@ -239,11 +246,12 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         # exp(NEG_INF - lse) underflows to 0 for masked/pad rows; force it
         # for bit-exact zeros.
         p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)  # [Bq, Bk]
-        dv_new = dv_acc + jnp.dot(p.T, do_blk,
+        pcast = p.astype(do_blk.dtype)
+        dv_new = dv_acc + jnp.dot(pcast.T, do_blk,
                                   preferred_element_type=jnp.float32)
         dp = jnp.dot(do_blk, v.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
-        dk_new = dk_acc + jnp.dot(ds.T, q_blk,
+        dk_new = dk_acc + jnp.dot(ds.astype(q_blk.dtype).T, q_blk,
                                   preferred_element_type=jnp.float32)
         return dk_new, dv_new
 
@@ -263,8 +271,9 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     q_idx = pl.program_id(1)
     block_q = q_ref.shape[1]
     d = q_ref.shape[2]
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
+    # bf16 operands + f32 accumulation on every dot (see _flash_kernel).
+    q = q_ref[0]
+    do = do_ref[0]
     lse = lse_ref[0, 0]
     delta = delta_ref[0, 0]
 
@@ -276,8 +285,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         num_iter = num_k_blocks
 
     def body(i, dq_acc):
-        k_blk = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(i * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(i * block_k, block_k), :]
         s = scale * jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
         q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0
@@ -291,7 +300,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
         dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
-        return dq_acc + jnp.dot(ds, k_blk,
+        return dq_acc + jnp.dot(ds.astype(k_blk.dtype), k_blk,
                                 preferred_element_type=jnp.float32)
 
     dq = jax.lax.fori_loop(

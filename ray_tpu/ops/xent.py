@@ -63,14 +63,14 @@ def chunked_softmax_xent(
             jnp.exp((logits.astype(jnp.float32) - m[..., None])), axis=-1
         )
         lse = m + jnp.log(expsum)
-        # Gold logit recomputed exactly in f32 as a row dot — cheaper and
-        # more precise than gathering from the low-precision logits.
-        w_gold = head_w[tcb]                                      # [B, c, E]
-        gold = jnp.einsum(
-            "bce,bce->bc",
-            xcb.astype(jnp.float32),
-            w_gold.astype(jnp.float32),
-        )
+        # Gold logit gathered from the SAME tensor the logsumexp reduced:
+        # numerator and denominator share one precision, so lse >= gold
+        # always and per-token NLL cannot go negative. (An f32 recompute of
+        # the gold row dot is more precise in isolation but inconsistent
+        # with the bf16 lse — and costs a [B, c, E] f32 gather + einsum.)
+        gold = jnp.take_along_axis(
+            logits, tcb[..., None], axis=-1
+        )[..., 0].astype(jnp.float32)
         s = s + ((lse - gold) * mcb).sum()
         cnt = cnt + mcb.sum()
         return (s, cnt), None

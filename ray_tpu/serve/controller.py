@@ -235,7 +235,7 @@ class ServeController:
         # setdefault): Replica's decorated methods hard-require "control",
         # so user-supplied groups must not clobber it.
         opts["concurrency_groups"] = {
-            "control": 2, **(opts.get("concurrency_groups") or {})
+            **(opts.get("concurrency_groups") or {}), "control": 2
         }
         gang = int(spec.get("gang_size") or 1)
         if gang > 1:
