@@ -111,37 +111,89 @@ class Dataset:
     def _with(self, fn) -> "Dataset":
         return Dataset(self._blocks, self._pending + [fn], self._executor)
 
-    def map(self, fn, **_) -> "Dataset":
-        return self._with(_map_rows_fn(fn))
+    @staticmethod
+    def _transform_opts(op: str, num_cpus=None, num_gpus=None,
+                        resources=None, concurrency=None, unknown=None):
+        """Validate + package per-transform execution options. A kwarg we
+        neither honor nor know is a TypeError, not a silent no-op
+        (reference: ``data/dataset.py`` map signature validates kwargs)."""
+        if unknown:
+            raise TypeError(
+                f"Dataset.{op}() got unexpected keyword argument(s) "
+                f"{sorted(unknown)}; supported: num_cpus, num_gpus, "
+                "resources, concurrency"
+            )
+        opts = {}
+        if num_cpus is not None:
+            opts["num_cpus"] = num_cpus
+        if num_gpus is not None:
+            opts["num_gpus"] = num_gpus
+        if resources is not None:
+            opts["resources"] = dict(resources)
+        if concurrency is not None:
+            opts["concurrency"] = int(concurrency)
+        return opts
 
-    def flat_map(self, fn, **_) -> "Dataset":
-        return self._with(_flat_map_fn(fn))
+    def map(self, fn, *, num_cpus=None, num_gpus=None, resources=None,
+            concurrency=None, **kw) -> "Dataset":
+        opts = self._transform_opts(
+            "map", num_cpus, num_gpus, resources, concurrency, kw
+        )
+        stage = _map_rows_fn(fn)
+        stage._rt_opts = opts
+        return self._with(stage)
 
-    def filter(self, fn, **_) -> "Dataset":
-        return self._with(_filter_fn(fn))
+    def flat_map(self, fn, *, num_cpus=None, num_gpus=None, resources=None,
+                 concurrency=None, **kw) -> "Dataset":
+        opts = self._transform_opts(
+            "flat_map", num_cpus, num_gpus, resources, concurrency, kw
+        )
+        stage = _flat_map_fn(fn)
+        stage._rt_opts = opts
+        return self._with(stage)
+
+    def filter(self, fn, *, num_cpus=None, num_gpus=None, resources=None,
+               concurrency=None, **kw) -> "Dataset":
+        opts = self._transform_opts(
+            "filter", num_cpus, num_gpus, resources, concurrency, kw
+        )
+        stage = _filter_fn(fn)
+        stage._rt_opts = opts
+        return self._with(stage)
 
     def map_batches(self, fn, *, batch_size: Optional[int] = 1024,
                     batch_format: str = "numpy",
                     fn_kwargs: Optional[dict] = None,
-                    concurrency: int = 2,
+                    concurrency: Optional[int] = None,
                     fn_constructor_args: tuple = (),
                     fn_constructor_kwargs: Optional[dict] = None,
-                    resources: Optional[dict] = None, **_) -> "Dataset":
+                    num_cpus=None, num_gpus=None,
+                    resources: Optional[dict] = None, **kw) -> "Dataset":
         """Batch transform. A callable CLASS runs on a pool of stateful
         actors (constructed once per actor, reused across blocks —
         reference: actor_pool_map_operator); a plain function fuses into
         per-block tasks."""
         import inspect
 
+        opts = self._transform_opts(
+            "map_batches", num_cpus, num_gpus, resources, None, kw
+        )
         if inspect.isclass(fn):
             from ray_tpu.data.executor import ActorStage
 
             return self._with(ActorStage(
                 fn, fn_constructor_args, fn_constructor_kwargs,
-                batch_size, batch_format, fn_kwargs, concurrency,
-                resources=resources,
+                batch_size, batch_format, fn_kwargs, concurrency or 2,
+                resources=resources, num_cpus=num_cpus, num_gpus=num_gpus,
             ))
-        return self._with(_map_batches_fn(fn, batch_size, batch_format, fn_kwargs))
+        stage = _map_batches_fn(fn, batch_size, batch_format, fn_kwargs)
+        # Only an EXPLICIT concurrency caps the fused stage's in-flight
+        # window; the actor-pool default above must not throttle the
+        # task path.
+        if concurrency is not None:
+            opts = dict(opts, concurrency=concurrency)
+        stage._rt_opts = opts
+        return self._with(stage)
 
     def add_column(self, name: str, fn, **_) -> "Dataset":
         return self._with(_add_column_fn(name, fn))

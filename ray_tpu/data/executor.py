@@ -50,7 +50,8 @@ class ActorStage:
     blocks; per-task construction would pay model-load per block)."""
 
     def __init__(self, cls, ctor_args, ctor_kwargs, batch_size, batch_format,
-                 fn_kwargs, concurrency, resources=None):
+                 fn_kwargs, concurrency, resources=None, num_cpus=None,
+                 num_gpus=None):
         import cloudpickle
 
         self.payload = cloudpickle.dumps(
@@ -59,6 +60,8 @@ class ActorStage:
         )
         self.concurrency = max(int(concurrency), 1)
         self.resources = resources
+        self.num_cpus = num_cpus
+        self.num_gpus = num_gpus
 
     def build_local(self):
         """Local-mode transform: one instance, applied inline."""
@@ -175,11 +178,39 @@ class StreamingExecutor:
         import ray_tpu
 
         payload = cloudpickle.dumps(fns)
+        # Per-transform execution options (Dataset.map(num_cpus=...,
+        # resources=..., concurrency=...)): a fused group takes the max
+        # CPU/GPU request, the union of custom resources, and the
+        # tightest concurrency cap of its member transforms.
+        num_cpus = num_gpus = None
+        resources = {}
+        in_flight = self.max_in_flight
+        for fn in fns:
+            o = getattr(fn, "_rt_opts", None) or {}
+            if o.get("num_cpus") is not None:
+                num_cpus = max(num_cpus or 0, o["num_cpus"])
+            if o.get("num_gpus") is not None:
+                num_gpus = max(num_gpus or 0, o["num_gpus"])
+            for k, v in (o.get("resources") or {}).items():
+                # per-key MAX (like num_cpus): the fused task runs EVERY
+                # member transform, so it needs the largest request
+                resources[k] = max(resources.get(k, 0), v)
+            if o.get("concurrency"):
+                in_flight = min(in_flight, o["concurrency"])
+        task_opts = {}
+        if num_cpus is not None:
+            task_opts["num_cpus"] = num_cpus
+        if num_gpus is not None:
+            task_opts["num_gpus"] = num_gpus
+        if resources:
+            task_opts["resources"] = resources
         apply_task = ray_tpu.remote(_remote_apply)
+        if task_opts:
+            apply_task = apply_task.options(**task_opts)
         pending = collections.deque()
         exhausted = False
         while pending or not exhausted:
-            while not exhausted and len(pending) < self.max_in_flight:
+            while not exhausted and len(pending) < in_flight:
                 try:
                     ref = next(stream)
                 except StopIteration:
@@ -201,6 +232,10 @@ class StreamingExecutor:
         opts = {}
         if stage.resources:
             opts["resources"] = stage.resources
+        if stage.num_cpus is not None:
+            opts["num_cpus"] = stage.num_cpus
+        if stage.num_gpus is not None:
+            opts["num_gpus"] = stage.num_gpus
         worker_cls = ray_tpu.remote(**opts)(_BatchPoolWorker) if opts else (
             ray_tpu.remote(_BatchPoolWorker)
         )

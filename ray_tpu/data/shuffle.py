@@ -263,8 +263,11 @@ class ShufflePlan:
             (list(pending), key, max(sample_cap // max(len(blocks), 1), 64))
         )
         samples = ray_tpu.get([task.remote(payload, b) for b in blocks])
-        allv = np.sort(np.concatenate([s for s in samples if len(s)]))
-        if not len(allv):
+        nonempty = [s for s in samples if len(s)]
+        if not nonempty:
+            # Every block empty (e.g. post-filter): a valid empty dataset —
+            # np.concatenate([]) would raise instead of sorting nothing.
             return np.asarray([])
+        allv = np.sort(np.concatenate(nonempty))
         qs = np.linspace(0, len(allv) - 1, self.P + 1)[1:-1].astype(int)
         return allv[qs]

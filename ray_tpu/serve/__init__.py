@@ -48,10 +48,12 @@ __all__ = [
     "run",
     "shutdown",
     "start_http_proxy",
+    "start_grpc_proxy",
     "status",
 ]
 
 _proxy = None
+_grpc_proxy = None
 
 
 def _get_or_start_controller():
@@ -182,7 +184,7 @@ def delete(name: str):
 
 
 def shutdown():
-    global _proxy
+    global _proxy, _grpc_proxy
     import ray_tpu
 
     try:
@@ -200,6 +202,13 @@ def shutdown():
         except Exception:
             pass
         _proxy = None
+    if _grpc_proxy is not None:
+        try:
+            ray_tpu.get(_grpc_proxy.stop.remote(), timeout=10)
+            ray_tpu.kill(_grpc_proxy)
+        except Exception:
+            pass
+        _grpc_proxy = None
 
 
 def start_http_proxy(host: str = "127.0.0.1", port: int = 0) -> int:
@@ -214,3 +223,18 @@ def start_http_proxy(host: str = "127.0.0.1", port: int = 0) -> int:
         host, port
     )
     return ray_tpu.get(_proxy.start.remote(), timeout=30)
+
+
+def start_grpc_proxy(host: str = "127.0.0.1", port: int = 0) -> int:
+    """Start the gRPC ingress actor; returns the bound port (reference:
+    the dual-protocol ProxyActor — ``serve/_private/proxy.py:11``; msgpack
+    payloads over generic method handlers, see ``grpc_proxy.py``)."""
+    global _grpc_proxy
+    import ray_tpu
+    from ray_tpu.serve.grpc_proxy import GRPCProxy
+
+    actor_cls = ray_tpu.remote(max_concurrency=64)(GRPCProxy)
+    _grpc_proxy = actor_cls.options(
+        name="__serve_grpc_proxy", get_if_exists=True
+    ).remote(host, port)
+    return ray_tpu.get(_grpc_proxy.start.remote(), timeout=30)

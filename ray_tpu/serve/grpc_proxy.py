@@ -1,0 +1,185 @@
+"""gRPC ingress proxy (reference: ``python/ray/serve/_private/proxy.py:11``
+— the reference ProxyActor serves HTTP *and* gRPC; this is the gRPC half).
+
+Runs a ``grpc.aio`` server inside an async actor, sharing the SAME routing
+machinery as the HTTP proxy (controller route table + DeploymentHandle's
+power-of-two-choices router). The service is registered with *generic*
+method handlers — no protoc codegen — and speaks msgpack payloads:
+
+    service rayserve.v1.RayServe {
+      rpc Predict(bytes) returns (bytes);            // unary
+      rpc PredictStream(bytes) returns (stream bytes);  // generator apps
+    }
+
+Request payload (msgpack map):
+    {"route": "/app", "method": "__call__"?, "data": <any>,
+     "multiplexed_model_id": str?}
+Response payload (msgpack): the deployment's return value. Errors map to
+gRPC status codes (NOT_FOUND for unknown routes, INTERNAL for user errors),
+matching the reference proxy's status semantics.
+
+The ``serve-multiplexed-model-id`` request metadata key is honored like the
+reference's gRPC proxy, taking precedence over the payload field.
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, Optional
+
+import msgpack
+
+SERVICE = "rayserve.v1.RayServe"
+
+
+def _pack(obj) -> bytes:
+    return msgpack.packb(obj, use_bin_type=True, default=str)
+
+
+def _unpack(data: bytes):
+    return msgpack.unpackb(data, raw=False)
+
+
+class GRPCProxy:
+    """Async actor hosting the gRPC ingress (reference: ProxyActor's gRPC
+    server sharing the Router with the HTTP side)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._host = host
+        self._port = port
+        self._handles: Dict[str, object] = {}
+        self._server = None
+
+    async def start(self) -> int:
+        import grpc
+
+        proxy = self
+
+        class _Handler(grpc.GenericRpcHandler):
+            def service(self, handler_call_details):
+                name = handler_call_details.method
+                if name == f"/{SERVICE}/Predict":
+                    return grpc.unary_unary_rpc_method_handler(
+                        proxy._predict,
+                        request_deserializer=None,
+                        response_serializer=None,
+                    )
+                if name == f"/{SERVICE}/PredictStream":
+                    return grpc.unary_stream_rpc_method_handler(
+                        proxy._predict_stream,
+                        request_deserializer=None,
+                        response_serializer=None,
+                    )
+                return None
+
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers((_Handler(),))
+        self._port = self._server.add_insecure_port(
+            f"{self._host}:{self._port}"
+        )
+        await self._server.start()
+        return self._port
+
+    def port(self) -> int:
+        return self._port
+
+    # ------------------------------------------------------------- routing
+
+    def _route_for(self, path: str) -> Optional[str]:
+        import ray_tpu
+        from ray_tpu.serve.controller import CONTROLLER_NAME
+
+        routes = ray_tpu.get(
+            ray_tpu.get_actor(CONTROLLER_NAME).get_routes.remote(), timeout=10
+        )
+        best = None
+        for prefix, deployment in routes.items():
+            if path.startswith(prefix) and (
+                best is None or len(prefix) > len(best[0])
+            ):
+                best = (prefix, deployment)
+        return None if best is None else best[1]
+
+    def _handle_for(self, req: dict, context):
+        """Resolve the deployment handle + per-request options, or abort."""
+        import grpc
+
+        route = req.get("route") or "/"
+        deployment = self._route_for(route)
+        if deployment is None:
+            context.set_code(grpc.StatusCode.NOT_FOUND)
+            context.set_details(f"no route for {route!r}")
+            return None, None
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        handle = self._handles.get(deployment)
+        if handle is None:
+            handle = self._handles[deployment] = DeploymentHandle(deployment)
+        model_id = req.get("multiplexed_model_id") or ""
+        for key, value in context.invocation_metadata() or ():
+            if key == "serve-multiplexed-model-id" and value:
+                model_id = value
+        if model_id:
+            handle = handle.options(multiplexed_model_id=model_id)
+        return handle, req.get("method") or "__call__"
+
+    async def _predict(self, request: bytes, context) -> bytes:
+        import grpc
+
+        try:
+            req = _unpack(request)
+        except Exception as e:
+            context.set_code(grpc.StatusCode.INVALID_ARGUMENT)
+            context.set_details(f"bad msgpack request: {e}")
+            return b""
+        handle, method = self._handle_for(req, context)
+        if handle is None:
+            return b""
+        loop = asyncio.get_running_loop()
+        try:
+            caller = (
+                handle if method == "__call__" else getattr(handle, method)
+            )
+            resp = caller.remote(req.get("data"))
+            out = await loop.run_in_executor(None, resp.result, 60)
+        except Exception as e:
+            context.set_code(grpc.StatusCode.INTERNAL)
+            context.set_details(f"{type(e).__name__}: {e}")
+            return b""
+        return _pack(out)
+
+    async def _predict_stream(self, request: bytes, context):
+        import grpc
+
+        try:
+            req = _unpack(request)
+        except Exception as e:
+            context.set_code(grpc.StatusCode.INVALID_ARGUMENT)
+            context.set_details(f"bad msgpack request: {e}")
+            return
+        handle, method = self._handle_for(req, context)
+        if handle is None:
+            return
+        handle = handle.options(stream=True)
+        loop = asyncio.get_running_loop()
+        try:
+            caller = (
+                handle if method == "__call__" else getattr(handle, method)
+            )
+            gen = caller.remote(req.get("data"))
+            # __iter__ resolves the response (blocking): keep it off-loop.
+            it = await loop.run_in_executor(None, iter, gen)
+            done = object()  # StopIteration cannot cross an executor Future
+            while True:
+                chunk = await loop.run_in_executor(None, next, it, done)
+                if chunk is done:
+                    break
+                yield _pack(chunk)
+        except Exception as e:
+            context.set_code(grpc.StatusCode.INTERNAL)
+            context.set_details(f"{type(e).__name__}: {e}")
+
+    async def stop(self) -> bool:
+        if self._server is not None:
+            await self._server.stop(grace=1.0)
+            self._server = None
+        return True

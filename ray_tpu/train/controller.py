@@ -63,6 +63,10 @@ class TrainController:
         decision = self._scaling_policy.initial_decision()
         world_size = decision.world_size
         attempt = 0
+        # World size to revert to if a VOLUNTARY grow restart fails to
+        # start (the capacity grow_decision saw raced away): not a training
+        # failure, must not consume retries.
+        grow_fallback = None
         while True:
             group = WorkerGroup(
                 self._scaling,
@@ -78,10 +82,32 @@ class TrainController:
                     self._ckpt_manager.latest_checkpoint,
                     attempt=attempt,
                 )
-                error = self._monitor(group)
             except Exception as e:  # start failed (e.g. resources not yet
-                # released after a node death) — treat as a group failure
+                # released after a node death) — treat as a group failure,
+                # unless this was a grow attempt: then just fall back.
+                group.shutdown()
+                if grow_fallback is not None:
+                    world_size = grow_fallback
+                    grow_fallback = None
+                    attempt += 1
+                    continue
                 error = f"worker group start failed: {e}"
+                if (
+                    self._failure_policy.make_decision(error)
+                    is FailureDecision.RAISE
+                ):
+                    raise TrainingFailedError(
+                        f"training failed after "
+                        f"{self._failure_policy.failures - 1} retries: "
+                        f"{error}"
+                    )
+                world_size, attempt = self._await_recovery(error, attempt)
+                continue
+            grow_fallback = None
+            try:
+                error = self._monitor(group, world_size)
+            except Exception as e:
+                error = f"worker group poll failed: {e}"
             group.shutdown()
             if error is None:
                 return Result(
@@ -91,30 +117,43 @@ class TrainController:
                     path=self._run_dir,
                     metrics_history=self._metrics_history,
                 )
+            if isinstance(error, tuple) and error[0] == "__grow__":
+                # Capacity returned (elastic): resize up from the latest
+                # checkpoint. Not a failure — does not consume retries
+                # (reference: elastic.py resize decisions).
+                grow_fallback = world_size
+                world_size = error[1]
+                attempt += 1
+                continue
             if self._failure_policy.make_decision(error) is FailureDecision.RAISE:
                 raise TrainingFailedError(
                     f"training failed after {self._failure_policy.failures - 1} "
                     f"retries: {error}"
                 )
-            # Let leases/health state settle before sizing the restart
-            # (resources of the failed group release asynchronously).
-            recovery = None
-            deadline = time.monotonic() + self._recovery_timeout
-            while time.monotonic() < deadline:
-                time.sleep(self._poll_interval * 4)
-                recovery = self._scaling_policy.recovery_decision()
-                if recovery is not None and recovery.world_size >= 1:
-                    break
-            if recovery is None:
-                raise TrainingFailedError(
-                    f"cannot restart: cluster below min_workers "
-                    f"({self._scaling.min_workers}); last error: {error}"
-                )
-            world_size = recovery.world_size
-            attempt += 1
+            world_size, attempt = self._await_recovery(error, attempt)
 
-    def _monitor(self, group: WorkerGroup) -> Optional[str]:
-        """Poll until all workers finish. Returns an error string or None."""
+    def _await_recovery(self, error, attempt):
+        """Wait for leases/health state to settle, then size the restart
+        (resources of the failed group release asynchronously)."""
+        recovery = None
+        deadline = time.monotonic() + self._recovery_timeout
+        while time.monotonic() < deadline:
+            time.sleep(self._poll_interval * 4)
+            recovery = self._scaling_policy.recovery_decision()
+            if recovery is not None and recovery.world_size >= 1:
+                break
+        if recovery is None:
+            raise TrainingFailedError(
+                f"cannot restart: cluster below min_workers "
+                f"({self._scaling.min_workers}); last error: {error}"
+            )
+        return recovery.world_size, attempt + 1
+
+    def _monitor(self, group: WorkerGroup, world_size: int = 0):
+        """Poll until all workers finish. Returns an error string, a
+        ("__grow__", n) resize marker, or None."""
+        grow_check = getattr(self._scaling_policy, "grow_decision", None)
+        next_grow = time.monotonic() + 2.0
         while True:
             statuses = group.poll()
             error = None
@@ -127,6 +166,17 @@ class TrainController:
                 return error
             if all(st.done for st in statuses):
                 return None
+            # Elastic grow-back: when spare capacity appears mid-run and a
+            # checkpoint exists to resume from, restart larger.
+            if (
+                grow_check is not None
+                and time.monotonic() >= next_grow
+                and self._ckpt_manager.latest_checkpoint is not None
+            ):
+                next_grow = time.monotonic() + 2.0
+                decision = grow_check(world_size)
+                if decision is not None:
+                    return ("__grow__", decision.world_size)
             time.sleep(self._poll_interval)
 
     def _ingest_report(self, rep: dict):

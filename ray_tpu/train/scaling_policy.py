@@ -68,6 +68,19 @@ class ElasticScalingPolicy(ScalingPolicy):
             return None
         return ScalingDecision(n)
 
+    def grow_decision(self, current: int) -> Optional[ScalingDecision]:
+        """Mid-run grow check (reference: elastic.py resize decisions —
+        a returned node grows the world back toward num_workers). The
+        running workers' resources are already acquired, so free capacity
+        counts EXTRA worlds on top of ``current``."""
+        if current >= self.scaling.num_workers:
+            return None
+        extra = self._available_worlds()
+        n = min(self.scaling.num_workers, current + extra)
+        if n > current:
+            return ScalingDecision(n)
+        return None
+
 
 def make_scaling_policy(scaling: ScalingConfig) -> ScalingPolicy:
     return (

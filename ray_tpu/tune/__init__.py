@@ -29,6 +29,7 @@ from ray_tpu.tune.schedulers import (
     TrialScheduler,
 )
 from ray_tpu.tune.search import (
+    BayesOptSearch,
     OptunaSearch,
     BasicVariantGenerator,
     Choice,
@@ -68,6 +69,7 @@ __all__ = [
     "get_checkpoint",
     "get_context",
     "grid_search",
+    "BayesOptSearch",
     "OptunaSearch",
     "lograndint",
     "loguniform",

@@ -326,3 +326,131 @@ class OptunaSearch(Searcher):
             )
         else:
             self._study.tell(trial, result[self._metric])
+
+
+class BayesOptSearch(Searcher):
+    """Native GP-UCB Bayesian searcher — no external dependency.
+
+    Reference analog: ``python/ray/tune/search/bayesopt`` (which wraps the
+    bayesian-optimization package). Here the model is the same
+    numpy-RBF-kernel-ridge GP recipe PB2 already uses (``schedulers.PB2``):
+    continuous domains are normalized to [0, 1]^d, UCB (mu + kappa * sigma)
+    is maximized over random candidates, and observations come from
+    ``on_trial_complete``. Categorical/choice axes fall back to random
+    sampling (GP-UCB over one-hot axes adds noise at these trial counts).
+    """
+
+    def __init__(self, param_space: Dict[str, Any], *, metric: str,
+                 mode: str = "min", num_samples: int = 16,
+                 random_startup: int = 4, kappa: float = 1.5,
+                 seed: Optional[int] = None):
+        import math
+
+        import numpy as np
+
+        if not metric:
+            raise ValueError("BayesOptSearch requires metric=")
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
+        self._np = np
+        self._metric = metric
+        self._mode = mode
+        self._num_samples = num_samples
+        self._startup = random_startup
+        self._kappa = kappa
+        self._rng = random.Random(seed)
+        self._np_rng = np.random.RandomState(seed)
+        # (name, transform) for GP axes; everything else samples randomly.
+        self._axes: List[tuple] = []
+        self._other: Dict[str, Domain] = {}
+        self._fixed: Dict[str, Any] = {}
+        for name, dom in param_space.items():
+            if isinstance(dom, dict):
+                raise ValueError(
+                    "BayesOptSearch does not support nested/grid spaces "
+                    f"(param {name!r}); flatten the space or use the "
+                    "default BasicVariantGenerator"
+                )
+            if isinstance(dom, LogUniform):
+                lo, hi = dom.lo, dom.hi  # already log-space bounds
+                self._axes.append(
+                    (name, lambda u, lo=lo, hi=hi: math.exp(
+                        lo + u * (hi - lo)))
+                )
+            elif isinstance(dom, QUniform):
+                lo, hi, q = dom.low, dom.high, dom.q
+                self._axes.append(
+                    (name, lambda u, lo=lo, hi=hi, q=q: round(
+                        (lo + u * (hi - lo)) / q) * q)
+                )
+            elif isinstance(dom, Uniform):
+                lo, hi = dom.low, dom.high
+                self._axes.append(
+                    (name, lambda u, lo=lo, hi=hi: lo + u * (hi - lo))
+                )
+            elif isinstance(dom, (RandInt, LogRandInt)):
+                lo, hi = dom.low, dom.high
+                self._axes.append(
+                    (name, lambda u, lo=lo, hi=hi: min(
+                        int(lo + u * (hi - lo)), hi - 1))
+                )
+            elif isinstance(dom, Domain):
+                self._other[name] = dom
+            else:
+                self._fixed[name] = dom
+        self._suggested = 0
+        self._pending: Dict[str, "Any"] = {}  # trial_id -> unit vector
+        self._X: List[Any] = []
+        self._y: List[float] = []
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._suggested >= self._num_samples:
+            return None
+        self._suggested += 1
+        u = self._pick_unit()
+        self._pending[trial_id] = u
+        cfg = dict(self._fixed)
+        for (name, tf), ui in zip(self._axes, u):
+            cfg[name] = tf(float(ui))
+        for name, dom in self._other.items():
+            cfg[name] = dom.sample(self._rng)
+        return cfg
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict],
+                          error: bool = False):
+        u = self._pending.pop(trial_id, None)
+        if u is None or error or not result or self._metric not in result:
+            return
+        score = float(result[self._metric])
+        if self._mode == "min":
+            score = -score
+        self._X.append(u)
+        self._y.append(score)
+
+    # ------------------------------------------------------- GP-UCB pick
+
+    def _pick_unit(self):
+        np = self._np
+        d = max(len(self._axes), 1)
+        cands = self._np_rng.rand(256, d)
+        if len(self._y) < self._startup or not self._axes:
+            return cands[0]
+        X = np.stack(self._X[-256:])
+        y = np.asarray(self._y[-256:])
+        y = (y - y.mean()) / (y.std() + 1e-9)
+
+        def rbf(A, B, ls=0.3):
+            d2 = ((A[:, None, :] - B[None, :, :]) ** 2).sum(-1)
+            return np.exp(-0.5 * d2 / (ls * ls))
+
+        K = rbf(X, X) + 1e-2 * np.eye(len(X))
+        try:
+            Kinv_y = np.linalg.solve(K, y)
+            Ks = rbf(cands, X)
+            mu = Ks @ Kinv_y
+            Kinv_Ks = np.linalg.solve(K, Ks.T)
+            var = np.clip(1.0 - np.sum(Ks * Kinv_Ks.T, axis=1), 1e-9, None)
+            ucb = mu + self._kappa * np.sqrt(var)
+        except np.linalg.LinAlgError:
+            return cands[0]
+        return cands[int(np.argmax(ucb))]

@@ -283,3 +283,84 @@ def test_local_testing_mode_no_cluster():
     h = serve.run(Shouter.bind(Inner.bind()), local_testing_mode=True)
     assert h.remote("hey").result() == "<HEY>!"
     assert h.whisper.remote("LOUD").result() == "loud"
+
+
+def test_grpc_proxy_unary(srv):
+    """gRPC ingress shares the router with HTTP (reference: dual-protocol
+    ProxyActor, serve/_private/proxy.py:11). Unary Predict + status codes."""
+    import grpc
+    import msgpack
+
+    @serve.deployment
+    class Api:
+        def __call__(self, data):
+            return {"doubled": data["x"] * 2}
+
+        def extra(self, data):
+            return {"method": "extra", "x": data["x"]}
+
+    serve.run(Api.bind(), name="gapi", route_prefix="/gapi")
+    port = serve.start_grpc_proxy(port=0)
+
+    chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+    predict = chan.unary_unary(
+        "/rayserve.v1.RayServe/Predict",
+        request_serializer=lambda b: b,
+        response_deserializer=lambda b: b,
+    )
+    out = msgpack.unpackb(predict(
+        msgpack.packb({"route": "/gapi", "data": {"x": 21}},
+                      use_bin_type=True), timeout=60,
+    ), raw=False)
+    assert out == {"doubled": 42}
+
+    # named-method dispatch
+    out = msgpack.unpackb(predict(
+        msgpack.packb({"route": "/gapi", "method": "extra",
+                       "data": {"x": 7}}, use_bin_type=True), timeout=60,
+    ), raw=False)
+    assert out == {"method": "extra", "x": 7}
+
+    # unknown route -> NOT_FOUND
+    with pytest.raises(grpc.RpcError) as ei:
+        predict(msgpack.packb({"route": "/nope", "data": None},
+                              use_bin_type=True), timeout=60)
+    assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+
+    # user error -> INTERNAL
+    with pytest.raises(grpc.RpcError) as ei:
+        predict(msgpack.packb({"route": "/gapi", "data": {}},
+                              use_bin_type=True), timeout=60)
+    assert ei.value.code() == grpc.StatusCode.INTERNAL
+    chan.close()
+
+
+def test_grpc_proxy_streaming(srv):
+    """Server-streaming over a generator deployment."""
+    import grpc
+    import msgpack
+
+    @serve.deployment
+    class Gen:
+        def __call__(self, data):
+            for i in range(int(data["n"])):
+                yield {"i": i}
+
+    serve.run(Gen.bind(), name="ggen", route_prefix="/ggen")
+    port = serve.start_grpc_proxy(port=0)
+
+    chan = grpc.insecure_channel(f"127.0.0.1:{port}")
+    stream = chan.unary_stream(
+        "/rayserve.v1.RayServe/PredictStream",
+        request_serializer=lambda b: b,
+        response_deserializer=lambda b: b,
+    )
+    chunks = [
+        msgpack.unpackb(c, raw=False)
+        for c in stream(
+            msgpack.packb({"route": "/ggen", "data": {"n": 4}},
+                          use_bin_type=True), timeout=60,
+        )
+    ]
+    assert chunks == [{"i": 0}, {"i": 1}, {"i": 2}, {"i": 3}]
+    chan.close()

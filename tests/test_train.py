@@ -1,6 +1,7 @@
 """Train layer tests (reference test model: ``python/ray/train/tests/
 test_data_parallel_trainer.py`` and v2 controller/worker-group tests —
 in-process cluster, fake resources, no real accelerator; SURVEY.md §4)."""
+import json
 import os
 
 import pytest
@@ -280,3 +281,130 @@ def test_torch_trainer_ddp_gloo(rt_cluster, tmp_path):
     import math
 
     assert math.isfinite(result.metrics["loss"])
+
+
+@pytest.mark.parametrize(
+    "rt_cluster", [{"num_cpus": 2, "num_nodes": 2}], indirect=True
+)
+def test_elastic_grows_back_when_node_returns(rt_cluster, tmp_path):
+    """2 -> 1 -> 2: kill a node (shrink), return capacity (grow-back from
+    the latest checkpoint) — the round-trip the reference's elastic.py
+    resize decisions cover (train/v2/.../scaling_policy/elastic.py:29)."""
+    import threading
+    import time as _t
+
+    ray_tpu_mod, cluster = rt_cluster
+
+    def train_fn(config):
+        import tempfile
+        import time
+
+        ctx = train.get_context()
+        start = 0
+        ckpt = train.get_checkpoint()
+        if ckpt is not None:
+            with open(os.path.join(ckpt.path, "step.txt")) as f:
+                start = int(f.read()) + 1
+        for step in range(start, 44):
+            if ctx.get_world_rank() == 0:
+                with tempfile.TemporaryDirectory() as d:
+                    with open(os.path.join(d, "step.txt"), "w") as f:
+                        f.write(str(step))
+                    train.report(
+                        {"step": step, "world": ctx.get_world_size()},
+                        checkpoint=Checkpoint.from_directory(d),
+                    )
+            else:
+                train.report({"step": step, "world": ctx.get_world_size()})
+            time.sleep(0.25)
+
+    def chaos():
+        _t.sleep(2.0)
+        cluster.kill_node(cluster.nodes[1])  # shrink to 1
+        _t.sleep(3.0)
+        cluster.add_node({"CPU": 2})  # capacity returns: grow back
+
+    t = threading.Thread(target=chaos, daemon=True)
+    t.start()
+    result = DataParallelTrainer(
+        train_fn,
+        scaling_config=ScalingConfig(
+            num_workers=2, min_workers=1,
+            resources_per_worker={"CPU": 2},
+            placement_strategy="SPREAD",
+        ),
+        run_config=_run_config(
+            tmp_path, "elastic_grow",
+            failure_config=FailureConfig(max_failures=3),
+        ),
+    ).fit()
+    t.join()
+    assert result.metrics["step"] == 43
+    worlds = [m["world"] for m in result.metrics_history]
+    assert 1 in worlds, f"expected shrink to world=1, saw {set(worlds)}"
+    # after the shrink, the world grew back to 2 and training RESUMED
+    # (later steps at world=2 than the last world=1 step)
+    last_w1 = max(i for i, w in enumerate(worlds) if w == 1)
+    assert any(w == 2 for w in worlds[last_w1 + 1:]), (
+        f"no grow-back after shrink: {worlds}"
+    )
+
+
+def test_megascale_env_rendezvous(tmp_path):
+    """get_tpu_coordinator_env_vars output actually lets two simulated
+    slices rendezvous: two processes run jax.distributed.initialize with
+    the generated MEGASCALE/coordinator settings and agree on the process
+    count (reference: util/tpu.py:205 + train/v2/jax/config.py)."""
+    import socket
+    import subprocess
+    import sys
+
+    from ray_tpu.util.tpu import get_tpu_coordinator_env_vars
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    coord = f"127.0.0.1:{port}"
+
+    script = """
+import json, os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=os.environ["RT_COORD"],
+    num_processes=2,
+    process_id=int(os.environ["RT_PID"]),
+)
+print(json.dumps({
+    "procs": jax.process_count(),
+    "idx": jax.process_index(),
+    "megascale": {
+        k: v for k, v in os.environ.items() if k.startswith("MEGASCALE")
+    },
+}), flush=True)
+"""
+    procs = []
+    for slice_id in range(2):
+        env = dict(
+            os.environ,
+            RT_COORD=coord,
+            RT_PID=str(slice_id),
+            JAX_PLATFORMS="cpu",
+            **get_tpu_coordinator_env_vars(coord, 2, slice_id),
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script],
+            stdout=subprocess.PIPE, text=True, env=env,
+        ))
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=180)
+        assert p.returncode == 0
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+    assert {o["idx"] for o in outs} == {0, 1}
+    assert all(o["procs"] == 2 for o in outs)
+    assert all(
+        o["megascale"]["MEGASCALE_COORDINATOR_ADDRESS"] == coord
+        for o in outs
+    )
+    assert {o["megascale"]["MEGASCALE_SLICE_ID"] for o in outs} == {"0", "1"}
