@@ -73,6 +73,7 @@ def init(
     ignore_reinit_error: bool = False,
     log_level: str = "WARNING",
     _node_env: Optional[Dict[str, str]] = None,
+    _system_config: Optional[Dict[str, Any]] = None,
 ) -> "ClientContext":
     """Start (or connect to) a cluster.
 
@@ -89,6 +90,19 @@ def init(
             if ignore_reinit_error:
                 return ClientContext(_worker_mod.global_worker)
             raise RuntimeError("ray_tpu.init() called twice")
+        if _system_config:
+            # Cluster-wide config overrides (reference: _system_config on
+            # the raylet/GCS command line, gcs_server.h:72). Installed here
+            # for the driver + in-process head; propagated to spawned
+            # nodes as RT_* env vars via _node_env below, and published to
+            # the head KV so workers that CONNECT later (remote clusters,
+            # head-restart rejoin) apply them at registration.
+            from ray_tpu._private.config import rt_config
+
+            rt_config.apply_system_config(_system_config)
+            _node_env = dict(
+                rt_config.system_config_env(), **(_node_env or {})
+            )
         # Resolve the head address like the reference's RAY_ADDRESS/"auto":
         # env var (set for submitted jobs), then the head's address file.
         if address is None:
@@ -182,6 +196,17 @@ def init(
             )
             driver.start_driver()
             _worker_mod.global_worker = driver
+        if _system_config:
+            # Publish to the head KV so later-connecting workers (remote
+            # clusters, rejoin after head restart) apply the overrides at
+            # registration (_connect_gcs reads __rt/system_config).
+            import json as _json
+
+            w = _worker_mod.global_worker
+            w.run_sync(w.gcs.call(
+                "kv_put", {"ns": "__rt", "key": "system_config"},
+                [_json.dumps(_system_config).encode()],
+            ))
         atexit.register(shutdown)
         from ray_tpu._private.usage_stats import record_session_start
 

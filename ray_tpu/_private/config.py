@@ -1,0 +1,169 @@
+"""Typed config registry with env + cluster-wide overrides.
+
+Reference analog: ``src/ray/common/ray_config_def.h`` (240 ``RAY_CONFIG``
+entries) + ``includes/ray_config.pxi``: every tunable is DECLARED in one
+place with a type and default, each is overridable per-process via the
+``RT_<NAME>`` environment variable, and a driver can push cluster-wide
+overrides with ``ray_tpu.init(_system_config={...})`` (reference:
+``_system_config`` serialized into every raylet/GCS command line,
+``gcs_server.h:72``). Here the propagation rides worker-spawn environments
+(local nodes) and the head KV (``__system_config`` namespace, applied by
+workers at registration).
+
+Resolution order (highest wins): explicit env var > cluster _system_config
+> declared default.
+
+Usage::
+
+    from ray_tpu._private.config import rt_config
+    cap = rt_config.arena_bytes
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Optional
+
+
+def _parse_bool(s: str) -> bool:
+    return s.strip().lower() not in ("0", "false", "no", "off", "")
+
+
+class _Entry:
+    __slots__ = ("name", "type", "default", "doc")
+
+    def __init__(self, name: str, type_: Callable, default, doc: str):
+        self.name = name
+        self.type = type_
+        self.default = default
+        self.doc = doc
+
+    @property
+    def env(self) -> str:
+        return "RT_" + self.name.upper()
+
+
+class ConfigRegistry:
+    def __init__(self):
+        self._entries: Dict[str, _Entry] = {}
+        # cluster-wide overrides pushed via init(_system_config=...)
+        self._system: Dict[str, Any] = {}
+
+    def declare(self, name: str, type_: Callable, default, doc: str):
+        self._entries[name] = _Entry(name, type_, default, doc)
+
+    def entries(self) -> Dict[str, _Entry]:
+        return dict(self._entries)
+
+    def validate_system_config(self, overrides: Dict[str, Any]):
+        unknown = set(overrides) - set(self._entries)
+        if unknown:
+            raise ValueError(
+                f"unknown _system_config key(s): {sorted(unknown)}; "
+                f"declared: {sorted(self._entries)}"
+            )
+
+    def apply_system_config(self, overrides: Dict[str, Any]):
+        """Install cluster-wide overrides in this process (values are
+        re-parsed through the declared type so strings from the KV work)."""
+        self.validate_system_config(overrides)
+        for k, v in overrides.items():
+            e = self._entries[k]
+            if e.type is bool:
+                self._system[k] = (
+                    _parse_bool(v) if isinstance(v, str) else bool(v)
+                )
+            elif isinstance(v, str) and e.type is not str:
+                self._system[k] = e.type(v)
+            else:
+                self._system[k] = e.type(v) if e.type is not str else str(v)
+
+    def system_config(self) -> Dict[str, Any]:
+        return dict(self._system)
+
+    def system_config_env(self) -> Dict[str, str]:
+        """The overrides as RT_* env vars for spawned worker processes —
+        the local propagation channel (reference: _system_config on the
+        raylet command line)."""
+        return {
+            self._entries[k].env: str(v) for k, v in self._system.items()
+        }
+
+    def get(self, name: str):
+        e = self._entries[name]
+        raw = os.environ.get(e.env)
+        if raw is not None:
+            try:
+                return e.type(raw) if e.type is not bool else _parse_bool(raw)
+            except (TypeError, ValueError):
+                return e.default
+        if name in self._system:
+            return self._system[name]
+        return e.default
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        try:
+            return self.get(name)
+        except KeyError:
+            raise AttributeError(name) from None
+
+
+rt_config = ConfigRegistry()
+
+# ---------------------------------------------------------------- registry
+# One declaration per tunable (reference: ray_config_def.h). Env var is
+# RT_<NAME>; most existed as scattered os.environ reads before round 4.
+
+rt_config.declare(
+    "arena_bytes", int, 4 << 30,
+    "Native shm arena capacity per session (plasma-equivalent store size).")
+rt_config.declare(
+    "disable_native_store", bool, False,
+    "Force the portable per-segment store even when the native arena "
+    "builds (diagnostics).")
+rt_config.declare(
+    "native_xfer", bool, True,
+    "Serve shm objects over the native C++ TCP transfer plane.")
+rt_config.declare(
+    "native_sched", bool, True,
+    "Use the native C++ resource scheduler in the head.")
+rt_config.declare(
+    "native_ring", bool, True,
+    "Use the shm ring fast-dispatch plane for same-host task/actor calls.")
+rt_config.declare(
+    "spill_dir", str, "",
+    "Directory for object spills (default: session temp dir).")
+rt_config.declare(
+    "memory_threshold", float, 0.95,
+    "Host memory fraction above which the OOM defense engages "
+    "(reference: memory_usage_threshold).")
+rt_config.declare(
+    "lineage_bytes", int, 256 << 20,
+    "Max bytes of task lineage retained for object reconstruction "
+    "(reference: max_lineage_bytes).")
+rt_config.declare(
+    "head_reconnect_s", float, 60.0,
+    "How long workers/drivers retry the head connection before giving up "
+    "(live-cluster rejoin window).")
+rt_config.declare(
+    "runtime_env_dir", str, "",
+    "Cache directory for runtime-env venvs/packages.")
+rt_config.declare(
+    "cluster_state_dir", str, "",
+    "Directory for cluster launcher state files.")
+rt_config.declare(
+    "profile_dir", str, "",
+    "Dump per-process cProfile stats here on exit (diagnostics).")
+rt_config.declare(
+    "stream_window", int, 16,
+    "Streaming-generator flow control: max items a producer runs ahead "
+    "of consumer acknowledgments.")
+rt_config.declare(
+    "lease_idle_s", float, 1.0,
+    "How long a worker caches an idle task lease before returning it "
+    "(reference: idle worker reaping).")
+rt_config.declare(
+    "health_check_period_s", float, 2.0,
+    "Head liveness probe interval per node "
+    "(reference: health_check_period_ms).")

@@ -188,7 +188,9 @@ class HeadService:
         # autoscaler; scheduling decisions come from the native side when the
         # library is buildable (RT_NATIVE_SCHED=0 forces the Python fallback).
         self._nsched = None
-        if os.environ.get("RT_NATIVE_SCHED", "1") != "0":
+        from ray_tpu._private.config import rt_config
+
+        if rt_config.native_sched:
             try:
                 from ray_tpu.native import sched as _native_sched
 

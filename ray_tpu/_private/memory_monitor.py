@@ -12,6 +12,12 @@ cgroup v2 limits are honored when present (containers), else /proc/meminfo.
 from __future__ import annotations
 
 import os
+
+
+def _rt_config():
+    from ray_tpu._private.config import rt_config
+
+    return rt_config
 import time
 from typing import Optional, Tuple
 
@@ -84,7 +90,7 @@ class MemoryMonitor:
     def __init__(self, threshold: Optional[float] = None):
         if threshold is None:
             threshold = float(
-                os.environ.get("RT_MEMORY_THRESHOLD", DEFAULT_THRESHOLD)
+                _rt_config().get("memory_threshold")
             )
         self.threshold = threshold
         self._last_check = 0.0

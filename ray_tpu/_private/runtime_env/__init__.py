@@ -15,13 +15,15 @@ dedicated per-env subprocess (``executor.py``) instead of re-launching the
 whole worker: the process-per-host worker owns the TPU and must not be
 recycled per env.
 
-Supported plugins: env_vars, working_dir, py_modules, pip, uv.
+Supported plugins: env_vars, working_dir, py_modules, pip, uv, conda
+(cached conda envs — ``conda.py``), image_uri (container executors).
 Anything else fails loudly at execution time — silent degradation hid real
 capability gaps (round-1 review finding).
 """
 from __future__ import annotations
 
-KNOWN_PLUGINS = ("env_vars", "working_dir", "py_modules", "pip", "uv")
+KNOWN_PLUGINS = ("env_vars", "working_dir", "py_modules", "pip", "uv",
+                 "conda", "image_uri")
 
 
 def validate(renv: dict):

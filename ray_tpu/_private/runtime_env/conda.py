@@ -1,0 +1,109 @@
+"""Conda + container (image_uri) runtime-env plugins.
+
+Reference analogs: ``python/ray/_private/runtime_env/conda.py`` (cached
+conda env creation keyed by the spec hash) and ``image_uri.py`` (worker
+runs inside a container). Both reuse the venv plugins' executor-subprocess
+model: the prepared interpreter runs the framed child loop from
+``executor.py``; for containers the loop simply launches through
+``docker run -i`` (or podman) — the stdin/stdout protocol is
+transport-agnostic.
+
+Both plugins fail LOUDLY when their binary (conda / docker / podman) is
+absent: a task must not silently run outside the environment it asked for.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import subprocess
+from typing import Any, Dict, List, Optional, Union
+
+from ray_tpu._private.runtime_env.venv import _env_root as _cache_root
+
+
+def conda_env_key(spec: Union[List[str], Dict[str, Any]]) -> str:
+    blob = json.dumps(spec, sort_keys=True).encode()
+    return "conda-" + hashlib.sha256(blob).hexdigest()[:16]
+
+
+def ensure_conda_env(spec: Union[List[str], Dict[str, Any]]) -> str:
+    """Create (or reuse) a cached conda env; returns its python path.
+
+    ``spec``: a package list (``{"conda": ["scipy=1.11"]}``) or a full
+    environment dict (``{"dependencies": [...], "channels": [...]}``) —
+    the same two shapes the reference accepts.
+    """
+    conda = shutil.which("conda") or shutil.which("mamba") \
+        or shutil.which("micromamba")
+    if conda is None:
+        raise RuntimeError(
+            "runtime_env 'conda' requires a conda/mamba binary on PATH; "
+            "none found (use the 'pip' plugin for venv-based envs)"
+        )
+    root = _cache_root()
+    prefix = os.path.join(root, conda_env_key(spec))
+    python = os.path.join(prefix, "bin", "python")
+    if os.path.exists(python):
+        return python
+    tmp_prefix = prefix + ".tmp"
+    shutil.rmtree(tmp_prefix, ignore_errors=True)
+    if isinstance(spec, dict):
+        import tempfile
+
+        with tempfile.NamedTemporaryFile(
+            "w", suffix=".yml", delete=False
+        ) as f:
+            try:
+                import yaml
+
+                yaml.safe_dump(spec, f)
+            except ImportError:
+                json.dump(spec, f)  # conda accepts JSON env files
+            env_file = f.name
+        cmd = [conda, "env", "create", "-p", tmp_prefix, "-f", env_file,
+               "--yes"]
+    else:
+        cmd = [conda, "create", "-p", tmp_prefix, "--yes", "python",
+               *list(spec)]
+    res = subprocess.run(cmd, capture_output=True, text=True, timeout=1800)
+    if res.returncode != 0:
+        shutil.rmtree(tmp_prefix, ignore_errors=True)
+        raise RuntimeError(
+            f"conda env creation failed:\n{res.stderr[-2000:]}"
+        )
+    os.replace(tmp_prefix, prefix)
+    return python
+
+
+def container_argv(image_uri: str, child_src: str,
+                   path_entries: Optional[List[str]] = None,
+                   working_dir: Optional[str] = None) -> List[str]:
+    """argv that runs the executor child loop inside a container
+    (reference: ``image_uri.py`` — podman-launched workers). The repo,
+    staged py_modules, and the task's working_dir are bind-mounted at
+    their HOST paths so cloudpickled functions, sys.path entries, and
+    os.chdir targets resolve inside the container; PYTHONPATH is set
+    in-container (the docker client's env never crosses the boundary)."""
+    runtime = shutil.which("podman") or shutil.which("docker")
+    if runtime is None:
+        raise RuntimeError(
+            "runtime_env 'image_uri' requires podman or docker on PATH; "
+            "neither found"
+        )
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )))
+    entries = [os.path.abspath(e) for e in (path_entries or ())]
+    pythonpath = os.pathsep.join([*entries, repo_root])
+    argv = [runtime, "run", "--rm", "-i",
+            "-v", f"{repo_root}:{repo_root}:ro",
+            "-e", f"PYTHONPATH={pythonpath}"]
+    for e in entries:
+        argv += ["-v", f"{e}:{e}:ro"]
+    if working_dir:
+        wd = os.path.abspath(working_dir)
+        argv += ["-v", f"{wd}:{wd}"]
+    argv += [image_uri, "python", "-u", "-c", child_src]
+    return argv

@@ -99,7 +99,14 @@ class EnvExecutor:
     task-slot accounting still bounds concurrency — one slot drives one
     executor call at a time)."""
 
-    def __init__(self, python: str, path_entries: Optional[List[str]] = None):
+    def __init__(self, python: str, path_entries: Optional[List[str]] = None,
+                 argv: Optional[List[str]] = None,
+                 inherit_parent_site: bool = True):
+        """``argv`` overrides the child command entirely (the container
+        plugin launches the SAME child loop via ``docker run -i ... python
+        -c``; the framed stdin/stdout protocol is transport-agnostic).
+        ``inherit_parent_site=False`` for isolated interpreters (conda,
+        containers) whose package set must not be polluted by the host's."""
         self.python = python
         env = dict(os.environ)
         # The child must import ray_tpu's deps (cloudpickle) and any staged
@@ -118,12 +125,13 @@ class EnvExecutor:
         # Parent site-packages (appended by the child AFTER its own): see
         # _CHILD_SRC. sys.path is the honest source — site.getsitepackages
         # misses venv layouts.
-        env["RT_PARENT_SITE"] = os.pathsep.join(
-            p for p in sys.path if "site-packages" in p
-        )
+        if inherit_parent_site:
+            env["RT_PARENT_SITE"] = os.pathsep.join(
+                p for p in sys.path if "site-packages" in p
+            )
         self._lock = threading.Lock()
         self.proc = subprocess.Popen(
-            [python, "-u", "-c", _CHILD_SRC],
+            argv or [python, "-u", "-c", _CHILD_SRC],
             stdin=subprocess.PIPE,
             stdout=subprocess.PIPE,
             env=env,

@@ -37,7 +37,9 @@ class SpillManager:
     """
 
     def __init__(self, root: Optional[str] = None, session: str = ""):
-        env_root = os.environ.get("RT_SPILL_DIR")
+        from ray_tpu._private.config import rt_config
+
+        env_root = rt_config.spill_dir or None
         self.root = root or env_root or os.path.join(
             tempfile.gettempdir(), f"rt_spill_{session or os.getpid()}"
         )

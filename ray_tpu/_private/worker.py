@@ -59,6 +59,12 @@ from ray_tpu.object_ref import ObjectRef, collect_refs_during
 
 logger = logging.getLogger(__name__)
 
+
+def _lineage_bytes_limit() -> int:
+    from ray_tpu._private.config import rt_config
+
+    return rt_config.lineage_bytes
+
 INLINE_OBJECT_MAX = 100 * 1024  # small objects travel inline / live in memory store
 FN_NS = "fn"
 
@@ -293,7 +299,7 @@ class CoreWorker:
         self._env_exec_keylocks: Dict[tuple, threading.Lock] = {}
         self._env_exec_lock = threading.Lock()
         self._LINEAGE_MAX_BYTES = int(
-            os.environ.get("RT_LINEAGE_BYTES", 256 * 1024 * 1024)
+            _lineage_bytes_limit()
         )
         self._reconstructing: set = set()
         self._task_events_buf: List[dict] = []
@@ -423,7 +429,8 @@ class CoreWorker:
         # instead of the Python RPC plane. Binds the SAME host the RPC plane
         # advertises (no wider), and starts in an executor — the first call
         # may compile the library and must not stall the event loop.
-        if os.environ.get("RT_NATIVE_XFER", "1") != "0":
+        from ray_tpu._private.config import rt_config
+        if rt_config.native_xfer:
             try:
                 from ray_tpu.native import xfer as native_xfer
 
@@ -459,6 +466,21 @@ class CoreWorker:
         self.gcs.on_close = self._on_gcs_lost
         await self.gcs.call("subscribe", {"channel": "object_free"})
         await self.gcs.call("subscribe", {"channel": "lease_reclaim"})
+        # Cluster-wide config overrides (init(_system_config=...)) live in
+        # the head KV; every process applies them at (re)connection —
+        # the reference passes _system_config on raylet command lines.
+        try:
+            hh, frames = await self.gcs.call(
+                "kv_get", {"ns": "__rt", "key": "system_config"}
+            )
+            if hh.get("found") and frames:
+                import json as _json
+
+                from ray_tpu._private.config import rt_config
+
+                rt_config.apply_system_config(_json.loads(frames[0]))
+        except (protocol.RpcError, ValueError):
+            pass
         if self.is_driver:
             await self.gcs.call("register_job", {"job_id": self.job_id.hex()})
         else:
@@ -510,10 +532,12 @@ class CoreWorker:
             self._gcs_reconnecting = False
 
     async def _reconnect_gcs_inner(self):
+        from ray_tpu._private.config import rt_config
+
         for lease_set in self.leases.values():
             lease_set.slots = [s for s in lease_set.slots if s.busy > 0]
         deadline = time.monotonic() + float(
-            os.environ.get("RT_HEAD_RECONNECT_S", "60")
+            rt_config.head_reconnect_s
         )
         delay = 0.25
         while not self._shutdown and time.monotonic() < deadline:
@@ -2561,7 +2585,15 @@ class CoreWorker:
         entries = []
         if renv.get("py_modules"):
             entries = packaging.fetch_modules(self, renv["py_modules"])
-        key = (venv.env_key(packages, use_uv), tuple(entries))
+        if renv.get("image_uri"):
+            ekey = "img-" + renv["image_uri"]
+        elif renv.get("conda"):
+            from ray_tpu._private.runtime_env import conda as conda_mod
+
+            ekey = conda_mod.conda_env_key(renv["conda"])
+        else:
+            ekey = venv.env_key(packages, use_uv)
+        key = (ekey, tuple(entries))
         with self._env_exec_lock:
             ex = self._env_executors.get(key)
             if ex is not None and not ex.alive():
@@ -2578,8 +2610,36 @@ class CoreWorker:
                 with self._env_exec_lock:
                     ex = self._env_executors.get(key)
                 if ex is None or not ex.alive():
-                    python = venv.ensure_venv(packages, use_uv=use_uv)
-                    ex = EnvExecutor(python, path_entries=entries)
+                    if renv.get("image_uri"):
+                        from ray_tpu._private.runtime_env import (
+                            conda as conda_mod,
+                        )
+                        from ray_tpu._private.runtime_env import (
+                            executor as exec_mod,
+                        )
+
+                        argv = conda_mod.container_argv(
+                            renv["image_uri"], exec_mod._CHILD_SRC,
+                            path_entries=entries,
+                            working_dir=renv.get("working_dir"),
+                        )
+                        ex = EnvExecutor(
+                            "container", path_entries=entries, argv=argv,
+                            inherit_parent_site=False,
+                        )
+                    elif renv.get("conda"):
+                        from ray_tpu._private.runtime_env import (
+                            conda as conda_mod,
+                        )
+
+                        python = conda_mod.ensure_conda_env(renv["conda"])
+                        ex = EnvExecutor(
+                            python, path_entries=entries,
+                            inherit_parent_site=False,
+                        )
+                    else:
+                        python = venv.ensure_venv(packages, use_uv=use_uv)
+                        ex = EnvExecutor(python, path_entries=entries)
                     with self._env_exec_lock:
                         self._env_executors[key] = ex
         try:
@@ -2728,10 +2788,11 @@ class CoreWorker:
             self.current_task_id.value = tid
             self.current_actor_id.value = None
             self.put_counter.value = 0
-            if renv.get("pip") or renv.get("uv"):
+            if renv.get("pip") or renv.get("uv") or renv.get("conda") \
+                    or renv.get("image_uri"):
                 # Whole env (incl. env_vars/working_dir/py_modules) applies
-                # inside the venv child — the parent process must stay
-                # unpolluted.
+                # inside the venv/conda/container child — the parent
+                # process must stay unpolluted.
                 try:
                     with tracing_helper.span(
                         f"task::{h.get('name', 'task')}", h.get("trace"),
@@ -3139,10 +3200,12 @@ class CoreWorker:
 
         def construct():
             renv = spec.get("renv") or {}
-            if renv.get("pip") or renv.get("uv"):
+            if renv.get("pip") or renv.get("uv") or renv.get("conda") \
+                    or renv.get("image_uri"):
                 return False, (
                     exc.RayTpuError(
-                        "actors with pip/uv runtime envs are not supported: "
+                        "actors with pip/uv/conda/image_uri runtime envs "
+                        "are not supported: "
                         "the actor would live outside the TPU-owning worker "
                         "process (use py_modules, or run a task instead)"
                     ),

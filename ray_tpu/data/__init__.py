@@ -21,6 +21,13 @@ from ray_tpu.data.datasource import (
     read_json,
     read_numpy,
     read_parquet,
+    read_sql,
+    read_webdataset,
+    read_lance,
+    read_iceberg,
+    read_bigquery,
+    read_mongo,
+    write_sql,
     read_text,
 )
 from ray_tpu.data.executor import StreamingExecutor
@@ -44,5 +51,12 @@ __all__ = [
     "read_json",
     "read_numpy",
     "read_parquet",
+    "read_sql",
+    "read_webdataset",
+    "read_lance",
+    "read_iceberg",
+    "read_bigquery",
+    "read_mongo",
+    "write_sql",
     "read_text",
 ]

@@ -218,3 +218,192 @@ def write_json(ds: Dataset, path: str, **kw) -> List[str]:
         )
 
     return _write_blocks(ds, path, "json", write_one)
+
+
+def read_sql(sql: str, connection_factory, *, parallelism: int = 1) -> Dataset:
+    """Read a SQL query through a DBAPI2 connection factory (reference:
+    ``data/datasource/sql_datasource.py`` — same shape: the factory runs on
+    the reading task so connections never pickle). Works with stdlib
+    sqlite3, psycopg2, mysqlclient, duckdb, ...
+
+    ``parallelism`` > 1 shards the query by row number windows — only use
+    it when the query is deterministic and cheap to re-run; default is one
+    task (the reference also reads unpartitioned queries in one task).
+    """
+    import cloudpickle
+
+    payload = cloudpickle.dumps((sql, connection_factory))
+
+    def read_shard(shard: int, nshards: int) -> pa.Table:
+        import cloudpickle as cp
+
+        q, factory = cp.loads(payload)
+        conn = factory()
+        try:
+            cur = conn.cursor()
+            if nshards > 1:
+                # Window functions are illegal in WHERE: project the row
+                # number in a subquery, filter one level up.
+                q = (
+                    f"SELECT * FROM (SELECT __rt_sub.*, "
+                    f"ROW_NUMBER() OVER () AS __rt_rn FROM ({q}) __rt_sub) "
+                    f"__rt_outer WHERE __rt_rn % {nshards} = {shard}"
+                )
+            try:
+                cur.execute(q)
+            except Exception as e:
+                raise type(e)(f"{e} (query: {q!r})")
+            cols = [d[0] for d in cur.description]
+            rows = cur.fetchall()
+        finally:
+            conn.close()
+        if nshards > 1:
+            cols = cols[:-1]  # drop the __rt_rn shard column
+            rows = [r[:-1] for r in rows]
+        arrays = {c: [r[i] for r in rows] for i, c in enumerate(cols)}
+        return pa.table(arrays)
+
+    import builtins
+
+    from ray_tpu._private import worker as worker_mod
+
+    # NOT the module-level dataset range() that shadows the builtin here
+    shards = list(builtins.range(max(parallelism, 1)))
+    if worker_mod.global_worker is None:
+        return Dataset([read_shard(s, len(shards)) for s in shards])
+    import ray_tpu
+
+    task = ray_tpu.remote(read_shard)
+    return Dataset([task.remote(s, len(shards)) for s in shards])
+
+
+def read_webdataset(paths, *, suffixes: Optional[List[str]] = None,
+                    **kw) -> Dataset:
+    """Read WebDataset tar shards (reference:
+    ``data/datasource/webdataset_datasource.py``): files in each tar are
+    grouped by key (basename before the first dot); each group becomes one
+    row with a column per suffix holding the raw bytes."""
+
+    def read_one(path: str) -> pa.Table:
+        import tarfile
+        from collections import OrderedDict
+
+        groups: "OrderedDict[str, dict]" = OrderedDict()
+        with tarfile.open(path) as tf:
+            for member in tf:
+                if not member.isfile():
+                    continue
+                base = os.path.basename(member.name)
+                if "." not in base:
+                    continue
+                key, suffix = base.split(".", 1)
+                if suffixes is not None and suffix not in suffixes:
+                    continue
+                groups.setdefault(key, {"__key__": key})[suffix] = (
+                    tf.extractfile(member).read()
+                )
+        rows = list(groups.values())
+        cols = ["__key__"] + sorted(
+            {k for r in rows for k in r} - {"__key__"}
+        )
+        return pa.table(
+            {c: [r.get(c) for r in rows] for c in cols}
+        )
+
+    return _read_files(paths, read_one)
+
+
+def read_lance(uri: str, **kw) -> Dataset:
+    """Read a Lance dataset (reference: ``data/datasource/lance_datasource``).
+    Requires the optional ``lance`` package."""
+    try:
+        import lance
+    except ImportError as e:
+        raise ImportError(
+            "read_lance requires the optional 'lance' package "
+            "(pip install pylance)"
+        ) from e
+    ds = lance.dataset(uri)
+    return Dataset([frag_table for frag_table in (
+        ds.scanner(fragments=[f]).to_table() for f in ds.get_fragments()
+    )])
+
+
+def read_iceberg(table_identifier: str, *, catalog_kwargs=None,
+                 **kw) -> Dataset:
+    """Read an Apache Iceberg table (reference:
+    ``data/datasource/iceberg_datasource.py``). Requires ``pyiceberg``."""
+    try:
+        from pyiceberg.catalog import load_catalog
+    except ImportError as e:
+        raise ImportError(
+            "read_iceberg requires the optional 'pyiceberg' package"
+        ) from e
+    catalog = load_catalog(**(catalog_kwargs or {}))
+    table = catalog.load_table(table_identifier)
+    return from_arrow(table.scan().to_arrow())
+
+
+def read_bigquery(query: str = None, *, project_id: str = None,
+                  dataset: str = None, **kw) -> Dataset:
+    """Read from Google BigQuery (reference:
+    ``data/datasource/bigquery_datasource.py``). Requires
+    ``google-cloud-bigquery``."""
+    try:
+        from google.cloud import bigquery
+    except ImportError as e:
+        raise ImportError(
+            "read_bigquery requires the optional 'google-cloud-bigquery' "
+            "package"
+        ) from e
+    client = bigquery.Client(project=project_id)
+    if query is None:
+        query = f"SELECT * FROM `{dataset}`"
+    return from_arrow(client.query(query).to_arrow())
+
+
+def read_mongo(uri: str, database: str, collection: str, *,
+               pipeline=None, **kw) -> Dataset:
+    """Read a MongoDB collection (reference:
+    ``data/datasource/mongo_datasource.py``). Requires ``pymongo``."""
+    try:
+        import pymongo
+    except ImportError as e:
+        raise ImportError(
+            "read_mongo requires the optional 'pymongo' package"
+        ) from e
+    client = pymongo.MongoClient(uri)
+    coll = client[database][collection]
+    docs = list(coll.aggregate(pipeline or []))
+    for d in docs:
+        d.pop("_id", None)
+    return from_items(docs)
+
+
+def write_sql(ds: Dataset, table: str, connection_factory) -> int:
+    """Write rows into a SQL table via a DBAPI2 factory; returns row count
+    (reference: ``Dataset.write_sql``)."""
+    total = 0
+    conn = connection_factory()
+    # Placeholder style differs per driver (sqlite/duckdb: qmark '?';
+    # psycopg2/mysqlclient: format '%s'): read it off the driver module.
+    import importlib
+    import sys as _sys
+
+    mod = _sys.modules.get(type(conn).__module__.split(".")[0])
+    style = getattr(mod, "paramstyle", "qmark") if mod else "qmark"
+    mark = "%s" if style in ("format", "pyformat") else "?"
+    try:
+        cur = conn.cursor()
+        for block in ds._streaming_blocks():
+            acc = BlockAccessor(block)
+            cols = block.column_names
+            ph = ", ".join([mark] * len(cols))
+            stmt = f"INSERT INTO {table} ({', '.join(cols)}) VALUES ({ph})"
+            for row in acc.iter_rows():
+                cur.execute(stmt, tuple(row[c] for c in cols))
+                total += 1
+        conn.commit()
+    finally:
+        conn.close()
+    return total

@@ -91,7 +91,9 @@ class NativeArenaStore:
         if capacity is None:
             # resolved at call time so tests/env can size a fresh session's
             # arena without re-importing the module
-            capacity = int(os.environ.get("RT_ARENA_BYTES", DEFAULT_CAPACITY))
+            from ray_tpu._private.config import rt_config
+
+            capacity = rt_config.arena_bytes
         self._lib = lib
         self.name = name
         self.created_arena = False
@@ -265,7 +267,9 @@ class HybridShmStore:
 
         self.spill = SpillManager(session=(arena_name or "anon").strip("/"))
         self.spill_handler = None
-        if arena_name and os.environ.get("RT_DISABLE_NATIVE_STORE") != "1":
+        from ray_tpu._private.config import rt_config
+
+        if arena_name and not rt_config.disable_native_store:
             try:
                 self.arena = NativeArenaStore(arena_name)
             except (RuntimeError, OSError) as e:

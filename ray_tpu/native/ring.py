@@ -22,6 +22,12 @@ from ray_tpu import native as native_mod
 
 logger = logging.getLogger(__name__)
 
+
+def _native_ring_enabled() -> bool:
+    from ray_tpu._private.config import rt_config
+
+    return rt_config.native_ring
+
 _DIR = os.path.dirname(os.path.abspath(native_mod.__file__))
 _LIB_PATH = os.path.join(_DIR, "librt_ring.so")
 _SRCS = [os.path.join(_DIR, "src", "ring.cc")]
@@ -73,7 +79,7 @@ def _load_library():
 
 def available() -> bool:
     return (
-        os.environ.get("RT_NATIVE_RING", "1") != "0"
+        _native_ring_enabled()
         and _load_library() is not None
     )
 

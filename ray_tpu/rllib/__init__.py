@@ -7,6 +7,10 @@ from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, make_trainable
 from ray_tpu.rllib.algorithms import (
     BC,
     BCConfig,
+    CQL,
+    CQLConfig,
+    IQL,
+    IQLConfig,
     DQN,
     DQNConfig,
     IMPALA,
@@ -22,6 +26,10 @@ from ray_tpu.rllib.env_runner import EnvRunnerGroup, SingleAgentEnvRunner
 from ray_tpu.rllib.learner import Learner, LearnerHyperparams
 
 __all__ = [
+    "CQL",
+    "CQLConfig",
+    "IQL",
+    "IQLConfig",
     "Algorithm", "AlgorithmConfig", "make_trainable",
     "PPO", "PPOConfig", "IMPALA", "IMPALAConfig", "DQN", "DQNConfig",
     "SAC", "SACConfig", "MARWIL", "MARWILConfig", "BC", "BCConfig",

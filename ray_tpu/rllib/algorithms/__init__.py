@@ -1,4 +1,5 @@
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
+from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig, IQL, IQLConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.marwil import BC, BCConfig, MARWIL, MARWILConfig
@@ -6,7 +7,7 @@ from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 
 __all__ = [
-    "APPO", "APPOConfig",
+    "APPO", "APPOConfig", "CQL", "CQLConfig", "IQL", "IQLConfig",
     "PPO", "PPOConfig", "IMPALA", "IMPALAConfig", "DQN", "DQNConfig",
     "SAC", "SACConfig", "MARWIL", "MARWILConfig", "BC", "BCConfig",
 ]

@@ -1,0 +1,525 @@
+"""CQL and IQL: offline RL for continuous control.
+
+Reference analogs: ``rllib/algorithms/cql/`` (Conservative Q-Learning —
+SAC-style twin critics plus a conservative penalty that pushes Q down on
+out-of-distribution actions and up on dataset actions) and the IQL
+capability of the reference's offline stack (Implicit Q-Learning: expectile
+value regression + advantage-weighted policy extraction; no OOD action
+queries at all). Both consume logged (s, a, r, s', done) transitions —
+episodes in the MARWIL format — and need no environment except for optional
+evaluation rollouts.
+
+TPU shape: each algorithm's whole update (critics + value + policy [+
+targets]) is ONE jitted program over replay minibatches; offline data sits
+in host numpy and minibatches stream in per step.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib import module as rl_module
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.marwil import _NullRunnerGroup
+
+
+def episodes_to_sarsd(episodes: List[Dict[str, Any]]) -> Dict[str, np.ndarray]:
+    """Flatten episodes into (s, a, r, s', done) transition arrays.
+    The terminal flag marks true environment termination; episode ends are
+    always transition boundaries."""
+    obs, act, rew, nobs, done = [], [], [], [], []
+    for ep in episodes:
+        o = np.asarray(ep["obs"], np.float32)
+        a = np.asarray(ep["actions"], np.float32)
+        r = np.asarray(ep["rewards"], np.float32)
+        T = len(r)
+        if o.shape[0] < T + 1:
+            # no trailing observation logged: drop the final transition
+            T = T - 1
+            if T <= 0:
+                continue
+        obs.append(o[:T])
+        nobs.append(o[1 : T + 1])
+        act.append(a[:T])
+        rew.append(r[:T])
+        d = np.zeros(T, np.float32)
+        if bool(ep.get("terminated", True)):
+            d[-1] = 1.0
+        done.append(d)
+    return {
+        "obs": np.concatenate(obs),
+        "actions": np.concatenate(act),
+        "rewards": np.concatenate(rew),
+        "next_obs": np.concatenate(nobs),
+        "dones": np.concatenate(done),
+    }
+
+
+class _OfflineBase(Algorithm):
+    """Shared bring-up for offline continuous-control algorithms."""
+
+    def _load_offline(self, config):
+        episodes = list(config.episodes or [])
+        if config.dataset is not None:
+            episodes.extend(config.dataset.take_all())
+        if not episodes:
+            raise ValueError(
+                f"{config.algo_name} needs offline data: "
+                "config.offline_data(episodes=...) or (dataset=...)"
+            )
+        self.data = episodes_to_sarsd(episodes)
+        self._n = self.data["obs"].shape[0]
+        if config.env is not None or config.env_creator is not None:
+            self._init_common(config)
+        else:
+            self.iteration = 0
+            self._total_env_steps = 0
+            self._last_step_count = 0
+            self._recent_returns = []
+            self.module_config = rl_module.RLModuleConfig(
+                obs_dim=self.data["obs"].shape[1],
+                action_dim=self.data["actions"].shape[1],
+                discrete=False,
+            )
+        if self.module_config.discrete:
+            raise ValueError(
+                f"{config.algo_name} requires continuous actions"
+            )
+
+    def _make_runner_group(self, config):
+        import jax
+
+        if config.env is not None or config.env_creator is not None:
+            from ray_tpu.rllib.env_runner import EnvRunnerGroup
+
+            self.runner_group = EnvRunnerGroup(
+                config.get_env_creator(), config.num_env_runners,
+                config.num_envs_per_runner, config.rollout_fragment_length,
+                self.module_config, seed=config.seed,
+                gamma=config.hp.gamma,
+            )
+            self.runner_group.sync_weights(jax.device_get(self.pi_params))
+        else:
+            self.runner_group = _NullRunnerGroup()
+
+    def _minibatch(self, bs):
+        import jax.numpy as jnp
+
+        idx = self._rng.randint(0, self._n, bs)
+        return {
+            k: jnp.asarray(v[idx]) for k, v in self.data.items()
+        }
+
+    def _eval_rollout(self):
+        import jax
+
+        self.runner_group.sync_weights(jax.device_get(self.pi_params))
+        frags = self.runner_group.sample()
+        if frags:
+            batch = self._build_batch(frags)
+            self._record_env_steps(batch)
+        else:
+            self._last_step_count = 0
+
+    def get_weights(self):
+        import jax
+
+        return jax.device_get(self.pi_params)
+
+    def save(self, path: str) -> str:
+        import os
+        import pickle
+
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, "algorithm_state.pkl"), "wb") as f:
+            pickle.dump({
+                "pi_params": jax.device_get(self.pi_params),
+                "q_params": jax.device_get(self.q_params),
+                "extra": jax.device_get(self._extra_state()),
+                "iteration": self.iteration,
+                "algo": self.config.algo_name,
+            }, f)
+        return path
+
+    def restore(self, path: str):
+        import os
+        import pickle
+
+        import jax
+        import jax.numpy as jnp
+
+        with open(os.path.join(path, "algorithm_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        self.pi_params = jax.tree.map(jnp.asarray, state["pi_params"])
+        self.q_params = jax.tree.map(jnp.asarray, state["q_params"])
+        self._restore_extra(jax.tree.map(jnp.asarray, state["extra"]))
+        self.iteration = state["iteration"]
+        self.runner_group.sync_weights(jax.device_get(self.pi_params))
+
+    def _extra_state(self):
+        return {}
+
+    def _restore_extra(self, extra):
+        pass
+
+
+# ------------------------------------------------------------------- IQL
+
+
+class IQLConfig(AlgorithmConfig):
+    algo_name = "iql"
+
+    def __init__(self):
+        super().__init__()
+        self.training(lr=3e-4, gamma=0.99)
+        self.learn_batch_size = 256
+        self.updates_per_step = 32
+        self.expectile = 0.8           # tau: V regresses toward upper Q
+        self.awr_beta = 3.0            # advantage-weighted regression temp
+        self.max_weight = 100.0
+        self.tau = 0.005               # polyak for target critics
+        self.critic_hidden = (128, 128)
+        self.episodes: Optional[List[Dict[str, Any]]] = None
+        self.dataset = None
+
+    def offline_data(self, *, episodes=None, dataset=None):
+        self.episodes = episodes
+        self.dataset = dataset
+        return self
+
+    def build_algo(self) -> "IQL":
+        return IQL(self)
+
+
+class IQL(_OfflineBase):
+    """Implicit Q-Learning (expectile value + AWR policy). Never queries Q
+    at out-of-distribution actions — the defining property."""
+
+    def __init__(self, config: IQLConfig):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.config = config
+        self._load_offline(config)
+        cfg = self.module_config
+        hp = config.hp
+        A = cfg.action_dim
+
+        key = jax.random.PRNGKey(config.seed)
+        k_pi, k_q1, k_q2, k_v = jax.random.split(key, 4)
+        self.pi_params = rl_module.init_params(cfg, k_pi)
+        q_sizes = [cfg.obs_dim + A, *config.critic_hidden, 1]
+        v_sizes = [cfg.obs_dim, *config.critic_hidden, 1]
+        self.q_params = {
+            "q1": rl_module._init_mlp(k_q1, q_sizes, cfg.dtype),
+            "q2": rl_module._init_mlp(k_q2, q_sizes, cfg.dtype),
+        }
+        self.q_target = jax.tree.map(jnp.copy, self.q_params)
+        self.v_params = rl_module._init_mlp(k_v, v_sizes, cfg.dtype)
+
+        self.pi_opt = optax.adam(hp.lr)
+        self.q_opt = optax.adam(hp.lr)
+        self.v_opt = optax.adam(hp.lr)
+        self.pi_os = self.pi_opt.init(self.pi_params)
+        self.q_os = self.q_opt.init(self.q_params)
+        self.v_os = self.v_opt.init(self.v_params)
+        self._rng = np.random.RandomState(config.seed)
+
+        gamma, tau = hp.gamma, config.tau
+        expectile, beta = config.expectile, config.awr_beta
+        max_w = config.max_weight
+
+        def q_value(qp, obs, act):
+            x = jnp.concatenate([obs, act], -1)
+            q1 = rl_module._mlp(qp["q1"], x)[..., 0]
+            q2 = rl_module._mlp(qp["q2"], x)[..., 0]
+            return q1, q2
+
+        def update(pi_p, q_p, q_t, v_p, pi_os, q_os, v_os, batch):
+            # 1) V: expectile regression toward min target-Q at DATA actions
+            tq1, tq2 = q_value(q_t, batch["obs"], batch["actions"])
+            tq = jax.lax.stop_gradient(jnp.minimum(tq1, tq2))
+
+            def v_loss_fn(vp):
+                v = rl_module._mlp(vp, batch["obs"])[..., 0]
+                diff = tq - v
+                w = jnp.where(diff > 0, expectile, 1.0 - expectile)
+                return jnp.mean(w * diff ** 2), v
+
+            (v_loss, v), v_grads = jax.value_and_grad(
+                v_loss_fn, has_aux=True
+            )(v_p)
+            v_up, v_os = self.v_opt.update(v_grads, v_os, v_p)
+            v_p = optax.apply_updates(v_p, v_up)
+
+            # 2) Q: bellman target r + gamma (1-d) V(s')
+            vs_next = rl_module._mlp(v_p, batch["next_obs"])[..., 0]
+            target = jax.lax.stop_gradient(
+                batch["rewards"]
+                + gamma * (1.0 - batch["dones"]) * vs_next
+            )
+
+            def q_loss_fn(qp):
+                q1, q2 = q_value(qp, batch["obs"], batch["actions"])
+                return jnp.mean((q1 - target) ** 2) \
+                    + jnp.mean((q2 - target) ** 2)
+
+            q_loss, q_grads = jax.value_and_grad(q_loss_fn)(q_p)
+            q_up, q_os = self.q_opt.update(q_grads, q_os, q_p)
+            q_p = optax.apply_updates(q_p, q_up)
+
+            # 3) policy: advantage-weighted regression onto data actions
+            adv = jax.lax.stop_gradient(tq - v)
+            w = jnp.minimum(jnp.exp(beta * adv), max_w)
+
+            def pi_loss_fn(pp):
+                logp, _, _ = rl_module.logp_entropy_value(
+                    pp, cfg, batch["obs"], batch["actions"]
+                )
+                return -jnp.mean(w * logp)
+
+            pi_loss, pi_grads = jax.value_and_grad(pi_loss_fn)(pi_p)
+            pi_up, pi_os = self.pi_opt.update(pi_grads, pi_os, pi_p)
+            pi_p = optax.apply_updates(pi_p, pi_up)
+
+            q_t = jax.tree.map(
+                lambda t, o: (1 - tau) * t + tau * o, q_t, q_p
+            )
+            return (pi_p, q_p, q_t, v_p, pi_os, q_os, v_os,
+                    pi_loss, q_loss, v_loss)
+
+        self._update = jax.jit(update)
+        self._make_runner_group(config)
+
+    def training_step(self) -> Dict[str, float]:
+        pi_ls, q_ls, v_ls = [], [], []
+        bs = min(self.config.learn_batch_size, self._n)
+        for _ in range(self.config.updates_per_step):
+            mb = self._minibatch(bs)
+            (self.pi_params, self.q_params, self.q_target, self.v_params,
+             self.pi_os, self.q_os, self.v_os, pi_l, q_l, v_l
+             ) = self._update(
+                self.pi_params, self.q_params, self.q_target,
+                self.v_params, self.pi_os, self.q_os, self.v_os, mb,
+            )
+            pi_ls.append(float(pi_l))
+            q_ls.append(float(q_l))
+            v_ls.append(float(v_l))
+        self._eval_rollout()
+        return {
+            "policy_loss": float(np.mean(pi_ls)),
+            "critic_loss": float(np.mean(q_ls)),
+            "value_loss": float(np.mean(v_ls)),
+            "total_loss": float(np.mean(pi_ls) + np.mean(q_ls)),
+            "num_offline_transitions": float(self._n),
+        }
+
+    def _extra_state(self):
+        return {"v_params": self.v_params, "q_target": self.q_target}
+
+    def _restore_extra(self, extra):
+        self.v_params = extra["v_params"]
+        self.q_target = extra["q_target"]
+
+
+# ------------------------------------------------------------------- CQL
+
+
+class CQLConfig(AlgorithmConfig):
+    algo_name = "cql"
+
+    def __init__(self):
+        super().__init__()
+        self.training(lr=3e-4, gamma=0.99)
+        self.learn_batch_size = 256
+        self.updates_per_step = 32
+        self.tau = 0.005
+        self.alpha_entropy = 0.1       # fixed SAC entropy temperature
+        self.cql_alpha = 1.0           # conservative penalty weight
+        self.cql_num_actions = 8       # sampled actions for the logsumexp
+        self.critic_hidden = (128, 128)
+        self.episodes: Optional[List[Dict[str, Any]]] = None
+        self.dataset = None
+
+    def offline_data(self, *, episodes=None, dataset=None):
+        self.episodes = episodes
+        self.dataset = dataset
+        return self
+
+    def build_algo(self) -> "CQL":
+        return CQL(self)
+
+
+class CQL(_OfflineBase):
+    """Conservative Q-Learning (reference: ``rllib/algorithms/cql``):
+    SAC-style twin critics + logsumexp conservative penalty."""
+
+    def __init__(self, config: CQLConfig):
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        self.config = config
+        self._load_offline(config)
+        self.module_config = dataclasses.replace(
+            self.module_config, exploration="squashed_gaussian"
+        )
+        cfg = self.module_config
+        hp = config.hp
+        A = cfg.action_dim
+
+        key = jax.random.PRNGKey(config.seed)
+        k_pi, k_q1, k_q2 = jax.random.split(key, 3)
+        self.pi_params = rl_module.init_params(cfg, k_pi)
+        q_sizes = [cfg.obs_dim + A, *config.critic_hidden, 1]
+        self.q_params = {
+            "q1": rl_module._init_mlp(k_q1, q_sizes, cfg.dtype),
+            "q2": rl_module._init_mlp(k_q2, q_sizes, cfg.dtype),
+        }
+        self.q_target = jax.tree.map(jnp.copy, self.q_params)
+        self.pi_opt = optax.adam(hp.lr)
+        self.q_opt = optax.adam(hp.lr)
+        self.pi_os = self.pi_opt.init(self.pi_params)
+        self.q_os = self.q_opt.init(self.q_params)
+        self._rng = np.random.RandomState(config.seed)
+        self._step_key = jax.random.PRNGKey(config.seed + 1)
+
+        gamma, tau = hp.gamma, config.tau
+        alpha = config.alpha_entropy
+        cql_alpha = config.cql_alpha
+        n_act = config.cql_num_actions
+
+        def q_value(qp, obs, act):
+            x = jnp.concatenate([obs, act], -1)
+            q1 = rl_module._mlp(qp["q1"], x)[..., 0]
+            q2 = rl_module._mlp(qp["q2"], x)[..., 0]
+            return q1, q2
+
+        def q_at_sampled(qp, obs, acts):
+            # acts: [K, B, A]; returns per-critic [K, B]
+            K = acts.shape[0]
+            ob = jnp.broadcast_to(obs[None], (K,) + obs.shape)
+            x = jnp.concatenate([ob, acts], -1).reshape(
+                K * obs.shape[0], -1
+            )
+            q1 = rl_module._mlp(qp["q1"], x)[..., 0].reshape(K, -1)
+            q2 = rl_module._mlp(qp["q2"], x)[..., 0].reshape(K, -1)
+            return q1, q2
+
+        def update(pi_p, q_p, q_t, pi_os, q_os, batch, rng):
+            B = batch["obs"].shape[0]
+            r_next, r_cur, r_unif = jax.random.split(rng, 3)
+
+            # SAC target with entropy bonus at the next state
+            mean_n, logstd_n = rl_module.squashed_gaussian_dist(
+                pi_p, cfg, batch["next_obs"]
+            )
+            a_next, logp_next = rl_module.squashed_sample_logp(
+                mean_n, logstd_n, r_next
+            )
+            tq1, tq2 = q_value(q_t, batch["next_obs"], a_next)
+            target = jax.lax.stop_gradient(
+                batch["rewards"] + gamma * (1.0 - batch["dones"]) * (
+                    jnp.minimum(tq1, tq2) - alpha * logp_next
+                )
+            )
+
+            # sampled actions for the conservative logsumexp:
+            # uniform + current policy at s
+            unif = jax.random.uniform(
+                r_unif, (n_act, B, A), minval=-1.0, maxval=1.0
+            )
+            mean_c, logstd_c = rl_module.squashed_gaussian_dist(
+                pi_p, cfg, batch["obs"]
+            )
+            pol = jnp.stack([
+                rl_module.squashed_sample_logp(
+                    mean_c, logstd_c, jax.random.fold_in(r_cur, i)
+                )[0]
+                for i in range(n_act)
+            ])
+            cand = jax.lax.stop_gradient(
+                jnp.concatenate([unif, pol], axis=0)
+            )
+
+            def critic_loss(qp):
+                q1, q2 = q_value(qp, batch["obs"], batch["actions"])
+                bellman = jnp.mean((q1 - target) ** 2) \
+                    + jnp.mean((q2 - target) ** 2)
+                s1, s2 = q_at_sampled(qp, batch["obs"], cand)
+                # push down on broad-action logsumexp, up on data actions
+                cons = (
+                    jnp.mean(jax.nn.logsumexp(s1, axis=0) - q1)
+                    + jnp.mean(jax.nn.logsumexp(s2, axis=0) - q2)
+                )
+                return bellman + cql_alpha * cons, (bellman, cons)
+
+            (q_loss, (bellman, cons)), q_grads = jax.value_and_grad(
+                critic_loss, has_aux=True
+            )(q_p)
+            q_up, q_os = self.q_opt.update(q_grads, q_os, q_p)
+            q_p = optax.apply_updates(q_p, q_up)
+
+            # SAC actor on the offline batch
+            def actor_loss(pp):
+                mean, logstd = rl_module.squashed_gaussian_dist(
+                    pp, cfg, batch["obs"]
+                )
+                a, logp = rl_module.squashed_sample_logp(
+                    mean, logstd, r_cur
+                )
+                q1, q2 = q_value(q_p, batch["obs"], a)
+                return jnp.mean(alpha * logp - jnp.minimum(q1, q2))
+
+            pi_loss, pi_grads = jax.value_and_grad(actor_loss)(pi_p)
+            pi_up, pi_os = self.pi_opt.update(pi_grads, pi_os, pi_p)
+            pi_p = optax.apply_updates(pi_p, pi_up)
+
+            q_t = jax.tree.map(
+                lambda t, o: (1 - tau) * t + tau * o, q_t, q_p
+            )
+            return (pi_p, q_p, q_t, pi_os, q_os,
+                    pi_loss, q_loss, bellman, cons)
+
+        self._update = jax.jit(update)
+        self._make_runner_group(config)
+
+    def training_step(self) -> Dict[str, float]:
+        import jax
+
+        pi_ls, q_ls, bell, cons = [], [], [], []
+        bs = min(self.config.learn_batch_size, self._n)
+        for _ in range(self.config.updates_per_step):
+            mb = self._minibatch(bs)
+            self._step_key, sub = jax.random.split(self._step_key)
+            (self.pi_params, self.q_params, self.q_target,
+             self.pi_os, self.q_os, pi_l, q_l, b_l, c_l
+             ) = self._update(
+                self.pi_params, self.q_params, self.q_target,
+                self.pi_os, self.q_os, mb, sub,
+            )
+            pi_ls.append(float(pi_l))
+            q_ls.append(float(q_l))
+            bell.append(float(b_l))
+            cons.append(float(c_l))
+        self._eval_rollout()
+        return {
+            "policy_loss": float(np.mean(pi_ls)),
+            "critic_loss": float(np.mean(q_ls)),
+            "bellman_loss": float(np.mean(bell)),
+            "conservative_gap": float(np.mean(cons)),
+            "total_loss": float(np.mean(q_ls)),
+            "num_offline_transitions": float(self._n),
+        }
+
+    def _extra_state(self):
+        return {"q_target": self.q_target}
+
+    def _restore_extra(self, extra):
+        self.q_target = extra["q_target"]

@@ -85,26 +85,21 @@ class GRPCProxy:
     # ------------------------------------------------------------- routing
 
     def _route_for(self, path: str) -> Optional[str]:
-        import ray_tpu
-        from ray_tpu.serve.controller import CONTROLLER_NAME
+        # Shared with the HTTP proxy: one longest-prefix resolver against
+        # the controller's route table.
+        from ray_tpu.serve.http_proxy import HTTPProxy
 
-        routes = ray_tpu.get(
-            ray_tpu.get_actor(CONTROLLER_NAME).get_routes.remote(), timeout=10
-        )
-        best = None
-        for prefix, deployment in routes.items():
-            if path.startswith(prefix) and (
-                best is None or len(prefix) > len(best[0])
-            ):
-                best = (prefix, deployment)
-        return None if best is None else best[1]
+        return HTTPProxy._route_for(self, path)
 
-    def _handle_for(self, req: dict, context):
+    async def _handle_for(self, req: dict, context):
         """Resolve the deployment handle + per-request options, or abort."""
         import grpc
 
         route = req.get("route") or "/"
-        deployment = self._route_for(route)
+        # The controller RPC blocks; it must not stall the grpc.aio loop.
+        deployment = await asyncio.get_running_loop().run_in_executor(
+            None, self._route_for, route
+        )
         if deployment is None:
             context.set_code(grpc.StatusCode.NOT_FOUND)
             context.set_details(f"no route for {route!r}")
@@ -131,7 +126,7 @@ class GRPCProxy:
             context.set_code(grpc.StatusCode.INVALID_ARGUMENT)
             context.set_details(f"bad msgpack request: {e}")
             return b""
-        handle, method = self._handle_for(req, context)
+        handle, method = await self._handle_for(req, context)
         if handle is None:
             return b""
         loop = asyncio.get_running_loop()
@@ -156,7 +151,7 @@ class GRPCProxy:
             context.set_code(grpc.StatusCode.INVALID_ARGUMENT)
             context.set_details(f"bad msgpack request: {e}")
             return
-        handle, method = self._handle_for(req, context)
+        handle, method = await self._handle_for(req, context)
         if handle is None:
             return
         handle = handle.options(stream=True)

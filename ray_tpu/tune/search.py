@@ -388,7 +388,14 @@ class BayesOptSearch(Searcher):
                 self._axes.append(
                     (name, lambda u, lo=lo, hi=hi: lo + u * (hi - lo))
                 )
-            elif isinstance(dom, (RandInt, LogRandInt)):
+            elif isinstance(dom, LogRandInt):
+                llo, lhi = math.log(dom.low), math.log(dom.high)
+                self._axes.append(
+                    (name, lambda u, llo=llo, lhi=lhi, hi=dom.high:
+                        min(int(round(math.exp(llo + u * (lhi - llo)))),
+                            hi - 1))
+                )
+            elif isinstance(dom, RandInt):
                 lo, hi = dom.low, dom.high
                 self._axes.append(
                     (name, lambda u, lo=lo, hi=hi: min(

@@ -350,3 +350,47 @@ def test_cli_stack_command(capsys):
         assert "--- thread" in out
     finally:
         ray_tpu.shutdown()
+
+
+def test_config_registry_resolution(monkeypatch):
+    """Declared default < _system_config < env var (reference:
+    ray_config_def.h RAY_CONFIG + _system_config override)."""
+    from ray_tpu._private.config import ConfigRegistry
+
+    reg = ConfigRegistry()
+    reg.declare("probe_knob", int, 7, "test knob")
+    assert reg.get("probe_knob") == 7
+    reg.apply_system_config({"probe_knob": 11})
+    assert reg.get("probe_knob") == 11
+    monkeypatch.setenv("RT_PROBE_KNOB", "13")
+    assert reg.get("probe_knob") == 13
+    assert reg.system_config_env() == {"RT_PROBE_KNOB": "11"}
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="unknown _system_config"):
+        reg.apply_system_config({"nope": 1})
+
+
+def test_system_config_propagates_to_workers(tmp_path):
+    """init(_system_config=...) reaches spawned worker processes as RT_*
+    env (the raylet-cmdline propagation analog)."""
+    import ray_tpu
+
+    ray_tpu.init(
+        num_cpus=2, num_nodes=1,
+        _system_config={"lineage_bytes": 123456789},
+    )
+    try:
+        @ray_tpu.remote
+        def probe():
+            import os
+
+            from ray_tpu._private.config import rt_config
+
+            return os.environ.get("RT_LINEAGE_BYTES"), rt_config.lineage_bytes
+
+        env_val, resolved = ray_tpu.get(probe.remote(), timeout=30)
+        assert env_val == "123456789"
+        assert resolved == 123456789
+    finally:
+        ray_tpu.shutdown()

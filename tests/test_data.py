@@ -383,3 +383,110 @@ def test_sort_empty_after_filter(rt):
 
     out = rt_data.range(50).filter(lambda r: False).sort("id").take_all()
     assert out == []
+
+
+def test_read_write_sql_sqlite(rt, tmp_path):
+    """DBAPI SQL datasource against stdlib sqlite3 (reference:
+    data/datasource/sql_datasource.py)."""
+    import sqlite3
+
+    from ray_tpu import data as rt_data
+
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE pts (x INTEGER, label TEXT)")
+    conn.executemany(
+        "INSERT INTO pts VALUES (?, ?)",
+        [(i, f"l{i % 3}") for i in range(30)],
+    )
+    conn.commit()
+    conn.close()
+
+    def factory(path=db):
+        import sqlite3 as s
+
+        return s.connect(path)
+
+    ds = rt_data.read_sql("SELECT x, label FROM pts", factory)
+    rows = ds.take_all()
+    assert len(rows) == 30 and {r["label"] for r in rows} == {"l0", "l1", "l2"}
+
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE out (x INTEGER, label TEXT)")
+    conn.commit()
+    conn.close()
+    n = rt_data.write_sql(ds.filter(lambda r: r["x"] < 10), "out", factory)
+    assert n == 10
+    conn = sqlite3.connect(db)
+    assert conn.execute("SELECT COUNT(*) FROM out").fetchone()[0] == 10
+    conn.close()
+
+
+def test_read_webdataset(rt, tmp_path):
+    """WebDataset tar shards group files by key into rows (reference:
+    data/datasource/webdataset_datasource.py)."""
+    import io
+    import tarfile
+
+    from ray_tpu import data as rt_data
+
+    shard = str(tmp_path / "shard-000.tar")
+    with tarfile.open(shard, "w") as tf:
+        for i in range(4):
+            for suffix, payload in (("txt", f"caption {i}"),
+                                    ("cls", str(i % 2))):
+                data_b = payload.encode()
+                info = tarfile.TarInfo(f"sample{i:04d}.{suffix}")
+                info.size = len(data_b)
+                tf.addfile(info, io.BytesIO(data_b))
+    rows = rt_data.read_webdataset(shard).take_all()
+    assert len(rows) == 4
+    assert rows[0]["__key__"] == "sample0000"
+    assert rows[0]["txt"] == b"caption 0"
+    assert {r["cls"] for r in rows} == {b"0", b"1"}
+
+
+def test_optional_datasources_gated(rt):
+    """Missing optional client libs raise a helpful ImportError, not a
+    bare ModuleNotFoundError at call time."""
+    import pytest as _pytest
+
+    from ray_tpu import data as rt_data
+
+    import importlib.util as ilu
+
+    for fn, args, lib in (
+        (rt_data.read_lance, ("/tmp/x.lance",), "lance"),
+        (rt_data.read_iceberg, ("db.t",), "pyiceberg"),
+        (rt_data.read_bigquery, ("SELECT 1",), "google.cloud.bigquery"),
+        (rt_data.read_mongo, ("mongodb://x", "db", "c"), "pymongo"),
+    ):
+        if ilu.find_spec(lib.split(".")[0]) is not None:
+            continue  # lib installed here: the gate isn't reachable
+        with _pytest.raises(ImportError, match="optional"):
+            fn(*args)
+
+
+def test_read_sql_sharded(rt, tmp_path):
+    """parallelism > 1 shards via a projected row number (window functions
+    are illegal in WHERE)."""
+    import sqlite3
+
+    from ray_tpu import data as rt_data
+
+    db = str(tmp_path / "s.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE t (x INTEGER)")
+    conn.executemany("INSERT INTO t VALUES (?)", [(i,) for i in range(40)])
+    conn.commit()
+    conn.close()
+
+    def factory(path=db):
+        import sqlite3 as s
+
+        return s.connect(path)
+
+    rows = rt_data.read_sql(
+        "SELECT x FROM t", factory, parallelism=3
+    ).take_all()
+    assert sorted(r["x"] for r in rows) == list(range(40))

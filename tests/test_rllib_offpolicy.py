@@ -232,3 +232,92 @@ def test_marwil_dataset_input(rl_cluster):
     algo = cfg.build_algo()
     m = algo.training_step()
     assert m["num_offline_transitions"] > 100
+
+
+def _pointmass_episodes(n_episodes=20, T=40, seed=0):
+    """1-D regulator: x' = x + 0.1 a, r = -x'^2; behavior policy is a noisy
+    expert (a = -clip(10 x, -1, 1) + noise). Good offline algorithms
+    extract the de-noised regulator."""
+    rng = np.random.RandomState(seed)
+    eps = []
+    for _ in range(n_episodes):
+        x = rng.uniform(-1, 1)
+        obs, acts, rews = [[x]], [], []
+        for _ in range(T):
+            a = float(np.clip(-10 * x, -1, 1) + rng.normal(0, 0.3))
+            a = float(np.clip(a, -1, 1))
+            x = x + 0.1 * a
+            obs.append([x])
+            acts.append([a])
+            rews.append(-x * x)
+        eps.append({
+            "obs": np.asarray(obs, np.float32),
+            "actions": np.asarray(acts, np.float32),
+            "rewards": np.asarray(rews, np.float32),
+            "terminated": False,
+        })
+    return eps
+
+
+def test_iql_learns_regulator_offline():
+    """IQL: expectile value + AWR extraction improves on the data without
+    ever querying out-of-distribution actions."""
+    from ray_tpu.rllib import IQLConfig
+    from ray_tpu.rllib import module as rl_module
+
+    cfg = IQLConfig().debugging(seed=0).offline_data(
+        episodes=_pointmass_episodes()
+    )
+    cfg.updates_per_step = 64
+    algo = cfg.build_algo()
+    first = algo.training_step()
+    for _ in range(12):
+        m = algo.training_step()
+    assert m["critic_loss"] < first["critic_loss"]
+    # extracted policy regulates: mean action opposes the state
+    import jax.numpy as jnp
+
+    mean, _ = rl_module.forward_policy(
+        algo.pi_params, algo.module_config, jnp.asarray([[0.5], [-0.5]])
+    ), None
+    mean = np.asarray(mean[0] if isinstance(mean, tuple) else mean)
+    acts = np.tanh(mean[:, :1]) if mean.shape[-1] > 1 else np.tanh(mean)
+    assert acts[0, 0] < 0 < acts[1, 0], f"policy not regulating: {acts}"
+
+
+def test_cql_learns_conservative_critic_offline():
+    """CQL: bellman + conservative penalty both optimize; the conservative
+    gap (logsumexp - data Q) shrinks as OOD actions get pushed down."""
+    from ray_tpu.rllib import CQLConfig
+
+    cfg = CQLConfig().debugging(seed=0).offline_data(
+        episodes=_pointmass_episodes()
+    )
+    cfg.updates_per_step = 48
+    algo = cfg.build_algo()
+    first = algo.training_step()
+    for _ in range(10):
+        m = algo.training_step()
+    assert m["conservative_gap"] < first["conservative_gap"]
+    assert np.isfinite(m["critic_loss"])
+
+
+def test_cql_iql_checkpoint_roundtrip(tmp_path):
+    from ray_tpu.rllib import IQLConfig
+
+    cfg = IQLConfig().debugging(seed=0).offline_data(
+        episodes=_pointmass_episodes(n_episodes=4)
+    )
+    cfg.updates_per_step = 4
+    algo = cfg.build_algo()
+    algo.training_step()
+    p = algo.save(str(tmp_path / "ck"))
+    algo2 = IQLConfig().debugging(seed=1).offline_data(
+        episodes=_pointmass_episodes(n_episodes=4)
+    ).build_algo()
+    algo2.restore(p)
+    import jax
+
+    a = jax.tree.leaves(algo.pi_params)
+    b = jax.tree.leaves(algo2.pi_params)
+    assert all(np.allclose(x, y) for x, y in zip(a, b))

@@ -150,3 +150,45 @@ def test_pip_unpicklable_result_is_task_error(rt, tmp_path):
 
     with pytest.raises(rt_exc.RayTpuError, match="serializable"):
         ray_tpu.get(bad.remote(), timeout=120)
+
+
+def test_conda_image_uri_plugins_validate_and_gate(rt_start):
+    """conda/image_uri are accepted plugins (reference:
+    runtime_env/conda.py, image_uri.py); without their binaries on PATH
+    the task fails LOUDLY with the binary requirement, never silently
+    running outside the requested env."""
+    import shutil as _shutil
+
+    import pytest as _pytest
+
+    from ray_tpu._private import runtime_env as renv_mod
+
+    # validation accepts both (unknown plugins still rejected)
+    renv_mod.validate({"conda": ["scipy"]})
+    renv_mod.validate({"image_uri": "python:3.12-slim"})
+    with _pytest.raises(Exception, match="not supported"):
+        renv_mod.validate({"bogus_plugin": 1})
+
+    @ray_tpu.remote(runtime_env={"conda": ["scipy"]})
+    def in_conda():
+        return 1
+
+    if _shutil.which("conda") is None:
+        with _pytest.raises(Exception, match="conda"):
+            ray_tpu.get(in_conda.remote(), timeout=60)
+
+    @ray_tpu.remote(runtime_env={"image_uri": "python:3.12-slim"})
+    def in_container():
+        return 1
+
+    if _shutil.which("docker") is None and _shutil.which("podman") is None:
+        with _pytest.raises(Exception, match="podman or docker"):
+            ray_tpu.get(in_container.remote(), timeout=60)
+
+
+def test_conda_env_key_stable():
+    from ray_tpu._private.runtime_env.conda import conda_env_key
+
+    assert conda_env_key(["a", "b"]) == conda_env_key(["a", "b"])
+    assert conda_env_key(["a"]) != conda_env_key(["b"])
+    assert conda_env_key({"dependencies": ["x"]}).startswith("conda-")
