@@ -95,7 +95,12 @@ class ConfigRegistry:
             try:
                 return e.type(raw) if e.type is not bool else _parse_bool(raw)
             except (TypeError, ValueError):
-                return e.default
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "ignoring unparseable %s=%r (expected %s)",
+                    e.env, raw, e.type.__name__,
+                )
         if name in self._system:
             return self._system[name]
         return e.default

@@ -92,16 +92,24 @@ def container_argv(image_uri: str, child_src: str,
             "runtime_env 'image_uri' requires podman or docker on PATH; "
             "neither found"
         )
+    import sys
+
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))
     )))
     entries = [os.path.abspath(e) for e in (path_entries or ())]
-    pythonpath = os.pathsep.join([*entries, repo_root])
+    # Host site-packages ride along read-only as a TAIL fallback so the
+    # child loop can import cloudpickle (pure-python) even in minimal
+    # images; the image's own packages win (PYTHONPATH order).
+    host_site = [p for p in sys.path if "site-packages" in p]
+    pythonpath = os.pathsep.join([*entries, repo_root, *host_site])
     argv = [runtime, "run", "--rm", "-i",
             "-v", f"{repo_root}:{repo_root}:ro",
             "-e", f"PYTHONPATH={pythonpath}"]
     for e in entries:
         argv += ["-v", f"{e}:{e}:ro"]
+    for sp in host_site:
+        argv += ["-v", f"{sp}:{sp}:ro"]
     if working_dir:
         wd = os.path.abspath(working_dir)
         argv += ["-v", f"{wd}:{wd}"]

@@ -171,15 +171,21 @@ class _ActorInstance:
         self.pool = ThreadPoolExecutor(
             max_workers=max_concurrency, thread_name_prefix=f"actor-{actor_id[:8]}"
         )
-        self.sem = asyncio.Semaphore(max_concurrency)
         self.groups: Dict[str, ThreadPoolExecutor] = {}
-        self.group_sems: Dict[str, asyncio.Semaphore] = {}
+        # Coroutine methods execute on the dedicated async-actor loop, and
+        # an asyncio.Semaphore binds to the loop that first acquires it —
+        # concurrency gating for coroutines happens THERE, never on the
+        # core loop (sync methods are bounded by their thread pools).
+        self.async_sem = asyncio.Semaphore(max_concurrency)
+        self.async_group_sems: Dict[str, asyncio.Semaphore] = {}
         for gname, limit in (concurrency_groups or {}).items():
             self.groups[gname] = ThreadPoolExecutor(
                 max_workers=max(int(limit), 1),
                 thread_name_prefix=f"actor-{actor_id[:8]}-{gname}",
             )
-            self.group_sems[gname] = asyncio.Semaphore(max(int(limit), 1))
+            self.async_group_sems[gname] = asyncio.Semaphore(
+                max(int(limit), 1)
+            )
         # per-caller ordered admission; seq_lock makes the cursor safe to
         # read/advance from the ring pump thread (fast dispatch) as well as
         # the event loop (slow path)
@@ -206,8 +212,11 @@ class _ActorInstance:
     def pool_for(self, gname: Optional[str]) -> ThreadPoolExecutor:
         return self.pool if gname is None else self.groups[gname]
 
-    def sem_for(self, gname: Optional[str]) -> asyncio.Semaphore:
-        return self.sem if gname is None else self.group_sems[gname]
+    def async_sem_for(self, gname: Optional[str]) -> asyncio.Semaphore:
+        return (
+            self.async_sem if gname is None
+            else self.async_group_sems[gname]
+        )
 
 
 class CoreWorker:
@@ -830,21 +839,38 @@ class CoreWorker:
         ):
             return False
         method = getattr(inst.instance, h.get("method", ""), None)
-        if method is None or asyncio.iscoroutinefunction(method):
+        if method is None:
             return False
+        is_coro = asyncio.iscoroutinefunction(method)
         if self._memory_monitor.is_pressing():
             return False
         caller, seq = h.get("caller", ""), h.get("seq", 0)
         with inst.seq_lock:
             if seq > 0 and seq != inst.next_seq.setdefault(caller, 1):
                 return False  # not next (or a retry duplicate): slow path
-            try:
-                inst.pool.submit(
-                    self._ring_execute_actor_task, inst, method, h, frames,
-                    rconn,
-                )
-            except RuntimeError:
-                return False  # pool shut down (actor being killed)
+            if is_coro:
+                # Coroutine methods: schedule straight onto the dedicated
+                # async-actor loop from the pump thread — the core event
+                # loop never sees the call. FIFO scheduling preserves
+                # per-caller order; the async-side semaphore bounds
+                # concurrency identically to the slow path.
+                try:
+                    asyncio.run_coroutine_threadsafe(
+                        self._ring_run_async_actor_task(
+                            inst, method, h, frames, rconn
+                        ),
+                        self._get_async_loop(),
+                    )
+                except RuntimeError:
+                    return False  # loop shut down
+            else:
+                try:
+                    inst.pool.submit(
+                        self._ring_execute_actor_task, inst, method, h,
+                        frames, rconn,
+                    )
+                except RuntimeError:
+                    return False  # pool shut down (actor being killed)
             # Queued in order: admit the caller's next call right away.
             if seq > 0:
                 inst.next_seq[caller] = seq + 1
@@ -884,6 +910,50 @@ class CoreWorker:
                 return
             except Exception as e:
                 ok, result = False, (e, traceback.format_exc())
+        except Exception as e:
+            ok, result = False, (e, traceback.format_exc())
+        self._ring_reply_result(h, ok, result, rconn)
+        inst.num_executed += 1
+        self._record_task_event({
+            "task_id": h["tid"], "name": h["method"], "type": "ACTOR_TASK",
+            "actor_id": h["aid"],
+            "state": "FINISHED" if ok else "FAILED",
+            "start_time": t0, "end_time": time.time(),
+            "node_id": self.node_id,
+        })
+
+    async def _ring_run_async_actor_task(self, inst, method, h, frames,
+                                         rconn):
+        """Coroutine twin of _ring_execute_actor_task: runs ON the dedicated
+        async-actor loop, gated by the async-side semaphore (shared with the
+        slow path's coroutine branch)."""
+        t0 = time.time()
+        try:
+            async with inst.async_sem:
+                arg_slots, plain, kwargs = self.ctx.deserialize_frames(
+                    frames
+                )
+                args = [plain[i] for _k, i in arg_slots]
+                _async_actor_id.set(h["aid"])
+                _async_task_id.set(h["tid"])
+                try:
+                    ok, result = True, await method(*args, **kwargs)
+                except SystemExit:
+                    self.hosted_actors.pop(h["aid"], None)
+                    inst.exiting = True
+                    self.gcs.notify(
+                        "actor_exited",
+                        {"actor_id": h["aid"], "clean": True,
+                         "reason": "exit_actor"},
+                    )
+                    rconn.send_reply(
+                        {"i": h["i"], "r": 1,
+                         "e": "ActorMissing: actor exited"},
+                        [],
+                    )
+                    return
+                except Exception as e:
+                    ok, result = False, (e, traceback.format_exc())
         except Exception as e:
             ok, result = False, (e, traceback.format_exc())
         self._ring_reply_result(h, ok, result, rconn)
@@ -2585,8 +2655,19 @@ class CoreWorker:
         entries = []
         if renv.get("py_modules"):
             entries = packaging.fetch_modules(self, renv["py_modules"])
+        if packages and (renv.get("conda") or renv.get("image_uri")):
+            raise exc.RayTpuError(
+                "runtime_env cannot combine pip/uv with conda or "
+                "image_uri: the venv packages would be silently ignored "
+                "inside the isolated env (install them via the conda "
+                "spec or bake them into the image)"
+            )
         if renv.get("image_uri"):
-            ekey = "img-" + renv["image_uri"]
+            # working_dir is baked into the container argv as a bind
+            # mount: it must key the executor cache too.
+            ekey = "img-" + renv["image_uri"] + "@" + (
+                renv.get("working_dir") or ""
+            )
         elif renv.get("conda"):
             from ray_tpu._private.runtime_env import conda as conda_mod
 
@@ -3319,23 +3400,27 @@ class CoreWorker:
                 )
             args, kwargs = await self._materialize_args(h, frames)
             if asyncio.iscoroutinefunction(method):
-                async with inst.sem_for(cg):
-                    self._advance_seq(inst, caller, seq)
-                    # Run on the dedicated async-actor loop, NOT the core
-                    # loop: a blocking ray_tpu.get() inside the method would
-                    # otherwise deadlock the whole process.
-                    async def _run_with_ctx():
+                # Run on the dedicated async-actor loop, NOT the core loop:
+                # a blocking ray_tpu.get() inside the method would otherwise
+                # deadlock the whole process. Concurrency is gated by the
+                # ASYNC-side semaphore (acquired on that loop) so the fast
+                # ring path and this path share one limit; admission order
+                # is the FIFO scheduling order onto the async loop, so seq
+                # advances at scheduling time.
+                async def _run_with_ctx():
+                    async with inst.async_sem_for(cg):
                         _async_actor_id.set(h["aid"])
                         _async_task_id.set(h["tid"])
                         return await method(*args, **kwargs)
 
-                    afut = asyncio.run_coroutine_threadsafe(
-                        _run_with_ctx(), self._get_async_loop()
-                    )
-                    try:
-                        result, ok = await asyncio.wrap_future(afut), True
-                    except (Exception, SystemExit) as e:
-                        result, ok = (e, traceback.format_exc()), False
+                afut = asyncio.run_coroutine_threadsafe(
+                    _run_with_ctx(), self._get_async_loop()
+                )
+                self._advance_seq(inst, caller, seq)
+                try:
+                    result, ok = await asyncio.wrap_future(afut), True
+                except (Exception, SystemExit) as e:
+                    result, ok = (e, traceback.format_exc()), False
             else:
                 def run():
                     tid = TaskID.from_hex(h["tid"])
@@ -3376,14 +3461,25 @@ class CoreWorker:
 
     # ------------------------------------------------------------------ misc
 
+    _async_loop_lock = threading.Lock()
+
     def _get_async_loop(self) -> asyncio.AbstractEventLoop:
         """Dedicated event loop thread for async actor method bodies
         (reference: per-actor asyncio loops in the Python worker). Keeping
         user coroutines off the core loop means blocking calls inside them
-        (get/put/wait) cannot deadlock the process's networking."""
+        (get/put/wait) cannot deadlock the process's networking. Called
+        from the core loop AND the ring pump thread — locked so two racing
+        callers cannot spawn two loops."""
         loop = getattr(self, "_async_actor_loop", None)
         if loop is not None:
             return loop
+        with self._async_loop_lock:
+            loop = getattr(self, "_async_actor_loop", None)
+            if loop is not None:
+                return loop
+            return self._spawn_async_loop()
+
+    def _spawn_async_loop(self) -> asyncio.AbstractEventLoop:
         ready = threading.Event()
         holder = {}
 

@@ -252,7 +252,10 @@ def read_sql(sql: str, connection_factory, *, parallelism: int = 1) -> Dataset:
             try:
                 cur.execute(q)
             except Exception as e:
-                raise type(e)(f"{e} (query: {q!r})")
+                # RuntimeError, not type(e): DBAPI error constructors take
+                # driver-specific args and re-raising type(e)(str) masks
+                # the real failure for e.g. MySQLdb's (errno, msg) shape.
+                raise RuntimeError(f"read_sql failed: {e} (query: {q!r})") from e
             cols = [d[0] for d in cur.description]
             rows = cur.fetchall()
         finally:
@@ -400,9 +403,10 @@ def write_sql(ds: Dataset, table: str, connection_factory) -> int:
             cols = block.column_names
             ph = ", ".join([mark] * len(cols))
             stmt = f"INSERT INTO {table} ({', '.join(cols)}) VALUES ({ph})"
-            for row in acc.iter_rows():
-                cur.execute(stmt, tuple(row[c] for c in cols))
-                total += 1
+            rows = [tuple(r[c] for c in cols) for r in acc.iter_rows()]
+            if rows:
+                cur.executemany(stmt, rows)
+                total += len(rows)
         conn.commit()
     finally:
         conn.close()

@@ -319,11 +319,19 @@ class IQL(_OfflineBase):
         }
 
     def _extra_state(self):
-        return {"v_params": self.v_params, "q_target": self.q_target}
+        return {
+            "v_params": self.v_params, "q_target": self.q_target,
+            "pi_os": self.pi_os, "q_os": self.q_os, "v_os": self.v_os,
+        }
 
     def _restore_extra(self, extra):
         self.v_params = extra["v_params"]
         self.q_target = extra["q_target"]
+        # Adam moments resume with the params: a restore must continue the
+        # same trajectory, not cold-start the optimizer.
+        self.pi_os = extra["pi_os"]
+        self.q_os = extra["q_os"]
+        self.v_os = extra["v_os"]
 
 
 # ------------------------------------------------------------------- CQL
@@ -519,7 +527,12 @@ class CQL(_OfflineBase):
         }
 
     def _extra_state(self):
-        return {"q_target": self.q_target}
+        return {
+            "q_target": self.q_target,
+            "pi_os": self.pi_os, "q_os": self.q_os,
+        }
 
     def _restore_extra(self, extra):
         self.q_target = extra["q_target"]
+        self.pi_os = extra["pi_os"]
+        self.q_os = extra["q_os"]
