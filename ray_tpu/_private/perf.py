@@ -530,6 +530,10 @@ def run_core_benchmarks(quick: bool = False) -> Dict[str, float]:
     out["wait_1k_refs_per_s"] = bench_wait_1k_refs(
         250 if quick else 1000
     )
+    # Let the 10k-refs/wait legs' free backlog drain: PG churn should
+    # measure placement-group ops, not the previous leg's cleanup fanout
+    # (observed 79/s mid-drain vs ~2,000/s steady on the same build).
+    time.sleep(2.0)
     _progress("pg_churn")
     out["pg_create_remove_per_s"] = bench_pg_churn(20 if quick else 50)
     import os as _os
