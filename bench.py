@@ -12,7 +12,13 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
+
+# Persistent XLA compilation cache: the big-model compiles (~60-500 s
+# through the tunneled compile helper) are paid once per machine, not once
+# per bench run. Must be set before jax initializes.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache")
 
 BASELINE_TASKS_ASYNC = 7096.8  # reference release/perf_metrics/microbenchmark.json
 
@@ -188,8 +194,13 @@ def bench_train_tokens_per_sec(quick: bool = False):
 
 def bench_train_medium():
     """GPT-2-medium (350M) tokens/sec/chip — the BASELINE.md north-star
-    model size. Larger dims (E=1024, L=24) fill the MXU better than small;
-    remat=False tried first, dots fallback."""
+    model size. Larger dims (E=1024, L=24) fill the MXU better than small.
+
+    Ladder ordered upside-first: the tunneled compile helper rejects
+    programs over its size limit with a FAST HTTP 500 (seconds, measured),
+    so trying bigger-batch / no-remat configs first costs little, and the
+    final rung — B=16 + remat "dots" — is the measured-feasible config on
+    the v5e (35.2k tok/s, MFU 0.38)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -201,10 +212,10 @@ def bench_train_medium():
         make_train_step,
     )
 
-    B, T, steps = 16, 1024, 10
+    T, steps = 1024, 10
     opt = OptimizerConfig().build()
     rng = np.random.RandomState(0)
-    for remat in (False, True):
+    for B, remat in ((32, False), (32, True), (16, False), (16, True)):
         config = gpt2.GPT2Config(
             vocab_size=50304, max_seq_len=1024, num_layers=24, num_heads=16,
             embed_dim=1024, remat=remat,
@@ -233,6 +244,7 @@ def bench_train_medium():
                     gpt2.flops_per_token(config) * tps / 197e12
                 ),
                 "gpt2_medium_remat": remat,
+                "gpt2_medium_batch": B,
             }
         except Exception:
             continue

@@ -46,6 +46,10 @@ def ensure_conda_env(spec: Union[List[str], Dict[str, Any]]) -> str:
     prefix = os.path.join(root, conda_env_key(spec))
     python = os.path.join(prefix, "bin", "python")
     if os.path.exists(python):
+        # Idempotent: envs cached before cloudpickle seeding existed (or
+        # whose seed was wiped) must still heal on reuse — the executor
+        # child cannot start without it.
+        _seed_cloudpickle(prefix)
         return python
     tmp_prefix = prefix + ".tmp"
     shutil.rmtree(tmp_prefix, ignore_errors=True)
@@ -73,8 +77,41 @@ def ensure_conda_env(spec: Union[List[str], Dict[str, Any]]) -> str:
         raise RuntimeError(
             f"conda env creation failed:\n{res.stderr[-2000:]}"
         )
+    _seed_cloudpickle(tmp_prefix)
     os.replace(tmp_prefix, prefix)
     return python
+
+
+def _seed_cloudpickle(prefix: str) -> None:
+    """Copy the host's cloudpickle (pure python) into the env's
+    site-packages: the executor child loop imports it before any task runs,
+    and a newly created conda env does not ship it. Copying just this one
+    package keeps the env isolated — no host site-packages fallback that
+    would silently satisfy imports the declared env is missing.
+
+    Also runs as a heal on cache hits, so it must be atomic and
+    race-tolerant: copy to a temp name, rename into place (losers of a
+    concurrent race just discard their temp), and treat a dir missing
+    ``__init__.py`` — an interrupted earlier copy — as absent."""
+    import glob
+
+    import cloudpickle
+
+    src = os.path.dirname(cloudpickle.__file__)
+    for site in glob.glob(
+        os.path.join(prefix, "lib", "python*", "site-packages")
+    ):
+        dst = os.path.join(site, "cloudpickle")
+        if os.path.exists(os.path.join(dst, "__init__.py")):
+            continue
+        tmp = f"{dst}.tmp{os.getpid()}"
+        shutil.rmtree(tmp, ignore_errors=True)
+        shutil.copytree(src, tmp)
+        shutil.rmtree(dst, ignore_errors=True)  # partial leftover, if any
+        try:
+            os.replace(tmp, dst)
+        except OSError:  # a concurrent seeder won the rename
+            shutil.rmtree(tmp, ignore_errors=True)
 
 
 def container_argv(image_uri: str, child_src: str,
@@ -100,12 +137,16 @@ def container_argv(image_uri: str, child_src: str,
     entries = [os.path.abspath(e) for e in (path_entries or ())]
     # Host site-packages ride along read-only as a TAIL fallback so the
     # child loop can import cloudpickle (pure-python) even in minimal
-    # images; the image's own packages win (PYTHONPATH order).
+    # images. They go through RT_PARENT_SITE — which the child loop appends
+    # AFTER the image interpreter's own sys.path — never PYTHONPATH, whose
+    # entries would precede the image's site-packages and silently shadow
+    # the very packages image_uri was asked to provide.
     host_site = [p for p in sys.path if "site-packages" in p]
-    pythonpath = os.pathsep.join([*entries, repo_root, *host_site])
+    pythonpath = os.pathsep.join([*entries, repo_root])
     argv = [runtime, "run", "--rm", "-i",
             "-v", f"{repo_root}:{repo_root}:ro",
-            "-e", f"PYTHONPATH={pythonpath}"]
+            "-e", f"PYTHONPATH={pythonpath}",
+            "-e", f"RT_PARENT_SITE={os.pathsep.join(host_site)}"]
     for e in entries:
         argv += ["-v", f"{e}:{e}:ro"]
     for sp in host_site:

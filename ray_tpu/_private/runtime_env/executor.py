@@ -34,12 +34,38 @@ _proto_fd = os.dup(1)
 os.dup2(2, 1)
 out = os.fdopen(_proto_fd, "wb")
 # The parent's site-packages ride along as a FALLBACK (appended, so venv
-# installs take precedence): `python -m venv` from a venv interpreter
-# points system-site at the BASE prefix, losing the parent venv's packages
-# (cloudpickle, numpy) that result shipping depends on.
-for _p in os.environ.get("RT_PARENT_SITE", "").split(os.pathsep):
-    if _p and _p not in sys.path:
+# installs take precedence — except cloudpickle, pinned to the parent's
+# copy below): `python -m venv` from a venv interpreter points system-site
+# at the BASE prefix, losing the parent venv's packages (cloudpickle,
+# numpy) that result shipping depends on.
+_psite = [p for p in os.environ.get("RT_PARENT_SITE", "").split(os.pathsep) if p]
+for _p in _psite:
+    if _p not in sys.path:
         sys.path.append(_p)
+# Protocol pin: the framed wire format is cloudpickle, and dumps/loads must
+# run the SAME version on both ends (its reconstruction helpers are
+# referenced by name; major-version gaps break loads). When the parent's
+# site rides along, import ITS copy under the real module name — by-name
+# references inside the stream then resolve to it too — instead of letting
+# an image/venv-bundled older cloudpickle take over the protocol. This is
+# the ONE package for which the env's own install does NOT win. Best-effort:
+# if the parent's copy won't execute here (interpreter too old, mount
+# unreadable), fall back to the env's own cloudpickle below.
+import importlib.util as _ilu
+for _p in _psite:
+    _init = os.path.join(_p, "cloudpickle", "__init__.py")
+    if os.path.exists(_init):
+        try:
+            _spec = _ilu.spec_from_file_location(
+                "cloudpickle", _init,
+                submodule_search_locations=[os.path.join(_p, "cloudpickle")])
+            _mod = _ilu.module_from_spec(_spec)
+            sys.modules["cloudpickle"] = _mod
+            _spec.loader.exec_module(_mod)
+        except BaseException:
+            sys.modules.pop("cloudpickle", None)
+            continue
+        break
 import cloudpickle
 
 _U32 = struct.Struct("<I")
@@ -105,8 +131,15 @@ class EnvExecutor:
         """``argv`` overrides the child command entirely (the container
         plugin launches the SAME child loop via ``docker run -i ... python
         -c``; the framed stdin/stdout protocol is transport-agnostic).
-        ``inherit_parent_site=False`` for isolated interpreters (conda,
-        containers) whose package set must not be polluted by the host's."""
+        ``inherit_parent_site=False`` for conda envs, which stay fully
+        isolated (cloudpickle is seeded into them at creation —
+        ``conda._seed_cloudpickle``). Containers instead receive a
+        RT_PARENT_SITE tail-fallback set by ``conda.container_argv`` so
+        minimal images can still import cloudpickle; the child appends it
+        AFTER the image's own sys.path, so image packages win — with the
+        single exception of cloudpickle itself, which the child pins to
+        the parent's copy because it IS the wire protocol (see
+        ``_CHILD_SRC``)."""
         self.python = python
         env = dict(os.environ)
         # The child must import ray_tpu's deps (cloudpickle) and any staged

@@ -2714,6 +2714,10 @@ class CoreWorker:
                         )
 
                         python = conda_mod.ensure_conda_env(renv["conda"])
+                        # The env is isolated (no host-site fallback);
+                        # cloudpickle — the one package the child loop
+                        # needs before user code — is seeded into the env
+                        # at creation (conda.py _seed_cloudpickle).
                         ex = EnvExecutor(
                             python, path_entries=entries,
                             inherit_parent_site=False,

@@ -65,9 +65,10 @@ class ActorMethod:
         return ActorMethod(
             self._handle,
             self._method_name,
-            # None = keep the declared/@method value, don't reset to 1
+            # None = keep the declared/@method value, don't reset
             self._num_returns if num_returns is None else num_returns,
-            concurrency_group,
+            self._concurrency_group if concurrency_group is None
+            else concurrency_group,
         )
 
     def bind(self, *args, **kwargs):

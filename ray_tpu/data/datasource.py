@@ -220,18 +220,30 @@ def write_json(ds: Dataset, path: str, **kw) -> List[str]:
     return _write_blocks(ds, path, "json", write_one)
 
 
-def read_sql(sql: str, connection_factory, *, parallelism: int = 1) -> Dataset:
+def read_sql(sql: str, connection_factory, *, parallelism: int = 1,
+             order_by: Optional[str] = None) -> Dataset:
     """Read a SQL query through a DBAPI2 connection factory (reference:
     ``data/datasource/sql_datasource.py`` — same shape: the factory runs on
     the reading task so connections never pickle). Works with stdlib
     sqlite3, psycopg2, mysqlclient, duckdb, ...
 
-    ``parallelism`` > 1 shards the query by row number windows — only use
-    it when the query is deterministic and cheap to re-run; default is one
-    task (the reference also reads unpartitioned queries in one task).
+    ``parallelism`` > 1 re-runs the query once per shard and splits it by
+    ``ROW_NUMBER() OVER (ORDER BY {order_by})`` windows, so it requires
+    ``order_by``: a column list giving a total order. Without one, engines
+    with nondeterministic scan order (e.g. Postgres parallel seq scans) can
+    number rows differently per run, silently duplicating or dropping rows.
+    Default is one task (the reference also reads unpartitioned queries in
+    one task).
     """
     import cloudpickle
 
+    if parallelism > 1 and not order_by:
+        raise ValueError(
+            "read_sql(parallelism>1) requires order_by=: sharding re-runs "
+            "the query per shard and splits by row number, which is only "
+            "stable under a total order. Pass order_by='<unique column(s)>' "
+            "or use parallelism=1."
+        )
     payload = cloudpickle.dumps((sql, connection_factory))
 
     def read_shard(shard: int, nshards: int) -> pa.Table:
@@ -243,10 +255,12 @@ def read_sql(sql: str, connection_factory, *, parallelism: int = 1) -> Dataset:
             cur = conn.cursor()
             if nshards > 1:
                 # Window functions are illegal in WHERE: project the row
-                # number in a subquery, filter one level up.
+                # number in a subquery, filter one level up. ORDER BY makes
+                # the numbering stable across the per-shard re-runs.
                 q = (
                     f"SELECT * FROM (SELECT __rt_sub.*, "
-                    f"ROW_NUMBER() OVER () AS __rt_rn FROM ({q}) __rt_sub) "
+                    f"ROW_NUMBER() OVER (ORDER BY {order_by}) AS __rt_rn "
+                    f"FROM ({q}) __rt_sub) "
                     f"__rt_outer WHERE __rt_rn % {nshards} = {shard}"
                 )
             try:

@@ -1,0 +1,88 @@
+"""Probe which GPT-2-medium train configs compile+run on this chip.
+
+Walks a ladder of (B, T, remat, policy) configs, records
+tokens/sec + MFU for each that works into scripts/medium_probe.jsonl.
+Run from /root/repo (axon backend is cwd-sensitive).
+"""
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+try:
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+except Exception:
+    pass
+
+sys.path.insert(0, "/root/repo")
+from ray_tpu.models import gpt2  # noqa: E402
+from ray_tpu.train.step import (  # noqa: E402
+    OptimizerConfig,
+    create_train_state,
+    make_train_step,
+)
+
+LOG = "/root/repo/scripts/medium_probe.jsonl"
+
+
+def log(rec):
+    rec["t"] = round(time.time(), 1)
+    with open(LOG, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(rec, flush=True)
+
+
+def try_config(B, T, remat, policy, steps=10):
+    config = gpt2.GPT2Config(
+        vocab_size=50304, max_seq_len=T, num_layers=24, num_heads=16,
+        embed_dim=1024, remat=remat, remat_policy=policy,
+    )
+    opt = OptimizerConfig().build()
+    rng = np.random.RandomState(0)
+    t0 = time.perf_counter()
+    state = create_train_state(config, opt, jax.random.PRNGKey(0))
+    step = make_train_step(config, opt)
+    batch = {"tokens": jnp.asarray(rng.randint(0, 50304, (B, T + 1)))}
+    state, m = step(state, batch)
+    float(m["loss"])
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for i in range(steps):
+        state, m = step(state, batch)
+        if (i + 1) % 5 == 0:
+            float(m["loss"])
+    float(m["loss"])
+    dt = time.perf_counter() - t0
+    tps = steps * B * T / dt
+    mfu = gpt2.flops_per_token(config) * tps / 197e12
+    return {"tps": round(tps, 1), "mfu": round(mfu, 4),
+            "compile_s": round(compile_s, 1), "loss": float(m["loss"])}
+
+
+LADDER = [
+    # (B, T, remat, policy)
+    (16, 1024, True, "dots"),
+    (8, 1024, True, "dots"),
+    (8, 1024, True, "full"),
+    (4, 1024, True, "full"),
+    (8, 512, True, "dots"),
+]
+
+for B, T, remat, policy in LADDER:
+    key = {"B": B, "T": T, "remat": remat, "policy": policy}
+    try:
+        res = try_config(B, T, remat, policy)
+        log({**key, "ok": True, **res})
+        # first success is the preferred config; keep going only to see
+        # whether a larger-batch alternative also works (ladder is ordered
+        # by preference, so stop at first success).
+        break
+    except Exception as e:
+        log({**key, "ok": False, "error": f"{type(e).__name__}: {e}"[:500]})
+log({"done": True})

@@ -268,6 +268,12 @@ def test_concurrency_group_call_override(rt_start):
     assert out == 0
     assert _t.perf_counter() - t0 < 2.0
     assert ray_tpu.get(blocker, timeout=20) == 3
+
+    # Chained .options() preserve earlier overrides symmetrically: setting
+    # num_returns later must not silently drop the group override.
+    m = a.work.options(concurrency_group="fast").options(num_returns=1)
+    assert m._concurrency_group == "fast"
+    assert m._num_returns == 1
     ray_tpu.kill(a)
 
 

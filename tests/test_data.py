@@ -487,6 +487,11 @@ def test_read_sql_sharded(rt, tmp_path):
         return s.connect(path)
 
     rows = rt_data.read_sql(
-        "SELECT x FROM t", factory, parallelism=3
+        "SELECT x FROM t", factory, parallelism=3, order_by="x"
     ).take_all()
     assert sorted(r["x"] for r in rows) == list(range(40))
+
+    # Sharding without a total order is refused loudly: row numbering is
+    # only stable across the per-shard re-runs under an ORDER BY.
+    with pytest.raises(ValueError, match="order_by"):
+        rt_data.read_sql("SELECT x FROM t", factory, parallelism=3)
