@@ -18,7 +18,10 @@ import time
 # Persistent XLA compilation cache: the big-model compiles (~60-500 s
 # through the tunneled compile helper) are paid once per machine, not once
 # per bench run. Must be set before jax initializes.
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/repo/.jax_cache")
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+)
 
 BASELINE_TASKS_ASYNC = 7096.8  # reference release/perf_metrics/microbenchmark.json
 

@@ -72,12 +72,19 @@ class RingConnection:
         loop: asyncio.AbstractEventLoop,
         handler=None,
         fast_dispatch: Optional[Callable] = None,
+        fast_batch: Optional[Callable] = None,
         name: str = "",
     ):
         self.ring = ring
         self.loop = loop
         self.handler = handler
         self.fast_dispatch = fast_dispatch
+        # Optional whole-batch fast path: receives every sub-request of one
+        # "batch" wire message at once (list of (header, frames)) and
+        # returns the leftovers for the slow path. Lets the executor side
+        # run a burst as a few grouped submissions with ONE batched reply
+        # per group instead of per-task submit/encode/send.
+        self.fast_batch = fast_batch
         self.name = name or ring.name
         self.peer_info: dict = {}
         self.on_close: Optional[Callable] = None
@@ -254,6 +261,27 @@ class RingConnection:
             raise
         return futs
 
+    def send_reply_batch(self, subs: List[dict], counts: List[int],
+                         frames: List[bytes]):
+        """Reply to many requests in ONE ring message (any thread).
+
+        ``subs[k]`` must carry its request's correlation id under ``i``;
+        ``counts[k]`` frames belong to it. When the combined message
+        exceeds the ring, each sub-reply is sent individually (whose own
+        too-big handling degrades to an inline error) — a batch that
+        cannot be correlated must never leave sub-futures hanging."""
+        try:
+            self._send_auto({"r": 1, "bh": subs, "bn": counts}, frames)
+            return
+        except MessageTooBig:
+            pass
+        except protocol.ConnectionLost:
+            return
+        pos = 0
+        for sub, n in zip(subs, counts):
+            self.send_reply({**sub, "r": 1}, frames[pos:pos + n])
+            pos += n
+
     def send_reply(self, header: dict, frames: List[bytes]):
         """Reply to a request (any thread)."""
         try:
@@ -300,17 +328,34 @@ class RingConnection:
                                          self.name)
                         continue
                     if header.get("r"):
-                        replies.append((header, frames))
+                        if "bh" in header:
+                            # Batched reply: sub-replies ride one message,
+                            # each under its own correlation id.
+                            pos = 0
+                            for sub, n in zip(header["bh"], header["bn"]):
+                                replies.append((sub, frames[pos:pos + n]))
+                                pos += n
+                        else:
+                            replies.append((header, frames))
                         continue
                     if header.get("m") == "batch":
                         # Unpack sub-requests: each carries its own id and
                         # resolves (fast or slow) independently.
                         method = header.get("bm")
                         pos = 0
+                        subs = []
                         for sub, n in zip(header["bh"], header["bn"]):
                             sub["m"] = method
-                            sfr = frames[pos:pos + n]
+                            subs.append((sub, frames[pos:pos + n]))
                             pos += n
+                        if self.fast_batch is not None:
+                            try:
+                                subs = self.fast_batch(subs, self)
+                            except Exception:
+                                logger.exception(
+                                    "ring batch fast dispatch failed; slow"
+                                )
+                        for sub, sfr in subs:
                             if fast is not None:
                                 try:
                                     if fast(sub, sfr, self):

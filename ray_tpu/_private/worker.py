@@ -694,6 +694,7 @@ class CoreWorker:
         rc = RingConnection(
             nring, asyncio.get_running_loop(), handler=self._handle_rpc,
             fast_dispatch=self._ring_fast_dispatch,
+            fast_batch=self._ring_fast_dispatch_batch,
             name=f"ringsrv-{h['name']}",
         )
         # keep for teardown; prune dead ones so reconnect churn stays bounded
@@ -741,6 +742,112 @@ class CoreWorker:
         ex.submit(self._ring_execute_task, fn, h, frames, rconn)
         return True
 
+    def _ring_fast_dispatch_batch(self, items, rconn):
+        """Pump-thread fast path for a WHOLE batch wire message: the
+        fast-eligible plain tasks in it are split into ≤ num_task_slots
+        contiguous chunks, each chunk executing sequentially on one
+        executor thread and answering with ONE batched reply — per-task
+        submit/encode/send amortizes across the chunk while real
+        parallelism still matches the node's task slots. Everything not
+        eligible (actor pushes, refs, runtime envs, uncached functions) is
+        returned for the per-item fast/slow paths, whose semantics are
+        authoritative."""
+        ex = self.task_executor
+        if ex is None or self._memory_monitor.is_pressing():
+            return items
+        eligible = []
+        leftovers = []
+        for h, frames in items:
+            if (
+                h.get("m") != "push_task"
+                or h.get("nret", 1) < 1
+                or h.get("argrefs")
+                or h.get("borrows")
+                or h.get("renv")
+                or h.get("trace")
+            ):
+                leftovers.append((h, frames))
+                continue
+            fn = self.fn_cache.get(h["fkey"])
+            if fn is None:
+                leftovers.append((h, frames))
+                continue
+            eligible.append((fn, h, frames))
+        if not eligible:
+            return leftovers
+        nchunks = min(len(eligible), max(self.num_task_slots, 1))
+        size, rem = divmod(len(eligible), nchunks)
+        pos = 0
+        for c in range(nchunks):
+            ln = size + (1 if c < rem else 0)
+            chunk = eligible[pos:pos + ln]
+            pos += ln
+            try:
+                ex.submit(self._ring_execute_chunk, chunk, rconn)
+            except RuntimeError:
+                # Executor shut down mid-batch: route THIS and all
+                # remaining chunks to the slow path; already-submitted
+                # chunks must not be re-dispatched (double execution).
+                leftovers.extend((h, fr) for _fn, h, fr in chunk)
+                leftovers.extend(
+                    (h, fr) for _fn, h, fr in eligible[pos:]
+                )
+                break
+        return leftovers
+
+    def _ring_execute_chunk(self, chunk, rconn):
+        """Execute a chunk of fast-path tasks sequentially on this executor
+        thread; small results coalesce into one batched reply, oversized
+        ones fall back to the individual shm-reply path."""
+        subs = []
+        counts = []
+        out: List[bytes] = []
+        now = time.time
+        for fn, h, frames in chunk:
+            t0 = now()
+            try:
+                arg_slots, plain, kwargs = self.ctx.deserialize_frames(frames)
+                args = [plain[i] for _k, i in arg_slots]
+                self.current_task_id.value = TaskID.from_hex(h["tid"])
+                self.current_actor_id.value = None
+                self.put_counter.value = 0
+                try:
+                    ok, result = True, fn(*args, **kwargs)
+                except Exception as e:
+                    ok, result = False, (e, traceback.format_exc())
+            except Exception as e:
+                ok, result = False, (e, traceback.format_exc())
+            try:
+                rets, out_frames, big = self._package_result_parts(
+                    h, ok, result
+                )
+            except Exception as e:
+                logger.exception("ring chunk reply packaging failed")
+                subs.append(
+                    {"i": h["i"], "e": f"reply packaging failed: {e!r}"}
+                )
+                counts.append(0)
+                continue
+            if big:
+                # shm + head registration: individual async reply path,
+                # reusing THIS packaging pass (a second one would register
+                # nested-ref borrows twice and re-serialize the value)
+                self._ring_reply_packaged(h, rets, out_frames, big, rconn)
+            else:
+                subs.append({"i": h["i"], "rets": rets})
+                counts.append(len(out_frames))
+                out.extend(out_frames)
+            self._stats["tasks_executed"] += 1
+            self._record_task_event({
+                "task_id": h["tid"], "name": h.get("name") or h["fkey"],
+                "type": "NORMAL_TASK",
+                "state": "FINISHED" if ok else "FAILED",
+                "start_time": t0, "end_time": now(),
+                "node_id": self.node_id,
+            })
+        if subs:
+            rconn.send_reply_batch(subs, counts, out)
+
     def _ring_execute_task(self, fn, h, frames, rconn):
         t0 = time.time()
         try:
@@ -770,6 +877,20 @@ class CoreWorker:
         (shared by the task and actor ring fast paths)."""
         try:
             rets, out_frames, big = self._package_result_parts(h, ok, result)
+        except Exception as e:
+            logger.exception("ring task reply failed")
+            rconn.send_reply(
+                {"i": h["i"], "r": 1, "e": f"reply packaging failed: {e!r}"},
+                [],
+            )
+            return
+        self._ring_reply_packaged(h, rets, out_frames, big, rconn)
+
+    def _ring_reply_packaged(self, h, rets, out_frames, big, rconn):
+        """Send an ALREADY-packaged result (from an executor thread).
+        Packaging must happen exactly once per execution — it registers
+        nested-ref borrows, and a second pass would leak them."""
+        try:
             if big:
                 # Oversized values: write shm here (sync), but the head
                 # registration is an RPC — finish on the loop, and only
@@ -1711,8 +1832,17 @@ class CoreWorker:
         fkey = self.export_function(fn)
         task_id = TaskID.of()
         frames, ref_ids, borrow_ids = self._serialize_args(args, kwargs)
-        resources = dict(resources or {"CPU": 1})
-        strategy = strategy or {}
+        if not resources and not strategy:
+            # Hot path: the shared default dict + precomputed sched key skip
+            # a dict copy and a sorted-tuple build per call. Never mutated
+            # downstream (_LeaseSet holds it read-only).
+            resources, strategy, skey = (
+                self._DEFAULT_RESOURCES, {}, self._DEFAULT_SCHED_KEY
+            )
+        else:
+            resources = dict(resources or {"CPU": 1})
+            strategy = strategy or {}
+            skey = None
         header = {
             "tid": task_id.hex(),
             "fkey": fkey,
@@ -1744,7 +1874,7 @@ class CoreWorker:
         self._stats["tasks_submitted"] += 1
         self._enqueue_dispatch(
             self._dispatch_task_fast, (header, frames, resources, strategy,
-                                       max_retries)
+                                       max_retries, skey)
         )
         if streaming:
             from ray_tpu.object_ref import StreamingObjectRefGenerator
@@ -1797,9 +1927,14 @@ class CoreWorker:
                 self._submit_scheduled = False
             raise
 
+    _DEFAULT_RESOURCES = {"CPU": 1}
+    _DEFAULT_SCHED_KEY = ((("CPU", 1),), ())
+
     def _dispatch_task_fast(self, header, frames, resources, strategy,
-                            retries):
-        key = self._sched_key(resources, strategy)
+                            retries, skey=None):
+        key = skey if skey is not None else self._sched_key(
+            resources, strategy
+        )
         lease_set = self.leases.get(key)
         if lease_set is None:
             lease_set = _LeaseSet(resources, strategy)

@@ -1,0 +1,28 @@
+"""A/B the regressed hot-path legs: native store on vs off, current HEAD.
+
+Usage: python scripts/hotpath_ab.py [on|off]
+"""
+import os
+import sys
+import time
+
+if len(sys.argv) > 1 and sys.argv[1] == "off":
+    os.environ["RT_DISABLE_NATIVE_STORE"] = "1"
+
+sys.path.insert(0, "/root/repo")
+import ray_tpu  # noqa: E402
+from ray_tpu._private import perf  # noqa: E402
+from ray_tpu._private import worker as worker_mod  # noqa: E402
+
+ray_tpu.init(num_cpus=2, num_nodes=1)
+print("native:", worker_mod.get_global_worker().shm.native_enabled)
+for name, fn, n in [
+    ("tasks_async", perf.bench_single_client_tasks_async, 2000),
+    ("actor_async", perf.bench_actor_calls_async, 2000),
+    ("async_actor", perf.bench_async_actor_calls, 1000),
+]:
+    vals = []
+    for _ in range(3):
+        vals.append(fn(n))
+    print(name, [round(v, 1) for v in vals])
+ray_tpu.shutdown()

@@ -1,0 +1,29 @@
+"""Run the non-headline core-bench legs (spawn-safe: must be a real file)."""
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+import ray_tpu  # noqa: E402
+from ray_tpu._private import perf  # noqa: E402
+
+if __name__ == "__main__":
+    ray_tpu.init(num_cpus=2, num_nodes=1)
+    legs = [
+        ("actor_concurrent", perf.bench_actor_calls_concurrent, (1000,)),
+        ("n_n", perf.bench_actor_calls_n_n, ()),
+        ("multi_client_tasks", perf.bench_multi_client_tasks_async, ()),
+        ("get_calls", perf.bench_get_calls, (2000,)),
+        ("put_calls", perf.bench_put_calls, (2000,)),
+        ("wait_1k", perf.bench_wait_1k_refs, (10,)),
+    ]
+    for name, fn, a in legs:
+        t0 = time.perf_counter()
+        try:
+            v = fn(*a)
+        except Exception as e:
+            print(name, "ERROR", repr(e)[:200], flush=True)
+            continue
+        print(name, round(v, 1), "wall", round(time.perf_counter() - t0, 1),
+              flush=True)
+    ray_tpu.shutdown()
+    print("DONE", flush=True)

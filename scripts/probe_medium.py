@@ -1,8 +1,13 @@
 """Probe which GPT-2-medium train configs compile+run on this chip.
 
-Walks a ladder of (B, T, remat, policy) configs, records
+Walks a ladder of (B, T, remat, policy) configs — the default ladder or one
+given on the CLI as comma-separated rungs ``B:T:remat:policy`` — and records
 tokens/sec + MFU for each that works into scripts/medium_probe.jsonl.
-Run from /root/repo (axon backend is cwd-sensitive).
+Run from /root/repo (axon backend is cwd-sensitive)::
+
+    python scripts/probe_medium.py                 # default ladder, stop at
+                                                   # first success
+    python scripts/probe_medium.py 32:1024:1:dots 16:1024:0:dots --all
 """
 import json
 import os
@@ -56,7 +61,7 @@ def try_config(B, T, remat, policy, steps=10):
     for i in range(steps):
         state, m = step(state, batch)
         if (i + 1) % 5 == 0:
-            float(m["loss"])
+            float(m["loss"])  # real device->host sync (tunnel-honest)
     float(m["loss"])
     dt = time.perf_counter() - t0
     tps = steps * B * T / dt
@@ -65,8 +70,7 @@ def try_config(B, T, remat, policy, steps=10):
             "compile_s": round(compile_s, 1), "loss": float(m["loss"])}
 
 
-LADDER = [
-    # (B, T, remat, policy)
+DEFAULT_LADDER = [
     (16, 1024, True, "dots"),
     (8, 1024, True, "dots"),
     (8, 1024, True, "full"),
@@ -74,15 +78,29 @@ LADDER = [
     (8, 512, True, "dots"),
 ]
 
-for B, T, remat, policy in LADDER:
-    key = {"B": B, "T": T, "remat": remat, "policy": policy}
-    try:
-        res = try_config(B, T, remat, policy)
-        log({**key, "ok": True, **res})
-        # first success is the preferred config; keep going only to see
-        # whether a larger-batch alternative also works (ladder is ordered
-        # by preference, so stop at first success).
-        break
-    except Exception as e:
-        log({**key, "ok": False, "error": f"{type(e).__name__}: {e}"[:500]})
-log({"done": True})
+
+def main(argv):
+    run_all = "--all" in argv
+    rungs = [a for a in argv if ":" in a]
+    if rungs:
+        ladder = []
+        for r in rungs:
+            b, t, rm, pol = r.split(":")
+            ladder.append((int(b), int(t), bool(int(rm)), pol))
+    else:
+        ladder = DEFAULT_LADDER
+    for B, T, remat, policy in ladder:
+        key = {"B": B, "T": T, "remat": remat, "policy": policy}
+        try:
+            res = try_config(B, T, remat, policy)
+            log({**key, "ok": True, **res})
+            if not run_all:
+                break
+        except Exception as e:
+            log({**key, "ok": False,
+                 "error": f"{type(e).__name__}: {e}"[:500]})
+    log({"done": True})
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
