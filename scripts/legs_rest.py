@@ -14,7 +14,7 @@ if __name__ == "__main__":
         ("multi_client_tasks", perf.bench_multi_client_tasks_async, ()),
         ("get_calls", perf.bench_get_calls, (2000,)),
         ("put_calls", perf.bench_put_calls, (2000,)),
-        ("wait_1k", perf.bench_wait_1k_refs, (10,)),
+        ("wait_1k", perf.bench_wait_1k_refs, (1000,)),
     ]
     for name, fn, a in legs:
         t0 = time.perf_counter()
