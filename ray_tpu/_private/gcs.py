@@ -1213,7 +1213,12 @@ class HeadService:
     # --------------------------------------------------------- object dir
 
     async def rpc_object_register(self, h, frames, conn):
-        self.object_dir[h["oid"]] = h["meta"]
+        # Owners flush registrations in batches ("items") — one notify per
+        # put-burst, not per object; single oid/meta kept for compat.
+        if "items" in h:
+            self.object_dir.update(h["items"])
+        else:
+            self.object_dir[h["oid"]] = h["meta"]
         return {}, []
 
     async def rpc_object_lookup(self, h, frames, conn):

@@ -1192,11 +1192,15 @@ class CoreWorker:
         self._drain_borrows()
         q = self._release_queue
         freed: List[str] = []
+        to_register: List[tuple] = []
         to_release: Dict[tuple, List[str]] = {}
         to_add: Dict[tuple, List[str]] = {}
         my_addr = tuple(self.addr or ())
         while q:
             kind, payload = q.popleft()
+            if kind == "reg":
+                to_register.append(payload)
+                continue
             if kind == "pin":
                 for oid, owner in payload:
                     rec = self.owned.get(oid)
@@ -1226,6 +1230,17 @@ class CoreWorker:
             self.loop.create_task(
                 self._notify_owner_many(owner, "release_borrow", oids)
             )
+        # Registrations flush BEFORE frees: a register landing after the
+        # free of the same (dying) object would leave the head directory
+        # pointing at reclaimed arena memory forever. The reverse race —
+        # a reconstruction's re-register popped by the old free in the
+        # same batch — only costs a directory miss, which readers already
+        # survive via pull-from-owner.
+        if to_register:
+            try:
+                self.gcs.notify("object_register", {"items": to_register})
+            except protocol.ConnectionLost:
+                pass
         if freed:
             try:
                 self.gcs.notify("object_free", {"oids": freed})
@@ -1301,7 +1316,15 @@ class CoreWorker:
             return
         self._release_drain_scheduled = True
         try:
-            self.loop.call_soon_threadsafe(self._drain_releases)
+            # Short flush window (not next-tick): a sequential put/free
+            # loop otherwise drains once per op, sending a 1-item head
+            # notify each time. 5ms of latency on ref release is invisible
+            # (arena reclaim + head directory tolerate it; remote readers
+            # racing a free already handle miss-then-pull), while a burst
+            # collapses to one notify + one pubsub fanout.
+            self.loop.call_soon_threadsafe(
+                lambda: self.loop.call_later(0.005, self._drain_releases)
+            )
         except RuntimeError:
             self._release_drain_scheduled = False
 
@@ -1394,26 +1417,19 @@ class CoreWorker:
             self._register_owned(hex_, nested=nested)
             self.memory_store[hex_] = ("shm", meta)
             self._signal_store_event(hex_)
-
-            def _register():
-                # Fire-and-forget: we are the OWNER, so any later
-                # object_free leaves on the same head connection pipelined
-                # behind this registration; a reader that races the
-                # directory falls back to pull-from-owner (reference
-                # analog: owner-resolved locations,
-                # ownership_object_directory.h).
-                try:
-                    self.gcs.notify(
-                        "object_register", {"oid": hex_, "meta": meta}
-                    )
-                except protocol.ConnectionLost:
-                    pass
-
-            try:
-                self.loop.call_soon_threadsafe(_register)
-            except RuntimeError:
-                pass  # loop shut down mid-put
+            self._register_object_async(hex_, meta)
         return ObjectRef(oid, tuple(self.addr))
+
+    def _register_object_async(self, hex_: str, meta: dict):
+        """Queue a head directory registration on the SAME ordered ref-op
+        queue the frees ride (a separate buffer/timer could flush a free
+        BEFORE its object's registration, resurrecting a freed object as a
+        stale directory entry — the split-queue reordering class
+        _enqueue_ref_op documents). A put-burst flushes as ONE batched
+        notify; a reader racing the 5ms window falls back to
+        pull-from-owner (reference analog: owner-resolved locations,
+        ownership_object_directory.h)."""
+        self._enqueue_ref_op(("reg", (hex_, meta)))
 
     def _signal_store_event(self, hex_: str):
         """Wake any loop-side waiter (_wait_local) for an object stored from
@@ -1493,9 +1509,49 @@ class CoreWorker:
         single = isinstance(refs, ObjectRef)
         if single:
             refs = [refs]
-        coros = self.run_sync(self._get_many(refs, timeout))
-        values = coros
+        values = self._try_get_local(refs)
+        if values is None:
+            values = self.run_sync(self._get_many(refs, timeout))
         return values[0] if single else values
+
+    def _try_get_local(self, refs) -> Optional[list]:
+        """Caller-thread fast path: when EVERY ref already resolves in the
+        local store, deserialize right here — the loop round-trip
+        (run_sync handoff + task + wakeups, ~6 epoll cycles measured) is
+        pure overhead for an object that's already in hand. Any miss,
+        stale shm meta, or error entry falls back to the authoritative
+        async path (waiting, remote fetch, reconstruction). Store reads
+        and arena gets are thread-safe; deserialize already runs on
+        executor threads elsewhere."""
+        # Two phases: resolve EVERY ref's frames first, deserialize after —
+        # a miss on the last ref must not have already paid for (and then
+        # discarded) the earlier refs' deserialization.
+        resolved = []
+        for ref in refs:
+            entry = self.memory_store.get(ref.id().hex())
+            if entry is None:
+                return None
+            kind = entry[0]
+            if kind == "shm":
+                frames = self.shm.get_frames(ref.id().hex(), entry[1])
+                if frames is None:
+                    return None  # spilled/moved: slow path refreshes
+                resolved.append(("mem", frames))
+            elif kind in ("mem", "err"):
+                resolved.append(entry)
+            else:
+                return None
+        out = []
+        for kind, payload in resolved:
+            try:
+                if kind == "err":
+                    raise payload
+                out.append(self.ctx.deserialize_frames(payload))
+            except exc.RayTpuError:
+                raise
+            except Exception:
+                return None  # any decode hiccup: slow path is authoritative
+        return out
 
     async def _get_many(self, refs: List[ObjectRef], timeout: Optional[float]):
         results = await asyncio.gather(
