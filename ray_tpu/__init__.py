@@ -15,6 +15,7 @@ import atexit
 import inspect
 import os
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 from ray_tpu import exceptions
@@ -60,6 +61,27 @@ _head: Optional[HeadService] = None
 
 def is_initialized() -> bool:
     return _worker_mod.global_worker is not None
+
+
+def _prune_old_sessions(keep: int, active: str):
+    """Bound /tmp/ray_tpu growth: session dirs (worker log files) from old
+    clusters are removed oldest-first beyond the newest ``keep`` (the
+    reference bounds its session dirs the same way — session_latest
+    rotation). Best-effort; never blocks startup."""
+    import shutil
+
+    try:
+        root = "/tmp/ray_tpu"
+        dirs = [
+            os.path.join(root, d) for d in os.listdir(root)
+            if d.startswith("session_")
+        ]
+        dirs = [d for d in dirs if os.path.abspath(d) != os.path.abspath(active)]
+        dirs.sort(key=lambda d: os.path.getmtime(d))
+        for d in dirs[: max(len(dirs) - (keep - 1), 0)]:
+            shutil.rmtree(d, ignore_errors=True)
+    except OSError:
+        pass
 
 
 def init(
@@ -119,6 +141,18 @@ def init(
             address = info["address"]
         job_id = JobID.from_random()
         if address is None:
+            # Session dir: per-cluster scratch for worker log files (and
+            # anything else session-scoped). Spawned nodes learn it via
+            # RT_SESSION_DIR (reference: the ray session_latest dir).
+            session_dir = os.environ.get("RT_SESSION_DIR")
+            if not session_dir:
+                session_dir = os.path.join(
+                    "/tmp/ray_tpu",
+                    f"session_{int(time.time())}_{os.getpid()}",
+                )
+            os.makedirs(session_dir, exist_ok=True)
+            _prune_old_sessions(keep=5, active=session_dir)
+            _node_env = dict(_node_env or {}, RT_SESSION_DIR=session_dir)
             head = HeadService()
             driver = CoreWorker(
                 is_driver=True,

@@ -25,7 +25,7 @@ import itertools
 import logging
 import os
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -170,6 +170,10 @@ class HeadService:
         # the worker-failure path, ``cluster_lease_manager.cc``).
         self._conn_leases: Dict[int, list] = {}
         self.task_events: List[dict] = []  # bounded task-event buffer for state API
+        # Log plane: recent worker log lines per node (bounded ring), fed
+        # by worker_logs notifies, served to `rt logs` + the dashboard.
+        self.log_buffer: Dict[str, deque] = {}
+        self._LOG_BUFFER_LINES = 10_000
         self.jobs: Dict[str, dict] = {}
         self._schedule_rr = 0  # round-robin cursor
         self._shutting_down = False
@@ -473,6 +477,21 @@ class HeadService:
         )
         log("node %s dead: %s", node_id[:8], reason)
         self.publish("nodes", {"event": "node_dead", "node_id": node_id})
+        # Log plane: keep a post-mortem tail for the dead node but shrink
+        # its ring (a full 10k-line deque per dead node would grow the head
+        # without bound under autoscaler churn), and cap how many dead-node
+        # tails are retained at all.
+        buf = self.log_buffer.get(node_id)
+        if buf is not None and len(buf) > 500:
+            self.log_buffer[node_id] = deque(
+                itertools.islice(buf, len(buf) - 500, None), maxlen=500
+            )
+        dead_with_logs = [
+            nid for nid in self.log_buffer
+            if nid not in self.nodes or not self.nodes[nid].alive
+        ]
+        for nid in dead_with_logs[: max(len(dead_with_logs) - 32, 0)]:
+            self.log_buffer.pop(nid, None)
         # Fail/restart actors that lived there.
         for actor in list(self.actors.values()):
             if actor.node_id == node_id and actor.state in ("ALIVE", "PENDING"):
@@ -1202,6 +1221,56 @@ class HeadService:
     async def rpc_publish(self, h, frames, conn):
         self.publish(h["channel"], h.get("data"), frames)
         return {}, []
+
+    # ---------------------------------------------------------- log plane
+
+    async def rpc_worker_logs(self, h, frames, conn):
+        """A worker's log monitor pushed new lines: buffer a bounded ring
+        per node for rt logs/dashboard, fan out live to subscribed
+        drivers (reference behavior: log_monitor publish + driver echo)."""
+        buf = self.log_buffer.get(h["node_id"])
+        if buf is None:
+            buf = self.log_buffer[h["node_id"]] = deque(
+                maxlen=self._LOG_BUFFER_LINES
+            )
+        pid, stream = h.get("pid"), h.get("stream", "stdout")
+        for line in h.get("lines", ()):
+            buf.append((stream, pid, line))
+        # "shared": the worker's spawn job is not any registered driver job
+        # (rt start / autoscaler workers get a random JobID) — such lines
+        # belong to no one driver, so every driver may echo them. Without
+        # this, shared-cluster topologies would never see remote prints.
+        job = h.get("job_id", "")
+        self.publish("worker_logs", {
+            "node_id": h["node_id"], "pid": pid, "stream": stream,
+            "job_id": job, "shared": job not in self.jobs,
+            "lines": h.get("lines", []),
+        })
+        return {}, []
+
+    async def rpc_get_logs(self, h, frames, conn):
+        """Read back buffered worker logs: optional node filter + tail
+        count (rt logs / dashboard logs view)."""
+        node = h.get("node_id")
+        try:
+            tail = max(int(h.get("tail") or 1000), 0)
+        except (TypeError, ValueError):
+            tail = 1000
+        out = []
+        items = (
+            [(node, self.log_buffer.get(node))] if node
+            else list(self.log_buffer.items())
+        )
+        for nid, buf in items:
+            if not buf:
+                continue
+            # islice, not list(buf)[-tail:]: the dashboard polls this every
+            # 2s and a full 10k-entry copy per node per poll is pure churn.
+            start = max(len(buf) - tail, 0)
+            for stream, pid, line in itertools.islice(buf, start, None):
+                out.append({"node_id": nid, "pid": pid, "stream": stream,
+                            "line": line})
+        return {"lines": out[-tail:] if tail else []}, []
 
     def publish(self, channel: str, data, frames: List[bytes] = ()):
         for conn in list(self.subscribers.get(channel, [])):

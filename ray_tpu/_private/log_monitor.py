@@ -1,0 +1,148 @@
+"""Worker log capture + streaming (the cluster's log plane).
+
+Reference behavior reproduced (not code): ``python/ray/_private/
+log_monitor.py`` tails each worker's redirected stdout/stderr files and
+publishes new lines over GCS pubsub; ``python/ray/_private/worker.py:2285
+print_worker_logs`` echoes them on the driver prefixed with
+``(name pid=..., node=...)``. TPU-era shape: the process-per-host worker
+redirects its OWN fds 1/2 into session-dir files (C-level writes from
+native/XLA code land there too) and a daemon thread tails those files,
+pushing deltas to the head over the existing RPC connection — no separate
+monitor process per node.
+
+Files live in ``{session_dir}/logs/worker-{node8}.{out,err}`` and survive
+the worker, so ``rt logs`` and the dashboard can read history through the
+head while the driver stream shows lines live.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import List, Optional, Tuple
+
+# One publish is capped so a runaway print loop cannot wedge the head
+# connection with multi-MB notifies; the tail just catches up next poll.
+MAX_LINES_PER_PUBLISH = 200
+MAX_LINE_LEN = 4096
+POLL_S = 0.2
+
+
+def session_log_dir(session_dir: str) -> str:
+    d = os.path.join(session_dir, "logs")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def redirect_stdio(session_dir: str, node_id: str) -> Tuple[str, str]:
+    """Point fds 1/2 at per-worker session-dir files (dup2, so writes from
+    C/native code are captured too — a Python-level sys.stdout swap would
+    miss them). Returns the two paths. Line-buffered via O_APPEND +
+    unbuffered fds; Python-side print() still buffers per line because
+    sys.stdout is re-opened in line-buffered text mode."""
+    import sys
+
+    d = session_log_dir(session_dir)
+    out_path = os.path.join(d, f"worker-{node_id[:8]}.out")
+    err_path = os.path.join(d, f"worker-{node_id[:8]}.err")
+    out_fd = os.open(out_path, os.O_CREAT | os.O_WRONLY | os.O_APPEND, 0o644)
+    err_fd = os.open(err_path, os.O_CREAT | os.O_WRONLY | os.O_APPEND, 0o644)
+    os.dup2(out_fd, 1)
+    os.dup2(err_fd, 2)
+    os.close(out_fd)
+    os.close(err_fd)
+    sys.stdout = os.fdopen(1, "w", buffering=1, errors="replace")
+    sys.stderr = os.fdopen(2, "w", buffering=1, errors="replace")
+    return out_path, err_path
+
+
+class LogMonitor:
+    """Daemon thread tailing this worker's redirected log files and
+    publishing new complete lines to the head ("worker_logs" notifies).
+    The head buffers them for ``rt logs``/dashboard and fans them out to
+    subscribed drivers for the prefixed live echo."""
+
+    def __init__(self, worker, paths: List[Tuple[str, str]]):
+        # paths: [(stream_name, file_path)]
+        self.worker = worker
+        self.paths = [(s, p, [0]) for s, p in paths]  # [offset] is mutable
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="rt-logmon"
+        )
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+
+    def _run(self):
+        while not self._stop.wait(POLL_S):
+            for stream, path, off in self.paths:
+                try:
+                    self._poll_one(stream, path, off)
+                except Exception:
+                    pass  # the log plane must never kill a worker
+
+    def _poll_one(self, stream: str, path: str, off: List[int]):
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return
+        if size <= off[0]:
+            if size < off[0]:
+                off[0] = 0  # truncated/rotated: restart from the top
+            return
+        with open(path, "rb") as f:
+            f.seek(off[0])
+            chunk = f.read(1 << 20)
+        # publish only COMPLETE lines; the partial tail stays for next poll
+        nl = chunk.rfind(b"\n")
+        if nl < 0:
+            if len(chunk) >= MAX_LINE_LEN:  # unterminated runaway line
+                nl = len(chunk) - 1
+            else:
+                return
+        raw_lines = chunk[: nl + 1].splitlines(keepends=True)
+        w = self.worker
+        for i in range(0, len(raw_lines), MAX_LINES_PER_PUBLISH):
+            batch_raw = raw_lines[i : i + MAX_LINES_PER_PUBLISH]
+            batch = [
+                ln.rstrip(b"\r\n").decode("utf-8", "replace")[:MAX_LINE_LEN]
+                for ln in batch_raw
+            ]
+            try:
+                w.gcs.notify(
+                    "worker_logs",
+                    {
+                        "node_id": w.node_id,
+                        "pid": os.getpid(),
+                        "job_id": w.job_id.hex() if w.job_id else "",
+                        "stream": stream,
+                        "lines": batch,
+                    },
+                )
+            except Exception:
+                # Head gone (restart / reconnect window): the offset only
+                # moved past PUBLISHED batches, so these lines are re-read
+                # and re-published once the connection is back.
+                return
+            off[0] += sum(len(ln) for ln in batch_raw)
+
+
+def print_worker_logs(data: dict, file=None) -> None:
+    """Driver-side echo of a worker_logs pubsub message, prefixed the way
+    the reference prints remote output: ``(worker pid=..., node=...)``."""
+    import sys
+
+    out = file or (
+        sys.stderr if data.get("stream") == "stderr" else sys.stdout
+    )
+    prefix = f"(worker pid={data.get('pid')}, node={str(data.get('node_id'))[:8]})"
+    try:
+        for line in data.get("lines", ()):
+            print(f"{prefix} {line}", file=out)
+        out.flush()
+    except Exception:
+        pass

@@ -64,6 +64,13 @@ def spawn_node(
     child_env = dict(os.environ)
     if env:
         child_env.update(env)
+    # Log plane: every spawned worker gets a session dir to redirect its
+    # stdio into (worker_main + log_monitor). init() passes a timestamped
+    # one; standalone spawns (rt start, autoscaler local provider) default
+    # to a per-head dir so all of a cluster's workers share one place.
+    child_env.setdefault(
+        "RT_SESSION_DIR", f"/tmp/ray_tpu/session_p{gcs_addr[1]}"
+    )
     # Node processes must not inherit a driver-held TPU.
     proc = subprocess.Popen(cmd, env=child_env)
     return NodeHandle(proc, node_id, resources)

@@ -493,6 +493,23 @@ class CoreWorker:
         self.pubsub_handlers.setdefault("lease_reclaim", []).append(
             lambda data, frames: self._reclaim_idle_leases()
         )
+        # Live worker-log echo (reference: print_worker_logs — remote task
+        # prints appear on the driver, prefixed with worker/node). Job-
+        # scoped: lines from other jobs' workers stay out of this driver's
+        # terminal. RT_LOG_TO_DRIVER=0 silences the echo (files + rt logs
+        # still capture everything).
+        if self.is_driver and os.environ.get("RT_LOG_TO_DRIVER", "1") != "0":
+            from ray_tpu._private.log_monitor import print_worker_logs
+
+            my_job = self.job_id.hex() if self.job_id else ""
+
+            def _echo(data, frames):
+                # Own-job lines, plus lines from shared workers (spawned
+                # outside any driver job — rt start / autoscaler nodes).
+                if data.get("shared") or data.get("job_id") in ("", my_job):
+                    print_worker_logs(data)
+
+            self.pubsub_handlers.setdefault("worker_logs", []).append(_echo)
         await self._connect_gcs()
         self.loop.create_task(self._task_event_flusher())
 
@@ -507,6 +524,8 @@ class CoreWorker:
         self.gcs.on_close = self._on_gcs_lost
         await self.gcs.call("subscribe", {"channel": "object_free"})
         await self.gcs.call("subscribe", {"channel": "lease_reclaim"})
+        if "worker_logs" in self.pubsub_handlers:
+            await self.gcs.call("subscribe", {"channel": "worker_logs"})
         # Cluster-wide config overrides (init(_system_config=...)) live in
         # the head KV; every process applies them at (re)connection —
         # the reference passes _system_config on raylet command lines.

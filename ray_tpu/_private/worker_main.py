@@ -98,6 +98,22 @@ def main(argv=None):
     # Workers default to CPU jax unless the node was explicitly given TPUs:
     # only one process may own the TPU chips.
     resources = json.loads(args.resources)
+    # Log plane: point fds 1/2 at session-dir files BEFORE anything prints
+    # (reference behavior: workers write redirected log files that a
+    # monitor tails — _private/log_monitor.py). Skipped when no session
+    # dir rides the env (standalone/manual runs keep inherited stdio).
+    log_paths = []
+    session_dir = os.environ.get("RT_SESSION_DIR")
+    if session_dir:
+        from ray_tpu._private import log_monitor
+
+        try:
+            out_p, err_p = log_monitor.redirect_stdio(
+                session_dir, args.node_id or str(os.getpid())
+            )
+            log_paths = [("stdout", out_p), ("stderr", err_p)]
+        except OSError:
+            pass  # unwritable session dir: keep inherited stdio
     if resources.get("TPU", 0) <= 0:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
     # The env var alone is not enough: site hooks (e.g. a PJRT plugin
@@ -128,6 +144,11 @@ def main(argv=None):
     core.loop = loop
     loop.run_until_complete(core._async_setup())
     core._install_ref_hooks()
+    if log_paths:
+        from ray_tpu._private import log_monitor
+
+        monitor = log_monitor.LogMonitor(core, log_paths)
+        monitor.start()
 
     def handle_term(*_):
         loop.stop()

@@ -180,6 +180,19 @@ def cmd_summary(args):
         print(json.dumps({"nodes": len(nodes)}, indent=2))
 
 
+def cmd_logs(args):
+    """Tail buffered worker logs from the head (reference: ``ray logs``)."""
+    from ray_tpu.util import state
+
+    lines = state.list_logs(
+        _resolve_address(args), node_id=args.node_id, tail=args.tail
+    )
+    for rec in lines:
+        prefix = f"(worker pid={rec.get('pid')}, node={rec['node_id'][:8]})"
+        stream = sys.stderr if rec.get("stream") == "stderr" else sys.stdout
+        print(f"{prefix} {rec['line']}", file=stream)
+
+
 def cmd_stack(args):
     """Per-node all-thread stack dumps (reference: ``ray stack``)."""
     from ray_tpu.util.debug import get_cluster_stacks
@@ -270,6 +283,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("what", choices=["tasks", "actors", "nodes"])
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser("logs", help="tail buffered worker logs")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--node-id", default=None)
+    sp.add_argument("--tail", type=int, default=1000)
+    sp.set_defaults(fn=cmd_logs)
 
     sp = sub.add_parser("stack", help="all-thread stack dump of every node")
     sp.add_argument("--address", default=None)

@@ -39,6 +39,7 @@ class DashboardApp:
         r.add_get("/api/tasks", self._tasks)
         r.add_get("/api/cluster_status", self._cluster_status)
         r.add_get("/api/stacks", self._stacks)
+        r.add_get("/api/logs", self._logs)
         r.add_get("/metrics", self._metrics)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
@@ -128,6 +129,18 @@ class DashboardApp:
 
         sid = request.match_info["submission_id"]
         h, _ = await self._head("stop_job", {"submission_id": sid})
+        return web.json_response(h)
+
+    async def _logs(self, request):
+        from aiohttp import web
+
+        try:
+            tail = max(int(request.query.get("tail", "1000")), 0)
+        except ValueError:
+            tail = 1000
+        h, _ = await self._head("get_logs", {
+            "node_id": request.query.get("node_id"), "tail": tail,
+        })
         return web.json_response(h)
 
     async def _tasks(self, request):

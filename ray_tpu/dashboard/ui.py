@@ -47,6 +47,7 @@ INDEX_HTML = """<!doctype html>
   <section><h2>Placement groups</h2><div id="pgs"></div></section>
   <section><h2>Jobs</h2><div id="jobs"></div></section>
   <section><h2>Tasks (recent)</h2><div id="tasks"></div></section>
+  <section><h2>Worker logs (recent)</h2><div id="logs"></div></section>
 </main>
 <footer>auto-refreshes every 2s · JSON API under /api/*</footer>
 <script>
@@ -73,9 +74,9 @@ function util(res, avail) {
 async function j(url) { const r = await fetch(url); return r.json(); }
 async function refresh() {
   try {
-    const [nodes, actors, pgs, jobs, tasks] = await Promise.all([
+    const [nodes, actors, pgs, jobs, tasks, logs] = await Promise.all([
       j("/api/nodes"), j("/api/actors"), j("/api/placement_groups"),
-      j("/api/jobs"), j("/api/tasks")]);
+      j("/api/jobs"), j("/api/tasks"), j("/api/logs?tail=100")]);
     const ns = nodes.nodes || [];
     $("meta").textContent =
       `${ns.filter(n => n.alive).length} alive node(s), ` +
@@ -116,6 +117,12 @@ async function refresh() {
         : (t.state === "FAILED" ? '<span class=bad>FAILED</span>'
                                 : esc(t.state))],
       ["node", t => esc((t.node_id || "").slice(0, 10))]]);
+    const ls = (logs.lines || []).slice(-40);
+    $("logs").innerHTML = ls.length
+      ? "<pre>" + ls.map(l =>
+          `(pid=${esc(l.pid)}, node=${esc((l.node_id || "").slice(0, 8))}` +
+          `, ${esc(l.stream)}) ${esc(l.line)}`).join("\n") + "</pre>"
+      : "<i>none</i>";
   } catch (e) {
     $("meta").textContent = "refresh failed: " + e;
   }

@@ -67,6 +67,15 @@ def list_objects(address: Optional[str] = None, filters=None,
     return _apply_filters(rows, filters)[:limit]
 
 
+def list_logs(address: Optional[str] = None, node_id: Optional[str] = None,
+              tail: int = 1000) -> List[dict]:
+    """Buffered worker log lines from the head's log plane (reference:
+    ``ray logs`` / dashboard log view; fed by _private/log_monitor.py)."""
+    return _call(
+        "get_logs", {"node_id": node_id, "tail": tail}, address
+    )["lines"]
+
+
 def list_tasks(address: Optional[str] = None, filters=None,
                limit: int = 1000) -> List[dict]:
     rows = _call("list_task_events", {"limit": limit}, address)["events"]

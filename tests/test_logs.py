@@ -1,0 +1,94 @@
+"""Worker log plane: capture, head buffering, driver echo, state API.
+
+Reference behavior (not code): ``python/ray/_private/log_monitor.py``
+(tail redirected worker files, publish over pubsub) and
+``python/ray/_private/worker.py`` print_worker_logs (prefixed driver
+echo). Here the worker self-tails (process-per-host) — see
+``ray_tpu/_private/log_monitor.py``.
+"""
+import os
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import state
+
+
+@pytest.fixture()
+def rt_logs():
+    ray_tpu.init(num_cpus=2, num_nodes=1)
+    yield
+    ray_tpu.shutdown()
+
+
+def _wait_for(pred, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(0.2)
+    return None
+
+
+def test_task_print_reaches_driver_and_head(rt_logs, capfd):
+    marker = f"log-marker-{os.getpid()}"
+
+    @ray_tpu.remote
+    def shout():
+        print(marker, flush=True)
+        print(f"{marker}-err", file=sys.stderr, flush=True)
+        return 1
+
+    assert ray_tpu.get(shout.remote(), timeout=30) == 1
+
+    # Head buffer: the worker's monitor tails its redirected files and
+    # publishes; rt logs / dashboard read this back.
+    def head_has():
+        lines = state.list_logs(tail=5000)
+        got = {(r["stream"]) for r in lines if marker in r["line"]}
+        return got if {"stdout", "stderr"} <= got else None
+
+    assert _wait_for(head_has), "marker lines never reached the head buffer"
+
+    # Driver echo: the subscribed driver prints the line prefixed with
+    # (worker pid=..., node=...).
+    def echoed():
+        out = capfd.readouterr()
+        echoed.buf += out.out + out.err
+        return marker in echoed.buf and "(worker pid=" in echoed.buf
+    echoed.buf = ""
+    assert _wait_for(echoed), "driver never echoed the worker print"
+
+
+def test_log_files_exist_in_session_dir(rt_logs):
+    @ray_tpu.remote
+    def hello():
+        print("file-marker-xyz", flush=True)
+        return None
+
+    ray_tpu.get(hello.remote(), timeout=30)
+    from ray_tpu._private import worker as worker_mod
+
+    # The session dir rode RT_SESSION_DIR to the spawned node.
+    sessions = sorted(
+        p for p in os.listdir("/tmp/ray_tpu")
+        if p.startswith("session_")
+    )
+    assert sessions
+
+    def file_has():
+        for s in sessions[::-1]:
+            d = os.path.join("/tmp/ray_tpu", s, "logs")
+            if not os.path.isdir(d):
+                continue
+            for f in os.listdir(d):
+                if f.endswith(".out"):
+                    with open(os.path.join(d, f)) as fh:
+                        if "file-marker-xyz" in fh.read():
+                            return True
+        return False
+
+    assert _wait_for(file_has), "worker stdout file missing the print"
