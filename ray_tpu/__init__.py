@@ -70,6 +70,19 @@ def _prune_old_sessions(keep: int, active: str):
     rotation). Best-effort; never blocks startup."""
     import shutil
 
+    def liveness(d: str) -> float:
+        """Newest mtime across the session dir and its log files — a LIVE
+        cluster keeps appending, so its files stay recent even though the
+        dir's own mtime froze at creation."""
+        newest = os.path.getmtime(d)
+        logs = os.path.join(d, "logs")
+        try:
+            for f in os.listdir(logs):
+                newest = max(newest, os.path.getmtime(os.path.join(logs, f)))
+        except OSError:
+            pass
+        return newest
+
     try:
         root = "/tmp/ray_tpu"
         dirs = [
@@ -77,9 +90,11 @@ def _prune_old_sessions(keep: int, active: str):
             if d.startswith("session_")
         ]
         dirs = [d for d in dirs if os.path.abspath(d) != os.path.abspath(active)]
-        dirs.sort(key=lambda d: os.path.getmtime(d))
+        dirs.sort(key=liveness)
+        cutoff = time.time() - 3600
         for d in dirs[: max(len(dirs) - (keep - 1), 0)]:
-            shutil.rmtree(d, ignore_errors=True)
+            if liveness(d) < cutoff:  # never rmtree a live cluster's logs
+                shutil.rmtree(d, ignore_errors=True)
     except OSError:
         pass
 
@@ -195,7 +210,10 @@ def init(
             driver._install_ref_hooks()
             _worker_mod.global_worker = driver
             _head = head
-            _cluster = LocalCluster(head, driver.gcs_addr, job_id, driver)
+            _cluster = LocalCluster(
+                head, driver.gcs_addr, job_id, driver,
+                session_dir=session_dir,
+            )
             n_cpus = num_cpus if num_cpus is not None else (os.cpu_count() or 1)
             node_res = dict(resources or {})
             node_res["CPU"] = float(n_cpus)

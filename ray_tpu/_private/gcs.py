@@ -762,6 +762,10 @@ class HeadService:
         for fut in waiters:
             if not fut.done():
                 fut.set_result(None)
+        # Freed resources may satisfy a placement group whose creation RPC
+        # already returned PENDING; without this retry it would pend
+        # forever even on an empty cluster.
+        self._schedule_pending_pgs()
 
     # ----------------------------------------------------------------- actors
 
@@ -1126,14 +1130,9 @@ class HeadService:
         self.pgs[pg_id] = pg
         deadline = time.monotonic() + h.get("timeout", 30.0)
         while time.monotonic() < deadline:
-            placement = self._try_place_bundles(pg)
-            if placement is not None:
-                for i, node in enumerate(placement):
-                    self._node_acquire(node, bundles[i])
-                    pg.bundle_nodes[i] = node.node_id
-                self.pg_reserved[pg_id] = [dict(b) for b in bundles]
-                pg.state = "CREATED"
-                self.publish(f"pg:{pg_id}", pg.to_public())
+            if pg.state == "REMOVED":  # removed while we waited
+                return {"state": "REMOVED"}, []
+            if self._commit_pg(pg):
                 return {"state": "CREATED", "bundle_nodes": pg.bundle_nodes}, []
             # Same demand-driven reclaim as rpc_lease: idle cached slots on
             # workers are the usual reason an otherwise-free cluster can't
@@ -1145,7 +1144,33 @@ class HeadService:
                 await asyncio.wait_for(fut, timeout=1.0)
             except asyncio.TimeoutError:
                 pass
+        # The group STAYS registered as PENDING: whenever resources free
+        # (_wake_waiters), the head retries it — the reference reschedules
+        # pending placement groups the same way
+        # (gcs_placement_group_manager SchedulePendingPlacementGroups);
+        # clients poll get_pg and observe the late CREATED.
         return {"state": "PENDING"}, []
+
+    def _commit_pg(self, pg) -> bool:
+        """All-or-nothing bundle commit; publishes + flips state on
+        success. Shared by the creation RPC and the pending-PG retry."""
+        if pg.state == "CREATED":
+            return True
+        placement = self._try_place_bundles(pg)
+        if placement is None:
+            return False
+        for i, node in enumerate(placement):
+            self._node_acquire(node, pg.bundles[i])
+            pg.bundle_nodes[i] = node.node_id
+        self.pg_reserved[pg.pg_id] = [dict(b) for b in pg.bundles]
+        pg.state = "CREATED"
+        self.publish(f"pg:{pg.pg_id}", pg.to_public())
+        return True
+
+    def _schedule_pending_pgs(self):
+        for pg in list(self.pgs.values()):
+            if pg.state == "PENDING":
+                self._commit_pg(pg)
 
     def _try_place_bundles(self, pg) -> Optional[List[NodeInfo]]:
         # Work on a scratch copy of availability so it's all-or-nothing.
@@ -1253,7 +1278,8 @@ class HeadService:
         count (rt logs / dashboard logs view)."""
         node = h.get("node_id")
         try:
-            tail = max(int(h.get("tail") or 1000), 0)
+            tail = int(h["tail"]) if h.get("tail") is not None else 1000
+            tail = max(tail, 0)
         except (TypeError, ValueError):
             tail = 1000
         out = []
@@ -1261,16 +1287,18 @@ class HeadService:
             [(node, self.log_buffer.get(node))] if node
             else list(self.log_buffer.items())
         )
+        items = [(nid, buf) for nid, buf in items if buf]
+        # The budget is split ACROSS nodes (lines carry no global order, so
+        # a concat-then-truncate would silently drop whole earlier nodes).
+        share = max(tail // max(len(items), 1), 1) if tail else 0
         for nid, buf in items:
-            if not buf:
-                continue
-            # islice, not list(buf)[-tail:]: the dashboard polls this every
+            # islice, not list(buf)[-n:]: the dashboard polls this every
             # 2s and a full 10k-entry copy per node per poll is pure churn.
-            start = max(len(buf) - tail, 0)
+            start = max(len(buf) - share, 0)
             for stream, pid, line in itertools.islice(buf, start, None):
                 out.append({"node_id": nid, "pid": pid, "stream": stream,
                             "line": line})
-        return {"lines": out[-tail:] if tail else []}, []
+        return {"lines": out if tail else []}, []
 
     def publish(self, channel: str, data, frames: List[bytes] = ()):
         for conn in list(self.subscribers.get(channel, [])):

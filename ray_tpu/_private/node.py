@@ -81,11 +81,13 @@ class LocalCluster:
     ``python/ray/cluster_utils.py:137 Cluster`` — multi-node simulated by
     multiple node processes on one machine)."""
 
-    def __init__(self, head_service, gcs_addr, job_id: JobID, driver_worker):
+    def __init__(self, head_service, gcs_addr, job_id: JobID, driver_worker,
+                 session_dir: Optional[str] = None):
         self.head = head_service
         self.gcs_addr = gcs_addr
         self.job_id = job_id
         self.driver = driver_worker
+        self.session_dir = session_dir
         self.nodes: List[NodeHandle] = []
         atexit.register(self.shutdown)
 
@@ -98,6 +100,11 @@ class LocalCluster:
     ) -> NodeHandle:
         resources = dict(resources or {"CPU": 1})
         resources.setdefault("CPU", 1)
+        # Added nodes log into the SAME session dir as init-spawned ones —
+        # a cluster's log files must not split across two dirs.
+        if self.session_dir:
+            env = dict(env or {})
+            env.setdefault("RT_SESSION_DIR", self.session_dir)
         handle = spawn_node(self.gcs_addr, self.job_id, resources, labels, env)
         self.nodes.append(handle)
         if wait:

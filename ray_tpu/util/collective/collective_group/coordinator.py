@@ -102,7 +102,11 @@ def get_or_create_coordinator(group_name: str, world_size: int, rank: int,
     import ray_tpu
 
     name = f"__collective_coordinator:{group_name}"
-    actor_cls = ray_tpu.remote(max_concurrency=max(world_size * 2, 8))(
-        CollectiveCoordinator
-    )
+    # num_cpus=0: pure rendezvous/IO, no compute — it must never consume a
+    # CPU slot a group member needs (observed: the coordinator landing on
+    # the one node that advertised a member's custom resource made that
+    # member forever unschedulable).
+    actor_cls = ray_tpu.remote(
+        num_cpus=0, max_concurrency=max(world_size * 2, 8)
+    )(CollectiveCoordinator)
     return actor_cls.options(name=name, get_if_exists=True).remote(world_size)

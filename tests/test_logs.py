@@ -70,25 +70,21 @@ def test_log_files_exist_in_session_dir(rt_logs):
         return None
 
     ray_tpu.get(hello.remote(), timeout=30)
-    from ray_tpu._private import worker as worker_mod
 
-    # The session dir rode RT_SESSION_DIR to the spawned node.
-    sessions = sorted(
-        p for p in os.listdir("/tmp/ray_tpu")
-        if p.startswith("session_")
-    )
-    assert sessions
+    # Scan only THIS cluster's session dir — a stale marker left by an
+    # earlier run must not mask a broken redirect.
+    session = ray_tpu._internal_cluster().session_dir
+    assert session, "LocalCluster lost its session dir"
 
     def file_has():
-        for s in sessions[::-1]:
-            d = os.path.join("/tmp/ray_tpu", s, "logs")
-            if not os.path.isdir(d):
-                continue
-            for f in os.listdir(d):
-                if f.endswith(".out"):
-                    with open(os.path.join(d, f)) as fh:
-                        if "file-marker-xyz" in fh.read():
-                            return True
+        d = os.path.join(session, "logs")
+        if not os.path.isdir(d):
+            return False
+        for f in os.listdir(d):
+            if f.endswith(".out"):
+                with open(os.path.join(d, f)) as fh:
+                    if "file-marker-xyz" in fh.read():
+                        return True
         return False
 
     assert _wait_for(file_has), "worker stdout file missing the print"

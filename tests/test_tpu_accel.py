@@ -103,6 +103,32 @@ def test_slice_placement_group_reserves_hosts():
         ray_tpu.shutdown()
 
 
+def test_slice_placement_group_never_split():
+    """Slice atomicity: while one SlicePlacementGroup holds a slice, a
+    second group can neither take the slice's head token nor poach its
+    non-head hosts — it stays pending until the first group releases
+    (reference behavior: ``util/tpu.py`` head-resource reservation)."""
+    from ray_tpu.util.placement_group import remove_placement_group
+
+    ray_tpu.init(num_cpus=2)
+    try:
+        cluster = ray_tpu._internal_cluster()
+        cluster.add_node({"CPU": 1, "TPU": 8, "TPU-v5e-16-head": 1})
+        cluster.add_node({"CPU": 1, "TPU": 8})
+        cluster.wait_for_nodes(3)
+        spg1 = slice_placement_group("v5e-16")
+        assert spg1.ready(timeout=30)
+        # The whole slice (head token on host 0 + every host's chips) is
+        # reserved: a second slice group must not place anywhere.
+        spg2 = slice_placement_group("v5e-16", timeout=2)
+        assert not spg2.ready(timeout=3)
+        # Release slice 1 -> the pending group takes the whole slice.
+        remove_placement_group(spg1.placement_group)
+        assert spg2.ready(timeout=30)
+    finally:
+        ray_tpu.shutdown()
+
+
 def test_slice_placement_group_unsatisfiable():
     ray_tpu.init(num_cpus=2)
     try:
