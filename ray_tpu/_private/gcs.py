@@ -1290,15 +1290,25 @@ class HeadService:
         items = [(nid, buf) for nid, buf in items if buf]
         # The budget is split ACROSS nodes (lines carry no global order, so
         # a concat-then-truncate would silently drop whole earlier nodes).
-        share = max(tail // max(len(items), 1), 1) if tail else 0
-        for nid, buf in items:
+        # Fair allocation, quiet nodes' unused share flowing to busy ones:
+        # walk ascending by buffer size, each node taking at most an even
+        # split of what remains.
+        remaining = tail
+        left = len(items)
+        for nid, buf in sorted(items, key=lambda x: len(x[1])):
+            take = min(len(buf), remaining // left) if left else 0
+            left -= 1
+            remaining -= take
+            if take <= 0:
+                continue
             # islice, not list(buf)[-n:]: the dashboard polls this every
             # 2s and a full 10k-entry copy per node per poll is pure churn.
-            start = max(len(buf) - share, 0)
-            for stream, pid, line in itertools.islice(buf, start, None):
+            for stream, pid, line in itertools.islice(
+                buf, len(buf) - take, None
+            ):
                 out.append({"node_id": nid, "pid": pid, "stream": stream,
                             "line": line})
-        return {"lines": out if tail else []}, []
+        return {"lines": out}, []
 
     def publish(self, channel: str, data, frames: List[bytes] = ()):
         for conn in list(self.subscribers.get(channel, [])):

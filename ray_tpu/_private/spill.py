@@ -1,15 +1,21 @@
-"""Object spilling: sealed arena objects overflow to disk under memory
-pressure and are restored on demand.
+"""Object spilling: sealed arena objects overflow to external storage under
+memory pressure and are restored on demand.
 
 Reference behavior being reproduced (not copied):
 ``src/ray/raylet/local_object_manager.h:46`` — SpillObjects (:144) writes
 primary copies to external storage and frees the store memory;
-AsyncRestoreSpilledObject (:156) reads them back on demand. The reference
-runs spill IO in dedicated workers against pluggable storage
-(``python/ray/_private/external_storage.py``); here spilling is a library
-call made by the process that hits arena pressure — the arena's
-pin/seal/delete protocol (native/src/arena_store.cc) already makes
-concurrent spill vs. read crash-safe, so no broker process is needed.
+AsyncRestoreSpilledObject (:156) reads them back on demand;
+``python/ray/_private/external_storage.py`` — pluggable storage backends
+(filesystem and cloud URIs) behind one interface. Here the backend registry
+maps URI schemes to storage classes: ``file://`` (or a bare path) writes
+the frame format below to local disk, ``memory://`` is an in-process store
+for tests, and ``gs://``/``s3://`` route through fsspec when installed
+(loud ImportError otherwise — a TPU pod wants overflow in GCS buckets, not
+host disk). ``register_spill_storage`` lets deployments plug their own.
+
+IO runs on a small thread pool so a spill burst writes objects in parallel
+and an event-loop caller never blocks on a disk/bucket read (the worker
+routes restores through it).
 
 File format: little-endian u32 frame count, u32 lengths, then the frames
 back to back (no alignment: files are read sequentially, not mapped into
@@ -22,42 +28,53 @@ import os
 import struct
 import tempfile
 import threading
-from typing import List, Optional
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional, Tuple
 
 logger = logging.getLogger(__name__)
 
 _U32 = struct.Struct("<I")
 
 
-class SpillManager:
-    """Writes/reads spilled objects under one session-scoped directory.
+def _pack(frames: List) -> Tuple[bytes, int]:
+    total = 0
+    parts = [_U32.pack(len(frames))]
+    for fr in frames:
+        parts.append(_U32.pack(len(fr)))
+    for fr in frames:
+        parts.append(bytes(fr))
+        total += len(fr)
+    return b"".join(parts), total
 
-    Paths embed a random token so a crashed session's leftovers can never be
-    read by the next one (the directory is also session-named).
-    """
 
-    def __init__(self, root: Optional[str] = None, session: str = ""):
-        from ray_tpu._private.config import rt_config
+def _unpack(blob: bytes) -> List[bytes]:
+    (count,) = _U32.unpack_from(blob, 0)
+    pos = 4
+    lens = []
+    for _ in range(count):
+        lens.append(_U32.unpack_from(blob, pos)[0])
+        pos += 4
+    out = []
+    for n in lens:
+        out.append(blob[pos : pos + n])
+        pos += n
+    return out
 
-        env_root = rt_config.spill_dir or None
-        self.root = root or env_root or os.path.join(
-            tempfile.gettempdir(), f"rt_spill_{session or os.getpid()}"
-        )
-        # A user-supplied directory (env or arg) may be shared by other
-        # sessions (e.g. NFS): never rmtree it wholesale at teardown.
-        self._owns_root = root is None and env_root is None
-        self._lock = threading.Lock()
+
+class FileSpillStorage:
+    """Local-filesystem backend (``file://`` or a bare path). URIs are
+    plain paths so other processes on a shared filesystem can read them
+    directly."""
+
+    def __init__(self, root: str):
+        self.root = root
         self._made = False
 
-    def _ensure_dir(self):
+    def write(self, key: str, frames: List) -> Tuple[str, int]:
         if not self._made:
             os.makedirs(self.root, exist_ok=True)
             self._made = True
-
-    def spill(self, object_hex: str, frames: List) -> dict:
-        """Write frames to disk; returns the meta describing the copy."""
-        self._ensure_dir()
-        path = os.path.join(self.root, object_hex)
+        path = os.path.join(self.root, key)
         total = 0
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
@@ -68,34 +85,264 @@ class SpillManager:
                 f.write(fr)
                 total += len(fr)
         os.replace(tmp, path)  # atomic publish, mirroring the arena rename
-        return {"spill": path, "size": total}
+        return path, total
 
-    def read(self, meta: dict) -> Optional[List[bytes]]:
-        path = meta.get("spill")
-        if not path:
-            return None
+    def read(self, uri: str) -> Optional[List[bytes]]:
         try:
-            with open(path, "rb") as f:
+            with open(uri, "rb") as f:
                 (count,) = _U32.unpack(f.read(4))
                 lens = [_U32.unpack(f.read(4))[0] for _ in range(count)]
                 return [f.read(n) for n in lens]
         except (OSError, struct.error):
             return None
 
-    def delete(self, meta: dict):
-        path = meta.get("spill")
-        if path:
-            try:
-                os.unlink(path)
-            except OSError:
-                pass
+    def delete(self, uri: str):
+        try:
+            os.unlink(uri)
+        except OSError:
+            pass
 
     def cleanup(self):
-        if not self._owns_root:
-            return  # shared directory: other sessions' spills live here
-        try:
-            import shutil
+        import shutil
 
-            shutil.rmtree(self.root, ignore_errors=True)
+        shutil.rmtree(self.root, ignore_errors=True)
+
+
+class MemorySpillStorage:
+    """In-process dict store (``memory://``): the mocked remote backend for
+    tests — exercises the full scheme-routing/restore path without a real
+    bucket."""
+
+    _stores: Dict[str, Dict[str, bytes]] = {}
+    _lock = threading.Lock()
+
+    def __init__(self, root: str):
+        self.root = root.rstrip("/")
+        with self._lock:
+            self._store = self._stores.setdefault(self.root, {})
+
+    def write(self, key: str, frames: List) -> Tuple[str, int]:
+        blob, total = _pack(frames)
+        uri = f"{self.root}/{key}"
+        with self._lock:
+            self._store[uri] = blob
+        return uri, total
+
+    def read(self, uri: str) -> Optional[List[bytes]]:
+        with self._lock:
+            blob = self._store.get(uri)
+        return _unpack(blob) if blob is not None else None
+
+    def delete(self, uri: str):
+        with self._lock:
+            self._store.pop(uri, None)
+
+    def cleanup(self):
+        with self._lock:
+            self._store.clear()
+
+
+class FsspecSpillStorage:
+    """Cloud-bucket backend over fsspec (``gs://``, ``s3://``, ...).
+    Import-gated: the TPU image may not ship gcsfs/s3fs, and a spill
+    configured for a bucket must fail LOUDLY, not silently write to disk."""
+
+    def __init__(self, root: str):
+        try:
+            import fsspec
+        except ImportError as e:
+            raise ImportError(
+                f"spill_dir={root!r} needs the optional 'fsspec' (plus the "
+                f"scheme's driver, e.g. gcsfs for gs://); pip install it or "
+                f"point spill_dir at a local path"
+            ) from e
+        self.root = root.rstrip("/")
+        self._fs, _ = fsspec.core.url_to_fs(self.root)
+
+    def write(self, key: str, frames: List) -> Tuple[str, int]:
+        blob, total = _pack(frames)
+        uri = f"{self.root}/{key}"
+        with self._fs.open(uri, "wb") as f:
+            f.write(blob)
+        return uri, total
+
+    def read(self, uri: str) -> Optional[List[bytes]]:
+        try:
+            with self._fs.open(uri, "rb") as f:
+                return _unpack(f.read())
+        except Exception:
+            return None
+
+    def delete(self, uri: str):
+        try:
+            self._fs.rm(uri)
         except Exception:
             pass
+
+    def cleanup(self):
+        try:
+            self._fs.rm(self.root, recursive=True)
+        except Exception:
+            pass
+
+
+# scheme -> storage factory(root_uri). Deployments/tests may register more
+# (reference: external storage config by type).
+STORAGE_SCHEMES: Dict[str, Callable[[str], object]] = {
+    "file": lambda uri: FileSpillStorage(uri[len("file://"):] or "/"),
+    "memory": MemorySpillStorage,
+    "gs": FsspecSpillStorage,
+    "s3": FsspecSpillStorage,
+    "gcs": FsspecSpillStorage,
+}
+
+
+def register_spill_storage(scheme: str, factory: Callable[[str], object]):
+    STORAGE_SCHEMES[scheme] = factory
+
+
+def _storage_for(uri: str):
+    scheme = uri.split("://", 1)[0] if "://" in uri else ""
+    if not scheme:
+        return FileSpillStorage(uri)
+    factory = STORAGE_SCHEMES.get(scheme)
+    if factory is None:
+        raise ValueError(
+            f"no spill storage registered for scheme {scheme!r} "
+            f"(have: {sorted(STORAGE_SCHEMES)}); "
+            f"register_spill_storage() adds one"
+        )
+    return factory(uri)
+
+
+class SpillManager:
+    """Spill/restore against the configured storage backend, with a small
+    IO pool (writes in a pressure burst run in parallel; loop callers
+    restore without blocking) and running counters surfaced to the
+    metrics plane."""
+
+    _IO_THREADS = 4
+
+    def __init__(self, root: Optional[str] = None, session: str = ""):
+        from ray_tpu._private.config import rt_config
+
+        env_root = rt_config.spill_dir or None
+        target = root or env_root or os.path.join(
+            tempfile.gettempdir(), f"rt_spill_{session or os.getpid()}"
+        )
+        self.storage = _storage_for(target)
+        # Plain-path root kept for the file backend (back-compat paths);
+        # scheme backends expose their base uri here.
+        self.root = getattr(self.storage, "root", target)
+        # A user-supplied target (env or arg) may be shared by other
+        # sessions (e.g. NFS, a bucket): never wipe it wholesale.
+        self._owns_root = root is None and env_root is None
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._pool_lock = threading.Lock()
+        # Guarded: IO-pool threads update these concurrently, and a lost
+        # read-modify-write would permanently under-report the gauges.
+        self._stats_lock = threading.Lock()
+        self.stats = {
+            "spilled_objects": 0, "spilled_bytes": 0,
+            "restored_objects": 0, "restored_bytes": 0,
+        }
+
+    @property
+    def pool(self) -> ThreadPoolExecutor:
+        if self._pool is None:
+            with self._pool_lock:
+                if self._pool is None:
+                    self._pool = ThreadPoolExecutor(
+                        max_workers=self._IO_THREADS,
+                        thread_name_prefix="rt-spill",
+                    )
+        return self._pool
+
+    def key_uri(self, object_hex: str) -> str:
+        """The uri ``spill(object_hex, ...)`` would produce — for callers
+        that must delete a possibly-spilled object without holding its
+        meta."""
+        if isinstance(self.storage, FileSpillStorage):
+            return os.path.join(self.root, object_hex)
+        return f"{self.root}/{object_hex}"
+
+    def spill(self, object_hex: str, frames: List) -> dict:
+        """Write frames to the backend; returns the meta for the copy."""
+        uri, total = self.storage.write(object_hex, frames)
+        with self._stats_lock:
+            self.stats["spilled_objects"] += 1
+            self.stats["spilled_bytes"] += total
+        return {"spill": uri, "size": total}
+
+    def spill_many(self, items: List[Tuple[str, List]]) -> List[Optional[dict]]:
+        """Spill a batch in parallel on the IO pool (reference: SpillObjects
+        takes a batch; IO workers run the writes). Entry i is None when
+        that write failed."""
+        if not items:
+            return []
+        futs = [
+            self.pool.submit(self.spill, hex_, frames)
+            for hex_, frames in items
+        ]
+        out: List[Optional[dict]] = []
+        for hex_, fut in zip((h for h, _ in items), futs):
+            try:
+                out.append(fut.result())
+            except Exception:
+                logger.exception("spill of %s failed", hex_[:12])
+                out.append(None)
+        return out
+
+    def read(self, meta: dict) -> Optional[List[bytes]]:
+        uri = meta.get("spill")
+        if not uri:
+            return None
+        frames = _storage_for_uri(self.storage, uri).read(uri)
+        if frames is not None:
+            with self._stats_lock:
+                self.stats["restored_objects"] += 1
+                self.stats["restored_bytes"] += sum(len(f) for f in frames)
+        return frames
+
+    async def read_async(self, meta: dict, loop) -> Optional[List[bytes]]:
+        """Restore without blocking the caller's event loop (reference:
+        AsyncRestoreSpilledObject — restore is IO-worker work)."""
+        return await loop.run_in_executor(self.pool, self.read, meta)
+
+    def delete(self, meta: dict):
+        uri = meta.get("spill")
+        if uri:
+            _storage_for_uri(self.storage, uri).delete(uri)
+
+    def cleanup(self):
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+        if not self._owns_root:
+            return  # shared target: other sessions' spills live here
+        try:
+            self.storage.cleanup()
+        except Exception:
+            pass
+
+
+def _storage_for_uri(default_storage, uri: str):
+    """Route a READ/DELETE by the uri's own scheme: metas can arrive from
+    peers configured with a different backend (e.g. this node spills to
+    file://, a peer spilled to gs://)."""
+    scheme = uri.split("://", 1)[0] if "://" in uri else ""
+    default_scheme = ""
+    root = getattr(default_storage, "root", "")
+    if "://" in str(root):
+        default_scheme = str(root).split("://", 1)[0]
+    if scheme == default_scheme and (
+        not scheme or uri.startswith(str(root))
+    ):
+        return default_storage
+    if not scheme:
+        return default_storage if isinstance(
+            default_storage, FileSpillStorage
+        ) else FileSpillStorage(os.path.dirname(uri) or "/")
+    if scheme == "memory":
+        # must hit the SAME in-process store the writer used
+        return MemorySpillStorage(uri.rsplit("/", 1)[0])
+    return _storage_for(uri.rsplit("/", 1)[0])

@@ -364,26 +364,30 @@ class CoreWorker:
         arena = self._shm.arena if self._shm is not None else None
         if arena is None:
             return 0
-        freed = 0
-        regs = []
+        # Gather the batch first (oldest sealed objects up to `need`),
+        # then write it in PARALLEL on the spill IO pool (reference:
+        # SpillObjects batches; IO workers run the writes).
+        batch = []
+        batched = 0
         for hex_ in list(arena._created):  # insertion order = oldest first
-            if freed >= need:
+            if batched >= need:
                 break
             frames = arena.get_frames(hex_, {})
             if frames is None:
                 continue
-            try:
-                meta = self._shm.spill.spill(hex_, frames)
-            except OSError:
-                logger.exception("spill of %s failed; disk unavailable?",
-                                 hex_[:12])
-                break
-            finally:
-                del frames  # drop the read pin before delete
+            batch.append((hex_, frames))
+            batched += sum(len(f) for f in frames)
+        metas = self._shm.spill.spill_many(batch)
+        freed = 0
+        regs = []
+        for (hex_, _frames), meta in zip(batch, metas):
+            if meta is None:
+                continue  # write failed (storage unavailable); keep in arena
             arena.free(hex_)
             freed += meta["size"]
-            # "addr" routes readers that cannot open the path (other hosts)
-            # to this worker's RPC service, which serves the file's bytes.
+            # "addr" routes readers that cannot open the uri (other hosts,
+            # different backend) to this worker's RPC service, which
+            # serves the spilled bytes.
             meta = dict(
                 meta, node=self.node_id,
                 addr=list(self.addr) if self.addr else None,
@@ -391,6 +395,9 @@ class CoreWorker:
             if hex_ in self.memory_store:
                 self.memory_store[hex_] = ("shm", meta)
             regs.append((hex_, meta))
+        # Read pins ride the frame views inside `batch`; dropping it lets
+        # the finalizers release them so the freed blocks actually reclaim.
+        del batch
         if regs:
             def register():
                 for hex_, meta in regs:
@@ -1656,7 +1663,17 @@ class CoreWorker:
         if kind == "mem":
             return self.ctx.deserialize_frames(entry[1])
         if kind == "shm":
-            frames = self.shm.get_frames(hex_, entry[1])
+            meta = entry[1]
+            if isinstance(meta, dict) and "spill" in meta:
+                # Restore on the spill IO pool — a disk/bucket read must
+                # not block the event loop (reference:
+                # AsyncRestoreSpilledObject runs on IO workers).
+                raw = await self.shm.spill.read_async(meta, self.loop)
+                frames = (
+                    [memoryview(f) for f in raw] if raw is not None else None
+                )
+            else:
+                frames = self.shm.get_frames(hex_, meta)
             if frames is None:
                 # Our meta may be stale — e.g. another process spilled the
                 # object to disk under memory pressure. The head's directory
@@ -1670,7 +1687,18 @@ class CoreWorker:
                 if hh.get("found") and hh["meta"] != entry[1]:
                     entry = ("shm", hh["meta"])
                     self.memory_store[hex_] = entry
-                    frames = self.shm.get_frames(hex_, hh["meta"])
+                    nm = hh["meta"]
+                    if isinstance(nm, dict) and "spill" in nm:
+                        # Refreshed meta points at a spilled copy: restore
+                        # on the IO pool, same as the first attempt — a
+                        # bucket read must not block the event loop.
+                        raw = await self.shm.spill.read_async(nm, self.loop)
+                        frames = (
+                            [memoryview(f) for f in raw]
+                            if raw is not None else None
+                        )
+                    else:
+                        frames = self.shm.get_frames(hex_, nm)
             if frames is None:
                 # Not mappable here: bulk-fetch through the native transfer
                 # plane into a local segment (C++ end to end).
@@ -3087,8 +3115,16 @@ class CoreWorker:
             if now - last_metrics >= 2.0:
                 last_metrics = now
                 try:
-                    from ray_tpu.util.metrics import registry
+                    from ray_tpu.util.metrics import Gauge, registry
 
+                    if self._shm is not None:
+                        # Spill-plane counters ride the same pipeline
+                        # (reference: spill stats in the metrics agent).
+                        for k, v in self._shm.spill.stats.items():
+                            Gauge(
+                                f"spill_{k}",
+                                description="object spill counter",
+                            ).set(float(v))
                     snap = registry().snapshot()
                     if snap:
                         self.gcs.notify("metrics_push", {

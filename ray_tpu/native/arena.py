@@ -328,10 +328,9 @@ class HybridShmStore:
             self.arena.free(object_hex, meta)
             # The owner's meta can be stale (a sibling process spilled the
             # object after the owner cached the arena meta): also drop any
-            # disk copy, or frees leak spill files for the session's life.
-            self.spill.delete(
-                {"spill": os.path.join(self.spill.root, object_hex)}
-            )
+            # spilled copy, or frees leak spill objects for the session's
+            # life (key_uri: scheme-aware — file path or bucket uri).
+            self.spill.delete({"spill": self.spill.key_uri(object_hex)})
         if meta is None:
             self.fallback.free(object_hex)
 
