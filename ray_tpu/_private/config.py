@@ -124,6 +124,12 @@ rt_config.declare(
     "arena_bytes", int, 4 << 30,
     "Native shm arena capacity per session (plasma-equivalent store size).")
 rt_config.declare(
+    "oom_kill", bool, True,
+    "Kill subprocess-backed retriable tasks under memory pressure "
+    "(newest-first, grouped by owner) so the node survives a leaky "
+    "workload; the owner retries elsewhere. Admission rejection stays on "
+    "either way.")
+rt_config.declare(
     "gc_tuning", bool, True,
     "Tune CPython's cyclic GC at worker/driver startup: freeze the "
     "post-import heap and raise collection thresholds. Millions of live "

@@ -163,6 +163,9 @@ class EnvExecutor:
                 p for p in sys.path if "site-packages" in p
             )
         self._lock = threading.Lock()
+        # The task currently executing IN the child (set under _lock by
+        # run()); the pressure killer's victim population.
+        self.current_task: Optional[dict] = None
         self.proc = subprocess.Popen(
             argv or [python, "-u", "-c", _CHILD_SRC],
             stdin=subprocess.PIPE,
@@ -174,17 +177,23 @@ class EnvExecutor:
         return self.proc.poll() is None
 
     def run(self, fn, args, kwargs, env_vars: Optional[dict] = None,
-            cwd: Optional[str] = None) -> Tuple[bool, Any]:
+            cwd: Optional[str] = None,
+            task_info: Optional[dict] = None) -> Tuple[bool, Any]:
         """Returns (ok, result-or-(err_repr, traceback)). env_vars/cwd are
         applied PER CALL inside the child (executors are cached per venv, so
         per-task env differences must not bake into the process). Raises
         RuntimeError if the child died (caller treats as task failure and
-        discards the executor)."""
+        discards the executor). ``task_info`` is published as
+        ``self.current_task`` ONLY while this call holds the child (inside
+        the lock): the pressure killer must see the task actually running
+        in the subprocess, not one queued behind it."""
         import cloudpickle
 
         blob = cloudpickle.dumps((fn, args, kwargs, env_vars, cwd))
         with self._lock:
+            self.current_task = task_info
             if not self.alive():
+                self.current_task = None
                 raise RuntimeError("runtime-env executor process died")
             try:
                 self.proc.stdin.write(_U32.pack(len(blob)))
@@ -206,6 +215,8 @@ class EnvExecutor:
                     data += chunk
             except (BrokenPipeError, OSError) as e:
                 raise RuntimeError(f"runtime-env executor pipe: {e}")
+            finally:
+                self.current_task = None
         return cloudpickle.loads(data)
 
     def close(self):

@@ -36,15 +36,18 @@ logger = logging.getLogger(__name__)
 _U32 = struct.Struct("<I")
 
 
-def _pack(frames: List) -> Tuple[bytes, int]:
+def _pack_parts(frames: List) -> Tuple[List, int]:
+    """Wire parts for one spilled object, WITHOUT copying frame payloads
+    (join/write accept buffer views directly): under memory pressure an
+    extra full copy per object is exactly what a spill must not make."""
     total = 0
-    parts = [_U32.pack(len(frames))]
+    parts: List = [_U32.pack(len(frames))]
     for fr in frames:
         parts.append(_U32.pack(len(fr)))
     for fr in frames:
-        parts.append(bytes(fr))
+        parts.append(fr)
         total += len(fr)
-    return b"".join(parts), total
+    return parts, total
 
 
 def _unpack(blob: bytes) -> List[bytes]:
@@ -122,8 +125,9 @@ class MemorySpillStorage:
             self._store = self._stores.setdefault(self.root, {})
 
     def write(self, key: str, frames: List) -> Tuple[str, int]:
-        blob, total = _pack(frames)
+        parts, total = _pack_parts(frames)
         uri = f"{self.root}/{key}"
+        blob = b"".join(parts)  # the one unavoidable copy: the store IS ram
         with self._lock:
             self._store[uri] = blob
         return uri, total
@@ -160,10 +164,11 @@ class FsspecSpillStorage:
         self._fs, _ = fsspec.core.url_to_fs(self.root)
 
     def write(self, key: str, frames: List) -> Tuple[str, int]:
-        blob, total = _pack(frames)
+        parts, total = _pack_parts(frames)
         uri = f"{self.root}/{key}"
         with self._fs.open(uri, "wb") as f:
-            f.write(blob)
+            for p in parts:  # stream: no full-object in-RAM copy
+                f.write(p)
         return uri, total
 
     def read(self, uri: str) -> Optional[List[bytes]]:

@@ -519,6 +519,14 @@ class CoreWorker:
             self.pubsub_handlers.setdefault("worker_logs", []).append(_echo)
         await self._connect_gcs()
         self.loop.create_task(self._task_event_flusher())
+        if not self.is_driver:
+            from ray_tpu._private.config import rt_config
+
+            if rt_config.oom_kill:
+                threading.Thread(
+                    target=self._pressure_killer_loop, daemon=True,
+                    name="rt-oomkill",
+                ).start()
 
     async def _connect_gcs(self):
         """Connect + subscribe + (re-)register with the head. Shared by
@@ -1663,17 +1671,7 @@ class CoreWorker:
         if kind == "mem":
             return self.ctx.deserialize_frames(entry[1])
         if kind == "shm":
-            meta = entry[1]
-            if isinstance(meta, dict) and "spill" in meta:
-                # Restore on the spill IO pool — a disk/bucket read must
-                # not block the event loop (reference:
-                # AsyncRestoreSpilledObject runs on IO workers).
-                raw = await self.shm.spill.read_async(meta, self.loop)
-                frames = (
-                    [memoryview(f) for f in raw] if raw is not None else None
-                )
-            else:
-                frames = self.shm.get_frames(hex_, meta)
+            frames = await self._frames_for_meta(hex_, entry[1])
             if frames is None:
                 # Our meta may be stale — e.g. another process spilled the
                 # object to disk under memory pressure. The head's directory
@@ -1687,18 +1685,7 @@ class CoreWorker:
                 if hh.get("found") and hh["meta"] != entry[1]:
                     entry = ("shm", hh["meta"])
                     self.memory_store[hex_] = entry
-                    nm = hh["meta"]
-                    if isinstance(nm, dict) and "spill" in nm:
-                        # Refreshed meta points at a spilled copy: restore
-                        # on the IO pool, same as the first attempt — a
-                        # bucket read must not block the event loop.
-                        raw = await self.shm.spill.read_async(nm, self.loop)
-                        frames = (
-                            [memoryview(f) for f in raw]
-                            if raw is not None else None
-                        )
-                    else:
-                        frames = self.shm.get_frames(hex_, nm)
+                    frames = await self._frames_for_meta(hex_, hh["meta"])
             if frames is None:
                 # Not mappable here: bulk-fetch through the native transfer
                 # plane into a local segment (C++ end to end).
@@ -1727,6 +1714,16 @@ class CoreWorker:
                 return exc.ObjectLostError(hex_, "shm segment missing")
             return self.ctx.deserialize_frames(frames)
         return exc.ObjectLostError(hex_, f"bad store entry {kind}")
+
+    async def _frames_for_meta(self, hex_: str, meta):
+        """Loop-side frame resolution for one shm/spill meta. Spilled
+        copies restore on the spill IO pool — a disk/bucket read must not
+        block the event loop (reference: AsyncRestoreSpilledObject runs on
+        IO workers); arena reads are sub-ms native calls and stay sync."""
+        if isinstance(meta, dict) and "spill" in meta:
+            raw = await self.shm.spill.read_async(meta, self.loop)
+            return [memoryview(f) for f in raw] if raw is not None else None
+        return self.shm.get_frames(hex_, meta)
 
     def _with_xfer(self, meta: dict) -> dict:
         """Stamp shm metadata with this worker's transfer-server address so
@@ -1983,6 +1980,9 @@ class CoreWorker:
             "owner": list(self.addr),
             "name": name or getattr(fn, "__name__", "task"),
             "renv": self._prepare_runtime_env(runtime_env),
+            # executing side reads this for kill policy (a pressure kill
+            # must prefer tasks the owner will actually retry)
+            "retries": max_retries,
         }
         from ray_tpu.util.tracing import tracing_helper
 
@@ -2920,12 +2920,57 @@ class CoreWorker:
             args.append(fetched[idx] if kind == "ref" else plain[idx])
         return args, kwargs
 
-    def _run_in_env(self, renv: dict, fn, args, kwargs):
+    def _pressure_killer_loop(self):
+        """Pressure-based task killing (reference behavior:
+        ``src/ray/raylet/worker_killing_policy_group_by_owner.h`` driven by
+        the memory monitor): while the node is over its memory threshold,
+        pick the owner with the most running killable tasks, kill that
+        group's NEWEST task (least progress lost), and let the owner's
+        retry land elsewhere via the code="oom" node-avoid path. Killable
+        = subprocess-backed (runtime-env executor) tasks — killing the
+        child actually returns its memory; in-process thread tasks cannot
+        be killed and stay guarded by admission rejection + spilling."""
+        while not self._shutdown:
+            time.sleep(1.0)
+            try:
+                if not self._memory_monitor.is_pressing():
+                    continue
+                # Victims = tasks ACTUALLY executing inside an env child
+                # right now (ex.current_task, set under the executor's
+                # lock), and only RETRIABLE ones — killing a max_retries=0
+                # task trades a survivable pressure spike for a permanent
+                # user-visible failure.
+                groups: Dict[tuple, list] = {}
+                with self._env_exec_lock:
+                    for ex in self._env_executors.values():
+                        rec = ex.current_task
+                        if rec and rec.get("retriable"):
+                            groups.setdefault(rec["owner"], []).append(
+                                (rec, ex)
+                            )
+                if not groups:
+                    continue
+                _owner, recs = max(groups.items(), key=lambda kv: len(kv[1]))
+                victim, ex = max(recs, key=lambda r: r[0]["started"])
+                ex.pressure_killed = True
+                logger.warning(
+                    "memory pressure (%s): killing task %s of owner %s "
+                    "(retriable; owner will resubmit elsewhere)",
+                    self._memory_monitor.usage_string(),
+                    victim["tid"][:12], victim["owner"],
+                )
+                ex.close()
+            except Exception:
+                logger.exception("pressure killer iteration failed")
+
+    def _run_in_env(self, renv: dict, fn, args, kwargs, owner=(),
+                    retriable=False):
         """Execute a pip/uv task inside its cached venv subprocess
         (reference: worker-pool-per-runtime-env; here a per-env executor
         child — see runtime_env/executor.py). Runs on the executor thread;
         a cold venv build blocks only tasks of the SAME env (per-key lock),
-        and per-task env_vars/working_dir apply inside the child."""
+        and per-task env_vars/working_dir apply inside the child.
+        ``owner``/``retriable`` feed the pressure killer's policy."""
         from ray_tpu._private import runtime_env as renv_mod
         from ray_tpu._private.runtime_env import packaging, venv
         from ray_tpu._private.runtime_env.executor import EnvExecutor
@@ -3008,17 +3053,39 @@ class CoreWorker:
                         ex = EnvExecutor(python, path_entries=entries)
                     with self._env_exec_lock:
                         self._env_executors[key] = ex
+        # task_info feeds the pressure killer: only the task ACTUALLY
+        # executing inside the child (published under the executor's lock)
+        # is a victim candidate, never one queued behind it (reference:
+        # worker_killing_policy_group_by_owner.h operates on running
+        # workers).
+        tid = getattr(self.current_task_id, "value", None)
+        task_info = {
+            "tid": tid.hex() if tid is not None else "",
+            "owner": tuple(owner or ()),
+            "started": time.monotonic(),
+            "retriable": bool(retriable),
+        }
         try:
             ok, result = ex.run(
                 fn, args, kwargs,
                 env_vars=renv.get("env_vars"),
                 cwd=renv.get("working_dir"),
+                task_info=task_info,
             )
         except RuntimeError as e:
             with self._env_exec_lock:
                 if self._env_executors.get(key) is ex:
                     self._env_executors.pop(key, None)
             ex.close()
+            if getattr(ex, "pressure_killed", False):
+                # Retriable with node-avoid: the owner backs off this node
+                # and resubmits elsewhere (same path as admission OOM).
+                # Tasks queued behind the killed one land here too — they
+                # were headed for a pressured node either way.
+                raise exc.OutOfMemoryError(
+                    f"task killed under memory pressure on node "
+                    f"{self.node_id[:8]} ({self._memory_monitor.usage_string()})"
+                )
             raise exc.WorkerCrashedError(f"runtime-env executor: {e}")
         if ok:
             return True, result
@@ -3162,8 +3229,10 @@ class CoreWorker:
             self.current_task_id.value = tid
             self.current_actor_id.value = None
             self.put_counter.value = 0
-            if renv.get("pip") or renv.get("uv") or renv.get("conda") \
-                    or renv.get("image_uri"):
+            # Key PRESENCE routes, not truthiness: {"pip": []} explicitly
+            # asks for venv isolation (a subprocess executor) even with
+            # nothing to install.
+            if any(k in renv for k in ("pip", "uv", "conda", "image_uri")):
                 # Whole env (incl. env_vars/working_dir/py_modules) applies
                 # inside the venv/conda/container child — the parent
                 # process must stay unpolluted.
@@ -3172,7 +3241,11 @@ class CoreWorker:
                         f"task::{h.get('name', 'task')}", h.get("trace"),
                         {"task_id": h["tid"], "node_id": self.node_id},
                     ):
-                        return self._run_in_env(renv, fn, args, kwargs)
+                        return self._run_in_env(
+                            renv, fn, args, kwargs,
+                            owner=tuple(h.get("owner") or ()),
+                            retriable=h.get("retries", 0) > 0,
+                        )
                 except Exception as e:
                     return False, (e, traceback.format_exc())
             try:
@@ -3200,6 +3273,11 @@ class CoreWorker:
             "start_time": t0, "end_time": time.time(),
             "node_id": self.node_id,
         })
+        if not ok and isinstance(result[0], exc.OutOfMemoryError):
+            # Pressure-killed mid-run: surface as the SAME retriable
+            # code="oom" rejection the admission path uses — the owner
+            # backs off this node and resubmits elsewhere.
+            raise protocol.RpcError(str(result[0]), code="oom")
         return await self._package_result(h, ok, result)
 
     async def _execute_streaming_task(self, h, fn, args, kwargs, conn):
@@ -3574,8 +3652,7 @@ class CoreWorker:
 
         def construct():
             renv = spec.get("renv") or {}
-            if renv.get("pip") or renv.get("uv") or renv.get("conda") \
-                    or renv.get("image_uri"):
+            if any(k in renv for k in ("pip", "uv", "conda", "image_uri")):
                 return False, (
                     exc.RayTpuError(
                         "actors with pip/uv/conda/image_uri runtime envs "
