@@ -57,6 +57,8 @@ __all__ = [
 _init_lock = threading.Lock()
 _cluster: Optional[LocalCluster] = None
 _head: Optional[HeadService] = None
+# True when THIS process minted/adopted RT_AUTH_TOKEN (cleared on shutdown)
+_token_set_by_init = False
 
 
 def is_initialized() -> bool:
@@ -121,7 +123,7 @@ def init(
 
     Reference analog: ``ray.init`` (``python/ray/_private/worker.py:1413``).
     """
-    global _cluster, _head
+    global _cluster, _head, _token_set_by_init
     with _init_lock:
         if _worker_mod.global_worker is not None:
             if ignore_reinit_error:
@@ -154,6 +156,9 @@ def init(
                     "(start one with `raytpu start --head`)"
                 )
             address = info["address"]
+            if info.get("auth_token") and "RT_AUTH_TOKEN" not in os.environ:
+                os.environ["RT_AUTH_TOKEN"] = info["auth_token"]
+                _token_set_by_init = True
         job_id = JobID.from_random()
         if address is None:
             # Session dir: per-cluster scratch for worker log files (and
@@ -167,6 +172,17 @@ def init(
                 )
             os.makedirs(session_dir, exist_ok=True)
             _prune_old_sessions(keep=5, active=session_dir)
+            # Cluster auth token (reference: src/ray/rpc/authentication/):
+            # minted per cluster; spawned nodes inherit it via the env and
+            # every TCP plane requires it as the connection's first
+            # message. RT_AUTH_TOKEN= (empty) disables.
+            from ray_tpu._private.config import rt_config as _rtc
+
+            if "RT_AUTH_TOKEN" not in os.environ and not _rtc.auth_token:
+                import secrets
+
+                os.environ["RT_AUTH_TOKEN"] = secrets.token_hex(16)
+                _token_set_by_init = True
             _node_env = dict(_node_env or {}, RT_SESSION_DIR=session_dir)
             head = HeadService()
             driver = CoreWorker(
@@ -282,7 +298,7 @@ class ClientContext:
 
 
 def shutdown():
-    global _cluster, _head
+    global _cluster, _head, _token_set_by_init
     atexit.unregister(shutdown)
     w = _worker_mod.global_worker
     if w is None:
@@ -293,6 +309,13 @@ def shutdown():
     w.shutdown()
     _head = None
     _worker_mod.global_worker = None
+    if _token_set_by_init:
+        # A token THIS process minted/adopted dies with the cluster: a
+        # later init against a different head must not present it (the
+        # rejection is an opaque ConnectionLost). User-provided tokens
+        # are left alone.
+        os.environ.pop("RT_AUTH_TOKEN", None)
+        _token_set_by_init = False
 
 
 def remote(*args, **kwargs):

@@ -124,6 +124,12 @@ rt_config.declare(
     "arena_bytes", int, 4 << 30,
     "Native shm arena capacity per session (plasma-equivalent store size).")
 rt_config.declare(
+    "auth_token", str, "",
+    "Cluster auth token (reference: src/ray/rpc/authentication/ token "
+    "auth). Minted at head start and required as the FIRST message on "
+    "every control/xfer TCP connection; a reachable head port without it "
+    "is a full cluster takeover. Empty = auth disabled (tests/dev).")
+rt_config.declare(
     "oom_kill", bool, True,
     "Kill subprocess-backed retriable tasks under memory pressure "
     "(newest-first, grouped by owner) so the node survives a leaky "

@@ -59,9 +59,21 @@ def main(argv=None):
 
     logging.basicConfig(level=getattr(logging, args.log_level.upper(), 30))
 
+    from ray_tpu._private.config import rt_config
     from ray_tpu._private.gcs import HeadService
     from ray_tpu._private.ids import JobID
     from ray_tpu._private.node import spawn_node
+
+    # Cluster auth token, minted at head start (reference:
+    # src/ray/rpc/authentication/): every node/driver/xfer connection must
+    # present it first. Rides the env to spawned nodes and the (0600)
+    # address/info files to drivers.
+    # RT_AUTH_TOKEN= (explicitly empty) is the documented opt-out and must
+    # be honored; only an ABSENT token mints one.
+    if "RT_AUTH_TOKEN" not in os.environ and not rt_config.auth_token:
+        import secrets
+
+        os.environ["RT_AUTH_TOKEN"] = secrets.token_hex(16)
 
     loop = asyncio.new_event_loop()
     asyncio.set_event_loop(loop)
@@ -122,16 +134,23 @@ def main(argv=None):
         "dashboard_port": dash_port,
         "head_pid": os.getpid(),
         "node_pids": [node.proc.pid] if node else [],
+        "auth_token": rt_config.auth_token,
     }
+    def _write_private(path: str, payload: dict):
+        """0600 from CREATION (open-then-chmod leaves a window where
+        another local user reads the token off the well-known path)."""
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        os.chmod(path, 0o600)  # a pre-existing 0644 file keeps its mode
+        with os.fdopen(fd, "w") as f:
+            json.dump(payload, f)
+
     if not args.no_address_file:
-        with open(address_file_path(), "w") as f:
-            json.dump(info, f)
+        _write_private(address_file_path(), info)
     if args.info_file:
         # atomic publish for launchers polling a private path (a cluster
         # launcher must not read another cluster's global address file)
         tmp = args.info_file + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(info, f)
+        _write_private(tmp, info)
         os.replace(tmp, args.info_file)
     # parseable by the CLI parent
     print(json.dumps(info), flush=True)

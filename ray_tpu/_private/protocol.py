@@ -53,11 +53,23 @@ def decode_message_bytes(data: bytes) -> Tuple[dict, List[bytes]]:
     return header, frames[1:]
 
 
-async def read_message(reader: asyncio.StreamReader) -> Tuple[dict, List[bytes]]:
+async def read_message(
+    reader: asyncio.StreamReader, max_bytes: Optional[int] = None,
+) -> Tuple[dict, List[bytes]]:
+    """``max_bytes`` bounds total frame bytes (and frame count): an
+    UNAUTHENTICATED peer must not be able to make readexactly allocate
+    gigabytes before the auth gate ever sees the message."""
     nframes = _HDR.unpack(await reader.readexactly(4))[0]
+    if max_bytes is not None and nframes > 16:
+        raise ConnectionResetError("pre-auth message exceeds frame budget")
     frames: List[bytes] = []
+    budget = max_bytes
     for _ in range(nframes):
         ln = _HDR.unpack(await reader.readexactly(4))[0]
+        if budget is not None:
+            budget -= ln
+            if budget < 0:
+                raise ConnectionResetError("pre-auth message too large")
         frames.append(await reader.readexactly(ln))
     header = msgpack.unpackb(frames[0], raw=False)
     return header, frames[1:]
@@ -105,6 +117,9 @@ class Connection:
         self._recv_task: Optional[asyncio.Task] = None
         self.on_close: Optional[Callable[["Connection"], None]] = None
         self.peer_info: dict = {}  # set by registration handshakes
+        # Set by accepting servers when cluster auth is on: the expected
+        # token; cleared by a valid __auth first message.
+        self.require_auth_token: Optional[str] = None
         # Write coalescing: send_raw buffers encoded messages and a single
         # call_soon callback flushes them next loop tick — a burst of small
         # RPCs (the task-submission hot loop) costs one send(2) instead of
@@ -126,7 +141,29 @@ class Connection:
     async def _recv_loop(self):
         try:
             while True:
-                header, frames = await read_message(self.reader)
+                header, frames = await read_message(
+                    self.reader,
+                    max_bytes=(
+                        4096 if self.require_auth_token is not None else None
+                    ),
+                )
+                if self.require_auth_token is not None:
+                    # Token auth (reference: src/ray/rpc/authentication/):
+                    # the FIRST inbound message must be a valid __auth; a
+                    # wrong or missing token closes the connection before
+                    # any request is dispatched.
+                    if (
+                        not header.get("r")
+                        and header.get("m") == "__auth"
+                        and header.get("t") == self.require_auth_token
+                    ):
+                        self.require_auth_token = None
+                        continue
+                    logger.warning(
+                        "rejecting unauthenticated connection (%s)",
+                        self.name,
+                    )
+                    return  # finally: _teardown closes the socket
                 if header.get("r"):  # reply
                     fut = self._pending.pop(header["i"], None)
                     if fut is not None and not fut.done():
@@ -281,6 +318,9 @@ class RpcServer:
         self.on_connection: Optional[Callable[[Connection], None]] = None
 
     async def start(self) -> Tuple[str, int]:
+        # Pin the expected token at START: a server's trust anchor must
+        # not drift with later env changes in the process.
+        self.auth_token = _auth_token()
         self._server = await asyncio.start_server(
             self._on_client, self.host, self.port
         )
@@ -290,6 +330,9 @@ class RpcServer:
 
     async def _on_client(self, reader, writer):
         conn = Connection(reader, writer, self.handler, name="server-accept")
+        tok = getattr(self, "auth_token", "")
+        if tok:
+            conn.require_auth_token = tok
         conn.on_close = lambda c: (
             self.connections.remove(c) if c in self.connections else None
         )
@@ -306,6 +349,12 @@ class RpcServer:
             await self._server.wait_closed()
 
 
+def _auth_token() -> str:
+    from ray_tpu._private.config import rt_config
+
+    return rt_config.auth_token
+
+
 async def connect(
     addr: Tuple[str, int], handler=None, name: str = ""
 ) -> Connection:
@@ -317,5 +366,14 @@ async def connect(
     except Exception:
         pass
     conn = Connection(reader, writer, handler, name=name or f"client->{addr}")
+    tok = _auth_token()
+    if tok:
+        # Both directions of a connection serve RPCs, so the accepting
+        # side expects the token as our first message; ordered streams
+        # guarantee it precedes every call queued after connect().
+        conn.require_auth_token = None
+        conn.start()
+        conn.notify("__auth", {"t": tok})
+        return conn
     conn.start()
     return conn

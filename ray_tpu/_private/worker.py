@@ -2952,6 +2952,10 @@ class CoreWorker:
                     continue
                 _owner, recs = max(groups.items(), key=lambda kv: len(kv[1]))
                 victim, ex = max(recs, key=lambda r: r[0]["started"])
+                if ex.current_task is not victim:
+                    continue  # victim finished since the snapshot; a task
+                    # that slipped in behind it may be non-retriable —
+                    # re-evaluate next tick rather than kill blind
                 ex.pressure_killed = True
                 logger.warning(
                     "memory pressure (%s): killing task %s of owner %s "

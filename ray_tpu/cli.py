@@ -26,6 +26,8 @@ def _resolve_address(args) -> str:
         print("error: no running head found (raytpu start --head)",
               file=sys.stderr)
         sys.exit(1)
+    if info.get("auth_token"):
+        os.environ.setdefault("RT_AUTH_TOKEN", info["auth_token"])
     return info["address"]
 
 

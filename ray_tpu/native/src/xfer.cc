@@ -8,9 +8,10 @@
 // over TCP so a remote host's fetch never touches the Python RPC plane.
 //
 // Protocol (little-endian):
-//   request:  u32 magic "RTX1" | u8 kind (0 = shm segment, 1 = arena object)
+//   request:  u32 magic "RTX2" | u8 kind (0 = shm segment, 1 = arena object)
 //             u16 len1, name1   (kind 0: segment name; kind 1: arena name)
 //             u16 len2, name2   (kind 1: object hex; else empty)
+//             u16 len3, token   (cluster auth token; empty = auth off)
 //   response: u8 status (0 ok, 1 not found, 2 error) | u64 len | payload
 //
 // The payload is the segment's/object's raw bytes — the store's
@@ -56,7 +57,20 @@ int rt_obj_release(int handle, const char* object_hex);
 
 namespace {
 
-constexpr uint32_t kMagic = 0x31585452;  // "RTX1"
+constexpr uint32_t kMagic = 0x32585452;  // "RTX2" (v2 adds the auth token)
+
+// Cluster auth token (reference behavior: src/ray/rpc/authentication/
+// token auth): cached from RT_AUTH_TOKEN at first use; the request's
+// token field must match or the connection is dropped before any
+// object bytes move. Empty env = auth disabled.
+std::string expected_token() {
+  // Read per call, NOT a static: a long-lived process that re-inits
+  // against a different cluster updates the env, and the xfer plane must
+  // follow (a cached stale token would fail every cross-node fetch until
+  // restart). getenv is cheap next to a TCP round trip.
+  const char* t = getenv("RT_AUTH_TOKEN");
+  return std::string(t ? t : "");
+}
 
 // Only framework-owned shm names are served (segments "rt*", arenas "/rt*"):
 // the server must not let a peer read arbitrary host shared memory.
@@ -181,9 +195,13 @@ void HandleConn(int fd) {
   uint8_t kind;
   std::string name1, name2;
   SetIoTimeout(fd, 120000);  // a wedged peer must not pin a thread forever
+  std::string token;
   if (ReadFull(fd, &magic, 4) && magic == kMagic && ReadFull(fd, &kind, 1) &&
-      ReadName(fd, &name1) && ReadName(fd, &name2)) {
-    if (!AllowedName(name1)) {
+      ReadName(fd, &name1) && ReadName(fd, &name2) && ReadName(fd, &token)) {
+    if (!expected_token().empty() && token != expected_token()) {
+      // wrong/missing token: close without a response (an attacker learns
+      // nothing about which objects exist)
+    } else if (!AllowedName(name1)) {
       SendResponse(fd, 2, nullptr, 0);
     } else if (kind == 0) {
       ServeSegment(fd, name1);
@@ -431,7 +449,8 @@ int64_t rt_xfer_fetch(const char* host, int port, int kind, const char* name1,
   if (fd < 0) return fd;
   uint8_t k = static_cast<uint8_t>(kind);
   if (!WriteFull(fd, &kMagic, 4) || !WriteFull(fd, &k, 1) ||
-      !SendName(fd, name1) || !SendName(fd, name2 ? name2 : "")) {
+      !SendName(fd, name1) || !SendName(fd, name2 ? name2 : "") ||
+      !SendName(fd, expected_token())) {
     close(fd);
     return -EIO;
   }

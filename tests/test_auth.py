@@ -1,0 +1,57 @@
+"""Cluster auth token (reference: ``src/ray/rpc/authentication/`` token
+auth): minted at head start, required as the first message on every
+control-plane TCP connection; wrong or missing tokens are rejected before
+any request dispatches."""
+import asyncio
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import protocol
+from ray_tpu._private import worker as worker_mod
+
+
+@pytest.fixture
+def rt_auth():
+    ray_tpu.init(num_cpus=2, num_nodes=1)
+    yield worker_mod.get_global_worker()
+    ray_tpu.shutdown()
+
+
+def test_token_minted_and_cluster_works(rt_auth):
+    assert os.environ.get("RT_AUTH_TOKEN"), "init must mint a cluster token"
+
+    @ray_tpu.remote
+    def f():
+        return os.environ.get("RT_AUTH_TOKEN")
+
+    # workers inherited the same token and the authed planes carry tasks
+    assert ray_tpu.get(f.remote(), timeout=30) == os.environ["RT_AUTH_TOKEN"]
+
+
+def test_wrong_or_missing_token_rejected(rt_auth, monkeypatch):
+    w = rt_auth
+    addr = tuple(w.gcs_addr)
+    good = os.environ["RT_AUTH_TOKEN"]
+
+    async def attempt():
+        conn = await protocol.connect(addr, None, name="auth-probe")
+        try:
+            h, _ = await asyncio.wait_for(
+                conn.call("get_nodes", {}), timeout=5
+            )
+            return "ok" if "nodes" in h else "bad-reply"
+        except (protocol.ConnectionLost, asyncio.TimeoutError) as e:
+            return type(e).__name__
+        finally:
+            await conn.close()
+
+    monkeypatch.setenv("RT_AUTH_TOKEN", "deadbeef" * 4)
+    assert w.run_sync(attempt()) in ("ConnectionLost", "TimeoutError")
+
+    monkeypatch.setenv("RT_AUTH_TOKEN", "")
+    assert w.run_sync(attempt()) in ("ConnectionLost", "TimeoutError")
+
+    monkeypatch.setenv("RT_AUTH_TOKEN", good)
+    assert w.run_sync(attempt()) == "ok"

@@ -10,15 +10,7 @@ import pytest
 
 import ray_tpu
 from ray_tpu._private.memory_monitor import get_memory_usage
-
-
-def _wait_for(pred, timeout=60.0):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if pred():
-            return True
-        time.sleep(0.2)
-    return False
+from ray_tpu._private.test_utils import wait_for_condition
 
 
 def test_leaky_task_killed_and_retried_elsewhere(tmp_path):
@@ -60,8 +52,9 @@ def test_leaky_task_killed_and_retried_elsewhere(tmp_path):
         # Attempt 1 has started leaking on the pressured node: bring up
         # the rescue node the retry should land on.
         import os
-        assert _wait_for(lambda: os.path.exists(marker), 60), (
-            "first attempt never started"
+        wait_for_condition(
+            lambda: os.path.exists(marker), timeout=60,
+            message="first attempt never started",
         )
         cluster = ray_tpu._internal_cluster()
         cluster.add_node(
