@@ -156,8 +156,9 @@ def init(
                     "(start one with `raytpu start --head`)"
                 )
             address = info["address"]
-            if info.get("auth_token") and "RT_AUTH_TOKEN" not in os.environ:
-                os.environ["RT_AUTH_TOKEN"] = info["auth_token"]
+            from ray_tpu._private import auth as _auth
+
+            if _auth.adopt_token(info):
                 _token_set_by_init = True
         job_id = JobID.from_random()
         if address is None:
@@ -176,12 +177,9 @@ def init(
             # minted per cluster; spawned nodes inherit it via the env and
             # every TCP plane requires it as the connection's first
             # message. RT_AUTH_TOKEN= (empty) disables.
-            from ray_tpu._private.config import rt_config as _rtc
+            from ray_tpu._private import auth as _auth
 
-            if "RT_AUTH_TOKEN" not in os.environ and not _rtc.auth_token:
-                import secrets
-
-                os.environ["RT_AUTH_TOKEN"] = secrets.token_hex(16)
+            if _auth.ensure_cluster_token():
                 _token_set_by_init = True
             _node_env = dict(_node_env or {}, RT_SESSION_DIR=session_dir)
             head = HeadService()
@@ -258,6 +256,18 @@ def init(
                 )
             _cluster.wait_for_nodes(num_nodes)
         else:
+            # Explicit address on the head's own machine: the local
+            # address file supplies the token (the `connect with:` hint
+            # raytpu start prints must work in a fresh shell). Remote
+            # drivers set RT_AUTH_TOKEN themselves.
+            if "RT_AUTH_TOKEN" not in os.environ:
+                from ray_tpu._private import auth as _auth
+                from ray_tpu._private.head_main import read_address_file
+
+                finfo = read_address_file()
+                if finfo and finfo.get("address") == address:
+                    if _auth.adopt_token(finfo):
+                        _token_set_by_init = True
             host, port = address.rsplit(":", 1)
             driver = CoreWorker(
                 is_driver=True, gcs_addr=(host, int(port)), job_id=job_id

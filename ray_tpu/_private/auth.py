@@ -1,0 +1,50 @@
+"""Cluster auth token helpers (reference: ``src/ray/rpc/authentication/``).
+
+One place for the two security-critical behaviors every entrypoint needs —
+the call sites (init, head_main, CLI, launcher) must not hand-roll them:
+
+- ``ensure_cluster_token()``: mint a token unless one is present OR the
+  operator explicitly opted out with ``RT_AUTH_TOKEN=`` (empty-but-set).
+- ``adopt_token(info)``: take the token from a head's address/info dict
+  when this process has none.
+
+Both return True when they changed the environment, so callers that own a
+cluster lifetime (ray_tpu.init) can undo the change at shutdown.
+"""
+from __future__ import annotations
+
+import os
+
+ENV = "RT_AUTH_TOKEN"
+
+
+def ensure_cluster_token() -> bool:
+    """Mint a cluster token into the env unless present or explicitly
+    disabled. Returns True when this call minted one."""
+    from ray_tpu._private.config import rt_config
+
+    if ENV in os.environ or rt_config.auth_token:
+        return False
+    import secrets
+
+    os.environ[ENV] = secrets.token_hex(16)
+    return True
+
+
+def adopt_token(info) -> bool:
+    """Adopt ``info['auth_token']`` when this process has no token yet.
+    Returns True when adopted."""
+    tok = (info or {}).get("auth_token")
+    if not tok or ENV in os.environ:
+        return False
+    os.environ[ENV] = tok
+    return True
+
+
+def redacted(info: dict) -> dict:
+    """A copy safe to print/log: the token never reaches stdout (logs are
+    routinely world-readable; the 0600 files are the distribution
+    channel)."""
+    if not info.get("auth_token"):
+        return dict(info)
+    return {**info, "auth_token": "<redacted>"}

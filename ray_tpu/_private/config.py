@@ -124,6 +124,12 @@ rt_config.declare(
     "arena_bytes", int, 4 << 30,
     "Native shm arena capacity per session (plasma-equivalent store size).")
 rt_config.declare(
+    "data_cpu_fraction", float, 0.5,
+    "Fraction of cluster CPUs the data streaming executor may occupy "
+    "(split across a driver's active operators, min one task each). "
+    "Keeps ingest from starving co-located train/serve actors "
+    "(reference: execution/resource_manager.py budgets).")
+rt_config.declare(
     "auth_token", str, "",
     "Cluster auth token (reference: src/ray/rpc/authentication/ token "
     "auth). Minted at head start and required as the FIRST message on "

@@ -59,6 +59,7 @@ def main(argv=None):
 
     logging.basicConfig(level=getattr(logging, args.log_level.upper(), 30))
 
+    from ray_tpu._private import auth as _auth
     from ray_tpu._private.config import rt_config
     from ray_tpu._private.gcs import HeadService
     from ray_tpu._private.ids import JobID
@@ -67,13 +68,9 @@ def main(argv=None):
     # Cluster auth token, minted at head start (reference:
     # src/ray/rpc/authentication/): every node/driver/xfer connection must
     # present it first. Rides the env to spawned nodes and the (0600)
-    # address/info files to drivers.
-    # RT_AUTH_TOKEN= (explicitly empty) is the documented opt-out and must
-    # be honored; only an ABSENT token mints one.
-    if "RT_AUTH_TOKEN" not in os.environ and not rt_config.auth_token:
-        import secrets
-
-        os.environ["RT_AUTH_TOKEN"] = secrets.token_hex(16)
+    # address/info files to drivers; RT_AUTH_TOKEN= (explicitly empty) is
+    # the opt-out.
+    _auth.ensure_cluster_token()
 
     loop = asyncio.new_event_loop()
     asyncio.set_event_loop(loop)
@@ -152,8 +149,10 @@ def main(argv=None):
         tmp = args.info_file + ".tmp"
         _write_private(tmp, info)
         os.replace(tmp, args.info_file)
-    # parseable by the CLI parent
-    print(json.dumps(info), flush=True)
+    # Parseable by the CLI parent. REDACTED: stdout routinely lands in
+    # 0644 log files (launchers redirect it); the token's distribution
+    # channel is the 0600 files, never a log line.
+    print(json.dumps(_auth.redacted(info)), flush=True)
 
     def term(*_):
         loop.stop()

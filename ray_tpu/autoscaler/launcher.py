@@ -189,6 +189,12 @@ def up(path: str, *, wait_for_min_workers: float = 0.0) -> Dict[str, Any]:
             f"head failed to start (see {head_log.name})"
         )
     address = info["address"]
+    # Adopt the head's auth token: the monitor subprocess, every node it
+    # spawns, and this process's own head RPCs (min-worker wait, status)
+    # all authenticate with it via the inherited env.
+    from ray_tpu._private.auth import adopt_token
+
+    adopt_token(info)
     mon_log = open(os.path.join(log_dir, f"{name}-monitor.log"), "ab")
     monitor = subprocess.Popen(
         [

@@ -16,18 +16,25 @@ import time
 
 
 def _resolve_address(args) -> str:
-    addr = getattr(args, "address", None) or os.environ.get("RAY_TPU_ADDRESS")
-    if addr and addr != "auto":
-        return addr
+    from ray_tpu._private.auth import adopt_token
     from ray_tpu._private.head_main import read_address_file
 
+    if getattr(args, "auth_token", None):
+        os.environ["RT_AUTH_TOKEN"] = args.auth_token
+    addr = getattr(args, "address", None) or os.environ.get("RAY_TPU_ADDRESS")
     info = read_address_file()
+    if addr and addr != "auto":
+        # Explicit address on the head's machine: the local 0600 address
+        # file supplies the token. Remote machines pass --auth-token or
+        # set RT_AUTH_TOKEN.
+        if info and info.get("address") == addr:
+            adopt_token(info)
+        return addr
     if info is None:
         print("error: no running head found (raytpu start --head)",
               file=sys.stderr)
         sys.exit(1)
-    if info.get("auth_token"):
-        os.environ.setdefault("RT_AUTH_TOKEN", info["auth_token"])
+    adopt_token(info)
     return info["address"]
 
 
@@ -236,6 +243,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("start", help="start a head or worker node")
     sp.add_argument("--head", action="store_true")
     sp.add_argument("--address", default=None)
+    sp.add_argument("--auth-token", default=None,
+                    help="cluster token for joining a remote head "
+                         "(same-host joins read the 0600 address file)")
     sp.add_argument("--host", default="127.0.0.1")
     sp.add_argument("--port", type=int, default=0)
     sp.add_argument("--num-cpus", type=int, default=0)

@@ -207,21 +207,37 @@ class StreamingExecutor:
         apply_task = ray_tpu.remote(_remote_apply)
         if task_opts:
             apply_task = apply_task.options(**task_opts)
+        from ray_tpu.data.resource_manager import default_resource_manager
+
+        rm = default_resource_manager()
+        op = rm.register_op(
+            "map", concurrency_cap=in_flight,
+            cpu_per_task=num_cpus if num_cpus is not None else 1.0,
+        )
         pending = collections.deque()
         exhausted = False
-        while pending or not exhausted:
-            while not exhausted and len(pending) < in_flight:
-                try:
-                    ref = next(stream)
-                except StopIteration:
-                    exhausted = True
-                    break
-                pending.append(apply_task.remote(payload, ref))
-                self.stats.tasks_submitted += 1
-            if pending:
-                # Pop in order: preserves block order; completed later tasks
-                # simply wait in the store (streaming window gives overlap).
-                yield pending.popleft()
+        try:
+            while pending or not exhausted:
+                # The policy chain (per-op cap + reserved-CPU budget)
+                # gates every submission: ingest never occupies more than
+                # its share of the cluster even while a consumer lags.
+                while not exhausted and rm.can_add_input(op):
+                    try:
+                        ref = next(stream)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    pending.append(apply_task.remote(payload, ref))
+                    rm.on_task_submitted(op)
+                    self.stats.tasks_submitted += 1
+                if pending:
+                    # Pop in order: preserves block order; completed later
+                    # tasks simply wait in the store (streaming window
+                    # gives overlap).
+                    yield pending.popleft()
+                    rm.on_task_output_consumed(op)
+        finally:
+            rm.unregister_op(op)
 
     def _actor_pool(self, stream, stage: ActorStage):
         """Bounded-in-flight round-robin over a pool of stateful actors;

@@ -1,0 +1,169 @@
+"""Per-operator execution budgets + backpressure policies for the data
+streaming executor.
+
+Reference behavior being reproduced (not code):
+``python/ray/data/_internal/execution/resource_manager.py`` (global limits,
+per-operator budgets with reserved minimums) and
+``backpressure_policy/concurrency_cap_backpressure_policy.py`` — the
+scheduling loop asks the policies whether an operator may launch more work.
+The TPU-era failure mode this guards: a data-ingest pipeline co-located
+with training actors must not occupy every cluster CPU — ingest gets a
+configurable FRACTION of the cluster (``RT_DATA_CPU_FRACTION``), split
+across this driver's concurrently-executing operators, with a reserved
+minimum of one task per operator so progress is always possible.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+
+@dataclass
+class ExecutionResources:
+    """The resource vector budgets are expressed in (reference:
+    ExecutionResources — cpu/gpu/object_store_memory; object-store bytes
+    here are arena bytes)."""
+
+    cpu: float = 0.0
+    object_store_bytes: int = 0
+
+
+@dataclass
+class OpState:
+    """Live accounting for one executing operator (stage)."""
+
+    name: str
+    concurrency_cap: int  # per-op cap (Dataset.map(concurrency=...))
+    cpu_per_task: float = 1.0
+    in_flight: int = 0
+    tasks_launched: int = 0
+
+    @property
+    def cpu_in_use(self) -> float:
+        return self.in_flight * self.cpu_per_task
+
+
+class BackpressurePolicy:
+    """One admission rule: may ``op`` launch another task right now?"""
+
+    def can_add_input(self, op: OpState, rm: "ResourceManager") -> bool:
+        raise NotImplementedError
+
+
+class ConcurrencyCapBackpressurePolicy(BackpressurePolicy):
+    """Per-operator in-flight cap (reference:
+    concurrency_cap_backpressure_policy.py)."""
+
+    def can_add_input(self, op: OpState, rm: "ResourceManager") -> bool:
+        return op.in_flight < op.concurrency_cap
+
+
+class ReservedCpuBackpressurePolicy(BackpressurePolicy):
+    """Budget policy: all of this driver's data operators together stay
+    within ``data_cpu_fraction`` of the cluster's CPUs, the budget split
+    evenly across active operators — with a reserved minimum of ONE task
+    per operator so a tight budget degrades to serial progress, never
+    deadlock (reference: reserved resources in resource_manager.py)."""
+
+    def can_add_input(self, op: OpState, rm: "ResourceManager") -> bool:
+        if op.in_flight == 0:
+            return True  # reserved minimum: one task always admits
+        budget = rm.op_budget(op)
+        return op.cpu_in_use + op.cpu_per_task <= budget.cpu + 1e-9
+
+
+class ResourceManager:
+    """Global limits + per-op budgets + the policy chain. One instance per
+    driver process (operators of concurrent Dataset executions share the
+    data budget — they contend for the same cluster)."""
+
+    def __init__(self, policies: List[BackpressurePolicy] = None):
+        self._ops: Dict[int, OpState] = {}
+        self._lock = threading.Lock()
+        self.policies: List[BackpressurePolicy] = policies or [
+            ConcurrencyCapBackpressurePolicy(),
+            ReservedCpuBackpressurePolicy(),
+        ]
+
+    # ------------------------------------------------------------- limits
+
+    def global_limits(self) -> ExecutionResources:
+        """What the DATA plane may use cluster-wide: a fraction of total
+        CPUs (leaving the rest for co-located train/serve actors) and of
+        the object-store arena."""
+        from ray_tpu._private.config import rt_config
+
+        total_cpu = 0.0
+        try:
+            import ray_tpu
+
+            total_cpu = float(ray_tpu.cluster_resources().get("CPU", 0.0))
+        except Exception:
+            pass
+        frac = float(rt_config.data_cpu_fraction)
+        return ExecutionResources(
+            cpu=max(total_cpu * frac, 1.0),
+            object_store_bytes=int(rt_config.arena_bytes * frac),
+        )
+
+    def op_budget(self, op: OpState) -> ExecutionResources:
+        """This operator's share: the data budget split evenly across the
+        operators currently executing under this driver."""
+        limits = self.global_limits()
+        with self._lock:
+            n = max(len(self._ops), 1)
+        return ExecutionResources(
+            cpu=limits.cpu / n,
+            object_store_bytes=limits.object_store_bytes // n,
+        )
+
+    # ---------------------------------------------------------- lifecycle
+
+    def register_op(self, name: str, concurrency_cap: int,
+                    cpu_per_task: float = 1.0) -> OpState:
+        # Explicit 0 is honored (num_cpus=0 IO stages consume no budget);
+        # negative input clamps to 0.
+        op = OpState(name=name, concurrency_cap=max(concurrency_cap, 1),
+                     cpu_per_task=max(cpu_per_task, 0.0))
+        with self._lock:
+            self._ops[id(op)] = op
+        return op
+
+    def unregister_op(self, op: OpState):
+        with self._lock:
+            self._ops.pop(id(op), None)
+
+    # --------------------------------------------------------- accounting
+
+    def on_task_submitted(self, op: OpState):
+        op.in_flight += 1
+        op.tasks_launched += 1
+
+    def on_task_output_consumed(self, op: OpState):
+        op.in_flight = max(op.in_flight - 1, 0)
+
+    def can_add_input(self, op: OpState) -> bool:
+        return all(p.can_add_input(op, self) for p in self.policies)
+
+    def debug_state(self) -> List[dict]:
+        with self._lock:
+            return [
+                {"name": o.name, "in_flight": o.in_flight,
+                 "launched": o.tasks_launched,
+                 "budget_cpu": self.op_budget(o).cpu}
+                for o in self._ops.values()
+            ]
+
+
+_default_manager: ResourceManager = None
+_default_lock = threading.Lock()
+
+
+def default_resource_manager() -> ResourceManager:
+    global _default_manager
+    if _default_manager is None:
+        with _default_lock:
+            if _default_manager is None:
+                _default_manager = ResourceManager()
+    return _default_manager

@@ -63,13 +63,20 @@ constexpr uint32_t kMagic = 0x32585452;  // "RTX2" (v2 adds the auth token)
 // token auth): cached from RT_AUTH_TOKEN at first use; the request's
 // token field must match or the connection is dropped before any
 // object bytes move. Empty env = auth disabled.
-std::string expected_token() {
-  // Read per call, NOT a static: a long-lived process that re-inits
-  // against a different cluster updates the env, and the xfer plane must
-  // follow (a cached stale token would fail every cross-node fetch until
-  // restart). getenv is cheap next to a TCP round trip.
+// Token storage: initialized from the env at library load (single
+// threaded), updated through rt_xfer_set_token by the Python side on
+// re-init/shutdown. NOT per-call getenv: serving threads racing a
+// setenv/unsetenv from Python is POSIX-undefined (environ may be
+// realloc'd mid-walk).
+std::mutex g_token_mu;
+std::string g_token = [] {
   const char* t = getenv("RT_AUTH_TOKEN");
   return std::string(t ? t : "");
+}();
+
+std::string expected_token() {
+  std::lock_guard<std::mutex> lk(g_token_mu);
+  return g_token;
 }
 
 // Only framework-owned shm names are served (segments "rt*", arenas "/rt*"):
@@ -437,6 +444,11 @@ int rt_xfer_stop(int port) {
 // always complete — concurrent fetchers that find it existing may read it
 // immediately. timeout_ms <= 0 means no IO bound. Returns the payload
 // size, -EEXIST if a complete copy already exists locally, or -errno.
+void rt_xfer_set_token(const char* token) {
+  std::lock_guard<std::mutex> lk(g_token_mu);
+  g_token = token ? token : "";
+}
+
 int64_t rt_xfer_fetch(const char* host, int port, int kind, const char* name1,
                       const char* name2, const char* dest_name,
                       int timeout_ms) {

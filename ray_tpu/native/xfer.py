@@ -50,8 +50,20 @@ def _load_library():
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int,
         ]
         lib.rt_xfer_fetch.restype = ctypes.c_int64
+        lib.rt_xfer_set_token.argtypes = [ctypes.c_char_p]
+        lib.rt_xfer_set_token.restype = None
         _lib = lib
         return _lib
+
+
+def _sync_token(lib):
+    """Push the current cluster token into the native plane. Called before
+    every serve/fetch: the Python side reads the env under the GIL and the
+    C side stores it behind a mutex — no getenv from serving threads
+    (racing Python's setenv/unsetenv is POSIX-undefined)."""
+    import os
+
+    lib.rt_xfer_set_token(os.environ.get("RT_AUTH_TOKEN", "").encode())
 
 
 def start_server(host: str = "127.0.0.1") -> Optional[int]:
@@ -62,6 +74,7 @@ def start_server(host: str = "127.0.0.1") -> Optional[int]:
     lib = _load_library()
     if lib is None:
         return None
+    _sync_token(lib)
     port = lib.rt_xfer_serve(host.encode(), 0)
     if port < 0:
         logger.warning("xfer server failed to start: errno %d", -port)
@@ -90,6 +103,7 @@ def fetch_to_segment(
     lib = _load_library()
     if lib is None:
         return None
+    _sync_token(lib)
     if "seg" in meta:
         kind, name1, name2 = 0, meta["seg"], ""
     elif "arena" in meta:
