@@ -550,11 +550,17 @@ class HeadService:
         if node is None or not node.alive or node.conn is None:
             raise protocol.RpcError(f"node {h.get('node_id')!r} unavailable")
         method = h.get("method")
-        if method not in ("memory_profile", "dump_stacks"):
+        if method not in ("memory_profile", "dump_stacks", "cpu_profile",
+                          "xla_profile"):
             raise protocol.RpcError(f"node_debug: unsupported {method!r}")
-        fwd = {k: h[k] for k in ("action", "top") if k in h}
+        fwd = {
+            k: h[k]
+            for k in ("action", "top", "duration_s", "hz", "logdir")
+            if k in h
+        }
         hh, _ = await asyncio.wait_for(
-            node.conn.call(method, fwd), timeout=30
+            node.conn.call(method, fwd),
+            timeout=max(float(h.get("duration_s") or 0) + 30, 30),
         )
         # strip the forwarded reply's RPC envelope fields
         return {k: v for k, v in hh.items() if k not in ("i", "r")}, []

@@ -537,10 +537,13 @@ class CoreWorker:
             self.gcs_addr, self._handle_rpc, name="gcs-client"
         )
         self.gcs.on_close = self._on_gcs_lost
-        await self.gcs.call("subscribe", {"channel": "object_free"})
-        await self.gcs.call("subscribe", {"channel": "lease_reclaim"})
-        if "worker_logs" in self.pubsub_handlers:
-            await self.gcs.call("subscribe", {"channel": "worker_logs"})
+        # Subscribe to EVERY channel with a registered handler (plus the
+        # built-ins): a restarted head has an empty subscriber table, so
+        # reconnect must restore late-registered channels too (e.g. serve
+        # replica-change pushes), not just the boot-time set.
+        for channel in {"object_free", "lease_reclaim",
+                        *self.pubsub_handlers}:
+            await self.gcs.call("subscribe", {"channel": channel})
         # Cluster-wide config overrides (init(_system_config=...)) live in
         # the head KV; every process applies them at (re)connection —
         # the reference passes _system_config on raylet command lines.
@@ -3884,6 +3887,35 @@ class CoreWorker:
         return memory_profile_local(
             h.get("action", "snapshot"), h.get("top", 10)
         ), []
+
+    async def rpc_cpu_profile(self, h, frames, conn):
+        """Sampling CPU profile (py-spy record analog): the sampler runs
+        on an executor thread so the event loop stays live; returns
+        collapsed flamegraph stacks."""
+        from ray_tpu.util.debug import sample_cpu_profile
+
+        loop = asyncio.get_running_loop()
+        folded = await loop.run_in_executor(
+            None,
+            lambda: sample_cpu_profile(
+                float(h.get("duration_s") or 5.0),
+                float(h.get("hz") or 99.0),
+            ),
+        )
+        return {"folded": folded}, []
+
+    async def rpc_xla_profile(self, h, frames, conn):
+        """XLA/TPU profiler capture on this (chip-owning) worker."""
+        from ray_tpu.util.debug import xla_profile_capture
+
+        loop = asyncio.get_running_loop()
+        res = await loop.run_in_executor(
+            None,
+            lambda: xla_profile_capture(
+                float(h.get("duration_s") or 3.0), h.get("logdir")
+            ),
+        )
+        return res, []
 
     async def rpc_run_control(self, h, frames, conn):
         """Run a pickled zero-arg callable on this process's control loop —

@@ -145,8 +145,13 @@ class Autoscaler:
                 self._launch(tname, tcfg, launched, by_type, sim)
                 budget -= 1
 
-        # bin-pack demands: fit into simulated capacity, else launch the
-        # smallest node type that can hold the bundle
+        # Bin-pack demands into simulated capacity, else launch the
+        # smallest node type that can hold the bundle (reference:
+        # v2/scheduler.py try_schedule). First-fit-DECREASING: placing the
+        # big shapes first lets the small ones fill the leftovers — the
+        # unsorted order can strand a large bundle on a fresh node whose
+        # remainder the earlier small demands would have used.
+        demands.sort(key=lambda d: sum(d.values()), reverse=True)
         for need in demands:
             placed = False
             for avail in sim:

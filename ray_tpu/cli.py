@@ -202,6 +202,40 @@ def cmd_logs(args):
         print(f"{prefix} {rec['line']}", file=stream)
 
 
+def cmd_profile(args):
+    """Profile one node: sampling CPU flamegraph (collapsed stacks) or an
+    XLA/TPU trace capture (reference: ray's reporter profile_manager;
+    the XLA capture is the TPU-native extension)."""
+    from ray_tpu.util import state
+    from ray_tpu.util.debug import node_cpu_profile, node_xla_profile
+
+    address = _resolve_address(args)
+    node_id = args.node_id
+    if node_id is None:
+        nodes = [n for n in state.list_nodes(address) if n.get("alive")]
+        if not nodes:
+            print("error: no alive nodes", file=sys.stderr)
+            sys.exit(1)
+        node_id = nodes[0]["node_id"]
+    if args.xla:
+        res = node_xla_profile(
+            node_id, duration_s=args.duration, logdir=args.output,
+            address=address,
+        )
+        print(json.dumps(res, indent=2))
+        sys.exit(0 if res.get("ok") else 1)
+    folded = node_cpu_profile(
+        node_id, duration_s=args.duration, address=address
+    )
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(folded)
+        print(f"wrote collapsed stacks to {args.output} "
+              f"(feed to flamegraph.pl / speedscope)")
+    else:
+        print(folded)
+
+
 def cmd_stack(args):
     """Per-node all-thread stack dumps (reference: ``ray stack``)."""
     from ray_tpu.util.debug import get_cluster_stacks
@@ -295,6 +329,19 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("what", choices=["tasks", "actors", "nodes"])
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser(
+        "profile", help="CPU flamegraph sampling / XLA trace capture"
+    )
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--node-id", default=None,
+                    help="default: first alive node")
+    sp.add_argument("--duration", type=float, default=5.0)
+    sp.add_argument("--xla", action="store_true",
+                    help="capture an XLA/TPU profiler trace instead")
+    sp.add_argument("--output", "-o", default=None,
+                    help="collapsed-stacks file (cpu) or trace dir (xla)")
+    sp.set_defaults(fn=cmd_profile)
 
     sp = sub.add_parser("logs", help="tail buffered worker logs")
     sp.add_argument("--address", default=None)

@@ -26,11 +26,16 @@ from ray_tpu.serve.deployment import (
     DeploymentConfig,
     deployment,
 )
-from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.handle import (
+    BackPressureError,
+    DeploymentHandle,
+    DeploymentResponse,
+)
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.replica import GangContext, batch, get_gang_context
 
 __all__ = [
+    "BackPressureError",
     "Application",
     "AutoscalingConfig",
     "Deployment",
@@ -104,6 +109,7 @@ def _collect_specs(app: Application, specs: Dict[str, dict],
         "init_kwargs": init_kwargs,
         "num_replicas": cfg.num_replicas,
         "max_ongoing": cfg.max_ongoing_requests,
+        "max_queued": cfg.max_queued_requests,
         "actor_options": cfg.ray_actor_options,
         "user_config": cfg.user_config,
         "autoscaling": asc,

@@ -144,6 +144,35 @@ class ServeController:
             return []
         return [r["actor"] for r in st.replicas]
 
+    def get_router_info(self, deployment: str) -> dict:
+        """Everything a handle router needs in ONE call: the replica
+        handles plus routing config (load-shed cap)."""
+        st = self._deployments.get(deployment)
+        if st is None:
+            return {"handles": [], "max_queued": -1, "max_ongoing": 16}
+        mq = st.spec.get("max_queued")
+        return {
+            "handles": [r["actor"] for r in st.replicas],
+            # no `or -1`: an explicit 0 (reject-all/drain) must survive
+            "max_queued": -1 if mq is None else int(mq),
+            "max_ongoing": int(st.spec.get("max_ongoing", 16)),
+        }
+
+    def _publish_replica_change(self, name: str):
+        """Push-invalidate every handle's cached replica set (the
+        long-poll fan-out analog, reference: serve/_private/long_poll.py
+        — here a head pubsub message; handles re-fetch on receipt instead
+        of polling at a tight interval)."""
+        try:
+            from ray_tpu._private.worker import get_global_worker
+
+            get_global_worker().gcs.notify(
+                "publish",
+                {"channel": f"serve_replicas:{name}", "data": {}},
+            )
+        except Exception:
+            pass  # push is an optimization; the poll fallback covers it
+
     def get_routes(self) -> Dict[str, str]:
         return dict(self._routes)
 
@@ -189,6 +218,7 @@ class ServeController:
             # that shutdown just killed.
             return
         for st in list(self._deployments.values()):
+            before = [r["id"] for r in st.replicas]
             while len(st.replicas) < st.target_replicas:
                 r = await self._start_replica(st)
                 if r is None:
@@ -198,6 +228,8 @@ class ServeController:
                 await self._stop_replica(st.replicas.pop())
             if st.deleted and not st.replicas:
                 self._deployments.pop(st.name, None)
+            if [r["id"] for r in st.replicas] != before:
+                self._publish_replica_change(st.name)
         # health: drop dead replicas so the loop replaces them. A gang
         # replica is healthy only if EVERY member answers (scale-as-a-unit);
         # a failed gang is torn down whole so its surviving members and the
@@ -217,6 +249,8 @@ class ServeController:
                     alive.append(r)
                 except Exception:
                     await self._stop_replica(r)  # reconcile restarts it
+            if len(alive) != len(st.replicas):
+                self._publish_replica_change(st.name)
             st.replicas = alive
 
     async def _start_replica(self, st: _DeploymentState) -> Optional[dict]:

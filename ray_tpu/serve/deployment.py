@@ -26,6 +26,11 @@ class AutoscalingConfig:
 class DeploymentConfig:
     num_replicas: int = 1
     max_ongoing_requests: int = 16
+    # Handle-side load shedding (reference: Serve max_queued_requests):
+    # when this many requests are already in flight across the handle's
+    # replicas, further submissions raise BackPressureError (the HTTP
+    # proxy maps it to 503) instead of queueing without bound. -1 = off.
+    max_queued_requests: int = -1
     ray_actor_options: Dict[str, Any] = field(default_factory=dict)
     user_config: Optional[dict] = None
     autoscaling_config: Optional[AutoscalingConfig] = None
@@ -94,6 +99,7 @@ class Application:
 
 def deployment(cls_or_fn=None, *, name: Optional[str] = None,
                num_replicas: int = 1, max_ongoing_requests: int = 16,
+               max_queued_requests: int = -1,
                ray_actor_options: Optional[dict] = None,
                user_config: Optional[dict] = None,
                autoscaling_config: Optional[AutoscalingConfig] = None,
@@ -111,6 +117,7 @@ def deployment(cls_or_fn=None, *, name: Optional[str] = None,
         cfg = DeploymentConfig(
             num_replicas=num_replicas,
             max_ongoing_requests=max_ongoing_requests,
+            max_queued_requests=max_queued_requests,
             ray_actor_options=dict(ray_actor_options or {}),
             user_config=user_config,
             autoscaling_config=asc,

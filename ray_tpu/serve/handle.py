@@ -114,8 +114,56 @@ class DeploymentResponse:
         return self._ref
 
 
+class BackPressureError(Exception):
+    """The handle's queue beyond replica capacity exceeds
+    max_queued_requests: the caller should shed load (the HTTP proxy maps
+    this to 503) rather than queue without bound (reference: Serve's
+    BackPressureError)."""
+
+
+class _PushRegistry:
+    """Per-process fanout of serve replica-change pushes to live routers:
+    ONE pubsub handler and ONE subscribe call per channel, routers held
+    weakly (they churn with handle pickling)."""
+
+    def __init__(self):
+        import weakref
+
+        self._lock = threading.Lock()
+        self._channels: Dict[str, Any] = {}  # channel -> WeakSet of routers
+        self._weakset = weakref.WeakSet
+
+    def add(self, router: "_Router"):
+        from ray_tpu._private.worker import get_global_worker
+
+        channel = f"serve_replicas:{router._deployment}"
+        with self._lock:
+            routers = self._channels.get(channel)
+            first = routers is None
+            if first:
+                routers = self._channels[channel] = self._weakset()
+            routers.add(router)
+        if not first:
+            return
+        w = get_global_worker()
+
+        def _invalidate(_data, _frames, _ch=channel):
+            with self._lock:
+                live = list(self._channels.get(_ch, ()))
+            for r in live:
+                r._invalidation_gen += 1
+                r._fetched_at = -10.0  # next pick() re-fetches
+            return None
+
+        w.pubsub_handlers.setdefault(channel, []).append(_invalidate)
+        w.run_sync(w.gcs.call("subscribe", {"channel": channel}))
+
+
+_push_registry = _PushRegistry()
+
+
 class _Router:
-    def __init__(self, deployment: str, refresh_s: float = 1.0):
+    def __init__(self, deployment: str, refresh_s: float = 5.0):
         self._deployment = deployment
         # Globally unique: routers are recreated on every handle unpickle and
         # live in many processes; id(self) would collide across them.
@@ -131,6 +179,17 @@ class _Router:
         # pay nothing).
         self._multiplex = False
         self._model_map: Dict[str, set] = {}
+        # Load-shed cap from the deployment config (-1 = unbounded) and
+        # per-replica execution capacity (queued = inflight - capacity).
+        self._max_queued = -1
+        self._max_ongoing = 16
+        # Bumped by push invalidations; a refresh only stamps itself fresh
+        # when no invalidation arrived while its RPC was in flight.
+        self._invalidation_gen = 0
+        # Push invalidation (long-poll fan-out analog): once subscribed,
+        # a controller replica-change message forces the next pick() to
+        # re-fetch, so the poll interval can stay long.
+        self._subscribed = False
         # Autoscaling signal: refs of requests this handle has issued that
         # haven't completed yet (queued + executing), pushed to the
         # controller (reference: handle-side metrics in _private/router.py →
@@ -224,17 +283,37 @@ class _Router:
             self._controller_handle = ray_tpu.get_actor(CONTROLLER_NAME)
         return self._controller_handle
 
+    def _subscribe_push(self):
+        """Register for controller replica-change pushes on the head
+        pubsub (long-poll fan-out analog). Best-effort: without it the
+        periodic poll still converges. One handler + one subscribe per
+        (process, channel) — routers are re-created on every handle
+        unpickle, so per-router subscriptions would leak handlers and
+        duplicate head-side fanout; the registry holds routers weakly."""
+        if self._subscribed:
+            return
+        self._subscribed = True
+        try:
+            _push_registry.add(self)
+        except Exception:
+            pass
+
     def _refresh(self, force: bool = False):
         now = time.monotonic()
         if not force and now - self._fetched_at < self._refresh_s:
             return
         import ray_tpu
 
+        self._subscribe_push()
         try:
-            handles = ray_tpu.get(
-                self._controller().get_handles.remote(self._deployment),
+            gen = self._invalidation_gen
+            rinfo = ray_tpu.get(
+                self._controller().get_router_info.remote(self._deployment),
                 timeout=30,
             )
+            handles = rinfo["handles"]
+            self._max_queued = rinfo.get("max_queued", -1)
+            self._max_ongoing = rinfo.get("max_ongoing", 16)
         except Exception:
             self._controller_handle = None  # stale after controller restart
             raise
@@ -258,7 +337,10 @@ class _Router:
             for h in handles:
                 self._inflight.setdefault(id(h), 0)
             self._model_map = model_map
-            self._fetched_at = now
+            # A push that landed while the fetch was in flight must win:
+            # keep the invalidated timestamp so the next pick re-fetches.
+            if self._invalidation_gen == gen:
+                self._fetched_at = now
 
     def pick(self, model_id: Optional[str] = None):
         """Power-of-two-choices on locally tracked in-flight counts; with a
@@ -278,6 +360,20 @@ class _Router:
             self._refresh(force=True)
         with self._lock:
             self._drain_settled_locked()  # counts deferred from __del__ paths
+            if self._max_queued >= 0:
+                # Reference semantics: the cap counts requests QUEUED
+                # beyond what the replicas can execute concurrently, not
+                # total in-flight — shedding must not trigger while free
+                # execution slots remain.
+                total = sum(self._inflight.values())
+                capacity = len(self._replicas) * max(self._max_ongoing, 1)
+                if total - capacity >= self._max_queued:
+                    raise BackPressureError(
+                        f"deployment '{self._deployment}': "
+                        f"{total - capacity} queued beyond replica "
+                        f"capacity {capacity} >= max_queued_requests="
+                        f"{self._max_queued}"
+                    )
             pool = self._replicas
             if model_id:
                 holders = self._model_map.get(model_id, ())

@@ -77,6 +77,15 @@ class HTTPProxy:
             resp = handle.remote(payload)
             out = await loop.run_in_executor(None, resp.result, 60)
         except Exception as e:
+            from ray_tpu.serve.handle import BackPressureError
+
+            if isinstance(e, BackPressureError):
+                # saturated replicas: shed load (reference: Serve returns
+                # 503 when max_queued_requests is exceeded)
+                return web.Response(
+                    status=503, text=str(e),
+                    headers={"Retry-After": "1"},
+                )
             return web.Response(status=500, text=f"{type(e).__name__}: {e}")
         if isinstance(out, (bytes, bytearray)):
             return web.Response(body=bytes(out))

@@ -61,6 +61,71 @@ def memory_profile_local(action: str = "snapshot", top: int = 10):
     }
 
 
+def sample_cpu_profile(duration_s: float = 5.0, hz: float = 99.0) -> str:
+    """Sampling CPU profiler (py-spy record analog, reference:
+    ``dashboard/modules/reporter/profile_manager.py``): samples every
+    thread's Python stack at ``hz`` for ``duration_s`` and returns
+    COLLAPSED stacks ("mod:fn;mod:fn ... count" lines) — the folded
+    format flamegraph.pl / speedscope / inferno consume directly. Pure
+    stdlib: the sampler is a thread reading sys._current_frames, so it
+    works identically in any worker we own (~1% overhead at 99Hz)."""
+    import time as _time
+    from collections import Counter
+
+    interval = 1.0 / max(hz, 1.0)
+    counts: Counter = Counter()
+    deadline = _time.monotonic() + max(duration_s, 0.05)
+    me = threading.get_ident()
+    while _time.monotonic() < deadline:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue  # the sampler's own loop is noise
+            stack = []
+            f = frame
+            while f is not None:
+                code = f.f_code
+                stack.append(
+                    f"{code.co_filename.rsplit('/', 1)[-1]}:"
+                    f"{code.co_name}"
+                )
+                f = f.f_back
+            counts[";".join(reversed(stack))] += 1
+        _time.sleep(interval)
+    return "\n".join(f"{k} {v}" for k, v in counts.most_common())
+
+
+def xla_profile_capture(duration_s: float = 3.0,
+                        logdir: Optional[str] = None) -> Dict[str, Any]:
+    """Capture an XLA/TPU profiler trace for ``duration_s`` (the TPU-native
+    profiling the reference never needed): wraps
+    ``jax.profiler.start_trace/stop_trace``, producing a TensorBoard-/
+    xprof-readable trace dir with device timelines, HLO op costs and HBM
+    usage. Runs in the TPU-owning process — call through the node RPC for
+    workers."""
+    import time as _time
+
+    try:
+        import jax
+    except ImportError:
+        return {"ok": False, "error": "jax not importable here"}
+    if logdir is None:
+        import tempfile
+
+        logdir = tempfile.mkdtemp(prefix="rt_xla_trace_")
+    try:
+        jax.profiler.start_trace(logdir)
+        _time.sleep(max(duration_s, 0.1))
+        jax.profiler.stop_trace()
+    except Exception as e:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+    return {"ok": True, "logdir": logdir,
+            "hint": "tensorboard --logdir <logdir>  (profile plugin)"}
+
+
 # ----------------------------------------------------------- cluster-facing
 
 
@@ -77,6 +142,39 @@ def get_cluster_stacks(
     if include_driver:
         out["driver"] = dump_local_stacks()
     return out
+
+
+def node_cpu_profile(
+    node_id: str, duration_s: float = 5.0, hz: float = 99.0,
+    address: Optional[str] = None,
+) -> str:
+    """Sample one node's CPU profile; returns collapsed stacks (write to a
+    .folded file for flamegraph tooling)."""
+    from ray_tpu.util.state import _call
+
+    return _call(
+        "node_debug",
+        {"node_id": node_id, "method": "cpu_profile",
+         "duration_s": duration_s, "hz": hz},
+        address,
+        timeout=duration_s + 60,  # the capture itself takes duration_s
+    ).get("folded", "")
+
+
+def node_xla_profile(
+    node_id: str, duration_s: float = 3.0, logdir: Optional[str] = None,
+    address: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Capture an XLA/TPU trace on the node that owns the chips."""
+    from ray_tpu.util.state import _call
+
+    return _call(
+        "node_debug",
+        {"node_id": node_id, "method": "xla_profile",
+         "duration_s": duration_s, "logdir": logdir},
+        address,
+        timeout=duration_s + 60,  # the capture itself takes duration_s
+    )
 
 
 def node_memory_profile(

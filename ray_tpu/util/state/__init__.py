@@ -10,19 +10,20 @@ from collections import Counter
 from typing import Any, Dict, List, Optional
 
 
-def _call(method: str, header: dict, address: Optional[str] = None):
+def _call(method: str, header: dict, address: Optional[str] = None,
+          timeout: float = 30.0):
     if address is not None:
         from ray_tpu._private.sync_client import SyncHeadClient
 
         client = SyncHeadClient(address)
         try:
-            return client.call(method, header)[0]
+            return client.call(method, header, timeout=timeout)[0]
         finally:
             client.close()
     from ray_tpu._private.worker import get_global_worker
 
     w = get_global_worker()
-    return w.run_sync(w.gcs.call(method, header))[0]
+    return w.run_sync(w.gcs.call(method, header), timeout)[0]
 
 
 def _apply_filters(rows: List[dict], filters) -> List[dict]:

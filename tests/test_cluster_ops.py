@@ -184,6 +184,62 @@ def test_cli_job_submit_wait(standalone_head, capsys):
 # ------------------------------------------------------------- autoscaler
 
 
+def test_autoscaler_bin_packs_mixed_demand(monkeypatch):
+    """Mixed demand shapes pack into the fewest nodes (reference:
+    v2/scheduler.py try_schedule): launched nodes' leftover capacity
+    absorbs later demands, and first-fit-decreasing places big bundles
+    before small ones — no node-per-demand overprovisioning."""
+    from ray_tpu._private import sync_client as sc_mod
+    from ray_tpu.autoscaler import (
+        Autoscaler,
+        AutoscalerConfig,
+        NodeTypeConfig,
+    )
+
+    class FakeClient:
+        def __init__(self, *_a, **_k):
+            pass
+
+        def call(self, method, _h):
+            assert method == "cluster_load"
+            return {
+                "nodes": [],
+                # small demands FIRST: the unsorted order would place them
+                # before the big bundle (worst case for first-fit)
+                "pending": [{"resources": {"CPU": 1.0}, "count": 4}],
+                "pending_pgs": [{"bundles": [{"CPU": 4.0}]}],
+            }, []
+
+        def close(self):
+            pass
+
+    class FakeProvider:
+        def __init__(self):
+            self.created = []
+
+        def create_node(self, tname, resources, labels):
+            self.created.append(tname)
+
+        def non_terminated_nodes(self):
+            return []
+
+        def terminate_node(self, _):
+            pass
+
+    monkeypatch.setattr(sc_mod, "SyncHeadClient", FakeClient)
+    provider = FakeProvider()
+    config = AutoscalerConfig(
+        node_types={
+            "cpu8": NodeTypeConfig(resources={"CPU": 8.0}, max_workers=10),
+        },
+    )
+    scaler = Autoscaler("x:1", config, provider)
+    report = scaler.update()
+    # 4x1 CPU + 1x4 CPU = 8 CPUs: exactly ONE cpu8 node, not one per demand.
+    assert report["launched"] == {"cpu8": 1}, report
+    assert provider.created == ["cpu8"]
+
+
 def test_autoscaler_scales_up_and_down():
     from ray_tpu.autoscaler import (
         Autoscaler,

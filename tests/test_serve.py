@@ -364,3 +364,46 @@ def test_grpc_proxy_streaming(srv):
     ]
     assert chunks == [{"i": 0}, {"i": 1}, {"i": 2}, {"i": 3}]
     chan.close()
+
+
+@pytest.mark.parametrize("rt_start", [{"num_cpus": 8}], indirect=True)
+def test_max_queued_requests_sheds_load(srv):
+    """Handle-side load shedding (reference: Serve max_queued_requests ->
+    BackPressureError / HTTP 503): once the in-flight cap is reached,
+    further submissions fail fast instead of queueing unboundedly."""
+    @serve.deployment(num_replicas=1, max_ongoing_requests=1,
+                      max_queued_requests=2)
+    class Slow:
+        def __call__(self, x):
+            time.sleep(3)
+            return x
+
+    handle = serve.run(Slow.bind(), name="slow_app")
+    admitted = [handle.remote(i) for i in range(2)]
+    with pytest.raises(serve.BackPressureError, match="max_queued"):
+        for i in range(10):  # cap must trip within the window
+            admitted.append(handle.remote(100 + i))
+    # The admitted requests still complete: shedding, not failure.
+    assert admitted[0].result(timeout=30) == 0
+
+
+@pytest.mark.parametrize("rt_start", [{"num_cpus": 8}], indirect=True)
+def test_replica_change_push_invalidates_handles(srv):
+    """Scaling a deployment pushes a replica-change message (long-poll
+    fan-out analog); handles re-fetch on the NEXT call instead of waiting
+    out the slow poll interval."""
+    @serve.deployment(num_replicas=1)
+    def f(x):
+        return x
+
+    handle = serve.run(f.bind(), name="scale_app")
+    assert handle.remote(1).result(timeout=30) == 1
+    router = handle._router
+    assert len(router._replicas) == 1
+    # Scale 1 -> 3; the push must invalidate well before the 5s poll.
+    serve.run(f.options(num_replicas=3).bind(), name="scale_app")
+    deadline = time.monotonic() + 4.0
+    while time.monotonic() < deadline and len(router._replicas) < 3:
+        handle.remote(2).result(timeout=30)  # pick() applies invalidation
+        time.sleep(0.1)
+    assert len(router._replicas) == 3, "push invalidation never landed"
