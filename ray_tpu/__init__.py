@@ -129,167 +129,222 @@ def init(
             if ignore_reinit_error:
                 return ClientContext(_worker_mod.global_worker)
             raise RuntimeError("ray_tpu.init() called twice")
-        if _system_config:
-            # Cluster-wide config overrides (reference: _system_config on
-            # the raylet/GCS command line, gcs_server.h:72). Installed here
-            # for the driver + in-process head; propagated to spawned
-            # nodes as RT_* env vars via _node_env below, and published to
-            # the head KV so workers that CONNECT later (remote clusters,
-            # head-restart rejoin) apply them at registration.
-            from ray_tpu._private.config import rt_config
-
-            rt_config.apply_system_config(_system_config)
-            _node_env = dict(
-                rt_config.system_config_env(), **(_node_env or {})
+        try:
+            return _init_locked(
+                address, num_cpus, num_nodes, resources, labels,
+                _node_env, _system_config,
             )
-        # Resolve the head address like the reference's RAY_ADDRESS/"auto":
-        # env var (set for submitted jobs), then the head's address file.
-        if address is None:
-            address = os.environ.get("RAY_TPU_ADDRESS")
-        if address == "auto":
+        except BaseException:
+            # A failed start (node registration timeout, port in use, ...)
+            # must not leave half a cluster behind: the NEXT init would
+            # die on 'called twice' and every later caller cascades.
+            _cleanup_failed_init()
+            raise
+
+
+def _teardown_globals():
+    """The ONE teardown path (shutdown() and failed-init cleanup both use
+    it — two copies would drift): best-effort, tolerant of half-started
+    state in any field."""
+    global _cluster, _head, _token_set_by_init
+    if _cluster is not None:
+        try:
+            _cluster.shutdown()
+        except Exception:
+            pass
+        _cluster = None
+    w = _worker_mod.global_worker
+    if w is not None:
+        try:
+            w.shutdown()
+        except Exception:
+            pass
+    _worker_mod.global_worker = None
+    _head = None
+    if _token_set_by_init:
+        # A token THIS process minted/adopted dies with the cluster: a
+        # later init against a different head must not present it (the
+        # rejection is an opaque ConnectionLost). User-provided tokens
+        # are left alone.
+        os.environ.pop("RT_AUTH_TOKEN", None)
+        _token_set_by_init = False
+
+
+def _cleanup_failed_init():
+    _teardown_globals()
+
+
+def _init_locked(address, num_cpus, num_nodes, resources, labels,
+                 _node_env, _system_config):
+    global _cluster, _head, _token_set_by_init
+    if _system_config:
+        # Cluster-wide config overrides (reference: _system_config on
+        # the raylet/GCS command line, gcs_server.h:72). Installed here
+        # for the driver + in-process head; propagated to spawned
+        # nodes as RT_* env vars via _node_env below, and published to
+        # the head KV so workers that CONNECT later (remote clusters,
+        # head-restart rejoin) apply them at registration.
+        from ray_tpu._private.config import rt_config
+
+        rt_config.apply_system_config(_system_config)
+        _node_env = dict(
+            rt_config.system_config_env(), **(_node_env or {})
+        )
+    # Resolve the head address like the reference's RAY_ADDRESS/"auto":
+    # env var (set for submitted jobs), then the head's address file.
+    if address is None:
+        address = os.environ.get("RAY_TPU_ADDRESS")
+    if address == "auto":
+        from ray_tpu._private.head_main import read_address_file
+
+        info = read_address_file()
+        if info is None:
+            raise ConnectionError(
+                "address='auto' but no running head found "
+                "(start one with `raytpu start --head`)"
+            )
+        address = info["address"]
+        from ray_tpu._private import auth as _auth
+
+        if _auth.adopt_token(info):
+            _token_set_by_init = True
+    job_id = JobID.from_random()
+    if address is None:
+        # Session dir: per-cluster scratch for worker log files (and
+        # anything else session-scoped). Spawned nodes learn it via
+        # RT_SESSION_DIR (reference: the ray session_latest dir).
+        session_dir = os.environ.get("RT_SESSION_DIR")
+        if not session_dir:
+            session_dir = os.path.join(
+                "/tmp/ray_tpu",
+                f"session_{int(time.time())}_{os.getpid()}",
+            )
+        os.makedirs(session_dir, exist_ok=True)
+        _prune_old_sessions(keep=5, active=session_dir)
+        # Cluster auth token (reference: src/ray/rpc/authentication/):
+        # minted per cluster; spawned nodes inherit it via the env and
+        # every TCP plane requires it as the connection's first
+        # message. RT_AUTH_TOKEN= (empty) disables.
+        from ray_tpu._private import auth as _auth
+
+        if _auth.ensure_cluster_token():
+            _token_set_by_init = True
+        _node_env = dict(_node_env or {}, RT_SESSION_DIR=session_dir)
+        head = HeadService()
+        driver = CoreWorker(
+            is_driver=True,
+            gcs_addr=("127.0.0.1", 0),  # patched after head start
+            job_id=job_id,
+            head=head,
+        )
+        # Globals are assigned BEFORE boot so a mid-boot failure (e.g. the
+        # ready-wait timeout) gives the cleanup path something to tear
+        # down — otherwise the core-loop thread + head would leak.
+        _worker_mod.global_worker = driver
+        _head = head
+        # Start head + driver service on one core loop.
+        ready = threading.Event()
+        boot_err: List[BaseException] = []
+
+        def runner():
+            import asyncio
+
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            driver.loop = loop
+
+            async def boot():
+                addr = await head.start()
+                driver.gcs_addr = addr
+                await driver._async_setup()
+
+            try:
+                loop.run_until_complete(boot())
+            except BaseException as e:  # surface boot failures to caller
+                boot_err.append(e)
+                ready.set()
+                return
+            ready.set()
+            loop.run_forever()
+
+        t = threading.Thread(target=runner, name="rt-core-loop", daemon=True)
+        t.start()
+        driver.loop_thread = t
+        if not ready.wait(timeout=30):
+            raise RuntimeError("head service failed to start")
+        if boot_err:
+            raise boot_err[0]
+        driver._install_ref_hooks()
+        _cluster = LocalCluster(
+            head, driver.gcs_addr, job_id, driver,
+            session_dir=session_dir,
+        )
+        n_cpus = num_cpus if num_cpus is not None else (os.cpu_count() or 1)
+        node_res = dict(resources or {})
+        node_res["CPU"] = float(n_cpus)
+        # Accelerator autodetection (reference: _private/accelerators/):
+        # explicit resources always win; detection fills the gaps — and
+        # only on ONE simulated node, since all num_nodes processes share
+        # this machine's physical chips.
+        from ray_tpu._private.accelerators import (
+            detect_node_accelerators,
+            detect_node_labels,
+        )
+
+        accel_res = {
+            k: v for k, v in detect_node_accelerators().items()
+            if k not in node_res
+        }
+        accel_labels = detect_node_labels()
+        for i in range(num_nodes):
+            res_i = dict(node_res)
+            labels_i = dict(labels or {})
+            if i == 0:
+                res_i.update(accel_res)
+                labels_i = {**accel_labels, **labels_i}
+            _cluster.add_node(
+                res_i, labels=labels_i, env=_node_env, wait=False
+            )
+        # 120s: a node spawn is ~2-4s cold, but a loaded single-core host
+        # (CI running a whole suite) can stretch it past the old 30s —
+        # and a timeout here used to strand half-initialized state.
+        _cluster.wait_for_nodes(num_nodes, timeout=120.0)
+    else:
+        # Explicit address on the head's own machine: the local
+        # address file supplies the token (the `connect with:` hint
+        # raytpu start prints must work in a fresh shell). Remote
+        # drivers set RT_AUTH_TOKEN themselves.
+        if "RT_AUTH_TOKEN" not in os.environ:
+            from ray_tpu._private import auth as _auth
             from ray_tpu._private.head_main import read_address_file
 
-            info = read_address_file()
-            if info is None:
-                raise ConnectionError(
-                    "address='auto' but no running head found "
-                    "(start one with `raytpu start --head`)"
-                )
-            address = info["address"]
-            from ray_tpu._private import auth as _auth
+            finfo = read_address_file()
+            if finfo and finfo.get("address") == address:
+                if _auth.adopt_token(finfo):
+                    _token_set_by_init = True
+        host, port = address.rsplit(":", 1)
+        driver = CoreWorker(
+            is_driver=True, gcs_addr=(host, int(port)), job_id=job_id
+        )
+        # assigned before start: a mid-connect failure must be cleanable
+        _worker_mod.global_worker = driver
+        driver.start_driver()
+    if _system_config:
+        # Publish to the head KV so later-connecting workers (remote
+        # clusters, rejoin after head restart) apply the overrides at
+        # registration (_connect_gcs reads __rt/system_config).
+        import json as _json
 
-            if _auth.adopt_token(info):
-                _token_set_by_init = True
-        job_id = JobID.from_random()
-        if address is None:
-            # Session dir: per-cluster scratch for worker log files (and
-            # anything else session-scoped). Spawned nodes learn it via
-            # RT_SESSION_DIR (reference: the ray session_latest dir).
-            session_dir = os.environ.get("RT_SESSION_DIR")
-            if not session_dir:
-                session_dir = os.path.join(
-                    "/tmp/ray_tpu",
-                    f"session_{int(time.time())}_{os.getpid()}",
-                )
-            os.makedirs(session_dir, exist_ok=True)
-            _prune_old_sessions(keep=5, active=session_dir)
-            # Cluster auth token (reference: src/ray/rpc/authentication/):
-            # minted per cluster; spawned nodes inherit it via the env and
-            # every TCP plane requires it as the connection's first
-            # message. RT_AUTH_TOKEN= (empty) disables.
-            from ray_tpu._private import auth as _auth
+        w = _worker_mod.global_worker
+        w.run_sync(w.gcs.call(
+            "kv_put", {"ns": "__rt", "key": "system_config"},
+            [_json.dumps(_system_config).encode()],
+        ))
+    atexit.register(shutdown)
+    from ray_tpu._private.usage_stats import record_session_start
 
-            if _auth.ensure_cluster_token():
-                _token_set_by_init = True
-            _node_env = dict(_node_env or {}, RT_SESSION_DIR=session_dir)
-            head = HeadService()
-            driver = CoreWorker(
-                is_driver=True,
-                gcs_addr=("127.0.0.1", 0),  # patched after head start
-                job_id=job_id,
-                head=head,
-            )
-            # Start head + driver service on one core loop.
-            ready = threading.Event()
-            boot_err: List[BaseException] = []
-
-            def runner():
-                import asyncio
-
-                loop = asyncio.new_event_loop()
-                asyncio.set_event_loop(loop)
-                driver.loop = loop
-
-                async def boot():
-                    addr = await head.start()
-                    driver.gcs_addr = addr
-                    await driver._async_setup()
-
-                try:
-                    loop.run_until_complete(boot())
-                except BaseException as e:  # surface boot failures to caller
-                    boot_err.append(e)
-                    ready.set()
-                    return
-                ready.set()
-                loop.run_forever()
-
-            t = threading.Thread(target=runner, name="rt-core-loop", daemon=True)
-            t.start()
-            driver.loop_thread = t
-            if not ready.wait(timeout=30):
-                raise RuntimeError("head service failed to start")
-            if boot_err:
-                raise boot_err[0]
-            driver._install_ref_hooks()
-            _worker_mod.global_worker = driver
-            _head = head
-            _cluster = LocalCluster(
-                head, driver.gcs_addr, job_id, driver,
-                session_dir=session_dir,
-            )
-            n_cpus = num_cpus if num_cpus is not None else (os.cpu_count() or 1)
-            node_res = dict(resources or {})
-            node_res["CPU"] = float(n_cpus)
-            # Accelerator autodetection (reference: _private/accelerators/):
-            # explicit resources always win; detection fills the gaps — and
-            # only on ONE simulated node, since all num_nodes processes share
-            # this machine's physical chips.
-            from ray_tpu._private.accelerators import (
-                detect_node_accelerators,
-                detect_node_labels,
-            )
-
-            accel_res = {
-                k: v for k, v in detect_node_accelerators().items()
-                if k not in node_res
-            }
-            accel_labels = detect_node_labels()
-            for i in range(num_nodes):
-                res_i = dict(node_res)
-                labels_i = dict(labels or {})
-                if i == 0:
-                    res_i.update(accel_res)
-                    labels_i = {**accel_labels, **labels_i}
-                _cluster.add_node(
-                    res_i, labels=labels_i, env=_node_env, wait=False
-                )
-            _cluster.wait_for_nodes(num_nodes)
-        else:
-            # Explicit address on the head's own machine: the local
-            # address file supplies the token (the `connect with:` hint
-            # raytpu start prints must work in a fresh shell). Remote
-            # drivers set RT_AUTH_TOKEN themselves.
-            if "RT_AUTH_TOKEN" not in os.environ:
-                from ray_tpu._private import auth as _auth
-                from ray_tpu._private.head_main import read_address_file
-
-                finfo = read_address_file()
-                if finfo and finfo.get("address") == address:
-                    if _auth.adopt_token(finfo):
-                        _token_set_by_init = True
-            host, port = address.rsplit(":", 1)
-            driver = CoreWorker(
-                is_driver=True, gcs_addr=(host, int(port)), job_id=job_id
-            )
-            driver.start_driver()
-            _worker_mod.global_worker = driver
-        if _system_config:
-            # Publish to the head KV so later-connecting workers (remote
-            # clusters, rejoin after head restart) apply the overrides at
-            # registration (_connect_gcs reads __rt/system_config).
-            import json as _json
-
-            w = _worker_mod.global_worker
-            w.run_sync(w.gcs.call(
-                "kv_put", {"ns": "__rt", "key": "system_config"},
-                [_json.dumps(_system_config).encode()],
-            ))
-        atexit.register(shutdown)
-        from ray_tpu._private.usage_stats import record_session_start
-
-        record_session_start(extra={"mode": "connect" if address else "local"})
-        return ClientContext(driver)
+    record_session_start(extra={"mode": "connect" if address else "local"})
+    return ClientContext(driver)
 
 
 class ClientContext:
@@ -308,24 +363,10 @@ class ClientContext:
 
 
 def shutdown():
-    global _cluster, _head, _token_set_by_init
     atexit.unregister(shutdown)
-    w = _worker_mod.global_worker
-    if w is None:
+    if _worker_mod.global_worker is None and _cluster is None:
         return
-    if _cluster is not None:
-        _cluster.shutdown()
-        _cluster = None
-    w.shutdown()
-    _head = None
-    _worker_mod.global_worker = None
-    if _token_set_by_init:
-        # A token THIS process minted/adopted dies with the cluster: a
-        # later init against a different head must not present it (the
-        # rejection is an opaque ConnectionLost). User-provided tokens
-        # are left alone.
-        os.environ.pop("RT_AUTH_TOKEN", None)
-        _token_set_by_init = False
+    _teardown_globals()
 
 
 def remote(*args, **kwargs):

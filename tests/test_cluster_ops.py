@@ -512,3 +512,24 @@ def test_head_restart_live_rejoin(tmp_path):
                 proc.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 proc.kill()
+
+
+def test_failed_init_cleans_up_and_next_init_works(monkeypatch):
+    """A failed start (e.g. node-registration timeout) must not strand
+    half-initialized global state: the next init() must work, not die on
+    'called twice' (this cascade once took out 140 suite tests)."""
+    from ray_tpu._private import node as node_mod
+
+    def boom(self, count, timeout=30.0):
+        raise TimeoutError("forced registration timeout")
+
+    monkeypatch.setattr(node_mod.LocalCluster, "wait_for_nodes", boom)
+    with pytest.raises(TimeoutError):
+        ray_tpu.init(num_cpus=1, num_nodes=1)
+    assert not ray_tpu.is_initialized()
+    monkeypatch.undo()
+    ray_tpu.init(num_cpus=1, num_nodes=1)
+    try:
+        assert ray_tpu.get(ray_tpu.put(7)) == 7
+    finally:
+        ray_tpu.shutdown()
