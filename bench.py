@@ -189,10 +189,51 @@ def bench_train_tokens_per_sec(quick: bool = False):
             pass
         if not quick:
             try:
-                out.update(bench_train_medium())
+                # In-process first (works wherever HBM suffices, and is
+                # the only option on TPU VMs whose libtpu grants exclusive
+                # device ownership to this process). If the small leg's
+                # resident HBM starves it (RESOURCE_EXHAUSTED observed on
+                # 16GB chips), retry in a FRESH process: clean HBM, ~10s
+                # jax import, compile from the persistent cache.
+                med = bench_train_medium()
+                if "gpt2_medium_error" in med:
+                    sub = _bench_train_medium_subprocess()
+                    if "gpt2_medium_error" not in sub:
+                        med = sub
+                    else:
+                        med["gpt2_medium_error"] += (
+                            " | subprocess: " + sub["gpt2_medium_error"]
+                        )
+                out.update(med)
             except Exception as e:
                 out["gpt2_medium_error"] = f"{type(e).__name__}: {e}"
     return out
+
+
+def _bench_train_medium_subprocess():
+    import subprocess
+    import sys
+
+    code = (
+        "import json, bench\n"
+        "print('RTMED' + json.dumps(bench.bench_train_medium()))\n"
+    )
+    # 1200s: room for one cold ~500s tunnel compile + fast-fail rungs +
+    # the timed steps, while fitting inside the 1800s train watchdog.
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=1200,
+        cwd=os.path.dirname(os.path.abspath(__file__)),  # axon needs this cwd
+    )
+    for line in proc.stdout.splitlines():
+        if line.startswith("RTMED"):
+            return json.loads(line[len("RTMED"):])
+    return {
+        "gpt2_medium_error": (
+            f"medium subprocess rc={proc.returncode}: "
+            f"{proc.stderr[-300:]}"
+        )
+    }
 
 
 def bench_train_medium():
@@ -218,6 +259,7 @@ def bench_train_medium():
     T, steps = 1024, 10
     opt = OptimizerConfig().build()
     rng = np.random.RandomState(0)
+    errors = []
     for B, remat in ((32, False), (32, True), (16, False), (16, True)):
         config = gpt2.GPT2Config(
             vocab_size=50304, max_seq_len=1024, num_layers=24, num_heads=16,
@@ -249,9 +291,10 @@ def bench_train_medium():
                 "gpt2_medium_remat": remat,
                 "gpt2_medium_batch": B,
             }
-        except Exception:
+        except Exception as e:
+            errors.append(f"B{B}/remat{remat}: {type(e).__name__}: {e}"[:300])
             continue
-    return {"gpt2_medium_error": "no medium config compiled/ran"}
+    return {"gpt2_medium_error": " | ".join(errors) or "no config tried"}
 
 
 def bench_reference_jax_step(quick: bool = False):
@@ -364,25 +407,32 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true")
     parser.add_argument("--no-train", action="store_true")
+    parser.add_argument("--train-only", action="store_true",
+                        help="skip the core cluster benchmarks (debugging)")
     args = parser.parse_args()
 
     import os
 
-    import ray_tpu
-    from ray_tpu._private.perf import run_core_benchmarks
+    # Sentinel, not 0.0: a --train-only line must never read as a real
+    # throughput collapse to anything parsing the headline contract.
+    core = {"single_client_tasks_async_per_s": None, "core_skipped": True}
+    if not args.train_only:
+        import ray_tpu
+        from ray_tpu._private.perf import run_core_benchmarks
 
-    # Scale worker processes to the machine: task execution is GIL-bound per
-    # process, so on many-core hosts (TPU VMs have ~100 vCPUs) throughput
-    # comes from multiple node processes. On tiny CI hosts stay small.
-    cores = os.cpu_count() or 1
-    if cores >= 8:
-        ray_tpu.init(num_cpus=4, num_nodes=min(cores // 4, 8))
-    else:
-        ray_tpu.init(num_cpus=max(cores, 2), num_nodes=1)
-    try:
-        core = run_core_benchmarks(quick=args.quick)
-    finally:
-        ray_tpu.shutdown()
+        # Scale worker processes to the machine: task execution is
+        # GIL-bound per process, so on many-core hosts (TPU VMs have ~100
+        # vCPUs) throughput comes from multiple node processes. On tiny CI
+        # hosts stay small.
+        cores = os.cpu_count() or 1
+        if cores >= 8:
+            ray_tpu.init(num_cpus=4, num_nodes=min(cores // 4, 8))
+        else:
+            ray_tpu.init(num_cpus=max(cores, 2), num_nodes=1)
+        try:
+            core = run_core_benchmarks(quick=args.quick)
+        finally:
+            ray_tpu.shutdown()
 
     extra = {}
     if not args.no_train:
@@ -406,9 +456,12 @@ def main():
     value = core["single_client_tasks_async_per_s"]
     result = {
         "metric": "single_client_tasks_async",
-        "value": round(value, 1),
+        "value": round(value, 1) if value is not None else None,
         "unit": "tasks/s",
-        "vs_baseline": round(value / BASELINE_TASKS_ASYNC, 3),
+        "vs_baseline": (
+            round(value / BASELINE_TASKS_ASYNC, 3)
+            if value is not None else None
+        ),
         **{
             k: (round(v, 2) if isinstance(v, float) else v)
             for k, v in core.items()
