@@ -826,6 +826,10 @@ class CoreWorker:
             return items
         eligible = []
         leftovers = []
+        # Consecutive same-actor calls from one caller execute as ONE pool
+        # submission with one batched reply (the n:n actor-burst shape);
+        # anything the run path declines falls through per-item.
+        items = self._coalesce_actor_runs(items, rconn)
         for h, frames in items:
             if (
                 h.get("m") != "push_task"
@@ -862,6 +866,171 @@ class CoreWorker:
                     dq.clear()
                 break
         return leftovers
+
+    def _coalesce_actor_runs(self, items, rconn):
+        """Group consecutive eligible actor calls (same actor, same
+        caller, in-seq, plain sync method on a serial group-less actor)
+        into single pool submissions with ONE batched reply each; returns
+        the items NOT consumed by a run. Per-caller FIFO is preserved:
+        a run executes sequentially on the actor's serial pool exactly as
+        the per-item submissions would have."""
+        out = []
+        i = 0
+        n = len(items)
+        while i < n:
+            h, fr = items[i]
+            if h.get("m") != "push_actor_task":
+                out.append(items[i])
+                i += 1
+                continue
+            run = [items[i]]
+            j = i + 1
+            while j < n:
+                h2 = items[j][0]
+                if (
+                    h2.get("m") != "push_actor_task"
+                    or h2.get("aid") != h.get("aid")
+                    or h2.get("caller") != h.get("caller")
+                ):
+                    break
+                run.append(items[j])
+                j += 1
+            if len(run) >= 2 and self._try_submit_actor_run(run, rconn):
+                i = j
+            else:
+                # Whole run falls to per-item dispatch: retrying suffixes
+                # head-by-head would rescan the same headers O(n^2) on the
+                # pump thread.
+                out.extend(run)
+                i = j
+        return out
+
+    def _try_submit_actor_run(self, run, rconn) -> bool:
+        """Admit a whole same-(actor, caller) run atomically: every call
+        must pass the per-item fast-path gates AND the seqs must be
+        exactly consecutive from the caller's cursor. Any mismatch rejects
+        the WHOLE run (per-item dispatch handles it) — partial admission
+        would reorder."""
+        h0 = run[0][0]
+        inst = self.hosted_actors.get(h0.get("aid"))
+        if inst is None or inst.exiting or inst.max_concurrency != 1 \
+                or inst.groups:
+            return False
+        methods = []
+        for h, _fr in run:
+            if (
+                h.get("nret", 1) != 1
+                or h.get("argrefs")
+                or h.get("borrows")
+                or h.get("trace")
+                or h.get("cg")
+                or h.get("method") == "__rt_apply__"
+                or h.get("seq", 0) <= 0
+            ):
+                return False
+            method = getattr(inst.instance, h.get("method", ""), None)
+            if method is None or asyncio.iscoroutinefunction(method):
+                return False
+            methods.append(method)
+        caller = h0.get("caller", "")
+        with inst.seq_lock:
+            nxt = inst.next_seq.setdefault(caller, 1)
+            for k, (h, _fr) in enumerate(run):
+                if h.get("seq") != nxt + k:
+                    return False
+            try:
+                inst.pool.submit(
+                    self._ring_execute_actor_chunk, inst, methods, run,
+                    rconn,
+                )
+            except RuntimeError:
+                return False  # pool shut down (actor being killed)
+            inst.next_seq[caller] = nxt + len(run)
+            ev = inst.buffered.get(caller, {}).pop(nxt + len(run), None)
+        if ev is not None:
+            try:
+                self.loop.call_soon_threadsafe(ev.set)
+            except RuntimeError:
+                pass
+        return True
+
+    def _ring_execute_actor_chunk(self, inst, methods, run, rconn):
+        """Execute an admitted actor run sequentially on the actor's
+        serial pool; small results coalesce into one batched reply.
+        SystemExit (exit_actor) mid-run follows the per-item protocol for
+        that call and fails the remainder the way per-item dispatch would
+        have (actor exiting -> ActorMissing)."""
+        subs = []
+        counts = []
+        out: List[bytes] = []
+        exited = False
+        for method, (h, frames) in zip(methods, run):
+            # inst.exiting: a concurrent ray-kill must stop the rest of
+            # the run the way it would have cancelled still-queued
+            # per-item futures.
+            if exited or inst.exiting:
+                subs.append(
+                    {"i": h["i"], "e": "ActorMissing: actor exited"}
+                )
+                counts.append(0)
+                continue
+            t0 = time.time()
+            try:
+                arg_slots, plain, kwargs = self.ctx.deserialize_frames(
+                    frames
+                )
+                args = [plain[i] for _k, i in arg_slots]
+                self.current_task_id.value = TaskID.from_hex(h["tid"])
+                self.current_actor_id.value = h["aid"]
+                self.put_counter.value = 0
+                try:
+                    ok, result = True, method(*args, **kwargs)
+                except SystemExit:
+                    self.hosted_actors.pop(h["aid"], None)
+                    inst.exiting = True
+                    self.gcs.notify(
+                        "actor_exited",
+                        {"actor_id": h["aid"], "clean": True,
+                         "reason": "exit_actor"},
+                    )
+                    subs.append(
+                        {"i": h["i"], "e": "ActorMissing: actor exited"}
+                    )
+                    counts.append(0)
+                    exited = True
+                    continue
+                except Exception as e:
+                    ok, result = False, (e, traceback.format_exc())
+            except Exception as e:
+                ok, result = False, (e, traceback.format_exc())
+            try:
+                rets, out_frames, big = self._package_result_parts(
+                    h, ok, result
+                )
+            except Exception as e:
+                logger.exception("actor chunk reply packaging failed")
+                subs.append(
+                    {"i": h["i"], "e": f"reply packaging failed: {e!r}"}
+                )
+                counts.append(0)
+                continue
+            finally:
+                inst.num_executed += 1
+                self._record_task_event({
+                    "task_id": h["tid"], "name": h["method"],
+                    "type": "ACTOR_TASK", "actor_id": h["aid"],
+                    "state": "FINISHED" if ok else "FAILED",
+                    "start_time": t0, "end_time": time.time(),
+                    "node_id": self.node_id,
+                })
+            if big:
+                self._ring_reply_packaged(h, rets, out_frames, big, rconn)
+            else:
+                subs.append({"i": h["i"], "rets": rets})
+                counts.append(len(out_frames))
+                out.extend(out_frames)
+        if subs:
+            rconn.send_reply_batch(subs, counts, out)
 
     def _ring_execute_one(self, fn, h, frames):
         """The fast-path per-task execution core, shared by the batched and
