@@ -21,6 +21,18 @@ from ray_tpu.rllib.algorithms import (
     PPOConfig,
     SAC,
     SACConfig,
+    TQC,
+    TQCConfig,
+)
+from ray_tpu.rllib.connectors import (
+    ClipActions,
+    ClipObs,
+    ConnectorPipelineV2,
+    ConnectorV2,
+    FlattenObs,
+    FrameStack,
+    MeanStdFilter,
+    RescaleActions,
 )
 from ray_tpu.rllib.env_runner import EnvRunnerGroup, SingleAgentEnvRunner
 from ray_tpu.rllib.learner import Learner, LearnerHyperparams
@@ -32,7 +44,10 @@ __all__ = [
     "IQLConfig",
     "Algorithm", "AlgorithmConfig", "make_trainable",
     "PPO", "PPOConfig", "IMPALA", "IMPALAConfig", "DQN", "DQNConfig",
-    "SAC", "SACConfig", "MARWIL", "MARWILConfig", "BC", "BCConfig",
+    "SAC", "SACConfig", "TQC", "TQCConfig",
+    "MARWIL", "MARWILConfig", "BC", "BCConfig",
+    "ConnectorV2", "ConnectorPipelineV2", "MeanStdFilter", "FlattenObs",
+    "ClipObs", "FrameStack", "ClipActions", "RescaleActions",
     "EnvRunnerGroup", "SingleAgentEnvRunner",
     "Learner", "LearnerHyperparams",
 ]

@@ -45,6 +45,10 @@ class AlgorithmConfig:
         self.seed = 0
         self.mesh = None  # jax Mesh for the learner SPMD step (data axis)
         self.hp = LearnerHyperparams()
+        # ConnectorV2 pipeline factories (zero-arg callables returning a
+        # ConnectorPipelineV2 / ConnectorV2); see ray_tpu/rllib/connectors.py
+        self.env_to_module_connector: Optional[Callable] = None
+        self.module_to_env_connector: Optional[Callable] = None
 
     # builder sections -----------------------------------------------------
 
@@ -57,13 +61,19 @@ class AlgorithmConfig:
 
     def env_runners(self, *, num_env_runners: Optional[int] = None,
                     num_envs_per_env_runner: Optional[int] = None,
-                    rollout_fragment_length: Optional[int] = None):
+                    rollout_fragment_length: Optional[int] = None,
+                    env_to_module_connector: Optional[Callable] = None,
+                    module_to_env_connector: Optional[Callable] = None):
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
         if num_envs_per_env_runner is not None:
             self.num_envs_per_runner = num_envs_per_env_runner
         if rollout_fragment_length is not None:
             self.rollout_fragment_length = rollout_fragment_length
+        if env_to_module_connector is not None:
+            self.env_to_module_connector = env_to_module_connector
+        if module_to_env_connector is not None:
+            self.module_to_env_connector = module_to_env_connector
         return self
 
     def training(self, **kwargs):
@@ -111,6 +121,8 @@ class Algorithm:
             config.get_env_creator(), config.num_env_runners,
             config.num_envs_per_runner, config.rollout_fragment_length,
             self.module_config, seed=config.seed, gamma=config.hp.gamma,
+            env_to_module=config.env_to_module_connector,
+            module_to_env=config.module_to_env_connector,
         )
         self.runner_group.sync_weights(self.learner.get_weights())
 
@@ -158,6 +170,12 @@ class Algorithm:
     def train(self) -> Dict[str, Any]:
         t0 = time.perf_counter()
         metrics = self.training_step()
+        # Merge + rebroadcast stateful connector statistics (MeanStdFilter
+        # etc.) so every runner normalizes identically next iteration.
+        # getattr: custom runner groups (multi-agent shim) predate the hook.
+        sync_conn = getattr(self.runner_group, "sync_connector_states", None)
+        if sync_conn is not None:
+            sync_conn()
         self.iteration += 1
         ep_returns: List[float] = []
         num_episodes = 0

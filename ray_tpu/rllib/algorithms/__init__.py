@@ -5,9 +5,11 @@ from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.marwil import BC, BCConfig, MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
+from ray_tpu.rllib.algorithms.tqc import TQC, TQCConfig
 
 __all__ = [
     "APPO", "APPOConfig", "CQL", "CQLConfig", "IQL", "IQLConfig",
     "PPO", "PPOConfig", "IMPALA", "IMPALAConfig", "DQN", "DQNConfig",
-    "SAC", "SACConfig", "MARWIL", "MARWILConfig", "BC", "BCConfig",
+    "SAC", "SACConfig", "TQC", "TQCConfig",
+    "MARWIL", "MARWILConfig", "BC", "BCConfig",
 ]

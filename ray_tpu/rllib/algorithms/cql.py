@@ -98,6 +98,8 @@ class _OfflineBase(Algorithm):
                 config.num_envs_per_runner, config.rollout_fragment_length,
                 self.module_config, seed=config.seed,
                 gamma=config.hp.gamma,
+                env_to_module=config.env_to_module_connector,
+                module_to_env=config.module_to_env_connector,
             )
             self.runner_group.sync_weights(jax.device_get(self.pi_params))
         else:

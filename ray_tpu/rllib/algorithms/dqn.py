@@ -171,6 +171,8 @@ class DQN(Algorithm):
             config.get_env_creator(), config.num_env_runners,
             config.num_envs_per_runner, config.rollout_fragment_length,
             self.module_config, seed=config.seed, gamma=hp.gamma,
+            env_to_module=config.env_to_module_connector,
+            module_to_env=config.module_to_env_connector,
         )
         self.runner_group.sync_weights(jax.device_get(self.q_params))
 

@@ -31,6 +31,9 @@ class _NullRunnerGroup:
     def sync_weights(self, params):
         pass
 
+    def sync_connector_states(self):
+        return {}
+
     def stop(self):
         pass
 
@@ -197,6 +200,8 @@ class MARWIL(Algorithm):
                 config.get_env_creator(), config.num_env_runners,
                 config.num_envs_per_runner, config.rollout_fragment_length,
                 self.module_config, seed=config.seed, gamma=hp.gamma,
+                env_to_module=config.env_to_module_connector,
+                module_to_env=config.module_to_env_connector,
             )
             self.runner_group.sync_weights(jax.device_get(self.params))
         else:

@@ -1,105 +1,48 @@
-"""SAC: soft actor-critic for continuous control.
+"""TQC: truncated quantile critics for continuous control.
 
-Reference analog: ``rllib/algorithms/sac/`` (new API stack SAC). Off-policy
-maximum-entropy RL: a tanh-squashed gaussian policy (reparameterized), twin
-Q critics with clipped double-Q targets, polyak-averaged target critics, and
-automatic entropy-temperature tuning toward a target entropy of -action_dim.
-The whole update (critic + actor + alpha) is one jitted program over replay
-minibatches; runners explore with the same squashed-gaussian head via the
-normal weight broadcast.
+Reference analog: ``rllib/algorithms/`` TQC (distributional SAC variant;
+listed in the reference's algorithm roster). Off-policy maximum-entropy RL
+like SAC, but each critic is distributional — it predicts M quantile
+atoms of the return distribution — and the TD target pools the atoms of
+all N target critics, sorts them, and drops the top ``d`` atoms per critic
+before bootstrapping. Truncating the right tail of the pooled mixture is a
+finer-grained overestimation control than SAC's min-of-two-scalars.
+
+Loss is the quantile Huber regression of every predicted atom against every
+kept target atom (taus at quantile midpoints). Actor and temperature updates
+are SAC's, with Q(s,a) read as the mean over all critics' atoms.
+
+The whole update (critics + actor + alpha + polyak) is one jitted program
+over replay minibatches; exploration reuses the squashed-gaussian policy
+head and the SAC replay buffer.
 """
 from __future__ import annotations
 
 from typing import Dict
 
-import numpy as np
-
 from ray_tpu.rllib import module as rl_module
-from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.sac import ContinuousReplayBuffer, SACConfig
 
 
-class ContinuousReplayBuffer:
-    """Flat numpy ring of (s, a, r, s', done) with float action vectors."""
-
-    def __init__(self, capacity: int, obs_dim: int, action_dim: int,
-                 seed: int = 0):
-        self.capacity = capacity
-        self.obs = np.zeros((capacity, obs_dim), np.float32)
-        self.next_obs = np.zeros((capacity, obs_dim), np.float32)
-        self.actions = np.zeros((capacity, action_dim), np.float32)
-        self.rewards = np.zeros((capacity,), np.float32)
-        self.dones = np.zeros((capacity,), np.float32)
-        self.size = 0
-        self._pos = 0
-        self._rng = np.random.RandomState(seed)
-
-    def add_fragments(self, batch: Dict[str, np.ndarray]):
-        """Consume a [T, N] fragment batch (transitions t -> t+1; the last
-        step of each column has no in-fragment successor and is dropped).
-        Time-limit-truncated steps are dropped entirely: their stored
-        next_obs is the reset observation and SAC has no trained V(s) to
-        fold a bootstrap into the reward with."""
-        obs, act = batch["obs"], batch["actions"]
-        rew, done = batch["rewards"], batch["dones"]
-        T = obs.shape[0]
-        if T < 2:
-            return
-        o = obs[:-1].reshape(-1, obs.shape[-1])
-        no = obs[1:].reshape(-1, obs.shape[-1])
-        a = act[:-1].reshape(-1, act.shape[-1])
-        r = rew[:-1].reshape(-1)
-        d = done[:-1].reshape(-1)
-        trunc = batch.get("truncateds")
-        if trunc is not None:
-            keep = trunc[:-1].reshape(-1) < 0.5
-            o, no, a, r, d = o[keep], no[keep], a[keep], r[keep], d[keep]
-        n = o.shape[0]
-        if n == 0:
-            return
-        if n >= self.capacity:
-            o, no, a, r, d = (x[-self.capacity:] for x in (o, no, a, r, d))
-            n = self.capacity
-        idx = (self._pos + np.arange(n)) % self.capacity
-        self.obs[idx] = o
-        self.next_obs[idx] = no
-        self.actions[idx] = a
-        self.rewards[idx] = r
-        self.dones[idx] = d
-        self._pos = (self._pos + n) % self.capacity
-        self.size = min(self.size + n, self.capacity)
-
-    def sample(self, n: int) -> Dict[str, np.ndarray]:
-        idx = self._rng.randint(0, self.size, n)
-        return {
-            "obs": self.obs[idx],
-            "next_obs": self.next_obs[idx],
-            "actions": self.actions[idx],
-            "rewards": self.rewards[idx],
-            "dones": self.dones[idx],
-        }
-
-
-class SACConfig(AlgorithmConfig):
-    algo_name = "sac"
+class TQCConfig(SACConfig):
+    algo_name = "tqc"
 
     def __init__(self):
         super().__init__()
-        self.training(lr=3e-4, gamma=0.99)
-        self.replay_capacity = 100_000
-        self.learn_batch_size = 128
-        self.updates_per_step = 16
-        self.min_replay_size = 500
-        self.tau = 0.005                 # polyak rate for target critics
-        self.init_alpha = 0.1
-        self.target_entropy = None       # None -> -action_dim
-        self.critic_hidden = (128, 128)
+        self.n_critics = 2
+        self.n_quantiles = 25
+        # Atoms dropped from the TOP of the pooled target distribution,
+        # counted per critic (paper/SB3 convention): total kept =
+        # n_critics * (n_quantiles - top_quantiles_to_drop_per_net).
+        self.top_quantiles_to_drop_per_net = 2
 
-    def build_algo(self) -> "SAC":
-        return SAC(self)
+    def build_algo(self) -> "TQC":
+        return TQC(self)
 
 
-class SAC(Algorithm):
-    def __init__(self, config: SACConfig):
+class TQC(Algorithm):
+    def __init__(self, config: TQCConfig):
         import dataclasses
 
         import jax
@@ -109,7 +52,7 @@ class SAC(Algorithm):
         self._init_common(config)
         if self.module_config.discrete:
             raise ValueError(
-                "SAC requires a continuous (Box) action space; "
+                "TQC requires a continuous (Box) action space; "
                 f"{config.env or config.env_creator} has a discrete one"
             )
         self.module_config = dataclasses.replace(
@@ -118,19 +61,29 @@ class SAC(Algorithm):
         cfg = self.module_config
         hp = config.hp
         A = cfg.action_dim
+        N, M = config.n_critics, config.n_quantiles
+        drop_total = config.top_quantiles_to_drop_per_net * N
+        keep = N * M - drop_total
+        if keep <= 0:
+            raise ValueError(
+                f"top_quantiles_to_drop_per_net={config.top_quantiles_to_drop_per_net} "
+                f"drops every atom (n_critics={N}, n_quantiles={M})"
+            )
         target_entropy = (
             config.target_entropy
             if config.target_entropy is not None else -float(A)
         )
 
         key = jax.random.PRNGKey(config.seed)
-        k_pi, k_q1, k_q2 = jax.random.split(key, 3)
+        k_pi, *k_qs = jax.random.split(key, 1 + N)
         self.pi_params = rl_module.init_params(cfg, k_pi)
-        q_sizes = [cfg.obs_dim + A, *config.critic_hidden, 1]
-        self.q_params = {
-            "q1": rl_module._init_mlp(k_q1, q_sizes, cfg.dtype),
-            "q2": rl_module._init_mlp(k_q2, q_sizes, cfg.dtype),
-        }
+        q_sizes = [cfg.obs_dim + A, *config.critic_hidden, M]
+        # One stacked pytree: leaves have a leading [N] critic axis so a
+        # single vmapped forward evaluates the whole ensemble on the MXU.
+        self.q_params = jax.tree.map(
+            lambda *leaves: jnp.stack(leaves),
+            *[rl_module._init_mlp(k, q_sizes, cfg.dtype) for k in k_qs],
+        )
         self.q_target = jax.tree.map(jnp.copy, self.q_params)
         self.log_alpha = jnp.log(jnp.float32(config.init_alpha))
 
@@ -147,35 +100,52 @@ class SAC(Algorithm):
         self._update_key = jax.random.PRNGKey(config.seed + 1)
 
         gamma, tau = hp.gamma, config.tau
+        # Quantile midpoints tau_i = (2i+1)/2M — the regression targets'
+        # probability levels for each predicted atom.
+        taus = (jnp.arange(M, dtype=jnp.float32) + 0.5) / M
 
-        def q_value(qp, obs, act):
+        def atoms(qp, obs, act):
+            """[batch, N, M] quantile atoms from the stacked ensemble."""
             x = jnp.concatenate([obs, act], -1)
-            return rl_module._mlp(qp, x)[..., 0]
+            per_critic = jax.vmap(
+                lambda layers: rl_module._mlp(layers, x)
+            )(qp)                      # [N, batch, M]
+            return per_critic.transpose(1, 0, 2)
+
+        def quantile_huber(pred, target):
+            """pred [B, N, M] vs target [B, K]: mean quantile Huber loss.
+
+            Asymmetric |tau - 1{u<0}| weighting on a kappa=1 Huber kernel
+            (QR-DQN form), averaged over atoms, critics, and targets.
+            """
+            u = target[:, None, None, :] - pred[..., None]   # [B, N, M, K]
+            abs_u = jnp.abs(u)
+            huber = jnp.where(abs_u <= 1.0, 0.5 * u * u, abs_u - 0.5)
+            weight = jnp.abs(taus[None, None, :, None] - (u < 0.0))
+            return jnp.mean(jnp.sum(weight * huber, axis=2))
 
         def update(pi_p, q_p, q_t, log_alpha, pi_os, q_os, a_os, batch, rng):
             k_next, k_pi_new = jax.random.split(rng)
             alpha = jnp.exp(log_alpha)
 
-            # ---- critic: clipped double-Q soft target
+            # ---- target: pooled, sorted, top-truncated next-state atoms
             mean_n, logstd_n = rl_module.squashed_gaussian_dist(
                 pi_p, cfg, batch["next_obs"]
             )
             a_next, logp_next = rl_module.squashed_sample_logp(
                 mean_n, logstd_n, k_next
             )
-            q_next = jnp.minimum(
-                q_value(q_t["q1"], batch["next_obs"], a_next),
-                q_value(q_t["q2"], batch["next_obs"], a_next),
-            )
-            target = batch["rewards"] + gamma * (1 - batch["dones"]) * (
-                q_next - alpha * logp_next
-            )
-            target = jax.lax.stop_gradient(target)
+            z_next = atoms(q_t, batch["next_obs"], a_next)   # [B, N, M]
+            pooled = jnp.sort(z_next.reshape(z_next.shape[0], N * M), -1)
+            kept = pooled[:, :keep]                          # drop the top
+            target = batch["rewards"][:, None] + gamma * (
+                1.0 - batch["dones"][:, None]
+            ) * (kept - alpha * logp_next[:, None])
+            target = jax.lax.stop_gradient(target)           # [B, keep]
 
             def critic_loss(q_p):
-                q1 = q_value(q_p["q1"], batch["obs"], batch["actions"])
-                q2 = q_value(q_p["q2"], batch["obs"], batch["actions"])
-                return jnp.mean((q1 - target) ** 2 + (q2 - target) ** 2)
+                pred = atoms(q_p, batch["obs"], batch["actions"])
+                return quantile_huber(pred, target)
 
             c_loss, q_grads = jax.value_and_grad(critic_loss)(q_p)
             q_upd, q_os = self.q_opt.update(q_grads, q_os, q_p)
@@ -183,7 +153,7 @@ class SAC(Algorithm):
 
             q_p = _optax.apply_updates(q_p, q_upd)
 
-            # ---- actor: maximize E[min Q - alpha * logp] (reparameterized)
+            # ---- actor: maximize E[mean-of-atoms Q - alpha * logp]
             def actor_loss(pi_p):
                 mean, logstd = rl_module.squashed_gaussian_dist(
                     pi_p, cfg, batch["obs"]
@@ -191,10 +161,7 @@ class SAC(Algorithm):
                 a_new, logp = rl_module.squashed_sample_logp(
                     mean, logstd, k_pi_new
                 )
-                q_new = jnp.minimum(
-                    q_value(q_p["q1"], batch["obs"], a_new),
-                    q_value(q_p["q2"], batch["obs"], a_new),
-                )
+                q_new = jnp.mean(atoms(q_p, batch["obs"], a_new), (-2, -1))
                 return jnp.mean(alpha * logp - q_new), jnp.mean(logp)
 
             (a_loss, mean_logp), pi_grads = jax.value_and_grad(
@@ -203,7 +170,7 @@ class SAC(Algorithm):
             pi_upd, pi_os = self.pi_opt.update(pi_grads, pi_os, pi_p)
             pi_p = _optax.apply_updates(pi_p, pi_upd)
 
-            # ---- temperature: drive policy entropy toward target_entropy
+            # ---- temperature (SAC)
             def alpha_loss(log_a):
                 return -log_a * jax.lax.stop_gradient(
                     mean_logp + target_entropy
@@ -298,7 +265,7 @@ class SAC(Algorithm):
                 "log_alpha": float(self.log_alpha),
                 "iteration": self.iteration,
                 "total_env_steps": self._total_env_steps,
-                "algo": "sac",
+                "algo": "tqc",
             }, f)
         return path
 

@@ -1,0 +1,284 @@
+"""ConnectorV2: composable env<->module transform pipelines.
+
+Reference analog: ``rllib/connectors/`` (ConnectorV2 + ConnectorPipelineV2 —
+the new-API-stack abstraction that moves observation/action preprocessing
+out of env and module code into explicit, stateful, checkpointable
+pipelines; ``rllib/connectors/connector_pipeline_v2.py``).
+
+Three pipeline slots, mirroring the reference:
+
+- **env-to-module**: raw env observations -> module input (normalize,
+  clip, stack). Runs on every env runner before policy inference AND on
+  the learner batch before the update (same transform both places, so the
+  module always sees one distribution).
+- **module-to-env**: module action output -> env action (clip/rescale).
+- **learner**: training-batch-only transforms.
+
+Stateful connectors (e.g. ``MeanStdFilter``) expose ``get_state`` /
+``set_state`` / ``merge_states``; the runner group pulls per-runner states
+each iteration, merges them (count-weighted moment merge), and broadcasts
+the result — the reference's ``merge_env_runner_states`` flow — so every
+runner and the learner normalize with the same statistics.
+
+TPU note: connectors run host-side on numpy fragments (runner loops are
+CPU-bound env stepping anyway); the jitted policy/learner programs stay
+pure and static-shaped.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class ConnectorV2:
+    """One transform stage. Subclasses override ``__call__``."""
+
+    def __call__(self, batch: Dict[str, np.ndarray], **kw) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    # state sync (stateless connectors keep the defaults) ------------------
+
+    def get_state(self) -> Dict[str, Any]:
+        return {}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        pass
+
+    @staticmethod
+    def merge_states(states: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+        return states[0] if states else {}
+
+
+class ConnectorPipelineV2(ConnectorV2):
+    """Ordered list of connectors applied in sequence."""
+
+    def __init__(self, connectors: Optional[List[ConnectorV2]] = None):
+        self.connectors = list(connectors or [])
+
+    def append(self, connector: ConnectorV2) -> "ConnectorPipelineV2":
+        self.connectors.append(connector)
+        return self
+
+    def prepend(self, connector: ConnectorV2) -> "ConnectorPipelineV2":
+        self.connectors.insert(0, connector)
+        return self
+
+    def __call__(self, batch, **kw):
+        for c in self.connectors:
+            batch = c(batch, **kw)
+        return batch
+
+    def __len__(self):
+        return len(self.connectors)
+
+    def get_state(self):
+        return {i: c.get_state() for i, c in enumerate(self.connectors)}
+
+    def set_state(self, state):
+        for i, c in enumerate(self.connectors):
+            if i in state or str(i) in state:
+                c.set_state(state.get(i, state.get(str(i))))
+
+    def merge_states_from(
+        self, states: Sequence[Dict[str, Any]]
+    ) -> Dict[Any, Dict[str, Any]]:
+        """Merge per-runner pipeline states index-by-index, apply the
+        result to this pipeline (via set_state), and return it."""
+        merged: Dict[Any, Dict[str, Any]] = {}
+        for i, c in enumerate(self.connectors):
+            per_conn = [s[i] for s in states if i in s and s[i]]
+            if per_conn:
+                merged[i] = type(c).merge_states(per_conn)
+                c.set_state(merged[i])
+        return merged
+
+
+# ---------------------------------------------------------------- built-ins
+
+
+class FlattenObs(ConnectorV2):
+    """Flatten trailing obs dims to 1-D vectors (batch axis preserved)."""
+
+    def __call__(self, batch, **kw):
+        obs = batch["obs"]
+        if obs.ndim > 2:
+            batch = dict(batch)
+            batch["obs"] = obs.reshape(obs.shape[0], -1)
+        return batch
+
+
+class ClipObs(ConnectorV2):
+    def __init__(self, low: float = -10.0, high: float = 10.0):
+        self.low, self.high = low, high
+
+    def __call__(self, batch, **kw):
+        batch = dict(batch)
+        batch["obs"] = np.clip(batch["obs"], self.low, self.high)
+        return batch
+
+
+class MeanStdFilter(ConnectorV2):
+    """Running-moment observation normalizer (reference:
+    ``rllib/connectors/env_to_module/mean_std_filter.py``).
+
+    Tracks count/mean/M2 via Welford accumulation; ``merge_states`` uses
+    the parallel-variance (Chan) merge so per-runner statistics combine
+    exactly, independent of runner count or fragment interleaving.
+
+    Sync contract: each instance accumulates ONLY its own observations
+    (``get_state`` reports those), while normalization prefers the merged
+    cluster statistics received via ``set_state``. Keeping the two
+    separate means repeated merge→broadcast rounds never double-count a
+    runner's samples.
+    """
+
+    def __init__(self, shape: Optional[tuple] = None, clip: float = 10.0,
+                 update: bool = True):
+        self.clip = clip
+        self.update = update
+        self.count = 0.0
+        self.mean = np.zeros(shape, np.float64) if shape else None
+        self.m2 = np.zeros(shape, np.float64) if shape else None
+        self._applied: Optional[Dict[str, Any]] = None  # broadcast stats
+
+    def _ensure(self, dim):
+        if self.mean is None:
+            self.mean = np.zeros(dim, np.float64)
+            self.m2 = np.zeros(dim, np.float64)
+
+    def __call__(self, batch, **kw):
+        obs = np.asarray(batch["obs"], np.float64)
+        flat = obs.reshape(-1, obs.shape[-1])
+        self._ensure(flat.shape[-1])
+        if self.update and kw.get("training", True):
+            n = flat.shape[0]
+            b_mean = flat.mean(0)
+            b_m2 = ((flat - b_mean) ** 2).sum(0)
+            delta = b_mean - self.mean
+            tot = self.count + n
+            self.mean = self.mean + delta * (n / tot)
+            self.m2 = self.m2 + b_m2 + delta ** 2 * (self.count * n / tot)
+            self.count = tot
+        mean, std = self._norm_stats()
+        out = (obs - mean) / std
+        batch = dict(batch)
+        batch["obs"] = np.clip(out, -self.clip, self.clip).astype(np.float32)
+        return batch
+
+    def _norm_stats(self):
+        """(mean, std) used for normalization: the merged cluster stats
+        when a broadcast arrived, else this instance's own."""
+        a = self._applied
+        if a is not None and a["count"] >= 2:
+            return a["mean"], np.sqrt(
+                np.maximum(a["m2"] / a["count"], 1e-8)
+            )
+        return self.mean, self.std
+
+    @property
+    def std(self) -> np.ndarray:
+        if self.count < 2:
+            return np.ones_like(self.mean) if self.mean is not None else 1.0
+        return np.sqrt(np.maximum(self.m2 / self.count, 1e-8))
+
+    def get_state(self):
+        if self.mean is None:
+            return {}
+        return {
+            "count": float(self.count),
+            "mean": self.mean.copy(),
+            "m2": self.m2.copy(),
+        }
+
+    def set_state(self, state):
+        if not state:
+            return
+        self._applied = {
+            "count": float(state["count"]),
+            "mean": np.asarray(state["mean"], np.float64).copy(),
+            "m2": np.asarray(state["m2"], np.float64).copy(),
+        }
+
+    @staticmethod
+    def merge_states(states):
+        states = [s for s in states if s]
+        if not states:
+            return {}
+        count = states[0]["count"]
+        mean = np.asarray(states[0]["mean"], np.float64).copy()
+        m2 = np.asarray(states[0]["m2"], np.float64).copy()
+        for s in states[1:]:
+            n2, mean2 = s["count"], np.asarray(s["mean"], np.float64)
+            delta = mean2 - mean
+            tot = count + n2
+            mean = mean + delta * (n2 / tot)
+            m2 = m2 + np.asarray(s["m2"], np.float64) + (
+                delta ** 2 * (count * n2 / tot)
+            )
+            count = tot
+        return {"count": count, "mean": mean, "m2": m2}
+
+
+class FrameStack(ConnectorV2):
+    """Stack the last k observations per env along the feature axis.
+
+    Operates on [N, obs_dim] inference batches; keeps a per-env deque of
+    previous frames. ``dones`` (when provided via kw) reset a column's
+    history so frames never bleed across episode boundaries.
+    """
+
+    def __init__(self, k: int = 4):
+        self.k = k
+        self._hist: Optional[np.ndarray] = None  # [N, k, obs_dim]
+
+    def __call__(self, batch, dones: Optional[np.ndarray] = None,
+                 training: bool = True, **kw):
+        obs = np.asarray(batch["obs"], np.float32)
+        n, d = obs.shape
+        if not training:
+            # One-off probe (e.g. a truncation value read): answer without
+            # touching per-env history — treat the frame as a fresh stack.
+            batch = dict(batch)
+            batch["obs"] = np.tile(obs, (1, self.k))
+            return batch
+        if self._hist is None or self._hist.shape[0] != n:
+            self._hist = np.repeat(obs[:, None, :], self.k, axis=1)
+        else:
+            self._hist = np.concatenate(
+                [self._hist[:, 1:], obs[:, None, :]], axis=1
+            )
+        if dones is not None:
+            for i in np.nonzero(dones)[0]:
+                self._hist[i] = obs[i][None, :]
+        batch = dict(batch)
+        batch["obs"] = self._hist.reshape(n, self.k * d)
+        return batch
+
+
+class ClipActions(ConnectorV2):
+    """module-to-env: clip actions into the env's Box bounds."""
+
+    def __init__(self, low, high):
+        self.low = np.asarray(low, np.float32)
+        self.high = np.asarray(high, np.float32)
+
+    def __call__(self, batch, **kw):
+        batch = dict(batch)
+        batch["actions"] = np.clip(batch["actions"], self.low, self.high)
+        return batch
+
+
+class RescaleActions(ConnectorV2):
+    """module-to-env: map [-1, 1] policy actions to the env's Box bounds
+    (what squashed-gaussian policies emit)."""
+
+    def __init__(self, low, high):
+        self.low = np.asarray(low, np.float32)
+        self.high = np.asarray(high, np.float32)
+
+    def __call__(self, batch, **kw):
+        batch = dict(batch)
+        a = np.asarray(batch["actions"], np.float32)
+        batch["actions"] = self.low + (a + 1.0) * 0.5 * (self.high - self.low)
+        return batch

@@ -28,7 +28,8 @@ class SingleAgentEnvRunner:
 
     def __init__(self, env_creator: Callable[[], Any], num_envs: int,
                  fragment_len: int, module_config: dict, seed: int = 0,
-                 gamma: float = 0.99):
+                 gamma: float = 0.99, env_to_module: Optional[Callable] = None,
+                 module_to_env: Optional[Callable] = None):
         import jax
 
         self.envs = [env_creator() for _ in range(num_envs)]
@@ -36,6 +37,11 @@ class SingleAgentEnvRunner:
         self.fragment_len = fragment_len
         self.gamma = gamma
         self.config = rl_module.RLModuleConfig(**module_config)
+        # Connector pipelines (reference: ConnectorV2 env_to_module /
+        # module_to_env slots). Factories (zero-arg callables) so pipelines
+        # pickle across the actor boundary and each runner owns its state.
+        self.env_to_module = env_to_module() if env_to_module else None
+        self.module_to_env = module_to_env() if module_to_env else None
         self.params = None
         self.rng = jax.random.PRNGKey(seed)
         self._sample_fn = jax.jit(
@@ -71,7 +77,8 @@ class SingleAgentEnvRunner:
 
         assert self.params is not None, "set_weights before sample"
         T, N = self.fragment_len, self.num_envs
-        obs_buf = np.empty((T, N, self.obs.shape[1]), np.float32)
+        obs_buf = None  # allocated after the first transform (connectors
+        # like FrameStack change the module-side obs dim)
         act_dtype = np.int32 if self.config.discrete else np.float32
         act_shape = (T, N) if self.config.discrete else (T, N, self.config.action_dim)
         act_buf = np.empty(act_shape, act_dtype)
@@ -80,18 +87,31 @@ class SingleAgentEnvRunner:
         trunc_buf = np.zeros((T, N), np.float32)
         logp_buf = np.empty((T, N), np.float32)
         val_buf = np.empty((T, N), np.float32)
+        last_dones = None
 
         for t in range(T):
             self.rng, k = jax.random.split(self.rng)
-            action, logp, value = self._sample_fn(self.params, self.obs, k)
+            mobs = self.obs
+            if self.env_to_module is not None:
+                mobs = np.asarray(self.env_to_module(
+                    {"obs": self.obs}, dones=last_dones
+                )["obs"], np.float32)
+            if obs_buf is None:
+                obs_buf = np.empty((T, N, mobs.shape[1]), np.float32)
+            action, logp, value = self._sample_fn(self.params, mobs, k)
             action = np.asarray(action)
-            obs_buf[t] = self.obs
+            obs_buf[t] = mobs
             act_buf[t] = action
             logp_buf[t] = np.asarray(logp)
             val_buf[t] = np.asarray(value)
+            env_actions = action
+            if self.module_to_env is not None:
+                env_actions = np.asarray(
+                    self.module_to_env({"actions": action})["actions"]
+                )
             for i, env in enumerate(self.envs):
-                a = action[i]
-                if not self.config.discrete:
+                a = env_actions[i]
+                if self.module_to_env is None and not self.config.discrete:
                     low = env.action_space.low
                     high = env.action_space.high
                     if self.config.exploration == "squashed_gaussian":
@@ -119,10 +139,14 @@ class SingleAgentEnvRunner:
                     # replay rewards — SAC instead drops truncation-boundary
                     # transitions via the truncateds array.
                     if self.config.exploration != "squashed_gaussian":
-                        fv = self._value_fn(
-                            self.params,
-                            np.asarray(nobs, np.float32).ravel()[None, :],
-                        )
+                        vobs = np.asarray(nobs, np.float32).ravel()[None, :]
+                        if self.env_to_module is not None:
+                            # training=False: a one-off value probe must
+                            # not update running normalizer statistics
+                            vobs = np.asarray(self.env_to_module(
+                                {"obs": vobs}, training=False
+                            )["obs"], np.float32)
+                        fv = self._value_fn(self.params, vobs)
                         rew_buf[t, i] += self.gamma * float(np.asarray(fv)[0])
                 if done:
                     self._completed.append(
@@ -132,7 +156,18 @@ class SingleAgentEnvRunner:
                     self._ep_len[i] = 0
                     nobs = env.reset()[0]
                 self.obs[i] = np.asarray(nobs, np.float32).ravel()
-        bootstrap = np.asarray(self._value_fn(self.params, self.obs))
+            last_dones = done_buf[t]  # lets FrameStack reset columns next step
+        fobs = self.obs
+        if self.env_to_module is not None:
+            # Same transform the module saw during the fragment; a one-off
+            # probe, so it must not update normalizer statistics. For
+            # FrameStack this treats the frame as a fresh stack — the done
+            # columns ARE fresh, and live columns only matter through the
+            # bootstrap value, where the approximation is standard.
+            fobs = np.asarray(self.env_to_module(
+                {"obs": self.obs}, training=False
+            )["obs"], np.float32)
+        bootstrap = np.asarray(self._value_fn(self.params, fobs))
         self._total_steps += T * N
         return {
             "obs": obs_buf, "actions": act_buf, "rewards": rew_buf,
@@ -140,6 +175,15 @@ class SingleAgentEnvRunner:
             "logp": logp_buf, "values": val_buf,
             "bootstrap_value": bootstrap,
         }
+
+    def get_connector_state(self) -> Dict[str, Any]:
+        if self.env_to_module is None:
+            return {}
+        return self.env_to_module.get_state()
+
+    def set_connector_state(self, state: Dict[str, Any]) -> None:
+        if self.env_to_module is not None and state:
+            self.env_to_module.set_state(state)
 
     def metrics(self) -> Dict[str, Any]:
         completed, self._completed = self._completed, []
@@ -165,7 +209,9 @@ class EnvRunnerGroup:
 
     def __init__(self, env_creator, num_runners: int, num_envs_per_runner: int,
                  fragment_len: int, module_config: rl_module.RLModuleConfig,
-                 seed: int = 0, gamma: float = 0.99):
+                 seed: int = 0, gamma: float = 0.99,
+                 env_to_module: Optional[Callable] = None,
+                 module_to_env: Optional[Callable] = None):
         import ray_tpu
 
         self._make = lambda idx: ray_tpu.remote(SingleAgentEnvRunner).options(
@@ -173,9 +219,13 @@ class EnvRunnerGroup:
         ).remote(
             env_creator, num_envs_per_runner, fragment_len,
             dict(module_config.__dict__), seed + 1000 * idx, gamma,
+            env_to_module, module_to_env,
         )
         self.runners = [self._make(i) for i in range(num_runners)]
         self._weights = None
+        # Local template pipeline: holds the merged state and provides the
+        # per-connector merge_states implementations.
+        self._connector_template = env_to_module() if env_to_module else None
 
     def sync_weights(self, params):
         import ray_tpu
@@ -207,6 +257,42 @@ class EnvRunnerGroup:
                 except Exception:
                     pass
         return out
+
+    def sync_connector_states(self) -> Dict[str, Any]:
+        """Pull per-runner connector states, merge (count-weighted moment
+        merge for MeanStdFilter etc.), broadcast the result — the
+        reference's merge_env_runner_states flow. Returns the merged state
+        (e.g. for a learner-side copy of the pipeline)."""
+        import ray_tpu
+
+        tpl = self._connector_template
+        if tpl is None:
+            return {}
+        refs = [r.get_connector_state.remote() for r in self.runners]
+        states = []
+        for ref in refs:
+            try:
+                s = ray_tpu.get(ref, timeout=30)
+                if s:
+                    states.append(s)
+            except Exception:
+                pass
+        if not states:
+            return {}
+        from ray_tpu.rllib.connectors import ConnectorPipelineV2
+
+        if isinstance(tpl, ConnectorPipelineV2):
+            merged = tpl.merge_states_from(states)
+        else:
+            merged = type(tpl).merge_states(states)
+            tpl.set_state(merged)
+        refs = [r.set_connector_state.remote(merged) for r in self.runners]
+        for ref in refs:
+            try:
+                ray_tpu.get(ref, timeout=30)
+            except Exception:
+                pass
+        return merged
 
     def metrics(self) -> List[Dict[str, Any]]:
         import ray_tpu
