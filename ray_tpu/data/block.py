@@ -24,28 +24,32 @@ def _to_table(data: Any) -> pa.Table:
     if isinstance(data, pd.DataFrame):
         return pa.Table.from_pandas(data, preserve_index=False)
     if isinstance(data, dict):  # dict of columns (numpy arrays or lists)
-        import json
+        from ray_tpu.data.tensor_extension import (
+            ArrowTensorArray,
+            ArrowVariableShapedTensorArray,
+        )
 
         arrays, fields = [], []
         for k, v in data.items():
-            arr = np.asarray(v)
-            if arr.ndim > 1:  # tensor column → fixed-shape list array; the
-                # full inner shape rides in field metadata so >2-D tensors
-                # round-trip exactly (not silently flattened to 2-D)
-                inner = int(np.prod(arr.shape[1:]))  # safe for 0-row arrays
-                fsl = pa.FixedSizeListArray.from_arrays(
-                    pa.array(arr.reshape(-1)), inner
-                )
-                arrays.append(fsl)
-                fields.append(pa.field(
-                    k, fsl.type,
-                    metadata={b"tensor_shape": json.dumps(
-                        list(arr.shape[1:])).encode()},
-                ))
+            if (isinstance(v, (list, tuple)) and v
+                    and all(isinstance(a, np.ndarray) for a in v)
+                    and len({a.shape for a in v}) > 1):
+                # ragged tensor column (per-row shapes differ)
+                a = ArrowVariableShapedTensorArray.from_numpy(v)
             else:
-                a = pa.array(arr)
-                arrays.append(a)
-                fields.append(pa.field(k, a.type))
+                arr = np.asarray(v)
+                if arr.dtype == object and arr.ndim == 1 and len(arr) and \
+                        isinstance(arr[0], np.ndarray):
+                    a = ArrowVariableShapedTensorArray.from_numpy(list(arr))
+                elif arr.ndim > 1:
+                    # tensor column → arrow extension type: shape+dtype are
+                    # part of the TYPE, so they survive schema ops, IPC,
+                    # and parquet (reference: air ArrowTensorType)
+                    a = ArrowTensorArray.from_numpy(arr)
+                else:
+                    a = pa.array(arr)
+            arrays.append(a)
+            fields.append(pa.field(k, a.type))
         return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
     if isinstance(data, list):  # list of rows
         if data and isinstance(data[0], dict):
@@ -57,8 +61,24 @@ def _to_table(data: Any) -> pa.Table:
 def _column_to_numpy(table: pa.Table, name: str) -> np.ndarray:
     import json
 
+    from ray_tpu.data.tensor_extension import (
+        ArrowTensorType,
+        ArrowVariableShapedTensorType,
+    )
+
     col = table.column(name)
+    if isinstance(col.type, (ArrowTensorType, ArrowVariableShapedTensorType)):
+        chunk = col.combine_chunks()
+        if isinstance(chunk, pa.ChunkedArray):  # 0- or multi-chunk fallback
+            parts = [c.to_numpy() for c in chunk.chunks]
+            if not parts:
+                return np.empty(
+                    (0, *getattr(col.type, "shape", ())), np.float64
+                )
+            return np.concatenate(parts)
+        return chunk.to_numpy()
     if pa.types.is_fixed_size_list(col.type):
+        # legacy blocks (pre-extension-type) carried shape in field metadata
         flat = col.combine_chunks().flatten().to_numpy(zero_copy_only=False)
         field = table.schema.field(name)
         meta = field.metadata or {}
@@ -100,10 +120,35 @@ class BlockAccessor:
         names = columns or self._table.column_names
         return {n: _column_to_numpy(self._table, n) for n in names}
 
+    def _tensor_columns(self) -> List[str]:
+        from ray_tpu.data.tensor_extension import (
+            ArrowTensorType,
+            ArrowVariableShapedTensorType,
+        )
+
+        return [
+            f.name for f in self._table.schema
+            if isinstance(
+                f.type, (ArrowTensorType, ArrowVariableShapedTensorType)
+            )
+        ]
+
     def to_pylist(self) -> List[dict]:
-        return self._table.to_pylist()
+        tensor_cols = self._tensor_columns()
+        rows = self._table.to_pylist()
+        if tensor_cols:
+            # rows must carry ndarrays for tensor columns, not the storage
+            # array's flattened lists
+            for name in tensor_cols:
+                col = _column_to_numpy(self._table, name)
+                for i, row in enumerate(rows):
+                    row[name] = col[i]
+        return rows
 
     def iter_rows(self) -> Iterator[dict]:
+        if self._tensor_columns():
+            yield from self.to_pylist()
+            return
         for batch in self._table.to_batches():
             yield from batch.to_pylist()
 

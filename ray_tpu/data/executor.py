@@ -20,20 +20,71 @@ from ray_tpu.data.block import Block, BlockAccessor, batch_to_block
 
 
 @dataclass
+class StageStats:
+    """Per-operator execution statistics (reference:
+    ``data/_internal/stats.py`` per-operator summaries: tasks, blocks,
+    rows, bytes, UDF time, block-size distribution)."""
+
+    wall_s: float = 0.0
+    tasks: int = 0
+    blocks: int = 0
+    rows: int = 0
+    bytes: int = 0
+    udf_s: float = 0.0          # summed in-task transform time
+    min_block_rows: int = 0
+    max_block_rows: int = 0
+    min_block_bytes: int = 0
+    max_block_bytes: int = 0
+
+    def add_block(self, meta: Dict[str, Any]):
+        rows, nbytes = int(meta.get("rows", 0)), int(meta.get("bytes", 0))
+        if self.blocks == 0:
+            self.min_block_rows = self.max_block_rows = rows
+            self.min_block_bytes = self.max_block_bytes = nbytes
+        else:
+            self.min_block_rows = min(self.min_block_rows, rows)
+            self.max_block_rows = max(self.max_block_rows, rows)
+            self.min_block_bytes = min(self.min_block_bytes, nbytes)
+            self.max_block_bytes = max(self.max_block_bytes, nbytes)
+        self.blocks += 1
+        self.rows += rows
+        self.bytes += nbytes
+        self.udf_s += float(meta.get("udf_s", 0.0))
+
+
+@dataclass
 class ExecStats:
     tasks_submitted: int = 0
     blocks_produced: int = 0
     rows_produced: int = 0
     wall_time_s: float = 0.0
-    per_stage: Dict[str, float] = field(default_factory=dict)
+    per_stage: Dict[str, StageStats] = field(default_factory=dict)
+
+    def stage(self, name: str) -> StageStats:
+        if name not in self.per_stage:
+            self.per_stage[name] = StageStats()
+        return self.per_stage[name]
 
     def summary(self) -> str:
         lines = [
             f"tasks={self.tasks_submitted} blocks={self.blocks_produced} "
             f"rows={self.rows_produced} wall={self.wall_time_s:.3f}s"
         ]
-        for name, t in self.per_stage.items():
-            lines.append(f"  stage {name}: {t:.3f}s")
+        for name, st in self.per_stage.items():
+            lines.append(
+                f"  operator {name}: {st.wall_s:.3f}s wall, "
+                f"{st.tasks} tasks, {st.blocks} blocks, {st.rows} rows, "
+                f"{st.bytes / 1e6:.2f}MB, udf {st.udf_s:.3f}s"
+            )
+            if st.blocks:
+                mean_rows = st.rows / st.blocks
+                mean_bytes = st.bytes / st.blocks
+                lines.append(
+                    f"    block rows min/mean/max: {st.min_block_rows}/"
+                    f"{mean_rows:.0f}/{st.max_block_rows}; bytes "
+                    f"min/mean/max: {st.min_block_bytes}/"
+                    f"{mean_bytes:.0f}/{st.max_block_bytes}"
+                )
         return "\n".join(lines)
 
 
@@ -97,6 +148,14 @@ class _BatchPoolWorker:
     def apply(self, block: Block) -> Block:
         return _apply_batched(block, self.fn, self.bs, self.fmt, self.fkw)
 
+    def apply_meta(self, block: Block):
+        """(block, meta) variant feeding per-operator stats."""
+        import time as _time
+
+        t0 = _time.monotonic()
+        out = self.apply(block)
+        return out, _block_meta(out, _time.monotonic() - t0)
+
 
 def _remote_apply(serialized_fns, block: Block) -> Block:
     """Task body: run the fused transform chain on one block."""
@@ -104,6 +163,25 @@ def _remote_apply(serialized_fns, block: Block) -> Block:
 
     fns = cloudpickle.loads(serialized_fns)
     return _apply_fused(block, fns)
+
+
+def _block_meta(block: Block, udf_s: float) -> Dict[str, Any]:
+    acc = BlockAccessor(block)
+    return {"rows": acc.num_rows(), "bytes": acc.size_bytes(),
+            "udf_s": udf_s}
+
+
+def _remote_apply_meta(serialized_fns, block: Block):
+    """Task body returning (block, meta): meta carries rows/bytes/udf-time
+    so the driver's stats never have to fetch the (possibly large) block."""
+    import time as _time
+
+    import cloudpickle
+
+    fns = cloudpickle.loads(serialized_fns)
+    t0 = _time.monotonic()
+    out = _apply_fused(block, fns)
+    return out, _block_meta(out, _time.monotonic() - t0)
 
 
 class StreamingExecutor:
@@ -144,35 +222,46 @@ class StreamingExecutor:
                 groups[-1][1].append(fn)
             else:
                 groups.append(("fns", [fn]))
+        st = self.stats.stage(name)
         stream: Iterator[Any] = iter(in_refs)
-        for kind, payload in groups:
+        for gi, (kind, payload) in enumerate(groups):
+            # Only the FINAL group's outputs are the operator's outputs:
+            # intermediate groups of a chained stage (fns -> actor pool)
+            # must not inflate block/row accounting.
+            final = gi == len(groups) - 1
             if kind == "fns":
                 if local:
-                    stream = self._fused_local(stream, payload)
+                    stream = self._fused_local(stream, payload, st, final)
                 else:
-                    stream = self._fused_tasks(stream, payload)
+                    stream = self._fused_tasks(stream, payload, st, final)
             else:
                 if local:
                     stream = self._fused_local(
-                        stream, [payload.build_local()]
+                        stream, [payload.build_local()], st, final
                     )
                 else:
-                    stream = self._actor_pool(stream, payload)
+                    stream = self._actor_pool(stream, payload, st, final)
         for out in stream:
             self.stats.blocks_produced += 1
             yield out
-        self.stats.per_stage[name] = (
-            self.stats.per_stage.get(name, 0.0) + time.monotonic() - t0
-        )
+        st.wall_s += time.monotonic() - t0
         self.stats.wall_time_s += time.monotonic() - t0
 
-    def _fused_local(self, stream, fns):
+    def _fused_local(self, stream, fns, st: StageStats, final: bool = True):
+        import time as _time
+
         for b in stream:
+            t0 = _time.monotonic()
             out = _apply_fused(_resolve_local(b), fns)
-            self.stats.rows_produced += BlockAccessor(out).num_rows()
+            st.tasks += 1
+            if final:
+                meta = _block_meta(out, _time.monotonic() - t0)
+                st.add_block(meta)
+                self.stats.rows_produced += meta["rows"]
             yield out
 
-    def _fused_tasks(self, stream, fns):
+    def _fused_tasks(self, stream, fns, st: StageStats,
+                     final: bool = True):
         import cloudpickle
 
         import ray_tpu
@@ -204,9 +293,8 @@ class StreamingExecutor:
             task_opts["num_gpus"] = num_gpus
         if resources:
             task_opts["resources"] = resources
-        apply_task = ray_tpu.remote(_remote_apply)
-        if task_opts:
-            apply_task = apply_task.options(**task_opts)
+        task_opts["num_returns"] = 2  # (block, meta) — stats without fetch
+        apply_task = ray_tpu.remote(_remote_apply_meta).options(**task_opts)
         from ray_tpu.data.resource_manager import default_resource_manager
 
         rm = default_resource_manager()
@@ -215,6 +303,7 @@ class StreamingExecutor:
             cpu_per_task=num_cpus if num_cpus is not None else 1.0,
         )
         pending = collections.deque()
+        meta_refs: List[Any] = []
         exhausted = False
         try:
             while pending or not exhausted:
@@ -227,9 +316,12 @@ class StreamingExecutor:
                     except StopIteration:
                         exhausted = True
                         break
-                    pending.append(apply_task.remote(payload, ref))
+                    block_ref, meta_ref = apply_task.remote(payload, ref)
+                    pending.append(block_ref)
+                    meta_refs.append(meta_ref)
                     rm.on_task_submitted(op)
                     self.stats.tasks_submitted += 1
+                    st.tasks += 1
                 if pending:
                     # Pop in order: preserves block order; completed later
                     # tasks simply wait in the store (streaming window
@@ -238,8 +330,28 @@ class StreamingExecutor:
                     rm.on_task_output_consumed(op)
         finally:
             rm.unregister_op(op)
+            # Collect per-block metadata (tiny messages; every consumed
+            # block's task has finished, so these resolve immediately).
+            # Bounded wait: an early-abandoned stream (take(5)) must not
+            # hang the generator close on still-running stragglers.
+            ready = []
+            if meta_refs and final:
+                try:
+                    ready, _ = ray_tpu.wait(
+                        meta_refs, num_returns=len(meta_refs), timeout=10,
+                    )
+                except Exception:
+                    pass
+            for mr in ready:
+                try:
+                    meta = ray_tpu.get(mr, timeout=5)
+                    st.add_block(meta)
+                    self.stats.rows_produced += meta["rows"]
+                except Exception:
+                    pass
 
-    def _actor_pool(self, stream, stage: ActorStage):
+    def _actor_pool(self, stream, stage: ActorStage, st: StageStats,
+                    final: bool = True):
         """Bounded-in-flight round-robin over a pool of stateful actors;
         the pool dies with the stage (reference: actor_pool_map_operator
         autoscaling pool — fixed size here)."""
@@ -260,6 +372,7 @@ class StreamingExecutor:
             for _ in range(stage.concurrency)
         ]
         produced: List[Any] = []
+        meta_refs: List[Any] = []
         try:
             pending = collections.deque()
             exhausted = False
@@ -274,8 +387,13 @@ class StreamingExecutor:
                         break
                     actor = actors[i % len(actors)]
                     i += 1
-                    pending.append(actor.apply.remote(ref))
+                    block_ref, meta_ref = actor.apply_meta.options(
+                        num_returns=2
+                    ).remote(ref)
+                    pending.append(block_ref)
+                    meta_refs.append(meta_ref)
                     self.stats.tasks_submitted += 1
+                    st.tasks += 1
                 if pending:
                     out = pending.popleft()
                     produced.append(out)
@@ -292,6 +410,21 @@ class StreamingExecutor:
                     )
             except Exception:
                 pass
+            ready = []
+            if meta_refs and final:
+                try:
+                    ready, _ = ray_tpu.wait(
+                        meta_refs, num_returns=len(meta_refs), timeout=10,
+                    )
+                except Exception:
+                    pass
+            for mr in ready:
+                try:
+                    meta = ray_tpu.get(mr, timeout=5)
+                    st.add_block(meta)
+                    self.stats.rows_produced += meta["rows"]
+                except Exception:
+                    pass
             for a in actors:
                 try:
                     ray_tpu.kill(a)

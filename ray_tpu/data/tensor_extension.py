@@ -1,0 +1,163 @@
+"""Arrow tensor extension types: ndarray columns as first-class arrow data.
+
+Reference analog: ``python/ray/air/util/tensor_extensions/arrow.py``
+(``ArrowTensorType`` / ``ArrowTensorArray`` / ``ArrowVariableShapedTensorType``)
+— the reference stores multi-dimensional columns as arrow *extension types*
+so tensor shape survives schema operations, IPC, and parquet round-trips
+without side-channel metadata.
+
+Design (independent, not a translation): fixed-shape tensors are a
+``FixedSizeList`` storage array whose extension metadata carries the inner
+shape + dtype; variable-shaped (ragged) tensors are a
+``Struct{data: List, shape: List[Int64]}`` storage where each row owns its
+own shape vector. Both register with arrow's global extension registry at
+import so deserialized tables (plasma, parquet, IPC) reconstruct the typed
+columns automatically. Zero-copy: ``to_numpy`` reshapes the flat storage
+buffer without copying for fixed shapes.
+"""
+from __future__ import annotations
+
+import json
+from typing import Sequence
+
+import numpy as np
+import pyarrow as pa
+
+
+class ArrowTensorType(pa.ExtensionType):
+    """Fixed-shape tensor column: every row is an ndarray of ``shape``."""
+
+    EXT_NAME = "ray_tpu.tensor"
+
+    def __init__(self, shape: Sequence[int], value_type: pa.DataType):
+        self._shape = tuple(int(s) for s in shape)
+        size = 1
+        for s in self._shape:
+            size *= s
+        super().__init__(
+            pa.list_(value_type, size), self.EXT_NAME
+        )
+
+    @property
+    def shape(self):
+        return self._shape
+
+    def __arrow_ext_serialize__(self) -> bytes:
+        return json.dumps({"shape": list(self._shape)}).encode()
+
+    @classmethod
+    def __arrow_ext_deserialize__(cls, storage_type, serialized):
+        shape = json.loads(serialized.decode())["shape"]
+        return cls(shape, storage_type.value_type)
+
+    def __arrow_ext_class__(self):
+        return ArrowTensorArray
+
+    def __reduce__(self):
+        return (
+            ArrowTensorType, (self._shape, self.storage_type.value_type)
+        )
+
+
+class ArrowTensorArray(pa.ExtensionArray):
+    """Array of fixed-shape tensors over FixedSizeList storage."""
+
+    @staticmethod
+    def from_numpy(arr: np.ndarray) -> "ArrowTensorArray":
+        arr = np.ascontiguousarray(arr)
+        if arr.ndim < 2:
+            raise ValueError("tensor columns need ndim >= 2 ([row, ...])")
+        inner = int(np.prod(arr.shape[1:]))
+        storage = pa.FixedSizeListArray.from_arrays(
+            pa.array(arr.reshape(-1)), inner
+        )
+        typ = ArrowTensorType(arr.shape[1:], storage.type.value_type)
+        return pa.ExtensionArray.from_storage(typ, storage)
+
+    def to_numpy(self, zero_copy_only: bool = False) -> np.ndarray:
+        flat = self.storage.flatten().to_numpy(zero_copy_only=zero_copy_only)
+        return flat.reshape((len(self), *self.type.shape))
+
+
+class ArrowVariableShapedTensorType(pa.ExtensionType):
+    """Ragged tensor column: each row is an ndarray with its own shape
+    (same rank and dtype across rows is NOT required by storage, only by
+    convention at the numpy boundary)."""
+
+    EXT_NAME = "ray_tpu.var_tensor"
+
+    def __init__(self, value_type: pa.DataType):
+        storage = pa.struct([
+            pa.field("data", pa.list_(value_type)),
+            pa.field("shape", pa.list_(pa.int64())),
+        ])
+        super().__init__(storage, self.EXT_NAME)
+
+    def __arrow_ext_serialize__(self) -> bytes:
+        return b""
+
+    @classmethod
+    def __arrow_ext_deserialize__(cls, storage_type, serialized):
+        return cls(storage_type.field("data").type.value_type)
+
+    def __arrow_ext_class__(self):
+        return ArrowVariableShapedTensorArray
+
+    def __reduce__(self):
+        return (
+            ArrowVariableShapedTensorType,
+            (self.storage_type.field("data").type.value_type,),
+        )
+
+
+class ArrowVariableShapedTensorArray(pa.ExtensionArray):
+    @staticmethod
+    def from_numpy(arrs) -> "ArrowVariableShapedTensorArray":
+        """From a sequence of ndarrays with (possibly) different shapes."""
+        arrs = [np.asarray(a) for a in arrs]
+        if not arrs:
+            raise ValueError("empty tensor sequence")
+        dtype = arrs[0].dtype
+        data = pa.array(
+            [a.reshape(-1) for a in arrs],
+            type=pa.list_(pa.from_numpy_dtype(dtype)),
+        )
+        shape = pa.array(
+            [list(a.shape) for a in arrs], type=pa.list_(pa.int64())
+        )
+        storage = pa.StructArray.from_arrays([data, shape], ["data", "shape"])
+        typ = ArrowVariableShapedTensorType(pa.from_numpy_dtype(dtype))
+        return pa.ExtensionArray.from_storage(typ, storage)
+
+    def to_numpy(self, zero_copy_only: bool = False) -> np.ndarray:
+        """Object ndarray of per-row tensors (shapes differ by row)."""
+        data = self.storage.field("data")
+        shapes = self.storage.field("shape").to_pylist()
+        out = np.empty(len(self), dtype=object)
+        for i in range(len(self)):
+            out[i] = np.asarray(data[i].values.to_numpy(
+                zero_copy_only=False
+            )).reshape(shapes[i])
+        return out
+
+
+_registered = False
+
+
+def ensure_registered() -> None:
+    """Idempotently register both extension types with arrow's global
+    registry so IPC/parquet/plasma deserialization restores typed columns."""
+    global _registered
+    if _registered:
+        return
+    try:
+        pa.register_extension_type(ArrowTensorType((1,), pa.float32()))
+        pa.register_extension_type(
+            ArrowVariableShapedTensorType(pa.float32())
+        )
+    except pa.ArrowKeyError:  # another module registered first
+        pass
+    _registered = True
+
+
+ensure_registered()

@@ -59,6 +59,10 @@ class SingleAgentEnvRunner:
         self._ep_len = np.zeros(num_envs, np.int64)
         self._completed: List[tuple] = []
         self._total_steps = 0
+        # Dones of the previous step, persisted ACROSS sample() calls: an
+        # episode ending on a fragment's last step must still reset
+        # stateful connectors (FrameStack) at the next fragment's start.
+        self._last_dones: Optional[np.ndarray] = None
 
     def set_weights(self, params):
         self.params = params
@@ -87,14 +91,13 @@ class SingleAgentEnvRunner:
         trunc_buf = np.zeros((T, N), np.float32)
         logp_buf = np.empty((T, N), np.float32)
         val_buf = np.empty((T, N), np.float32)
-        last_dones = None
 
         for t in range(T):
             self.rng, k = jax.random.split(self.rng)
             mobs = self.obs
             if self.env_to_module is not None:
                 mobs = np.asarray(self.env_to_module(
-                    {"obs": self.obs}, dones=last_dones
+                    {"obs": self.obs}, dones=self._last_dones
                 )["obs"], np.float32)
             if obs_buf is None:
                 obs_buf = np.empty((T, N, mobs.shape[1]), np.float32)
@@ -156,7 +159,7 @@ class SingleAgentEnvRunner:
                     self._ep_len[i] = 0
                     nobs = env.reset()[0]
                 self.obs[i] = np.asarray(nobs, np.float32).ravel()
-            last_dones = done_buf[t]  # lets FrameStack reset columns next step
+            self._last_dones = done_buf[t]  # FrameStack resets next step
         fobs = self.obs
         if self.env_to_module is not None:
             # Same transform the module saw during the fragment; a one-off

@@ -70,6 +70,40 @@ def broadcast_from_rank_zero(data: Any = None, *, name: str = "bcast",
     raise TimeoutError(f"broadcast_from_rank_zero({name}) timed out")
 
 
+def allgather(data: Any = None, *, name: str = "allgather",
+              timeout_s: float = 60.0) -> list:
+    """Every rank's ``data``, rank-ordered, returned on every rank (used
+    for rendezvous that needs all worker addresses, e.g. TF_CONFIG cluster
+    specs). Same same-order contract as the other collectives."""
+    from ray_tpu._private.worker import get_global_worker
+
+    ctx = get_context()
+    w = get_global_worker()
+    gen = _seq(ctx, "g:" + name)
+    ns = _ns(ctx)
+    prefix = _key(ctx, f"ag:{name}:{gen}:")
+    w.run_sync(w.gcs.call(
+        "kv_put", {"ns": ns, "key": f"{prefix}{ctx.get_world_rank()}"},
+        [cloudpickle.dumps(data)],
+    ))
+    deadline = time.monotonic() + timeout_s
+    world = ctx.get_world_size()
+    while time.monotonic() < deadline:
+        h, _ = w.run_sync(w.gcs.call("kv_keys", {"ns": ns, "prefix": prefix}))
+        if len(h.get("keys", [])) >= world:
+            out = []
+            for r in range(world):
+                hh, frames = w.run_sync(w.gcs.call(
+                    "kv_get", {"ns": ns, "key": f"{prefix}{r}"}
+                ))
+                if not hh.get("found"):
+                    raise RuntimeError(f"allgather({name}): rank {r} vanished")
+                out.append(cloudpickle.loads(frames[0]))
+            return out
+        time.sleep(_POLL_S)
+    raise TimeoutError(f"allgather({name}) timed out")
+
+
 def barrier(*, name: str = "barrier", timeout_s: float = 60.0):
     """Blocks until every rank of the group arrives (same-order contract)."""
     from ray_tpu._private.worker import get_global_worker

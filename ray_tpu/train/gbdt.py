@@ -1,0 +1,185 @@
+"""Tree trainers: XGBoost / LightGBM on the framework's worker groups.
+
+Reference analog: ``python/ray/train/xgboost/`` and
+``python/ray/train/lightgbm/`` (v2 shape: a ``*Trainer`` running the
+native library's distributed training inside the framework's worker group,
+with the collective/rendezvous handled by the backend config —
+``xgboost.collective`` rabit-style tracker / LightGBM machine lists).
+
+Import-gated: the libraries are not in the base image, so constructing a
+trainer raises a clear ImportError naming the runtime-env route instead of
+failing deep inside a worker. When the library IS present, training runs:
+single-worker fits natively; multi-worker wires the library's own
+distributed setup from the train collectives (allgather of worker
+addresses).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.trainer import DataParallelTrainer
+
+
+def _require(module: str, trainer: str):
+    import importlib
+
+    try:
+        return importlib.import_module(module)
+    except ImportError as e:
+        raise ImportError(
+            f"{trainer} needs the '{module}' package, which is not in this "
+            f"image. Provide it per-task: runtime_env={{'pip': "
+            f"['{module}']}} on the trainer's workers, or bake it into an "
+            f"image_uri environment."
+        ) from e
+
+
+def _xgb_loop(user_params: Dict[str, Any], label_column: str,
+              num_boost_round: int) -> Callable:
+    def loop(config):
+        import numpy as np
+        import xgboost as xgb
+
+        from ray_tpu.train.collective import allgather
+        from ray_tpu.train.context import get_context, report
+        from ray_tpu.train.trainer import get_dataset_shard
+
+        ctx = get_context()
+        world = ctx.get_world_size()
+        shard = get_dataset_shard("train")
+        batches = list(shard.iter_batches(batch_size=65536))
+        X = np.concatenate([
+            np.stack([v for k, v in b.items() if k != label_column], 1)
+            if len(b) > 2 else
+            np.asarray(b[[k for k in b if k != label_column][0]]).reshape(
+                len(b[label_column]), -1
+            )
+            for b in batches
+        ])
+        y = np.concatenate([np.asarray(b[label_column]) for b in batches])
+        dtrain = xgb.DMatrix(X, label=y)
+        if world > 1:
+            # xgboost >= 2: native collective tracker. Rank 0 hosts it;
+            # every rank joins via the gathered address.
+            from xgboost import collective as xcoll
+            from xgboost.tracker import RabitTracker
+
+            from ray_tpu._private.worker import get_global_worker
+
+            host = get_global_worker().addr[0]
+            if ctx.get_world_rank() == 0:
+                tracker = RabitTracker(
+                    host_ip=host, n_workers=world, sortby="task"
+                )
+                tracker.start()
+                args = tracker.worker_args()
+            else:
+                args = None
+            args = allgather(args, name="xgb_tracker")[0]
+            with xcoll.CommunicatorContext(**args):
+                booster = xgb.train(
+                    user_params, dtrain, num_boost_round=num_boost_round
+                )
+        else:
+            booster = xgb.train(
+                user_params, dtrain, num_boost_round=num_boost_round
+            )
+        if ctx.get_world_rank() == 0:
+            report({"model_json": booster.save_raw("json").decode()})
+
+    return loop
+
+
+class XGBoostTrainer(DataParallelTrainer):
+    """Distributed XGBoost (reference: ``ray.train.xgboost.XGBoostTrainer``).
+
+    Gated: raises ImportError at construction when xgboost is absent."""
+
+    def __init__(
+        self,
+        *,
+        params: Dict[str, Any],
+        label_column: str,
+        num_boost_round: int = 10,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+    ):
+        _require("xgboost", "XGBoostTrainer")
+        super().__init__(
+            _xgb_loop(params, label_column, num_boost_round),
+            scaling_config=scaling_config,
+            run_config=run_config,
+            datasets=datasets,
+        )
+
+
+def _lgbm_loop(user_params: Dict[str, Any], label_column: str,
+               num_boost_round: int) -> Callable:
+    def loop(config):
+        import lightgbm as lgb
+        import numpy as np
+
+        from ray_tpu.train.collective import allgather
+        from ray_tpu.train.context import get_context, report
+        from ray_tpu.train.trainer import get_dataset_shard
+
+        ctx = get_context()
+        world = ctx.get_world_size()
+        shard = get_dataset_shard("train")
+        batches = list(shard.iter_batches(batch_size=65536))
+        X = np.concatenate([
+            np.stack([v for k, v in b.items() if k != label_column], 1)
+            for b in batches
+        ])
+        y = np.concatenate([np.asarray(b[label_column]) for b in batches])
+        params = dict(user_params)
+        if world > 1:
+            # LightGBM socket-mode distributed training: every machine
+            # lists every (host, port); local rank picks its own port.
+            import socket as _socket
+
+            from ray_tpu._private.worker import get_global_worker
+
+            host = get_global_worker().addr[0]
+            with _socket.socket() as s:
+                s.bind(("", 0))
+                port = s.getsockname()[1]
+            machines = allgather(f"{host}:{port}", name="lgbm_machines")
+            params.update({
+                "tree_learner": params.get("tree_learner", "data"),
+                "num_machines": world,
+                "machines": ",".join(machines),
+                "local_listen_port": port,
+            })
+        train_set = lgb.Dataset(X, label=y)
+        booster = lgb.train(params, train_set,
+                            num_boost_round=num_boost_round)
+        if ctx.get_world_rank() == 0:
+            report({"model_str": booster.model_to_string()})
+
+    return loop
+
+
+class LightGBMTrainer(DataParallelTrainer):
+    """Distributed LightGBM (reference:
+    ``ray.train.lightgbm.LightGBMTrainer``). Gated like XGBoostTrainer."""
+
+    def __init__(
+        self,
+        *,
+        params: Dict[str, Any],
+        label_column: str,
+        num_boost_round: int = 10,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+    ):
+        _require("lightgbm", "LightGBMTrainer")
+        super().__init__(
+            _lgbm_loop(params, label_column, num_boost_round),
+            scaling_config=scaling_config,
+            run_config=run_config,
+            datasets=datasets,
+        )

@@ -1,0 +1,138 @@
+"""PyTorch Lightning glue: run Lightning loops inside TorchTrainer workers.
+
+Reference analog: ``python/ray/train/lightning/`` — ``RayDDPStrategy``,
+``RayLightningEnvironment`` (cluster env that reads ranks from the train
+context instead of env-var guessing), ``RayTrainReportCallback`` (metrics →
+``train.report``), and ``prepare_trainer`` (validates the strategy/env
+combo).
+
+Import-gated: lightning is not in the base image, so every entry point
+raises a clear ImportError naming the runtime-env route when it is absent.
+Use inside a ``TorchTrainer`` train loop — the process group is already
+formed by the torch backend, so the strategy connects to it rather than
+launching its own.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+
+def _require_lightning():
+    import importlib
+
+    for root in ("pytorch_lightning", "lightning.pytorch"):
+        try:
+            pl = importlib.import_module(root)
+        except ImportError:
+            continue
+        # Resolve the submodules we use explicitly: attribute access on a
+        # package does not guarantee the submodule was imported.
+        importlib.import_module(f"{root}.plugins.environments")
+        importlib.import_module(f"{root}.strategies")
+        return pl
+    raise ImportError(
+        "ray_tpu.train.lightning needs the 'pytorch_lightning' (or "
+        "'lightning') package, which is not in this image. Provide "
+        "it per-task: runtime_env={'pip': ['pytorch_lightning']} on "
+        "the trainer's workers, or bake it into an image_uri "
+        "environment."
+    )
+
+
+def RayLightningEnvironment():
+    """Cluster environment mapping Lightning's rank/world queries onto the
+    train context (reference: lightning/_lightning_utils.py)."""
+    pl = _require_lightning()
+    ClusterEnvironment = pl.plugins.environments.ClusterEnvironment
+
+    from ray_tpu.train.context import get_context
+
+    class _Env(ClusterEnvironment):
+        @property
+        def creates_processes_externally(self) -> bool:
+            return True  # the worker group spawned us; Lightning must not
+
+        @property
+        def main_address(self) -> str:
+            import os
+
+            return os.environ.get("MASTER_ADDR", "127.0.0.1")
+
+        @property
+        def main_port(self) -> int:
+            import os
+
+            return int(os.environ.get("MASTER_PORT", "0"))
+
+        @staticmethod
+        def detect() -> bool:
+            return True
+
+        def world_size(self) -> int:
+            return get_context().get_world_size()
+
+        def set_world_size(self, size: int) -> None:
+            pass
+
+        def global_rank(self) -> int:
+            return get_context().get_world_rank()
+
+        def set_global_rank(self, rank: int) -> None:
+            pass
+
+        def local_rank(self) -> int:
+            return get_context().get_local_rank()
+
+        def node_rank(self) -> int:
+            return get_context().get_node_rank()
+
+    return _Env()
+
+
+def RayDDPStrategy(**kwargs) -> Any:
+    """DDP strategy that joins the worker group's existing process group
+    (reference: lightning RayDDPStrategy)."""
+    pl = _require_lightning()
+    DDPStrategy = pl.strategies.DDPStrategy
+
+    return DDPStrategy(
+        cluster_environment=RayLightningEnvironment(), **kwargs
+    )
+
+
+def RayTrainReportCallback():
+    """Per-epoch metrics → ``ray_tpu.train.report`` (reference:
+    lightning RayTrainReportCallback)."""
+    pl = _require_lightning()
+
+    from ray_tpu.train.context import report
+
+    class _Report(pl.Callback):
+        def on_train_epoch_end(self, trainer, pl_module) -> None:
+            metrics = {
+                k: (v.item() if hasattr(v, "item") else v)
+                for k, v in trainer.callback_metrics.items()
+            }
+            metrics["epoch"] = trainer.current_epoch
+            metrics["step"] = trainer.global_step
+            report(metrics)
+
+    return _Report()
+
+
+def prepare_trainer(trainer: Any) -> Any:
+    """Validate a Lightning Trainer built for this worker group
+    (reference: lightning/prepare_trainer)."""
+    pl = _require_lightning()
+    DDPStrategy = pl.strategies.DDPStrategy
+    SingleDeviceStrategy = pl.strategies.SingleDeviceStrategy
+
+    if not isinstance(
+        trainer.strategy, (DDPStrategy, SingleDeviceStrategy)
+    ):
+        raise RuntimeError(
+            "prepare_trainer: use RayDDPStrategy() (or single-device) so "
+            "Lightning joins the worker group's process group instead of "
+            "spawning its own"
+        )
+    return trainer

@@ -1,0 +1,152 @@
+"""TensorflowTrainer: multi-worker TF training on the framework.
+
+Reference analog: ``python/ray/train/tensorflow/`` — ``TensorflowConfig`` →
+``_TensorflowBackend`` (``config.py``: ``_setup_tensorflow_environment``
+builds the ``TF_CONFIG`` cluster spec from worker addresses and each
+worker's rank) and ``prepare_dataset_shard``.
+
+Rendezvous rides the train control-plane collectives (``allgather`` of
+per-worker (host, port)) instead of the reference's backend-executor
+address poll; the user loop then creates
+``tf.distribute.MultiWorkerMirroredStrategy()``, which reads ``TF_CONFIG``.
+
+On this framework TF is a CPU/host-side trainer family like torch-gloo;
+the TPU path is ``JaxTrainer``.
+"""
+from __future__ import annotations
+
+import socket
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.train.config import RunConfig, ScalingConfig
+from ray_tpu.train.trainer import DataParallelTrainer
+
+
+@dataclass
+class TensorflowConfig:
+    env_vars: Dict[str, str] = field(default_factory=dict)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+# A TF distributed runtime (server + collective ring) is per-process
+# global, like torch.distributed: guard against two ranks sharing one
+# host process (same rationale and failure mode — a silent rendezvous
+# hang — as ray_tpu/train/torch's _dist_owner slot).
+_slot_lock = threading.Lock()
+_slot_owner: Optional[int] = None
+
+
+def _acquire_tf_slot(rank: int):
+    global _slot_owner
+    with _slot_lock:
+        if _slot_owner is not None:
+            raise RuntimeError(
+                "two train workers share one host process — TensorFlow's "
+                "distributed runtime can hold only one rank per process. "
+                "Spread workers across hosts: "
+                "ScalingConfig(placement_strategy='SPREAD')."
+            )
+        _slot_owner = rank
+
+
+def _release_tf_slot(rank: int):
+    global _slot_owner
+    with _slot_lock:
+        if _slot_owner == rank:
+            _slot_owner = None
+
+
+def _tf_wrapped(user_fn: Callable, tf_config: TensorflowConfig) -> Callable:
+    def wrapped(config):
+        import json
+        import os
+
+        from ray_tpu.train.collective import allgather
+        from ray_tpu.train.context import get_context
+
+        ctx = get_context()
+        world = ctx.get_world_size()
+        rank = ctx.get_world_rank()
+        if world > 1:
+            from ray_tpu.train.tensorflow import (
+                _acquire_tf_slot,
+                _release_tf_slot,
+            )
+
+            _acquire_tf_slot(rank)
+        try:
+            if world > 1:
+                from ray_tpu._private.worker import get_global_worker
+
+                host = get_global_worker().addr[0]
+                addrs = allgather(
+                    f"{host}:{_free_port()}", name="tf_cluster"
+                )
+                os.environ["TF_CONFIG"] = json.dumps({
+                    "cluster": {"worker": addrs},
+                    "task": {"type": "worker", "index": rank},
+                })
+                for k, v in tf_config.env_vars.items():
+                    os.environ[k] = v
+            takes_arg = True
+            try:
+                import inspect
+
+                takes_arg = len(
+                    inspect.signature(user_fn).parameters
+                ) > 0
+            except (TypeError, ValueError):
+                pass
+            return user_fn(config) if takes_arg else user_fn()
+        finally:
+            if world > 1:
+                os.environ.pop("TF_CONFIG", None)
+                _release_tf_slot(rank)
+
+    return wrapped
+
+
+class TensorflowTrainer(DataParallelTrainer):
+    """Multi-worker TF trainer (reference:
+    ``ray.train.tensorflow.TensorflowTrainer``). Import of tensorflow is
+    deferred to the workers: the driver never needs it."""
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        tensorflow_config: Optional[TensorflowConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+    ):
+        super().__init__(
+            _tf_wrapped(train_loop_per_worker,
+                        tensorflow_config or TensorflowConfig()),
+            train_loop_config=train_loop_config,
+            scaling_config=scaling_config,
+            run_config=run_config,
+            datasets=datasets,
+        )
+
+
+def prepare_dataset_shard(dataset):
+    """Disable tf.data autosharding on an already-sharded dataset
+    (reference: ``train/tensorflow/train_loop_utils.py
+    prepare_dataset_shard`` — the framework shards via DataConfig, so
+    tf.data must not shard again)."""
+    import tensorflow as tf
+
+    options = tf.data.Options()
+    options.experimental_distribute.auto_shard_policy = (
+        tf.data.experimental.AutoShardPolicy.OFF
+    )
+    return dataset.with_options(options)

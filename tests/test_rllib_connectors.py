@@ -213,6 +213,20 @@ def test_frame_stack_integration_in_runner():
     assert np.allclose(obs[first_after], 0.0), obs
     assert frag["bootstrap_value"].shape == (1,)
 
+    # episode ending exactly on a fragment's LAST step: the reset must
+    # still reach the connector at the next fragment's first step
+    runner2 = SingleAgentEnvRunner(
+        CountingEnv, num_envs=1, fragment_len=3,
+        module_config={"obs_dim": k, "action_dim": 1, "discrete": False},
+        env_to_module=lambda: FrameStack(k=k),
+    )
+    runner2.set_weights(runner.params)
+    f1 = runner2.sample()
+    assert f1["dones"][-1, 0] == 1.0  # done on the fragment edge
+    f2 = runner2.sample()
+    # fresh episode: stacked history is [0, 0], not [2, 0]
+    assert np.allclose(f2["obs"][0, 0], 0.0), f2["obs"][0, 0]
+
 
 # ----------------------------------------------------------------- TQC algo
 
