@@ -208,7 +208,83 @@ class HeadService:
         self.server = protocol.RpcServer(self._handle, host, port)
         self.addr = await self.server.start()
         logger.info("head service listening on %s", self.addr)
+        # Structured export-event pipeline (reference: RayEventRecorder →
+        # aggregator agent): lifecycle transitions below emit typed events
+        # persisted as JSON-lines in the session dir.
+        try:
+            from ray_tpu.util.events import EventRecorder
+
+            session_dir = os.environ.get(
+                "RT_SESSION_DIR", f"/tmp/ray_tpu/session_p{self.addr[1]}"
+            )
+            self.events = EventRecorder(
+                path=os.path.join(session_dir, "events", "events.jsonl")
+            )
+        except Exception:
+            logger.exception("export-event recorder unavailable")
+            self.events = None
         return self.addr
+
+    def _emit_event(self, source_type: str, event_type: str,
+                    entity_id: str, message: str = "", **attrs):
+        if getattr(self, "events", None) is None:
+            return
+        try:
+            self.events.emit(
+                source_type, event_type, entity_id, message, **attrs
+            )
+        except Exception:
+            pass
+
+    # WAL: durable-table mutations (KV, jobs) append a record BEFORE the
+    # RPC reply, closing the between-snapshots loss window (reference:
+    # redis_store_client.cc — per-mutation durability, not timer-based).
+    def attach_wal(self, path_prefix: str):
+        from ray_tpu._private.wal import WalWriter
+
+        self.wal = WalWriter(path_prefix)
+        return self.wal
+
+    def _wal_append(self, op: dict):
+        wal = getattr(self, "wal", None)
+        if wal is None:
+            return
+        try:
+            wal.append(op)
+            wal.schedule_fsync(asyncio.get_running_loop())
+        except Exception:
+            logger.exception("WAL append failed (durability degraded)")
+
+    def replay_wal(self, path_prefix: str) -> int:
+        """Apply surviving WAL records over restored snapshot state.
+        Idempotent: puts overwrite, deletes are best-effort, job records
+        merge like restore() (running work is terminal after a restart)."""
+        from ray_tpu._private.wal import replay_all
+
+        n = 0
+        for op in replay_all(path_prefix):
+            kind = op.get("op")
+            if kind == "kv_put":
+                self.kv[op["ns"]][op["key"]] = op["val"]
+            elif kind == "kv_del":
+                self.kv[op["ns"]].pop(op["key"], None)
+            elif kind == "kv_del_prefix":
+                ns = self.kv[op["ns"]]
+                for k in [k for k in ns if k.startswith(op["prefix"])]:
+                    ns.pop(k, None)
+            elif kind == "job":
+                info = dict(op["job"])
+                if info.get("status") in ("RUNNING", "STOPPING", "PENDING"):
+                    info["status"] = "FAILED"
+                    info.setdefault("end_time", time.time())
+                if info.get("state") == "RUNNING":
+                    info["state"] = "DEAD"
+                    info.setdefault("end_time", time.time())
+                self.jobs[info["job_id"]] = {
+                    **self.jobs.get(info["job_id"], {}), **info
+                }
+            n += 1
+        return n
 
     async def close(self):
         self._shutting_down = True
@@ -219,6 +295,11 @@ class HeadService:
             await asyncio.gather(
                 *list(self._death_tasks), return_exceptions=True
             )
+        if getattr(self, "events", None) is not None:
+            try:
+                self.events.close()
+            except Exception:
+                pass
 
     # -------------------------------------------------------- persistence
     # Reference analog: GCS fault tolerance via Redis-backed store +
@@ -318,7 +399,10 @@ class HeadService:
 
     async def rpc_kv_put(self, h, frames, conn):
         ns = h.get("ns", "")
-        self.kv[ns][h["key"]] = frames[0] if frames else b""
+        val = frames[0] if frames else b""
+        self.kv[ns][h["key"]] = val
+        self._wal_append({"op": "kv_put", "ns": ns, "key": h["key"],
+                          "val": val})
         return {}, []
 
     async def rpc_kv_get(self, h, frames, conn):
@@ -327,6 +411,9 @@ class HeadService:
 
     async def rpc_kv_del(self, h, frames, conn):
         existed = self.kv[h.get("ns", "")].pop(h["key"], None) is not None
+        if existed:
+            self._wal_append({"op": "kv_del", "ns": h.get("ns", ""),
+                              "key": h["key"]})
         return {"deleted": existed}, []
 
     async def rpc_kv_del_prefix(self, h, frames, conn):
@@ -336,6 +423,9 @@ class HeadService:
             ns.pop(k, None)
         if not ns:
             self.kv.pop(h.get("ns", ""), None)
+        if doomed:
+            self._wal_append({"op": "kv_del_prefix", "ns": h.get("ns", ""),
+                              "prefix": h.get("prefix", "")})
         return {"deleted": len(doomed)}, []
 
     async def rpc_kv_keys(self, h, frames, conn):
@@ -372,6 +462,8 @@ class HeadService:
         # just re-registered (blip + reconnect) must not tear down the NEW
         # registration when its queued close event finally runs.
         info.epoch = next(self._conn_serial)
+        self._emit_event("NODE", "NODE_ALIVE", info.node_id,
+                         addr=list(info.addr), resources=info.resources)
         conn.peer_info["node_id"] = info.node_id
         conn.on_close = self._make_node_close_handler(info.node_id, info.epoch)
         # Live rejoin after a head restart: the node re-reports the actors
@@ -476,6 +568,7 @@ class HeadService:
             else logger.warning
         )
         log("node %s dead: %s", node_id[:8], reason)
+        self._emit_event("NODE", "NODE_DEAD", node_id, message=reason)
         self.publish("nodes", {"event": "node_dead", "node_id": node_id})
         # Log plane: keep a post-mortem tail for the dead node but shrink
         # its ring (a full 10k-line deque per dead node would grow the head
@@ -895,6 +988,10 @@ class HeadService:
             info.node_id = node.node_id
             info.addr = node.addr
             info.state = "ALIVE"
+            self._emit_event("ACTOR", "ACTOR_ALIVE", info.actor_id,
+                             class_name=info.class_name,
+                             node_id=node.node_id,
+                             restarts_used=info.restarts_used)
             self.publish(f"actor:{info.actor_id}", info.to_public())
             return True
         return False
@@ -939,6 +1036,9 @@ class HeadService:
             self._release_actor_placement(actor)
             actor.restarts_used += 1
             actor.state = "RESTARTING"
+            self._emit_event("ACTOR", "ACTOR_RESTARTING", actor.actor_id,
+                             message=reason,
+                             restarts_used=actor.restarts_used)
             actor.death_reason = reason
             self.publish(f"actor:{actor.actor_id}", actor.to_public())
             strategy = {}
@@ -954,6 +1054,9 @@ class HeadService:
         else:
             actor.state = "DEAD"
             actor.death_reason = reason
+            self._emit_event("ACTOR", "ACTOR_DEAD", actor.actor_id,
+                             message=reason,
+                             class_name=actor.class_name)
             if actor.name:
                 self.named_actors.pop((actor.namespace, actor.name), None)
             self._release_actor_placement(actor)
@@ -1170,6 +1273,8 @@ class HeadService:
             pg.bundle_nodes[i] = node.node_id
         self.pg_reserved[pg.pg_id] = [dict(b) for b in pg.bundles]
         pg.state = "CREATED"
+        self._emit_event("PLACEMENT_GROUP", "PG_CREATED", pg.pg_id,
+                         strategy=pg.strategy, bundles=len(pg.bundles))
         self.publish(f"pg:{pg.pg_id}", pg.to_public())
         return True
 
@@ -1217,6 +1322,7 @@ class HeadService:
         pg = self.pgs.get(h["pg_id"])
         if pg is None or pg.state == "REMOVED":
             return {}, []
+        self._emit_event("PLACEMENT_GROUP", "PG_REMOVED", pg.pg_id)
         if pg.state == "CREATED":
             for i, nid in enumerate(pg.bundle_nodes):
                 node = self.nodes.get(nid) if nid else None
@@ -1346,10 +1452,23 @@ class HeadService:
 
     # ------------------------------------------------------------- jobs/state
 
+    async def rpc_export_events(self, h, frames, conn):
+        """Recent structured export events (reference: the aggregator's
+        event query surface); filterable by source/event type."""
+        if getattr(self, "events", None) is None:
+            return {"events": []}, []
+        return {"events": self.events.recent(
+            limit=h.get("limit", 100),
+            source_type=h.get("source_type"),
+            event_type=h.get("event_type"),
+        )}, []
+
     async def rpc_register_job(self, h, frames, conn):
         self.jobs[h["job_id"]] = {
             "job_id": h["job_id"], "start_time": time.time(), "state": "RUNNING",
         }
+        self._wal_append({"op": "job", "job": self.jobs[h["job_id"]]})
+        self._emit_event("JOB", "JOB_STARTED", h["job_id"])
         return {}, []
 
     async def rpc_list_jobs(self, h, frames, conn):
@@ -1488,6 +1607,7 @@ class HeadService:
             "start_time": time.time(), "end_time": None, "log_path": log_path,
             "metadata": h.get("metadata") or {},
         }
+        self._wal_append({"op": "job", "job": dict(self.jobs[sub_id])})
         asyncio.get_running_loop().create_task(self._watch_job(sub_id, proc))
         return {"submission_id": sub_id}, []
 
@@ -1503,6 +1623,7 @@ class HeadService:
                     "SUCCEEDED" if proc.returncode == 0 else "FAILED"
                 )
             info["end_time"] = time.time()
+            self._wal_append({"op": "job", "job": dict(info)})
 
     async def rpc_job_status(self, h, frames, conn):
         info = self.jobs.get(h["submission_id"])

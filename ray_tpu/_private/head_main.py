@@ -78,6 +78,16 @@ def main(argv=None):
     port = args.port
     if args.state_file:
         head.load_from_file(args.state_file)
+        # Replay WAL over the snapshot: durable mutations since the last
+        # snapshot write (reference: Redis-store per-mutation durability).
+        try:
+            n = head.replay_wal(args.state_file + ".wal")
+            if n:
+                logging.getLogger(__name__).info(
+                    "replayed %d WAL records", n
+                )
+        except Exception:
+            logging.getLogger(__name__).exception("WAL replay failed")
         # Rebind the previous port (unless one was given explicitly) so
         # live nodes/drivers holding the old address can rejoin — the
         # worker side retries its head connection on loss (live-cluster
@@ -95,22 +105,38 @@ def main(argv=None):
         addr = loop.run_until_complete(head.start(args.host, args.port))
 
     if args.state_file:
+        wal = head.attach_wal(args.state_file + ".wal")
+        # In-flight off-loop snapshot write, visible to the shutdown path:
+        # a stale write completing AFTER the final save would clobber it
+        # (and the WAL is deleted by then — silent data loss).
+        inflight = {"fut": None}
+
+        def _write_state(blob):
+            # one executor hop: old-generation fsync + snapshot write
+            wal.sync_retired()
+            head.write_snapshot(args.state_file, blob)
+
         async def _persist_loop():
             while True:
                 await asyncio.sleep(args.state_save_interval)
                 try:
-                    # Snapshot ON the loop (handlers mutate the tables
-                    # between awaits only), write+fsync OFF it.
+                    # Rotate the WAL, then snapshot, both ON the loop (no
+                    # op can fall between); write+fsync OFF it. Old WAL
+                    # generations die only after the snapshot is durable.
+                    old_gen = wal.rotate()
                     blob = head.snapshot()
-                    await loop.run_in_executor(
-                        None, head.write_snapshot, args.state_file, blob
+                    inflight["fut"] = loop.run_in_executor(
+                        None, _write_state, blob
                     )
+                    await inflight["fut"]
+                    inflight["fut"] = None
+                    wal.delete_through(old_gen)
                 except Exception:
                     logging.getLogger(__name__).exception(
                         "head state persistence failed; will retry"
                     )
 
-        loop.create_task(_persist_loop())
+        persist_task = loop.create_task(_persist_loop())
 
     dash_port = None
     dashboard = None
@@ -157,8 +183,17 @@ def main(argv=None):
     def term(*_):
         loop.stop()
 
-    signal.signal(signal.SIGTERM, term)
-    signal.signal(signal.SIGINT, term)
+    # loop.add_signal_handler, NOT signal.signal: a raw handler that calls
+    # loop.stop() cannot wake a selector blocked on a long timeout — PEP
+    # 475 retries the poll after the handler returns, so shutdown would
+    # wait out the persist timer (observed: hung head with a 1h interval).
+    # asyncio's handler rides the loop's self-pipe and wakes it instantly.
+    try:
+        loop.add_signal_handler(signal.SIGTERM, term)
+        loop.add_signal_handler(signal.SIGINT, term)
+    except (NotImplementedError, RuntimeError):  # non-main thread/platform
+        signal.signal(signal.SIGTERM, term)
+        signal.signal(signal.SIGINT, term)
     exit_code = 0
     try:
         loop.run_forever()
@@ -168,7 +203,26 @@ def main(argv=None):
     finally:
         if args.state_file:
             try:
+                # the persist task must not tick against a closed WAL while
+                # the loop drains below
+                persist_task.cancel()
+                # join any in-flight executor snapshot write first: its
+                # os.replace landing after the final save would roll the
+                # state file back to a pre-shutdown blob
+                fut = inflight.get("fut")
+                if fut is not None and not fut.done():
+                    try:
+                        loop.run_until_complete(
+                            asyncio.wait_for(asyncio.shield(fut), timeout=10)
+                        )
+                    except Exception:
+                        pass
                 head.save_to_file(args.state_file)
+                from ray_tpu._private.wal import delete_all
+
+                head.wal.close()
+                # clean shutdown: the snapshot covers everything
+                delete_all(args.state_file + ".wal")
             except OSError:
                 pass
         if node is not None:

@@ -47,9 +47,10 @@ class SyncHeadClient:
     async def _noop_handler(self, method, header, frames, conn):
         return {}, []
 
-    def call(self, method: str, header: dict, timeout: float = 30.0):
+    def call(self, method: str, header: dict, timeout: float = 30.0,
+             frames: list = ()):
         fut = asyncio.run_coroutine_threadsafe(
-            self._conn.call(method, header), self._loop
+            self._conn.call(method, header, frames), self._loop
         )
         return fut.result(timeout)
 

@@ -1,0 +1,186 @@
+"""Write-ahead log for durable head state.
+
+Reference analog: GCS fault tolerance via a Redis-backed store
+(``src/ray/gcs/store_client/redis_store_client.cc``) — every durable table
+mutation is persisted as it happens, not on a snapshot timer. The TPU-era
+head keeps the snapshot-and-replay shape (``gcs.py snapshot/restore``) and
+closes the between-snapshots loss window with this log: durable mutations
+(KV puts/deletes, job records) append a record before the RPC reply, and
+restart replays snapshot + WAL.
+
+Format: per record ``<u32 len><u32 crc32><payload>`` where payload is a
+pickled op dict. Replay stops at the first short/corrupt record (a torn
+tail write is expected on crash — everything before it is intact).
+
+Generational rotation ties the log to the snapshot cycle: rotate() opens
+generation N+1 *before* the snapshot captures state (both on the head's
+event loop, so no op falls between), and once the snapshot is durably on
+disk the old generations are deleted. Restore replays every surviving
+generation in order — replay is idempotent (puts overwrite, deletes are
+best-effort), so a failed snapshot write only means replaying more.
+
+fsync policy: appends are buffered+flushed synchronously (survives process
+crash); fsync (survives host crash) is coalesced off the event loop — the
+same durability-vs-latency point as Redis ``appendfsync everysec``.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import zlib
+from typing import Any, Dict, Iterator, List, Optional
+
+_HDR = struct.Struct("<II")
+
+
+class WalWriter:
+    def __init__(self, path_prefix: str):
+        self.prefix = path_prefix
+        d = os.path.dirname(os.path.abspath(path_prefix))
+        os.makedirs(d, exist_ok=True)
+        gens = existing_generations(path_prefix)
+        self.gen = (gens[-1] + 1) if gens else 0
+        self._f = open(self._path(self.gen), "ab")
+        self._fsync_pending = False
+        self._dirty = False          # bytes appended since last fsync start
+        self._retired: List[Any] = []  # rotated-out files awaiting fsync
+
+    def _path(self, gen: int) -> str:
+        return f"{self.prefix}.{gen:08d}"
+
+    def append(self, op: Dict[str, Any]) -> None:
+        if self._f.closed:
+            raise ValueError("WAL closed")
+        payload = pickle.dumps(op, protocol=pickle.HIGHEST_PROTOCOL)
+        self._f.write(_HDR.pack(len(payload), zlib.crc32(payload)))
+        self._f.write(payload)
+        self._f.flush()  # survives process crash; host-crash via fsync
+        self._dirty = True
+
+    def schedule_fsync(self, loop) -> None:
+        """Coalesced off-loop fsync: at most one in flight, and appends
+        that land DURING an in-flight fsync re-arm a follow-up (the
+        trailing bytes must not wait for the next snapshot tick)."""
+        if self._fsync_pending or not self._dirty:
+            return
+        self._fsync_pending = True
+        self._dirty = False  # covers bytes appended up to this point
+        f = self._f
+
+        def _sync():
+            try:
+                os.fsync(f.fileno())
+            except (OSError, ValueError):  # rotated/closed underneath
+                pass
+
+        def _done(_):
+            self._fsync_pending = False
+            if self._dirty and not self._f.closed:
+                self.schedule_fsync(loop)  # appends arrived mid-flight
+
+        try:
+            fut = loop.run_in_executor(None, _sync)
+            fut.add_done_callback(_done)
+        except RuntimeError:  # loop closing
+            self._fsync_pending = False
+
+    def rotate(self) -> int:
+        """Switch appends to a fresh generation; returns the OLD gen id.
+        Call on the event loop immediately before snapshotting. The old
+        file is flushed here (cheap) but fsync'd+closed lazily off-loop —
+        call sync_retired() from the same executor hop that writes the
+        snapshot (an on-loop fsync would stall every RPC for its
+        duration)."""
+        old = self.gen
+        old_f = self._f
+        if old_f.closed:
+            raise ValueError("WAL closed")
+        self.gen += 1
+        self._f = open(self._path(self.gen), "ab")
+        try:
+            old_f.flush()
+        except OSError:
+            pass
+        self._retired.append(old_f)
+        return old
+
+    def sync_retired(self) -> None:
+        """fsync + close rotated-out generations (call OFF the loop)."""
+        retired, self._retired = self._retired, []
+        for f in retired:
+            try:
+                os.fsync(f.fileno())
+            except (OSError, ValueError):
+                pass
+            try:
+                f.close()
+            except OSError:
+                pass
+
+    def delete_through(self, gen: int) -> None:
+        """Remove generations <= gen (their ops are in a durable snapshot)."""
+        for g in existing_generations(self.prefix):
+            if g <= gen and g != self.gen:
+                try:
+                    os.remove(self._path(g))
+                except OSError:
+                    pass
+
+    def close(self) -> None:
+        self.sync_retired()
+        try:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+        except OSError:
+            pass
+        self._f.close()
+
+
+def existing_generations(path_prefix: str) -> List[int]:
+    d = os.path.dirname(os.path.abspath(path_prefix)) or "."
+    base = os.path.basename(path_prefix)
+    gens = []
+    try:
+        for name in os.listdir(d):
+            if name.startswith(base + "."):
+                suffix = name[len(base) + 1:]
+                if suffix.isdigit():
+                    gens.append(int(suffix))
+    except OSError:
+        pass
+    return sorted(gens)
+
+
+def replay_file(path: str) -> Iterator[Dict[str, Any]]:
+    """Yield ops until EOF or the first torn/corrupt record."""
+    try:
+        f = open(path, "rb")
+    except OSError:
+        return
+    with f:
+        while True:
+            hdr = f.read(_HDR.size)
+            if len(hdr) < _HDR.size:
+                return
+            length, crc = _HDR.unpack(hdr)
+            payload = f.read(length)
+            if len(payload) < length or zlib.crc32(payload) != crc:
+                return  # torn tail write: everything before it was intact
+            try:
+                yield pickle.loads(payload)
+            except Exception:
+                return
+
+
+def replay_all(path_prefix: str) -> Iterator[Dict[str, Any]]:
+    for gen in existing_generations(path_prefix):
+        yield from replay_file(f"{path_prefix}.{gen:08d}")
+
+
+def delete_all(path_prefix: str) -> None:
+    for gen in existing_generations(path_prefix):
+        try:
+            os.remove(f"{path_prefix}.{gen:08d}")
+        except OSError:
+            pass

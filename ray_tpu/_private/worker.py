@@ -2312,6 +2312,19 @@ class CoreWorker:
                     self, runtime_env["py_modules"]
                 ),
             )
+        hook = runtime_env.get("worker_process_setup_hook")
+        if callable(hook):
+            # Callables cannot ride the msgpack task header: pickle at
+            # submit time (reference: setup_hook.py exports the hook via
+            # the function table).
+            import cloudpickle
+
+            runtime_env = dict(
+                runtime_env,
+                worker_process_setup_hook={
+                    "__pickled_hook__": cloudpickle.dumps(hook).hex()
+                },
+            )
         return runtime_env
 
     def _sched_key(self, resources, strategy):
@@ -3152,6 +3165,11 @@ class CoreWorker:
         from ray_tpu._private.runtime_env.executor import EnvExecutor
 
         renv_mod.validate(renv)
+        hook = renv.get("worker_process_setup_hook")
+        if hook:
+            # the hook must run in the process that executes the task —
+            # the env-executor CHILD, not this parent
+            fn = renv_mod.SetupHookTask(hook, fn)
         use_uv = bool(renv.get("uv"))
         packages = list(renv.get("uv") or renv.get("pip") or ())
         entries = []
@@ -3272,6 +3290,20 @@ class CoreWorker:
     # restore). Tasks without working_dir never touch cwd and skip the lock.
     _cwd_lock = threading.Lock()
 
+    def _run_setup_hook(self, renv: dict):
+        """worker_process_setup_hook (reference:
+        ``_private/runtime_env/setup_hook.py``): run ONCE per worker
+        process before the first task using the env executes. Failures
+        propagate — a task must not run half-initialized. Runs AFTER the
+        rest of the env (env_vars/py_modules/working_dir) is in place so
+        hooks may depend on it."""
+        hook = (renv or {}).get("worker_process_setup_hook")
+        if not hook:
+            return
+        from ray_tpu._private import runtime_env as renv_mod
+
+        renv_mod.run_setup_hook_once(hook)
+
     def _apply_runtime_env(self, renv: dict):
         """Per-task environment (reference: _private/runtime_env/ plugins).
         Applied on the executor thread: env_vars, working_dir (cwd is
@@ -3314,8 +3346,16 @@ class CoreWorker:
             except OSError as e:
                 logger.warning("working_dir %r: %s", renv["working_dir"], e)
                 cwd = None
-        return {"env": old, "cwd": cwd, "locked": locked,
-                "sys_path": inserted}
+        state = {"env": old, "cwd": cwd, "locked": locked,
+                 "sys_path": inserted}
+        try:
+            # after env_vars/py_modules/working_dir: hooks may import
+            # staged modules or read the env they were shipped with
+            self._run_setup_hook(renv)
+        except BaseException:
+            self._restore_env(state)
+            raise
+        return state
 
     def _restore_env(self, old):
         if old.get("sys_path"):

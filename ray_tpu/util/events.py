@@ -1,0 +1,178 @@
+"""Structured export events: typed lifecycle records for external systems.
+
+Reference analog: the reference's structured Ray-event pipeline —
+``src/ray/observability/ray_event_recorder.cc`` (typed definition +
+lifecycle events for actors/jobs/nodes/tasks), the export schemas
+(``src/ray/protobuf/export_*.proto``), and the aggregator agent
+(``dashboard/modules/aggregator/aggregator_agent.py:76``) that buffers
+events and publishes them to external HTTP targets.
+
+TPU-era design: one recorder on the head (lifecycle authority), a JSON
+schema instead of protobuf (the control plane is msgpack/JSON end-to-end),
+JSON-lines persistence in the session dir, and an optional HTTP POST
+target (``RT_EVENT_HTTP_TARGET``) with bounded buffering + drop-oldest
+backpressure — the aggregator's publish loop collapsed into the recorder
+since there is no per-node agent tree to aggregate across.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from collections import deque
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, List, Optional
+
+# Event taxonomy (reference: export_*.proto event families)
+SOURCE_TYPES = ("NODE", "ACTOR", "TASK", "JOB", "PLACEMENT_GROUP", "DRIVER")
+
+
+@dataclass
+class ExportEvent:
+    event_id: str
+    timestamp: float
+    source_type: str           # one of SOURCE_TYPES
+    event_type: str            # e.g. NODE_ALIVE / NODE_DEAD / ACTOR_CREATED
+    entity_id: str
+    message: str = ""
+    attributes: Dict[str, Any] = field(default_factory=dict)
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self), separators=(",", ":"),
+                          default=str)
+
+
+class EventRecorder:
+    """Buffers typed events, appends them to a JSON-lines file, and
+    (optionally) POSTs batches to an HTTP target."""
+
+    def __init__(self, path: Optional[str] = None,
+                 http_target: Optional[str] = None,
+                 max_buffer: int = 10_000,
+                 flush_interval_s: float = 1.0):
+        self.path = path
+        self.http_target = http_target or os.environ.get(
+            "RT_EVENT_HTTP_TARGET"
+        )
+        self._buf: deque = deque(maxlen=max_buffer)  # drop-oldest
+        self._recent: deque = deque(maxlen=max_buffer)  # query window
+        self._lock = threading.Lock()
+        # async HTTP publishing (bounded backlog; drained by daemon thread)
+        self._http_batches: deque = deque(maxlen=64)
+        self._http_lock = threading.Lock()
+        self._http_thread: Optional[threading.Thread] = None
+        self._flush_interval = flush_interval_s
+        self._last_flush = 0.0
+        self._dropped = 0
+        if self.path:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+
+    def emit(self, source_type: str, event_type: str, entity_id: str,
+             message: str = "", **attributes) -> ExportEvent:
+        if source_type not in SOURCE_TYPES:
+            raise ValueError(
+                f"unknown source_type {source_type!r}; one of {SOURCE_TYPES}"
+            )
+        ev = ExportEvent(
+            event_id=uuid.uuid4().hex,
+            timestamp=time.time(),
+            source_type=source_type,
+            event_type=event_type,
+            entity_id=entity_id,
+            message=message,
+            attributes=attributes,
+        )
+        with self._lock:
+            if len(self._buf) == self._buf.maxlen:
+                self._dropped += 1
+            self._buf.append(ev)
+            self._recent.append(ev)
+        if time.monotonic() - self._last_flush >= self._flush_interval:
+            self.flush()
+        return ev
+
+    def flush(self) -> int:
+        """Drain the buffer to the JSONL sink + HTTP target. Returns the
+        number of events flushed."""
+        with self._lock:
+            batch = list(self._buf)
+            self._buf.clear()
+            self._last_flush = time.monotonic()
+        if not batch:
+            return 0
+        if self.path:
+            try:
+                with open(self.path, "a") as f:
+                    for ev in batch:
+                        f.write(ev.to_json() + "\n")
+            except OSError:
+                pass
+        if self.http_target:
+            # NEVER on the caller's thread: emit() runs on the head's
+            # event loop, and a slow/unreachable target would stall the
+            # whole control plane for the urlopen timeout. A dedicated
+            # daemon thread drains batches (reference: the aggregator
+            # agent's async publish loop).
+            with self._http_lock:
+                self._http_batches.append(batch)
+                if self._http_thread is None or not self._http_thread.is_alive():
+                    self._http_thread = threading.Thread(
+                        target=self._http_drain, daemon=True,
+                        name="rt-event-publish",
+                    )
+                    self._http_thread.start()
+        return len(batch)
+
+    def _http_drain(self):
+        import urllib.request
+
+        while True:
+            with self._http_lock:
+                if not self._http_batches:
+                    self._http_thread = None
+                    return
+                batch = self._http_batches.popleft()
+            try:
+                req = urllib.request.Request(
+                    self.http_target,
+                    data=json.dumps(
+                        [asdict(e) for e in batch], default=str
+                    ).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                urllib.request.urlopen(req, timeout=5)
+            except Exception:
+                # External target down: events stay in the JSONL sink;
+                # the reference aggregator likewise drops on publish error
+                pass
+
+    def recent(self, limit: int = 100,
+               source_type: Optional[str] = None,
+               event_type: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            evs = list(self._recent)
+        if source_type:
+            evs = [e for e in evs if e.source_type == source_type]
+        if event_type:
+            evs = [e for e in evs if e.event_type == event_type]
+        return [asdict(e) for e in evs[-limit:]]
+
+    @property
+    def dropped(self) -> int:
+        return self._dropped
+
+    def close(self):
+        self.flush()
+
+
+def read_events(path: str) -> List[dict]:
+    """Parse an events.jsonl file back into dicts (ops tooling/tests)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

@@ -70,6 +70,13 @@ def test_task_summary(ops_cluster):
 def standalone_head(tmp_path_factory):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    # Self-sufficient auth: clients in this module authenticate with the
+    # same token as the head regardless of test-file ordering (stdout info
+    # is redacted, so the env is the distribution channel here).
+    tok = env.get("RT_AUTH_TOKEN") or "standalone-head-test-token"
+    env["RT_AUTH_TOKEN"] = tok
+    prev = os.environ.get("RT_AUTH_TOKEN")
+    os.environ["RT_AUTH_TOKEN"] = tok
     proc = subprocess.Popen(
         [sys.executable, "-m", "ray_tpu._private.head_main",
          "--num-cpus", "2", "--dashboard-port", "0"],
@@ -78,6 +85,10 @@ def standalone_head(tmp_path_factory):
     line = proc.stdout.readline().strip()
     info = json.loads(line)
     yield info
+    if prev is None:
+        os.environ.pop("RT_AUTH_TOKEN", None)
+    else:
+        os.environ["RT_AUTH_TOKEN"] = prev
     proc.terminate()
     try:
         proc.wait(timeout=10)
@@ -292,10 +303,13 @@ def test_autoscaler_scales_up_and_down():
         ray_tpu.shutdown()
 
 
-def test_head_state_survives_restart(tmp_path):
+def test_head_state_survives_restart(tmp_path, monkeypatch):
     """Durable head state (KV, job records) persists across a head restart
     (reference: GCS fault tolerance via Redis-backed store + init replay)."""
     state_file = str(tmp_path / "head_state.bin")
+    # fixed token: standalone runs have no ambient cluster token, and the
+    # redacted stdout info cannot carry one to this client
+    monkeypatch.setenv("RT_AUTH_TOKEN", "statetest" * 3)
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
 
@@ -422,6 +436,11 @@ def test_head_restart_live_rejoin(tmp_path):
     import signal as _signal
 
     state_file = str(tmp_path / "head_state.bin")
+    # fixed token: --no-address-file + redacted stdout means the env is
+    # the only channel to this driver (standalone runs have no ambient
+    # cluster token)
+    prev_tok = os.environ.get("RT_AUTH_TOKEN")
+    os.environ["RT_AUTH_TOKEN"] = "rejoin-test-token"
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
 
@@ -507,6 +526,10 @@ def test_head_restart_live_rejoin(tmp_path):
         try:
             ray_tpu.shutdown()
         finally:
+            if prev_tok is None:
+                os.environ.pop("RT_AUTH_TOKEN", None)
+            else:
+                os.environ["RT_AUTH_TOKEN"] = prev_tok
             proc.terminate()
             try:
                 proc.wait(timeout=10)

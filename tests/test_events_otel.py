@@ -1,0 +1,158 @@
+"""Structured export events and OTel metric export.
+
+Reference analog: ``src/ray/observability/ray_event_recorder.cc`` +
+``dashboard/modules/aggregator/aggregator_agent.py`` (typed lifecycle
+events → JSONL/HTTP) and
+``observability/open_telemetry_metric_recorder.cc`` (stats → OTel).
+"""
+import json
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.events import EventRecorder, read_events
+
+
+def test_event_recorder_jsonl_roundtrip(tmp_path):
+    p = str(tmp_path / "events" / "events.jsonl")
+    rec = EventRecorder(path=p, flush_interval_s=1e9)  # manual flush
+    rec.emit("NODE", "NODE_ALIVE", "n1", addr=["127.0.0.1", 1])
+    rec.emit("ACTOR", "ACTOR_DEAD", "a1", message="oom")
+    assert rec.flush() == 2
+    evs = read_events(p)
+    assert [e["event_type"] for e in evs] == ["NODE_ALIVE", "ACTOR_DEAD"]
+    assert evs[0]["attributes"]["addr"] == ["127.0.0.1", 1]
+    assert evs[1]["message"] == "oom"
+    # recent() filtering
+    assert len(rec.recent(source_type="ACTOR")) == 1
+    with pytest.raises(ValueError, match="source_type"):
+        rec.emit("BOGUS", "X", "y")
+
+
+def test_event_recorder_drop_oldest(tmp_path):
+    rec = EventRecorder(path=None, max_buffer=3, flush_interval_s=1e9)
+    for i in range(5):
+        rec.emit("TASK", "TASK_FAILED", f"t{i}")
+    assert rec.dropped == 2
+    assert [e["entity_id"] for e in rec.recent()] == ["t2", "t3", "t4"]
+
+
+def test_head_emits_lifecycle_events(tmp_path, monkeypatch):
+    """Node/actor/PG lifecycle transitions land in the head's event log and
+    are queryable over RPC."""
+    monkeypatch.setenv("RT_SESSION_DIR", str(tmp_path / "sess"))
+    ray_tpu.init(num_cpus=2, num_nodes=2)
+    try:
+        @ray_tpu.remote
+        class A:
+            def ping(self):
+                return 1
+
+        a = A.remote()
+        assert ray_tpu.get(a.ping.remote()) == 1
+        from ray_tpu.util.placement_group import (
+            placement_group,
+            remove_placement_group,
+        )
+
+        pg = placement_group([{"CPU": 1}])
+        assert pg.ready()
+        remove_placement_group(pg)
+        ray_tpu.kill(a)
+
+        import time
+
+        from ray_tpu._private.worker import get_global_worker
+
+        w = get_global_worker()
+        deadline = time.monotonic() + 10
+        types = set()
+        while time.monotonic() < deadline:
+            h, _ = w.run_sync(w.gcs.call("export_events", {"limit": 200}))
+            types = {e["event_type"] for e in h["events"]}
+            if {"NODE_ALIVE", "ACTOR_ALIVE", "PG_CREATED",
+                    "PG_REMOVED"} <= types:
+                break
+            time.sleep(0.2)
+        assert {"NODE_ALIVE", "ACTOR_ALIVE", "PG_CREATED",
+                "PG_REMOVED"} <= types, types
+    finally:
+        ray_tpu.shutdown()
+    # persisted JSONL exists under the session dir after head close
+    p = str(tmp_path / "sess" / "events" / "events.jsonl")
+    assert os.path.exists(p)
+    evs = read_events(p)
+    assert any(e["event_type"] == "NODE_ALIVE" for e in evs)
+
+
+def test_otel_callbacks_without_sdk():
+    """The observable-instrument callbacks (the part that reads our
+    registry) work against the OTel API package alone — the SDK is only
+    needed for the exporter plumbing."""
+    pytest.importorskip("opentelemetry.metrics")
+    from ray_tpu.util import metrics
+    from ray_tpu.util.metrics_otel import OtelMetricsBridge
+
+    c = metrics.Counter("otel_cb_total", "demo")
+    c.inc(3.0, tags={"k": "v"})
+    h = metrics.Histogram("otel_cb_hist", boundaries=[1.0, 10.0])
+    h.observe(0.5)
+    h.observe(5.0)
+
+    bridge = OtelMetricsBridge.__new__(OtelMetricsBridge)
+    vals = bridge._value_callback("otel_cb_total")(None)
+    assert [(dict(o.attributes), o.value) for o in vals] == [({"k": "v"}, 3.0)]
+    cnt = bridge._hist_callback("otel_cb_hist", "count")(None)
+    assert cnt[0].value == 2
+    buckets = {
+        o.attributes["le"]: o.value
+        for o in bridge._hist_callback("otel_cb_hist", "bucket")(None)
+    }
+    assert buckets["1.0"] == 1 and buckets["+Inf"] == 2
+
+
+def test_otel_bridge_exports_registry():
+    otel_sdk = pytest.importorskip("opentelemetry.sdk.metrics")
+    from opentelemetry.sdk.metrics.export import InMemoryMetricReader
+
+    from ray_tpu.util import metrics
+    from ray_tpu.util.metrics_otel import OtelMetricsBridge
+
+    c = metrics.Counter("otel_test_total", "demo")
+    c.inc(3.0, tags={"k": "v"})
+    g = metrics.Gauge("otel_test_gauge")
+    g.set(7.5)
+    h = metrics.Histogram("otel_test_hist", boundaries=[1.0, 10.0])
+    h.observe(0.5)
+    h.observe(5.0)
+
+    # Bridge with an in-memory reader: bypass the periodic exporter and
+    # collect synchronously.
+    bridge = OtelMetricsBridge.__new__(OtelMetricsBridge)
+    from opentelemetry.sdk.metrics import MeterProvider
+
+    reader = InMemoryMetricReader()
+    bridge._provider = MeterProvider(metric_readers=[reader])
+    bridge._meter = bridge._provider.get_meter("test")
+    bridge._registered = set()
+    bridge._reader = reader
+    bridge.refresh_instruments()
+
+    data = reader.get_metrics_data()
+    points = {}
+    for rm in data.resource_metrics:
+        for sm in rm.scope_metrics:
+            for m in sm.metrics:
+                for dp in m.data.data_points:
+                    points.setdefault(m.name, []).append(
+                        (dict(dp.attributes), dp.value)
+                    )
+    assert points["otel_test_total"] == [({"k": "v"}, 3.0)]
+    assert points["otel_test_gauge"][0][1] == 7.5
+    assert any(v == 2 for _, v in points["otel_test_hist_count"])
+    buckets = dict(
+        (a["le"], v) for a, v in points["otel_test_hist_bucket"]
+    )
+    assert buckets["1.0"] == 1 and buckets["+Inf"] == 2
+    bridge._provider.shutdown()

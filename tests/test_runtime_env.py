@@ -192,3 +192,100 @@ def test_conda_env_key_stable():
     assert conda_env_key(["a", "b"]) == conda_env_key(["a", "b"])
     assert conda_env_key(["a"]) != conda_env_key(["b"])
     assert conda_env_key({"dependencies": ["x"]}).startswith("conda-")
+
+
+def _hook_counter():
+    import os
+
+    os.environ["RT_TEST_HOOK_RAN"] = str(
+        int(os.environ.get("RT_TEST_HOOK_RAN", "0")) + 1
+    )
+
+
+def test_worker_process_setup_hook_runs_once(rt):
+    """A pickled setup hook runs once per worker process before the first
+    task of that env (reference: runtime_env/setup_hook.py)."""
+
+    @ray_tpu.remote
+    def probe():
+        import os
+
+        return os.environ.get("RT_TEST_HOOK_RAN")
+
+    renv = {"worker_process_setup_hook": _hook_counter}
+    r1 = ray_tpu.get(probe.options(runtime_env=renv).remote())
+    r2 = ray_tpu.get(probe.options(runtime_env=renv).remote())
+    assert r1 == "1"
+    assert r2 == "1"  # once per process, not per task
+
+
+def test_worker_process_setup_hook_failure_fails_task(rt):
+    def boom():
+        raise RuntimeError("hook exploded")
+
+    @ray_tpu.remote
+    def probe():
+        return 1
+
+    with pytest.raises(Exception, match="hook exploded"):
+        ray_tpu.get(probe.options(
+            runtime_env={"worker_process_setup_hook": boom}
+        ).remote())
+
+
+def test_worker_process_setup_hook_module_path(rt):
+    @ray_tpu.remote
+    def probe():
+        import os
+
+        return os.environ.get("RT_TEST_HOOK_RAN", "0")
+
+    out = ray_tpu.get(probe.options(runtime_env={
+        "worker_process_setup_hook":
+            "tests.test_runtime_env._hook_counter"
+    }).remote())
+    assert int(out) >= 1
+
+
+def test_setup_hook_runs_after_env_vars_and_py_modules(rt, tmp_path):
+    """The hook sees the env it was shipped with (reference semantics:
+    setup hook runs after the rest of the env is prepared)."""
+
+    def hook():
+        import os
+
+        assert os.environ.get("HOOK_NEEDS_THIS") == "yes"
+        os.environ["HOOK_SAW_ENV"] = "1"
+
+    @ray_tpu.remote
+    def probe():
+        import os
+
+        return os.environ.get("HOOK_SAW_ENV")
+
+    out = ray_tpu.get(probe.options(runtime_env={
+        "env_vars": {"HOOK_NEEDS_THIS": "yes"},
+        "worker_process_setup_hook": hook,
+    }).remote())
+    assert out == "1"
+
+
+def test_setup_hook_runs_in_venv_child(rt):
+    """pip-isolated tasks run the hook inside the env-executor child
+    (the process that actually executes the task)."""
+
+    def hook():
+        import os
+
+        os.environ["CHILD_HOOK"] = f"pid-{os.getpid()}"
+
+    @ray_tpu.remote
+    def probe():
+        import os
+
+        return os.environ.get("CHILD_HOOK"), os.getpid()
+
+    marker, pid = ray_tpu.get(probe.options(runtime_env={
+        "pip": [], "worker_process_setup_hook": hook,
+    }).remote())
+    assert marker == f"pid-{pid}"  # ran in the same process as the task
