@@ -1,0 +1,154 @@
+"""cgroup resource isolation for spawned worker processes.
+
+Reference analog: ``src/ray/common/cgroup2/`` (``cgroup_manager.h``,
+``sysfs_cgroup_driver.cc``) — the reference carves a cgroup2 subtree per
+node, moving worker processes under it with cpu weights and memory limits
+so a runaway workload cannot take down the host services. Enabled
+explicitly (the reference gates on ``enable_resource_isolation``); here the
+switch is ``RT_CGROUP_ISOLATION=1`` on ``init``/``rt start``.
+
+TPU-era notes: the process-per-host worker owns the TPU chips, so the
+interesting limits are host memory (protect the head/daemon from worker
+OOM) and CPU weight (keep input pipelines from starving control). Pure
+cgroup2 hosts use ``cpu.max``/``memory.max``; v1-only hosts (common in
+container images where v2 controllers are claimed by the host) fall back
+to the v1 ``cpu``/``memory`` hierarchies. No permissions → cleanly
+disabled, never an error: isolation is an operator upgrade, not a
+correctness dependency.
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_V2_ROOT = "/sys/fs/cgroup"
+_V1_CPU = "/sys/fs/cgroup/cpu"
+_V1_MEM = "/sys/fs/cgroup/memory"
+
+
+def _writable_dir(path: str) -> bool:
+    return os.path.isdir(path) and os.access(path, os.W_OK)
+
+
+def _write(path: str, value: str) -> bool:
+    try:
+        with open(path, "w") as f:
+            f.write(value)
+        return True
+    except OSError:
+        return False
+
+
+class CgroupDriver:
+    """Creates per-worker cgroups and moves pids into them."""
+
+    def __init__(self, base_name: str = "ray_tpu"):
+        self.base = base_name
+        self.mode = self._detect()
+
+    @staticmethod
+    def _detect() -> Optional[str]:
+        try:
+            with open(os.path.join(_V2_ROOT, "cgroup.controllers")) as f:
+                ctrl = f.read().split()
+            if ("cpu" in ctrl or "memory" in ctrl) and _writable_dir(
+                _V2_ROOT
+            ):
+                return "v2"
+        except OSError:
+            pass
+        if _writable_dir(_V1_CPU) or _writable_dir(_V1_MEM):
+            return "v1"
+        return None
+
+    @property
+    def available(self) -> bool:
+        return self.mode is not None
+
+    # -- lifecycle --------------------------------------------------------
+
+    def create(self, name: str, *, cpu_shares: Optional[float] = None,
+               memory_limit_bytes: Optional[int] = None):
+        """Create a cgroup; returns an opaque handle (or None when
+        unavailable). ``cpu_shares``: relative weight in CPUs (1.0 = one
+        CPU's default weight); ``memory_limit_bytes``: hard cap."""
+        if self.mode is None:
+            return None
+        paths = []
+        try:
+            if self.mode == "v2":
+                path = os.path.join(_V2_ROOT, f"{self.base}_{name}")
+                os.makedirs(path, exist_ok=True)
+                if cpu_shares is not None:
+                    # cgroup2 cpu.weight: 1..10000, default 100 per unit
+                    _write(os.path.join(path, "cpu.weight"),
+                           str(max(1, min(10000, int(cpu_shares * 100)))))
+                if memory_limit_bytes is not None:
+                    _write(os.path.join(path, "memory.max"),
+                           str(int(memory_limit_bytes)))
+                paths.append(path)
+            else:
+                if _writable_dir(_V1_CPU):
+                    p = os.path.join(_V1_CPU, f"{self.base}_{name}")
+                    os.makedirs(p, exist_ok=True)
+                    if cpu_shares is not None:
+                        # v1 cpu.shares: default 1024 per unit
+                        _write(os.path.join(p, "cpu.shares"),
+                               str(max(2, int(cpu_shares * 1024))))
+                    paths.append(p)
+                if _writable_dir(_V1_MEM):
+                    p = os.path.join(_V1_MEM, f"{self.base}_{name}")
+                    os.makedirs(p, exist_ok=True)
+                    if memory_limit_bytes is not None:
+                        _write(os.path.join(p, "memory.limit_in_bytes"),
+                               str(int(memory_limit_bytes)))
+                    paths.append(p)
+        except OSError as e:
+            logger.debug("cgroup create %s failed: %s", name, e)
+            return None
+        return paths or None
+
+    def add_pid(self, handle, pid: int) -> bool:
+        if not handle:
+            return False
+        ok = False
+        for path in handle:
+            ok |= _write(os.path.join(path, "cgroup.procs"), str(pid))
+        return ok
+
+    def remove(self, handle) -> None:
+        """Remove the cgroup(s); surviving member pids fall back to the
+        parent group (kernel semantics: rmdir fails while populated, so
+        members are migrated to the root first)."""
+        if not handle:
+            return
+        for path in handle:
+            try:
+                procs_path = os.path.join(path, "cgroup.procs")
+                root_procs = os.path.join(
+                    os.path.dirname(path), "cgroup.procs"
+                )
+                with open(procs_path) as f:
+                    for line in f:
+                        pid = line.strip()
+                        if pid:
+                            _write(root_procs, pid)
+                os.rmdir(path)
+            except OSError:
+                pass
+
+    @staticmethod
+    def pid_cgroups(pid: int):
+        """The cgroup paths of a live pid (for tests/ops tooling)."""
+        try:
+            with open(f"/proc/{pid}/cgroup") as f:
+                return f.read().splitlines()
+        except OSError:
+            return []
+
+
+def enabled() -> bool:
+    return os.environ.get("RT_CGROUP_ISOLATION") == "1"

@@ -17,24 +17,38 @@ from typing import Dict, List, Optional
 
 from ray_tpu._private.ids import JobID, NodeID
 
+import logging
+
+logger = logging.getLogger(__name__)
+
 
 class NodeHandle:
-    def __init__(self, proc: subprocess.Popen, node_id: str, resources: dict):
+    def __init__(self, proc: subprocess.Popen, node_id: str, resources: dict,
+                 cgroup=None, cgroup_driver=None):
         self.proc = proc
         self.node_id = node_id
         self.resources = resources
+        self.cgroup = cgroup
+        self._cgroup_driver = cgroup_driver
+
+    def _drop_cgroup(self):
+        if self.cgroup and self._cgroup_driver is not None:
+            self._cgroup_driver.remove(self.cgroup)
+            self.cgroup = None
 
     def kill(self, sig=None):
         try:
             self.proc.kill()
         except ProcessLookupError:
             pass
+        self._drop_cgroup()
 
     def terminate(self):
         try:
             self.proc.terminate()
         except ProcessLookupError:
             pass
+        self._drop_cgroup()
 
     def alive(self) -> bool:
         return self.proc.poll() is None
@@ -73,7 +87,28 @@ def spawn_node(
     )
     # Node processes must not inherit a driver-held TPU.
     proc = subprocess.Popen(cmd, env=child_env)
-    return NodeHandle(proc, node_id, resources)
+    cgroup = driver = None
+    from ray_tpu._private import cgroups
+
+    if cgroups.enabled():
+        # Resource isolation (reference: cgroup2/cgroup_manager.h, gated
+        # like enable_resource_isolation): CPU weight from the node's CPU
+        # resource; memory capped at the node's memory resource when the
+        # operator declared one. Unavailable/unwritable -> disabled.
+        driver = cgroups.CgroupDriver()
+        mem = resources.get("memory")
+        cgroup = driver.create(
+            node_id[:12],
+            cpu_shares=resources.get("CPU"),
+            memory_limit_bytes=int(mem) if mem else None,
+        )
+        if cgroup and not driver.add_pid(cgroup, proc.pid):
+            driver.remove(cgroup)
+            cgroup = None
+        if cgroup is None and driver.available:
+            logger.warning("cgroup isolation requested but not applied "
+                           "for node %s", node_id[:8])
+    return NodeHandle(proc, node_id, resources, cgroup, driver)
 
 
 class LocalCluster:
