@@ -78,38 +78,49 @@ class CgroupDriver:
         if self.mode is None:
             return None
         paths = []
+        # Every REQUESTED limit must actually land: controllers can be
+        # advertised but not delegated (cgroup2 subtree_control in
+        # containers), in which case the limit file does not exist and the
+        # write fails — "created" with no cap would be silent non-isolation.
+        applied_ok = True
         try:
             if self.mode == "v2":
                 path = os.path.join(_V2_ROOT, f"{self.base}_{name}")
                 os.makedirs(path, exist_ok=True)
                 if cpu_shares is not None:
                     # cgroup2 cpu.weight: 1..10000, default 100 per unit
-                    _write(os.path.join(path, "cpu.weight"),
-                           str(max(1, min(10000, int(cpu_shares * 100)))))
+                    applied_ok &= _write(
+                        os.path.join(path, "cpu.weight"),
+                        str(max(1, min(10000, int(cpu_shares * 100)))))
                 if memory_limit_bytes is not None:
-                    _write(os.path.join(path, "memory.max"),
-                           str(int(memory_limit_bytes)))
+                    applied_ok &= _write(
+                        os.path.join(path, "memory.max"),
+                        str(int(memory_limit_bytes)))
                 paths.append(path)
             else:
-                if _writable_dir(_V1_CPU):
+                if cpu_shares is not None and _writable_dir(_V1_CPU):
                     p = os.path.join(_V1_CPU, f"{self.base}_{name}")
                     os.makedirs(p, exist_ok=True)
-                    if cpu_shares is not None:
-                        # v1 cpu.shares: default 1024 per unit
-                        _write(os.path.join(p, "cpu.shares"),
-                               str(max(2, int(cpu_shares * 1024))))
+                    # v1 cpu.shares: default 1024 per unit
+                    applied_ok &= _write(
+                        os.path.join(p, "cpu.shares"),
+                        str(max(2, int(cpu_shares * 1024))))
                     paths.append(p)
-                if _writable_dir(_V1_MEM):
+                if memory_limit_bytes is not None and _writable_dir(_V1_MEM):
                     p = os.path.join(_V1_MEM, f"{self.base}_{name}")
                     os.makedirs(p, exist_ok=True)
-                    if memory_limit_bytes is not None:
-                        _write(os.path.join(p, "memory.limit_in_bytes"),
-                               str(int(memory_limit_bytes)))
+                    applied_ok &= _write(
+                        os.path.join(p, "memory.limit_in_bytes"),
+                        str(int(memory_limit_bytes)))
                     paths.append(p)
         except OSError as e:
             logger.debug("cgroup create %s failed: %s", name, e)
+            self.remove(paths)
             return None
-        return paths or None
+        if not paths or not applied_ok:
+            self.remove(paths)
+            return None
+        return paths
 
     def add_pid(self, handle, pid: int) -> bool:
         if not handle:

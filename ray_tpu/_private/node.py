@@ -51,7 +51,11 @@ class NodeHandle:
         self._drop_cgroup()
 
     def alive(self) -> bool:
-        return self.proc.poll() is None
+        if self.proc.poll() is None:
+            return True
+        # the process died on its own: its cgroup must not outlive it
+        self._drop_cgroup()
+        return False
 
 
 def spawn_node(

@@ -905,6 +905,57 @@ class CoreWorker:
                 i = j
         return out
 
+    @staticmethod
+    def _actor_fast_inst_ok(inst) -> bool:
+        """Instance-level fast-path gates shared by the per-item dispatch
+        and the coalesced-run dispatch (they must never diverge — a gate
+        added to one but not the other silently changes semantics
+        depending on whether calls arrive as a burst)."""
+        return not (
+            inst is None or inst.exiting or inst.max_concurrency != 1
+            or inst.groups
+        )
+
+    @staticmethod
+    def _actor_fast_header_ok(h) -> bool:
+        """Header-level fast-path gates (same sharing contract)."""
+        return not (
+            h.get("nret", 1) != 1
+            or h.get("argrefs")
+            or h.get("borrows")
+            or h.get("trace")
+            or h.get("cg")
+            or h.get("method") == "__rt_apply__"
+        )
+
+    def _exec_actor_call(self, inst, method, h, frames):
+        """Execute one admitted actor call: deserialize, set task context,
+        run. Returns (ok, result) or the string "exited" after performing
+        the clean-exit protocol (actor table removal + head notify) for
+        SystemExit/exit_actor. Shared execution core of the per-item and
+        coalesced fast paths."""
+        try:
+            arg_slots, plain, kwargs = self.ctx.deserialize_frames(frames)
+            args = [plain[i] for _k, i in arg_slots]  # eligibility: no refs
+            self.current_task_id.value = TaskID.from_hex(h["tid"])
+            self.current_actor_id.value = h["aid"]
+            self.put_counter.value = 0
+            try:
+                return True, method(*args, **kwargs)
+            except SystemExit:
+                self.hosted_actors.pop(h["aid"], None)
+                inst.exiting = True
+                self.gcs.notify(
+                    "actor_exited",
+                    {"actor_id": h["aid"], "clean": True,
+                     "reason": "exit_actor"},
+                )
+                return "exited"
+            except Exception as e:
+                return False, (e, traceback.format_exc())
+        except Exception as e:
+            return False, (e, traceback.format_exc())
+
     def _try_submit_actor_run(self, run, rconn) -> bool:
         """Admit a whole same-(actor, caller) run atomically: every call
         must pass the per-item fast-path gates AND the seqs must be
@@ -913,20 +964,13 @@ class CoreWorker:
         would reorder."""
         h0 = run[0][0]
         inst = self.hosted_actors.get(h0.get("aid"))
-        if inst is None or inst.exiting or inst.max_concurrency != 1 \
-                or inst.groups:
+        if not self._actor_fast_inst_ok(inst):
             return False
+        if self._memory_monitor.is_pressing():
+            return False  # same pressure gate as the per-item path
         methods = []
         for h, _fr in run:
-            if (
-                h.get("nret", 1) != 1
-                or h.get("argrefs")
-                or h.get("borrows")
-                or h.get("trace")
-                or h.get("cg")
-                or h.get("method") == "__rt_apply__"
-                or h.get("seq", 0) <= 0
-            ):
+            if not self._actor_fast_header_ok(h) or h.get("seq", 0) <= 0:
                 return False
             method = getattr(inst.instance, h.get("method", ""), None)
             if method is None or asyncio.iscoroutinefunction(method):
@@ -975,34 +1019,15 @@ class CoreWorker:
                 counts.append(0)
                 continue
             t0 = time.time()
-            try:
-                arg_slots, plain, kwargs = self.ctx.deserialize_frames(
-                    frames
+            res = self._exec_actor_call(inst, method, h, frames)
+            if res == "exited":
+                subs.append(
+                    {"i": h["i"], "e": "ActorMissing: actor exited"}
                 )
-                args = [plain[i] for _k, i in arg_slots]
-                self.current_task_id.value = TaskID.from_hex(h["tid"])
-                self.current_actor_id.value = h["aid"]
-                self.put_counter.value = 0
-                try:
-                    ok, result = True, method(*args, **kwargs)
-                except SystemExit:
-                    self.hosted_actors.pop(h["aid"], None)
-                    inst.exiting = True
-                    self.gcs.notify(
-                        "actor_exited",
-                        {"actor_id": h["aid"], "clean": True,
-                         "reason": "exit_actor"},
-                    )
-                    subs.append(
-                        {"i": h["i"], "e": "ActorMissing: actor exited"}
-                    )
-                    counts.append(0)
-                    exited = True
-                    continue
-                except Exception as e:
-                    ok, result = False, (e, traceback.format_exc())
-            except Exception as e:
-                ok, result = False, (e, traceback.format_exc())
+                counts.append(0)
+                exited = True
+                continue
+            ok, result = res
             try:
                 rets, out_frames, big = self._package_result_parts(
                     h, ok, result
@@ -1179,18 +1204,9 @@ class CoreWorker:
         max_concurrency > 1) routes to the slow path, whose semantics are
         authoritative."""
         inst = self.hosted_actors.get(h.get("aid"))
-        if inst is None or inst.exiting:
+        if not self._actor_fast_inst_ok(inst):
             return False
-        if (
-            h.get("nret", 1) != 1
-            or h.get("argrefs")
-            or h.get("borrows")
-            or h.get("trace")
-            or inst.max_concurrency != 1
-            or inst.groups  # concurrency groups route via the slow path
-            or h.get("cg")
-            or h.get("method") == "__rt_apply__"
-        ):
+        if not self._actor_fast_header_ok(h):
             return False
         method = getattr(inst.instance, h.get("method", ""), None)
         if method is None:
@@ -1240,32 +1256,15 @@ class CoreWorker:
 
     def _ring_execute_actor_task(self, inst, method, h, frames, rconn):
         t0 = time.time()
-        try:
-            arg_slots, plain, kwargs = self.ctx.deserialize_frames(frames)
-            args = [plain[i] for _k, i in arg_slots]  # eligibility: no refs
-            self.current_task_id.value = TaskID.from_hex(h["tid"])
-            self.current_actor_id.value = h["aid"]
-            self.put_counter.value = 0
-            try:
-                ok, result = True, method(*args, **kwargs)
-            except SystemExit:
-                # exit_actor(): mirror the slow path's clean-exit protocol.
-                self.hosted_actors.pop(h["aid"], None)
-                inst.exiting = True
-                self.gcs.notify(
-                    "actor_exited",
-                    {"actor_id": h["aid"], "clean": True,
-                     "reason": "exit_actor"},
-                )
-                rconn.send_reply(
-                    {"i": h["i"], "r": 1, "e": "ActorMissing: actor exited"},
-                    [],
-                )
-                return
-            except Exception as e:
-                ok, result = False, (e, traceback.format_exc())
-        except Exception as e:
-            ok, result = False, (e, traceback.format_exc())
+        res = self._exec_actor_call(inst, method, h, frames)
+        if res == "exited":
+            # exit_actor(): mirror the slow path's clean-exit protocol.
+            rconn.send_reply(
+                {"i": h["i"], "r": 1, "e": "ActorMissing: actor exited"},
+                [],
+            )
+            return
+        ok, result = res
         self._ring_reply_result(h, ok, result, rconn)
         inst.num_executed += 1
         self._record_task_event({

@@ -6,6 +6,7 @@ from ray_tpu.autoscaler.autoscaler import (
 )
 from ray_tpu.autoscaler.node_provider import (
     GCETPUNodeProvider,
+    KubernetesNodeProvider,
     LocalNodeProvider,
     NodeProvider,
 )
@@ -13,4 +14,5 @@ from ray_tpu.autoscaler.node_provider import (
 __all__ = [
     "Autoscaler", "AutoscalerConfig", "AutoscalerMonitor", "NodeTypeConfig",
     "NodeProvider", "LocalNodeProvider", "GCETPUNodeProvider",
+    "KubernetesNodeProvider",
 ]

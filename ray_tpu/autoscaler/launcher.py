@@ -44,6 +44,7 @@ from ray_tpu.autoscaler.autoscaler import (
 )
 from ray_tpu.autoscaler.node_provider import (
     GCETPUNodeProvider,
+    KubernetesNodeProvider,
     LocalNodeProvider,
     NodeProvider,
 )
@@ -93,6 +94,16 @@ def build_provider(cfg: Dict[str, Any], head_address: str) -> NodeProvider:
                 name: dict(nt) for name, nt in cfg["node_types"].items()
             },
             version=p.get("version", "tpu-ubuntu2204-base"),
+        )
+    if kind == "kubernetes":
+        return KubernetesNodeProvider(
+            head_address,
+            namespace=p.get("namespace", "default"),
+            cluster_name=p.get("cluster_name", "raytpu"),
+            node_types={
+                name: dict(nt) for name, nt in cfg["node_types"].items()
+            },
+            image=p.get("image", "python:3.12-slim"),
         )
     raise ValueError(f"unknown provider type {kind!r}")
 

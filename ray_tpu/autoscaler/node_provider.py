@@ -69,6 +69,145 @@ class LocalNodeProvider(NodeProvider):
         return out
 
 
+def _cli_runner(args: List[str], stdin: Optional[str] = None,
+                timeout: int = 600) -> str:
+    """Shared subprocess runner for cloud CLIs (kubectl/gcloud)."""
+    import subprocess
+
+    res = subprocess.run(
+        args, capture_output=True, text=True, timeout=timeout, input=stdin
+    )
+    if res.returncode != 0:
+        raise RuntimeError(
+            f"{' '.join(args[:4])}... failed: {res.stderr[-500:]}"
+        )
+    return res.stdout
+
+
+class KubernetesNodeProvider(NodeProvider):
+    """Kubernetes pod-per-node provider (reference analogs: the in-tree
+    kubernetes NodeProvider, ``autoscaler/_private/kubernetes/
+    node_provider.py``, which manipulates worker pods directly, and the
+    KubeRay operator's pod templates). GKE TPU node pools expose chips as
+    the ``google.com/tpu`` resource with slice topology via node selectors
+    — a node type maps to a pod spec requesting them.
+
+    ``runner`` injects the command executor (tests pass a fake; production
+    uses subprocess + kubectl). No cluster calls at import or init.
+    """
+
+    def __init__(self, head_address: str, *, namespace: str = "default",
+                 cluster_name: str = "raytpu",
+                 node_types: Optional[Dict[str, dict]] = None,
+                 image: str = "python:3.12-slim", runner=None):
+        self._head_address = head_address
+        self._namespace = namespace
+        self._cluster = cluster_name
+        # node_type -> {"resources": {...}, "pod_resources": {k8s requests},
+        #               "node_selector": {...}, "image": optional override}
+        self._node_types = dict(node_types or {})
+        self._image = image
+        self._runner = runner or _cli_runner
+        self._counter = 0
+        self._nodes: Dict[str, dict] = {}
+
+    def _pod_manifest(self, name: str, node_type: str, tcfg: dict) -> dict:
+        pod_resources = dict(tcfg.get("pod_resources") or {})
+        container = {
+            "name": "worker",
+            "image": tcfg.get("image", self._image),
+            "command": ["python", "-m", "ray_tpu.cli", "start",
+                        "--address", self._head_address],
+            "env": [
+                # the cluster token rides a Secret, never the pod spec
+                {"name": "RT_AUTH_TOKEN", "valueFrom": {"secretKeyRef": {
+                    "name": f"{self._cluster}-auth", "key": "token",
+                    "optional": True,
+                }}},
+            ],
+        }
+        if pod_resources:
+            container["resources"] = {
+                "requests": pod_resources, "limits": pod_resources,
+            }
+        spec = {"containers": [container], "restartPolicy": "Never"}
+        if tcfg.get("node_selector"):
+            spec["nodeSelector"] = dict(tcfg["node_selector"])
+        return {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": name,
+                "namespace": self._namespace,
+                "labels": {
+                    "raytpu.io/cluster": self._cluster,
+                    "raytpu.io/node-type": node_type,
+                },
+            },
+            "spec": spec,
+        }
+
+    def create_node(self, node_type, resources, labels=None) -> str:
+        import json as _json
+
+        tcfg = self._node_types.get(node_type, {})
+        self._counter += 1
+        name = f"{self._cluster}-{node_type}-{self._counter}"
+        manifest = self._pod_manifest(name, node_type, tcfg)
+        self._runner(
+            ["kubectl", "-n", self._namespace, "apply", "-f", "-"],
+            stdin=_json.dumps(manifest),
+        )
+        self._nodes[name] = {
+            "provider_node_id": name,
+            "node_type": node_type,
+            "node_id": None,  # learned when the pod registers with the head
+        }
+        return name
+
+    def terminate_node(self, provider_node_id: str):
+        if provider_node_id not in self._nodes:
+            return
+        self._runner([
+            "kubectl", "-n", self._namespace, "delete", "pod",
+            provider_node_id, "--ignore-not-found", "--wait=false",
+        ])
+        self._nodes.pop(provider_node_id, None)
+
+    def non_terminated_nodes(self) -> List[dict]:
+        import json as _json
+
+        out = self._runner([
+            "kubectl", "-n", self._namespace, "get", "pods",
+            "-l", f"raytpu.io/cluster={self._cluster}", "-o", "json",
+        ])
+        live = {}
+        for pod in _json.loads(out or "{}").get("items", []):
+            name = pod.get("metadata", {}).get("name", "")
+            phase = pod.get("status", {}).get("phase")
+            if name in self._nodes and phase in (
+                "Pending", "Running", None
+            ):
+                live[name] = self._nodes[name]
+            elif phase in ("Failed", "Succeeded"):
+                # restartPolicy=Never leaves terminal pod objects behind;
+                # reclaim them or every worker crash accumulates quota
+                try:
+                    self._runner([
+                        "kubectl", "-n", self._namespace, "delete", "pod",
+                        name, "--ignore-not-found", "--wait=false",
+                    ])
+                except RuntimeError:
+                    pass
+        # drop records for pods that disappeared out from under us
+        self._nodes = dict(live)
+        return [
+            {k: info[k] for k in
+             ("provider_node_id", "node_type", "node_id")}
+            for info in live.values()
+        ]
+
+
 class GCETPUNodeProvider(NodeProvider):
     """GCE TPU-VM provider (reference analogs: the GCP provider +
     ``autoscaler/tpu_command_runner.py`` / ``gcp/tpu.yaml``): scales the
@@ -90,22 +229,9 @@ class GCETPUNodeProvider(NodeProvider):
         # node_type -> {"accelerator_type": "v5e-16", "resources": {...}}
         self._node_types = dict(node_types or {})
         self._version = version
-        self._runner = runner or self._subprocess_runner
+        self._runner = runner or _cli_runner
         self._counter = 0
         self._nodes: Dict[str, dict] = {}
-
-    @staticmethod
-    def _subprocess_runner(args: List[str]) -> str:
-        import subprocess
-
-        res = subprocess.run(
-            args, capture_output=True, text=True, timeout=600
-        )
-        if res.returncode != 0:
-            raise RuntimeError(
-                f"{' '.join(args[:4])}... failed: {res.stderr[-500:]}"
-            )
-        return res.stdout
 
     def _startup_script(self) -> str:
         return (

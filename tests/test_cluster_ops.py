@@ -556,3 +556,71 @@ def test_failed_init_cleans_up_and_next_init_works(monkeypatch):
         assert ray_tpu.get(ray_tpu.put(7)) == 7
     finally:
         ray_tpu.shutdown()
+
+
+def test_kubernetes_node_provider_fake_kubectl():
+    """K8s pod-per-node provider drives kubectl through an injected runner
+    (reference: the in-tree kubernetes NodeProvider / KubeRay pod
+    templates): pods carry cluster labels + TPU resource requests, the
+    token rides a Secret ref, and list/terminate track pod phase."""
+    from ray_tpu.autoscaler import KubernetesNodeProvider
+
+    calls, pods = [], {}
+
+    def fake_kubectl(args, stdin=None):
+        calls.append((args, stdin))
+        if args[3] == "apply":
+            manifest = json.loads(stdin)
+            pods[manifest["metadata"]["name"]] = {
+                "metadata": manifest["metadata"],
+                "status": {"phase": "Pending"},
+                "spec": manifest["spec"],
+            }
+            return ""
+        if args[3] == "delete":
+            pods.pop(args[5], None)
+            return ""
+        if args[3] == "get":
+            return json.dumps({"items": list(pods.values())})
+        raise AssertionError(args)
+
+    prov = KubernetesNodeProvider(
+        "10.0.0.1:6379", namespace="ml", cluster_name="rt",
+        node_types={"v5e-8": {
+            "resources": {"TPU": 8},
+            "pod_resources": {"google.com/tpu": "8",
+                              "cpu": "8", "memory": "32Gi"},
+            "node_selector": {
+                "cloud.google.com/gke-tpu-topology": "2x4"},
+        }},
+        runner=fake_kubectl,
+    )
+    pid = prov.create_node("v5e-8", {"TPU": 8})
+    manifest = json.loads(calls[0][1])
+    assert manifest["metadata"]["labels"]["raytpu.io/cluster"] == "rt"
+    c = manifest["spec"]["containers"][0]
+    assert c["resources"]["requests"]["google.com/tpu"] == "8"
+    assert manifest["spec"]["nodeSelector"][
+        "cloud.google.com/gke-tpu-topology"] == "2x4"
+    assert "--address" in c["command"] and "10.0.0.1:6379" in c["command"]
+    # token arrives via Secret ref, never inline
+    assert c["env"][0]["valueFrom"]["secretKeyRef"]["name"] == "rt-auth"
+    assert "RT_AUTH_TOKEN" not in json.dumps(manifest["spec"]).replace(
+        '"name": "RT_AUTH_TOKEN"', "")
+
+    live = prov.non_terminated_nodes()
+    assert [n["provider_node_id"] for n in live] == [pid]
+    # running pods stay; succeeded/failed pods drop off
+    pods[pid]["status"]["phase"] = "Running"
+    assert len(prov.non_terminated_nodes()) == 1
+    pods[pid]["status"]["phase"] = "Failed"
+    assert prov.non_terminated_nodes() == []
+    # terminal pods are reclaimed (restartPolicy=Never leaves objects)
+    assert pid not in pods
+    assert sum(1 for a, _ in calls if a[3] == "delete") == 1
+    # terminate is idempotent and kubectl-backed
+    prov2_pid = prov.create_node("v5e-8", {"TPU": 8})
+    prov.terminate_node(prov2_pid)
+    assert prov2_pid not in pods
+    prov.terminate_node(prov2_pid)  # no second kubectl call for unknown id
+    assert sum(1 for a, _ in calls if a[3] == "delete") == 2
