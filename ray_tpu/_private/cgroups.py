@@ -98,21 +98,27 @@ class CgroupDriver:
                         str(int(memory_limit_bytes)))
                 paths.append(path)
             else:
-                if cpu_shares is not None and _writable_dir(_V1_CPU):
-                    p = os.path.join(_V1_CPU, f"{self.base}_{name}")
-                    os.makedirs(p, exist_ok=True)
-                    # v1 cpu.shares: default 1024 per unit
-                    applied_ok &= _write(
-                        os.path.join(p, "cpu.shares"),
-                        str(max(2, int(cpu_shares * 1024))))
-                    paths.append(p)
-                if memory_limit_bytes is not None and _writable_dir(_V1_MEM):
-                    p = os.path.join(_V1_MEM, f"{self.base}_{name}")
-                    os.makedirs(p, exist_ok=True)
-                    applied_ok &= _write(
-                        os.path.join(p, "memory.limit_in_bytes"),
-                        str(int(memory_limit_bytes)))
-                    paths.append(p)
+                if cpu_shares is not None:
+                    if _writable_dir(_V1_CPU):
+                        p = os.path.join(_V1_CPU, f"{self.base}_{name}")
+                        os.makedirs(p, exist_ok=True)
+                        # v1 cpu.shares: default 1024 per unit
+                        applied_ok &= _write(
+                            os.path.join(p, "cpu.shares"),
+                            str(max(2, int(cpu_shares * 1024))))
+                        paths.append(p)
+                    else:
+                        applied_ok = False  # requested but no hierarchy
+                if memory_limit_bytes is not None:
+                    if _writable_dir(_V1_MEM):
+                        p = os.path.join(_V1_MEM, f"{self.base}_{name}")
+                        os.makedirs(p, exist_ok=True)
+                        applied_ok &= _write(
+                            os.path.join(p, "memory.limit_in_bytes"),
+                            str(int(memory_limit_bytes)))
+                        paths.append(p)
+                    else:
+                        applied_ok = False  # requested but no hierarchy
         except OSError as e:
             logger.debug("cgroup create %s failed: %s", name, e)
             self.remove(paths)

@@ -189,9 +189,11 @@ class KubernetesNodeProvider(NodeProvider):
                 "Pending", "Running", None
             ):
                 live[name] = self._nodes[name]
-            elif phase in ("Failed", "Succeeded"):
-                # restartPolicy=Never leaves terminal pod objects behind;
-                # reclaim them or every worker crash accumulates quota
+            elif name in self._nodes or phase in ("Failed", "Succeeded"):
+                # Terminal pods (restartPolicy=Never leaves the objects
+                # behind) AND tracked pods in unexpected phases (Unknown —
+                # partitioned kubelet) are reclaimed; dropping them from
+                # tracking without deletion would leak quota forever
                 try:
                     self._runner([
                         "kubectl", "-n", self._namespace, "delete", "pod",

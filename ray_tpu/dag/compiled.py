@@ -118,6 +118,31 @@ class CompiledDAGRef:
         return self._dag._fetch(self._seq, timeout)
 
 
+class CompiledDAGFuture:
+    """Awaitable result of execute_async() (reference:
+    ``CompiledDAGFuture`` — aDAG asyncio integration). Awaiting runs the
+    blocking channel read in the default executor so the event loop stays
+    free; like CompiledDAGRef, a result may be awaited only once."""
+
+    def __init__(self, dag: "CompiledDAG", seq: int):
+        self._dag = dag
+        self._seq = seq
+        self._taken = False
+
+    def __await__(self):
+        import asyncio
+
+        if self._taken:
+            raise ValueError(
+                "CompiledDAGFuture may only be awaited once"
+            )
+        self._taken = True
+        loop = asyncio.get_event_loop()
+        return loop.run_in_executor(
+            None, self._dag._fetch, self._seq, self._dag._timeout
+        ).__await__()
+
+
 class CompiledDAG:
     def __init__(self, root: DAGNode, channel_capacity: int = DEFAULT_CAPACITY,
                  submit_timeout: float = 60.0):
@@ -319,6 +344,10 @@ class CompiledDAG:
                     _dag_actor_loop, plan
                 )
             )
+        import threading as _threading
+
+        self._submit_lock = _threading.Lock()
+        self._fetch_lock = _threading.Lock()
         self._next_submit = 0
         self._next_fetch = 0
         self._buffered: Dict[int, Any] = {}
@@ -331,13 +360,47 @@ class CompiledDAG:
         if self._torn_down:
             raise RuntimeError("compiled DAG torn down")
         value = input_values[0] if input_values else None
-        for ch in self._input_chs:
-            self._channels[ch].write(value, timeout=self._timeout)
-        seq = self._next_submit
-        self._next_submit += 1
+        # same lock as execute_async: mixing the APIs must not interleave
+        # channel writes or race the seq counter
+        with self._submit_lock:
+            for ch in self._input_chs:
+                self._channels[ch].write(value, timeout=self._timeout)
+            seq = self._next_submit
+            self._next_submit += 1
         return CompiledDAGRef(self, seq)
 
+    async def execute_async(self, *input_values) -> "CompiledDAGFuture":
+        """asyncio twin of execute() (reference: compiled_dag_node.py
+        execute_async): submission happens off-loop (channel writes can
+        block when the pipeline is full) and the returned future is
+        awaited for the result."""
+        import asyncio
+
+        if self._torn_down:
+            raise RuntimeError("compiled DAG torn down")
+        value = input_values[0] if input_values else None
+        loop = asyncio.get_running_loop()
+        lock = self._submit_lock
+
+        def _submit():
+            # lock taken INSIDE the executor thread (never across an
+            # await): concurrent execute_async calls serialize their
+            # channel writes + seq assignment atomically
+            with lock:
+                for ch in self._input_chs:
+                    self._channels[ch].write(value, timeout=self._timeout)
+                seq = self._next_submit
+                self._next_submit += 1
+            return seq
+
+        seq = await loop.run_in_executor(None, _submit)
+        return CompiledDAGFuture(self, seq)
+
     def _fetch(self, seq: int, timeout: Optional[float]):
+        with self._fetch_lock:
+            return self._fetch_locked(seq, timeout)
+
+    def _fetch_locked(self, seq: int, timeout: Optional[float]):
         if seq in self._buffered:
             return self._buffered.pop(seq)
         if seq < self._next_fetch:

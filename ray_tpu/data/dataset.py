@@ -496,6 +496,62 @@ class Dataset:
                                        drop_last=drop_last):
             yield {k: torch.as_tensor(v) for k, v in batch.items()}
 
+    def iter_tf_batches(self, *, batch_size: int = 256,
+                        drop_last: bool = False) -> Iterator[dict]:
+        """Batches as dicts of ``tf.Tensor`` (reference:
+        ``Dataset.iter_tf_batches``)."""
+        import tensorflow as tf
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last):
+            yield {k: tf.convert_to_tensor(v) for k, v in batch.items()}
+
+    def to_tf(self, feature_columns, label_columns, *,
+              batch_size: int = 256, drop_last: bool = False):
+        """A ``tf.data.Dataset`` of (features, labels) batches (reference:
+        ``Dataset.to_tf`` — keras ``model.fit`` consumable). Column args
+        take a name or list of names; single names yield bare tensors,
+        lists yield dicts (the reference's convention)."""
+        import tensorflow as tf
+
+        feat_list = ([feature_columns] if isinstance(feature_columns, str)
+                     else list(feature_columns))
+        lab_list = ([label_columns] if isinstance(label_columns, str)
+                    else list(label_columns))
+
+        # one probe batch pins the signature (dtypes + trailing dims)
+        probe = next(self.iter_batches(batch_size=1, batch_format="numpy"))
+
+        def spec(col):
+            arr = np.asarray(probe[col])
+            return tf.TensorSpec(
+                shape=(None, *arr.shape[1:]), dtype=arr.dtype
+            )
+
+        def pick(batch, cols, single):
+            if single:
+                return tf.convert_to_tensor(batch[cols[0]])
+            return {c: tf.convert_to_tensor(batch[c]) for c in cols}
+
+        single_f = isinstance(feature_columns, str)
+        single_l = isinstance(label_columns, str)
+
+        def gen():
+            for batch in self.iter_batches(batch_size=batch_size,
+                                           batch_format="numpy",
+                                           drop_last=drop_last):
+                yield (pick(batch, feat_list, single_f),
+                       pick(batch, lab_list, single_l))
+
+        f_sig = (spec(feat_list[0]) if single_f
+                 else {c: spec(c) for c in feat_list})
+        l_sig = (spec(lab_list[0]) if single_l
+                 else {c: spec(c) for c in lab_list})
+        return tf.data.Dataset.from_generator(
+            gen, output_signature=(f_sig, l_sig)
+        )
+
     # ------------------------------------------------------- aggregates
 
     def count(self) -> int:

@@ -29,10 +29,30 @@ from ray_tpu.llm.config import LLMConfig, load_tokenizer
 
 @dataclass
 class SamplingParams:
+    """Per-request sampling controls (reference: vLLM SamplingParams —
+    the engine_kwargs surface ray/llm passes through)."""
+
     max_new_tokens: int = 64
-    temperature: float = 0.0  # 0 = greedy
-    top_k: int = 0            # 0 = no top-k cut
+    temperature: float = 0.0   # 0 = greedy
+    top_k: int = 0             # 0 = no top-k cut
+    top_p: float = 1.0         # nucleus: smallest set with cumprob >= top_p
+    min_p: float = 0.0         # keep tokens with prob >= min_p * max_prob
+    repetition_penalty: float = 1.0   # HF-style, over prompt + generated
+    presence_penalty: float = 0.0     # flat penalty on seen generated ids
+    frequency_penalty: float = 0.0    # per-count penalty on generated ids
+    logprobs: int = 0          # >0: return chosen + top-N logprobs/token
+    seed: Optional[int] = None  # per-request determinism
     stop_token_ids: Sequence[int] = field(default_factory=tuple)
+    stop: Sequence[str] = field(default_factory=tuple)  # string stops
+
+
+class GenerationResult(list):
+    """Generated token ids; quacks as the plain list older callers expect,
+    with per-token logprob entries riding along when requested."""
+
+    def __init__(self, token_ids, logprobs=None):
+        super().__init__(token_ids)
+        self.logprobs = logprobs or []
 
 
 @dataclass
@@ -45,6 +65,9 @@ class _Slot:
     future: Optional[Future] = None
     last_token: int = 0
     length: int = 0  # current absolute position (== tokens in cache)
+    prompt_ids: List[int] = field(default_factory=list)  # penalties
+    logprobs: List[dict] = field(default_factory=list)
+    rng: Optional[Any] = None  # per-request RandomState when seed given
 
 
 class DecodeEngine:
@@ -141,18 +164,80 @@ class DecodeEngine:
 
     # ------------------------------------------------------------- sampling
 
-    def _sample(self, logits_row: np.ndarray, p: SamplingParams) -> int:
-        if p.temperature <= 0:
-            return int(np.argmax(logits_row))
-        logits = logits_row / max(p.temperature, 1e-5)
-        k = min(p.top_k, logits.shape[0])  # request-controlled: clamp
-        if k > 0:
-            kth = np.partition(logits, -k)[-k]
-            logits = np.where(logits < kth, -np.inf, logits)
-        logits = logits - logits.max()
-        probs = np.exp(logits)
-        probs /= probs.sum()
-        return int(self._rng.choice(len(probs), p=probs))
+    def _rng_for(self, p: SamplingParams):
+        return (np.random.RandomState(p.seed) if p.seed is not None
+                else self._rng)
+
+    def _sample(self, logits_row: np.ndarray, p: SamplingParams,
+                prompt_ids: Sequence[int] = (),
+                generated: Sequence[int] = (), rng=None):
+        """(next_token, logprob_entry|None). Penalties -> temperature ->
+        logprobs snapshot -> top-k/top-p/min-p truncation -> draw (the
+        reported distribution is pre-truncation, vLLM's convention)."""
+        logits = logits_row.astype(np.float64, copy=True)
+        if p.repetition_penalty != 1.0:
+            seen = np.fromiter(
+                set(prompt_ids) | set(generated), dtype=np.int64,
+                count=len(set(prompt_ids) | set(generated)),
+            )
+            if seen.size:
+                vals = logits[seen]
+                logits[seen] = np.where(
+                    vals > 0, vals / p.repetition_penalty,
+                    vals * p.repetition_penalty,
+                )
+        if (p.presence_penalty or p.frequency_penalty) and generated:
+            ids, counts = np.unique(
+                np.asarray(generated, np.int64), return_counts=True
+            )
+            logits[ids] -= (
+                p.presence_penalty + p.frequency_penalty * counts
+            )
+        greedy = p.temperature <= 0
+        if not greedy:
+            logits = logits / max(p.temperature, 1e-5)
+        lp_entry = None
+        if p.logprobs > 0:
+            shifted = logits - logits.max()
+            logps = shifted - np.log(np.exp(shifted).sum())
+            n = min(p.logprobs, logps.shape[0])
+            top = np.argpartition(logps, -n)[-n:]
+            top = top[np.argsort(logps[top])[::-1]]
+            lp_entry = {
+                "top": [(int(t), float(logps[t])) for t in top],
+                "logps": logps,  # chosen-token logprob filled by caller
+            }
+        if greedy:
+            nxt = int(np.argmax(logits))
+        else:
+            k = min(p.top_k, logits.shape[0])  # request-controlled: clamp
+            if k > 0:
+                kth = np.partition(logits, -k)[-k]
+                logits = np.where(logits < kth, -np.inf, logits)
+            shifted = logits - logits.max()
+            probs = np.exp(shifted)
+            probs /= probs.sum()
+            if p.top_p < 1.0:
+                order = np.argsort(probs)[::-1]
+                cum = np.cumsum(probs[order])
+                # smallest prefix reaching top_p (always keep the head)
+                cut = int(np.searchsorted(cum, p.top_p)) + 1
+                mask = np.zeros_like(probs, dtype=bool)
+                mask[order[:cut]] = True
+                probs = np.where(mask, probs, 0.0)
+                probs /= probs.sum()
+            if p.min_p > 0.0:
+                keep = probs >= p.min_p * probs.max()
+                probs = np.where(keep, probs, 0.0)
+                probs /= probs.sum()
+            nxt = int((rng or self._rng).choice(len(probs), p=probs))
+        if lp_entry is not None:
+            lp_entry = {
+                "token": nxt,
+                "logprob": float(lp_entry["logps"][nxt]),
+                "top_logprobs": lp_entry["top"],
+            }
+        return nxt, lp_entry
 
     # ------------------------------------------------------------ lifecycle
 
@@ -210,8 +295,9 @@ class DecodeEngine:
         while len(self._prefix_cache) > cap:
             self._prefix_cache.popitem(last=False)
 
-    def _prefill_locked(self, prompt_ids, params):
-        """(slot_cache jax pytree, first_token). Caller holds the lock.
+    def _prefill_locked(self, prompt_ids, params, rng=None):
+        """(slot_cache jax pytree, first_token, first_logprob). Caller
+        holds the lock.
         Consults the prefix cache: an exact hit skips the model entirely; a
         strict-prefix hit prefills only the tail from the cached KV state."""
         import jax.numpy as jnp
@@ -226,8 +312,10 @@ class DecodeEngine:
         )
         if entry is not None and matched == n:
             self.stats["prefix_hits"] += 1
-            first = self._sample(entry["logits_row"], params)
-            return entry["cache"], first
+            first, lp = self._sample(
+                entry["logits_row"], params, prompt_ids, (), rng
+            )
+            return entry["cache"], first, lp
         if entry is not None and (
             matched + self._bucket(n - matched) > self.config.max_seq_len
         ):
@@ -259,21 +347,24 @@ class DecodeEngine:
             logits_np = np.asarray(logits)[0]
             row = logits_np[n - 1]
         self._prefix_store_locked(prompt_ids, cache1, logits_np, base)
-        first = self._sample(row, params)
-        return cache1, first
+        first, lp = self._sample(row, params, prompt_ids, (), rng)
+        return cache1, first, lp
 
     def _activate_slot_locked(self, b, cache1, first, prompt_len, params,
-                              fut):
+                              fut, prompt_ids=(), first_lp=None, rng=None):
         self._cache = self._insert(self._cache, cache1, b)
         slot = self._slots[b]
         slot.active = True
         slot.token_ids = [first]
         slot.prompt_len = prompt_len
-        slot.produced = 1
         slot.params = params
+        slot.produced = 1
         slot.future = fut
         slot.last_token = first
         slot.length = prompt_len
+        slot.prompt_ids = list(prompt_ids)
+        slot.logprobs = [first_lp] if first_lp is not None else []
+        slot.rng = rng
         self.stats["requests"] += 1
         self._finish_if_done_locked(b)
 
@@ -288,6 +379,7 @@ class DecodeEngine:
                 break
             b = free.pop(0)
             try:
+                rng = None
                 if item[0] == "prefilled":
                     # PD disaggregation: the prompt's KV was computed by a
                     # prefill server; insert its transferred cache directly.
@@ -298,14 +390,28 @@ class DecodeEngine:
                     }
                     first = int(prefilled["first_token"])
                     prompt_len = int(prefilled["prompt_len"])
+                    prompt_ids = ()
+                    first_lp = prefilled.get("first_logprob")
+                    if params.seed is not None:
+                        rng = self._rng_for(params)
+                        if params.temperature > 0:
+                            # the prefill server consumed one draw from
+                            # this seed sampling the first token; skip it
+                            # or token 2 reuses token 1's random value
+                            rng.random_sample()
                 else:
                     _, prompt_ids, params, fut = item
-                    cache1, first = self._prefill_locked(prompt_ids, params)
+                    if params.seed is not None:
+                        rng = self._rng_for(params)
+                    cache1, first, first_lp = self._prefill_locked(
+                        prompt_ids, params, rng
+                    )
                     prompt_len = len(prompt_ids)
                 if prompt_len <= 0:
                     raise ValueError("prompt must be non-empty")
                 self._activate_slot_locked(
-                    b, cache1, first, prompt_len, params, fut
+                    b, cache1, first, prompt_len, params, fut,
+                    prompt_ids=prompt_ids, first_lp=first_lp, rng=rng,
                 )
             except Exception as e:
                 # Admission failure (bad bucket, mismatched transferred
@@ -318,17 +424,41 @@ class DecodeEngine:
     def _finish_if_done_locked(self, b: int):
         slot = self._slots[b]
         stop = set(slot.params.stop_token_ids) | {self.tokenizer.eos_id}
+        out = None
         done = (
             slot.produced >= slot.params.max_new_tokens
             or slot.last_token in stop
             or slot.length + 1 >= self.config.max_seq_len
         )
+        if slot.params.stop:
+            # Runs even when another criterion already fired: the final
+            # token can both complete a stop needle and hit max_new_tokens,
+            # and the needle must still be trimmed. String stops match on
+            # the DECODED text (a stop may span token boundaries);
+            # O(len^2) worst case over a request, bounded by
+            # max_new_tokens.
+            text = self.tokenizer.decode(slot.token_ids)
+            for needle in slot.params.stop:
+                idx = text.find(needle)
+                if idx >= 0:
+                    # trim to the tokens whose decode stays before the stop
+                    keep = len(slot.token_ids)
+                    while keep > 0 and len(
+                        self.tokenizer.decode(slot.token_ids[:keep])
+                    ) > idx:
+                        keep -= 1
+                    out = slot.token_ids[:keep]
+                    done = True
+                    break
         if done:
-            out = slot.token_ids
-            if out and out[-1] in stop:
-                out = out[:-1]
+            if out is None:
+                out = slot.token_ids
+                if out and out[-1] in stop:
+                    out = out[:-1]
             if slot.future is not None:
-                slot.future.set_result(out)
+                slot.future.set_result(GenerationResult(
+                    out, slot.logprobs[: len(out)]
+                ))
             slot.active = False
             slot.future = None
 
@@ -350,8 +480,13 @@ class DecodeEngine:
         logits = np.asarray(logits)
         for i in active:
             slot = self._slots[i]
-            nxt = self._sample(logits[i], slot.params)
+            nxt, lp = self._sample(
+                logits[i], slot.params, slot.prompt_ids, slot.token_ids,
+                slot.rng,
+            )
             slot.token_ids.append(nxt)
+            if lp is not None:
+                slot.logprobs.append(lp)
             slot.last_token = nxt
             slot.produced += 1
             slot.length += 1
@@ -384,11 +519,14 @@ class DecodeEngine:
             raise ValueError("prompt must be non-empty")
         params = params or SamplingParams()
         with self._lock:
-            cache1, first = self._prefill_locked(list(prompt_ids), params)
+            cache1, first, lp = self._prefill_locked(
+                list(prompt_ids), params, self._rng_for(params)
+            )
             return {
                 "cache": {k: np.asarray(v) for k, v in cache1.items()},
                 "first_token": first,
                 "prompt_len": len(prompt_ids),
+                "first_logprob": lp,
             }
 
     def submit_prefilled(self, prefilled: dict,

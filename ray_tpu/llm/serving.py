@@ -19,12 +19,24 @@ from ray_tpu.llm.engine import DecodeEngine, SamplingParams
 
 def extract_sampling(payload: dict, config: LLMConfig) -> SamplingParams:
     """OpenAI request fields → SamplingParams (shared by every ingress)."""
+    stop = payload.get("stop") or ()
+    if isinstance(stop, str):
+        stop = (stop,)
     return SamplingParams(
         max_new_tokens=int(
             payload.get("max_tokens", config.max_new_tokens_default)
         ),
         temperature=float(payload.get("temperature", 0.0)),
         top_k=int(payload.get("top_k", 0)),
+        top_p=float(payload.get("top_p", 1.0)),
+        min_p=float(payload.get("min_p", 0.0)),
+        repetition_penalty=float(payload.get("repetition_penalty", 1.0)),
+        presence_penalty=float(payload.get("presence_penalty", 0.0)),
+        frequency_penalty=float(payload.get("frequency_penalty", 0.0)),
+        logprobs=int(payload.get("logprobs") or 0),
+        seed=(int(payload["seed"]) if payload.get("seed") is not None
+              else None),
+        stop=tuple(stop),
     )
 
 

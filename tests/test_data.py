@@ -495,3 +495,35 @@ def test_read_sql_sharded(rt, tmp_path):
     # only stable across the per-shard re-runs under an ORDER BY.
     with pytest.raises(ValueError, match="order_by"):
         rt_data.read_sql("SELECT x FROM t", factory, parallelism=3)
+
+
+def test_iter_tf_batches_and_to_tf(rt):
+    """TF feed paths (reference: iter_tf_batches / to_tf): tensors come out
+    typed and batched; to_tf trains a keras model end-to-end."""
+    import numpy as np
+    import tensorflow as tf
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 3).astype(np.float32)
+    y = (X.sum(1, keepdims=True) > 0).astype(np.float32)
+    ds = rd.from_numpy({"x": X, "y": y}, parallelism=4)
+
+    batches = list(ds.iter_tf_batches(batch_size=16))
+    assert len(batches) == 4
+    assert isinstance(batches[0]["x"], tf.Tensor)
+    assert batches[0]["x"].shape == (16, 3)
+
+    tfds = ds.to_tf("x", "y", batch_size=16)
+    f, l = next(iter(tfds))
+    assert f.shape == (16, 3) and l.shape == (16, 1)
+    model = tf.keras.Sequential([
+        tf.keras.layers.Dense(4, activation="relu"),
+        tf.keras.layers.Dense(1),
+    ])
+    model.compile(optimizer="sgd", loss="mse")
+    hist = model.fit(tfds, epochs=1, verbose=0)
+    assert np.isfinite(hist.history["loss"][0])
+    # dict-mode: list columns yield dict structures
+    tfds2 = ds.to_tf(["x"], ["y"], batch_size=32)
+    f2, l2 = next(iter(tfds2))
+    assert set(f2) == {"x"} and set(l2) == {"y"}
