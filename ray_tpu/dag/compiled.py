@@ -138,8 +138,11 @@ class CompiledDAGFuture:
             )
         self._taken = True
         loop = asyncio.get_event_loop()
+        # 60s: the RESULT deadline (same default as CompiledDAGRef.get),
+        # not the dag's submit_timeout — submission and step duration are
+        # unrelated budgets
         return loop.run_in_executor(
-            None, self._dag._fetch, self._seq, self._dag._timeout
+            None, self._dag._fetch, self._seq, 60.0
         ).__await__()
 
 

@@ -176,10 +176,8 @@ class DecodeEngine:
         reported distribution is pre-truncation, vLLM's convention)."""
         logits = logits_row.astype(np.float64, copy=True)
         if p.repetition_penalty != 1.0:
-            seen = np.fromiter(
-                set(prompt_ids) | set(generated), dtype=np.int64,
-                count=len(set(prompt_ids) | set(generated)),
-            )
+            union = set(prompt_ids) | set(generated)
+            seen = np.fromiter(union, dtype=np.int64, count=len(union))
             if seen.size:
                 vals = logits[seen]
                 logits[seen] = np.where(
@@ -390,7 +388,7 @@ class DecodeEngine:
                     }
                     first = int(prefilled["first_token"])
                     prompt_len = int(prefilled["prompt_len"])
-                    prompt_ids = ()
+                    prompt_ids = tuple(prefilled.get("prompt_ids", ()))
                     first_lp = prefilled.get("first_logprob")
                     if params.seed is not None:
                         rng = self._rng_for(params)
@@ -527,6 +525,8 @@ class DecodeEngine:
                 "first_token": first,
                 "prompt_len": len(prompt_ids),
                 "first_logprob": lp,
+                # penalties need the prompt on the DECODE side too
+                "prompt_ids": list(prompt_ids),
             }
 
     def submit_prefilled(self, prefilled: dict,

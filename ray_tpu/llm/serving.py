@@ -40,17 +40,31 @@ def extract_sampling(payload: dict, config: LLMConfig) -> SamplingParams:
     )
 
 
+def _logprobs_block(completion_ids) -> dict:
+    """OpenAI-style logprobs payload from a GenerationResult (token ids
+    stand in for token strings — the engine's ids ARE its vocabulary)."""
+    entries = getattr(completion_ids, "logprobs", None) or []
+    return {
+        "tokens": [e["token"] for e in entries],
+        "token_logprobs": [e["logprob"] for e in entries],
+        "top_logprobs": [
+            {str(t): lp for t, lp in e["top_logprobs"]} for e in entries
+        ],
+    }
+
+
 def completion_response(config: LLMConfig, prompt_tokens: int,
                         completion_ids, text: str, **extra) -> dict:
     """OpenAI text_completion envelope (shared by every ingress)."""
+    choice = {"index": 0, "text": text, "finish_reason": "stop"}
+    if getattr(completion_ids, "logprobs", None):
+        choice["logprobs"] = _logprobs_block(completion_ids)
     return {
         "id": f"cmpl-{uuid.uuid4().hex[:24]}",
         "object": "text_completion",
         "created": int(time.time()),
         "model": config.model_id,
-        "choices": [{
-            "index": 0, "text": text, "finish_reason": "stop",
-        }],
+        "choices": [choice],
         "usage": {
             "prompt_tokens": prompt_tokens,
             "completion_tokens": len(completion_ids),
@@ -104,16 +118,19 @@ class LLMServer:
         ids = self.engine.tokenizer.encode(prompt)
         out = self.engine.submit(ids, self._sampling(payload)).result(600)
         text = self.engine.tokenizer.decode(out)
+        choice = {
+            "index": 0,
+            "message": {"role": "assistant", "content": text},
+            "finish_reason": "stop",
+        }
+        if getattr(out, "logprobs", None):
+            choice["logprobs"] = _logprobs_block(out)
         return {
             "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
             "object": "chat.completion",
             "created": int(time.time()),
             "model": self.config.model_id,
-            "choices": [{
-                "index": 0,
-                "message": {"role": "assistant", "content": text},
-                "finish_reason": "stop",
-            }],
+            "choices": [choice],
             "usage": {
                 "prompt_tokens": len(ids),
                 "completion_tokens": len(out),

@@ -13,6 +13,8 @@ from ray_tpu.rllib.algorithms import (
     IQLConfig,
     DQN,
     DQNConfig,
+    DreamerV3,
+    DreamerV3Config,
     IMPALA,
     IMPALAConfig,
     MARWIL,
@@ -45,6 +47,7 @@ __all__ = [
     "Algorithm", "AlgorithmConfig", "make_trainable",
     "PPO", "PPOConfig", "IMPALA", "IMPALAConfig", "DQN", "DQNConfig",
     "SAC", "SACConfig", "TQC", "TQCConfig",
+    "DreamerV3", "DreamerV3Config",
     "MARWIL", "MARWILConfig", "BC", "BCConfig",
     "ConnectorV2", "ConnectorPipelineV2", "MeanStdFilter", "FlattenObs",
     "ClipObs", "FrameStack", "ClipActions", "RescaleActions",

@@ -1,6 +1,7 @@
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig, IQL, IQLConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.dreamerv3 import DreamerV3, DreamerV3Config
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.marwil import BC, BCConfig, MARWIL, MARWILConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
@@ -10,6 +11,7 @@ from ray_tpu.rllib.algorithms.tqc import TQC, TQCConfig
 __all__ = [
     "APPO", "APPOConfig", "CQL", "CQLConfig", "IQL", "IQLConfig",
     "PPO", "PPOConfig", "IMPALA", "IMPALAConfig", "DQN", "DQNConfig",
+    "DreamerV3", "DreamerV3Config",
     "SAC", "SACConfig", "TQC", "TQCConfig",
     "MARWIL", "MARWILConfig", "BC", "BCConfig",
 ]

@@ -214,14 +214,18 @@ class EnvRunnerGroup:
                  fragment_len: int, module_config: rl_module.RLModuleConfig,
                  seed: int = 0, gamma: float = 0.99,
                  env_to_module: Optional[Callable] = None,
-                 module_to_env: Optional[Callable] = None):
+                 module_to_env: Optional[Callable] = None,
+                 runner_cls: Optional[type] = None):
         import ray_tpu
 
-        self._make = lambda idx: ray_tpu.remote(SingleAgentEnvRunner).options(
+        cls = runner_cls or SingleAgentEnvRunner
+        mc = (dict(module_config.__dict__)
+              if hasattr(module_config, "__dict__") else dict(module_config))
+        self._make = lambda idx: ray_tpu.remote(cls).options(
             name=f"env_runner_{idx}_{time.monotonic_ns()}", num_cpus=1
         ).remote(
             env_creator, num_envs_per_runner, fragment_len,
-            dict(module_config.__dict__), seed + 1000 * idx, gamma,
+            mc, seed + 1000 * idx, gamma,
             env_to_module, module_to_env,
         )
         self.runners = [self._make(i) for i in range(num_runners)]

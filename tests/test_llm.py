@@ -367,10 +367,14 @@ def test_sampling_seed_reproducible_and_varied():
     out1 = eng.generate(prompt, p)
     out2 = eng.generate(prompt, p)
     assert list(out1) == list(out2)
-    out3 = eng.generate(
-        prompt, SamplingParams(max_new_tokens=8, temperature=1.0, seed=8)
+    # a different seed changes the draw sequence; on this model at
+    # temperature 1.0 at least one of a few seeds must diverge
+    assert any(
+        list(eng.generate(prompt, SamplingParams(
+            max_new_tokens=8, temperature=1.0, seed=sd
+        ))) != list(out1)
+        for sd in (8, 9, 10)
     )
-    assert list(out3) != list(out1) or True  # different seed may differ
 
 
 def test_sampling_top_p_restricts_support():
@@ -462,3 +466,18 @@ def test_pd_disaggregation_logprobs_and_seed_alignment():
     assert len(got.logprobs) == len(got)
     for tok, entry in zip(got, got.logprobs):
         assert entry["token"] == tok
+
+
+def test_serving_returns_logprobs(rt_serve_cluster=None):
+    """logprobs requested over the serving surface come back in the
+    OpenAI response shape (they are not silently dropped)."""
+    from ray_tpu.llm.serving import LLMServer
+
+    srv = LLMServer.__new__(LLMServer)
+    srv.config = LLMConfig(**_SMALL)
+    srv.engine = _engine()
+    resp = srv.completions({"prompt": "hi", "max_tokens": 4, "logprobs": 2})
+    lp = resp["choices"][0]["logprobs"]
+    assert len(lp["tokens"]) == resp["usage"]["completion_tokens"]
+    assert all(v <= 0 for v in lp["token_logprobs"])
+    assert all(len(d) == 2 for d in lp["top_logprobs"])
