@@ -22,6 +22,7 @@ from ray_tpu.data.datasource import (
     read_numpy,
     read_parquet,
     read_sql,
+    read_tfrecords,
     read_webdataset,
     read_lance,
     read_iceberg,
@@ -52,6 +53,7 @@ __all__ = [
     "read_numpy",
     "read_parquet",
     "read_sql",
+    "read_tfrecords",
     "read_webdataset",
     "read_lance",
     "read_iceberg",

@@ -685,6 +685,11 @@ class Dataset:
 
         datasource.write_json(self, path, **kw)
 
+    def write_tfrecords(self, path: str, **kw):
+        from ray_tpu.data import datasource
+
+        datasource.write_tfrecords(self, path, **kw)
+
 
 class GroupedData:
     """Groupby over the distributed shuffle plane (reference:

@@ -397,6 +397,86 @@ def read_mongo(uri: str, database: str, collection: str, *,
     return from_items(docs)
 
 
+def read_tfrecords(paths, **kw) -> Dataset:
+    """TFRecord files of tf.train.Example records → rows (reference:
+    ``ray.data.read_tfrecords``). Feature types map: bytes_list[0] →
+    bytes (utf-8 decoded when clean), int64/float lists → scalar when
+    length 1, else 1-D numpy arrays."""
+    import numpy as np
+    import tensorflow as tf
+
+    def read_one(path):
+        rows = []
+        for raw in tf.data.TFRecordDataset([path]):
+            ex = tf.train.Example()
+            ex.ParseFromString(bytes(raw.numpy()))
+            row = {}
+            for name, feat in ex.features.feature.items():
+                kind = feat.WhichOneof("kind")
+                if kind == "bytes_list":
+                    # bytes stay bytes (reference behavior): a per-value
+                    # decode heuristic would mix str/bytes in one column
+                    # and break arrow schema construction
+                    vals = [bytes(v) for v in feat.bytes_list.value]
+                elif kind == "int64_list":
+                    vals = [int(v) for v in feat.int64_list.value]
+                else:
+                    vals = [float(v) for v in feat.float_list.value]
+                row[name] = vals[0] if len(vals) == 1 else (
+                    np.asarray(vals) if kind != "bytes_list" else vals
+                )
+            rows.append(row)
+        import pyarrow as _pa
+
+        from ray_tpu.data.block import _to_table
+
+        return _to_table(rows) if rows else _pa.table({})
+
+    return _read_files(paths, read_one)
+
+
+def write_tfrecords(ds: Dataset, path: str, **kw) -> List[str]:
+    """Blocks → TFRecord files of tf.train.Example (reference:
+    ``Dataset.write_tfrecords``): int → int64_list, float → float_list,
+    str/bytes → bytes_list, 1-D ndarray columns → multi-value lists."""
+    import numpy as np
+    import tensorflow as tf
+
+    def write_one(block, fp):
+        rows = BlockAccessor(block).to_pylist()
+        with tf.io.TFRecordWriter(fp) as w:
+            for row in rows:
+                feats = {}
+                for k, v in row.items():
+                    if isinstance(v, np.ndarray):
+                        v = v.tolist()
+                    if isinstance(v, (list, tuple)):
+                        vals = v
+                    else:
+                        vals = [v]
+                    # bools ride int64_list (reference convention)
+                    if all(isinstance(x, (bool, int, np.integer))
+                           for x in vals):
+                        feat = tf.train.Feature(int64_list=tf.train.Int64List(
+                            value=[int(x) for x in vals]))
+                    elif all(isinstance(x, (int, float, np.floating,
+                                            np.integer)) for x in vals):
+                        feat = tf.train.Feature(float_list=tf.train.FloatList(
+                            value=[float(x) for x in vals]))
+                    else:
+                        feat = tf.train.Feature(bytes_list=tf.train.BytesList(
+                            value=[
+                                x.encode() if isinstance(x, str) else bytes(x)
+                                for x in vals
+                            ]))
+                    feats[k] = feat
+                w.write(tf.train.Example(
+                    features=tf.train.Features(feature=feats)
+                ).SerializeToString())
+
+    return _write_blocks(ds, path, "tfrecord", write_one)
+
+
 def write_sql(ds: Dataset, table: str, connection_factory) -> int:
     """Write rows into a SQL table via a DBAPI2 factory; returns row count
     (reference: ``Dataset.write_sql``)."""

@@ -68,6 +68,7 @@ class _Slot:
     prompt_ids: List[int] = field(default_factory=list)  # penalties
     logprobs: List[dict] = field(default_factory=list)
     rng: Optional[Any] = None  # per-request RandomState when seed given
+    stream_q: Optional[Any] = None  # queue.Queue for token streaming
 
 
 class DecodeEngine:
@@ -363,6 +364,9 @@ class DecodeEngine:
         slot.prompt_ids = list(prompt_ids)
         slot.logprobs = [first_lp] if first_lp is not None else []
         slot.rng = rng
+        slot.stream_q = getattr(fut, "_rt_stream_q", None)
+        if slot.stream_q is not None:
+            slot.stream_q.put(first)
         self.stats["requests"] += 1
         self._finish_if_done_locked(b)
 
@@ -453,6 +457,8 @@ class DecodeEngine:
                 out = slot.token_ids
                 if out and out[-1] in stop:
                     out = out[:-1]
+            if slot.stream_q is not None:
+                slot.stream_q.put(("__done__", len(out)))
             if slot.future is not None:
                 slot.future.set_result(GenerationResult(
                     out, slot.logprobs[: len(out)]
@@ -485,6 +491,8 @@ class DecodeEngine:
             slot.token_ids.append(nxt)
             if lp is not None:
                 slot.logprobs.append(lp)
+            if slot.stream_q is not None:
+                slot.stream_q.put(nxt)
             slot.last_token = nxt
             slot.produced += 1
             slot.length += 1
@@ -539,6 +547,51 @@ class DecodeEngine:
         )
         self._ensure_loop()
         return fut
+
+    def submit_stream(self, prompt_ids: List[int],
+                      params: Optional[SamplingParams] = None):
+        """Token-level streaming (reference: vLLM streaming generation /
+        OpenAI stream=true). Yields generated token ids as the decode loop
+        produces them; raises the request's error if admission fails.
+
+        Stop-token trimming is reflected (the trimmed token is simply not
+        yielded); string stops are NOT supported here — their trim point
+        is only known at the end, so such requests must use submit()
+        (the serving layer enforces this split)."""
+        if params and params.stop:
+            raise ValueError(
+                "string stops are not streamable; use submit()"
+            )
+        import queue as _q
+
+        fut: Future = Future()
+        q: "_q.Queue" = _q.Queue()
+        fut._rt_stream_q = q
+        self._pending.put(
+            ("prompt", list(prompt_ids), params or SamplingParams(), fut)
+        )
+        self._ensure_loop()
+
+        def gen():
+            while True:
+                if fut.done() and fut.exception() is not None:
+                    raise fut.exception()
+                try:
+                    item = q.get(timeout=1.0)
+                except _q.Empty:
+                    continue
+                if isinstance(item, tuple) and item[0] == "__done__":
+                    return
+                # a stop TOKEN ends the request without being part of the
+                # output; the done marker's kept-length already excludes
+                # it, so check before yielding
+                stop = set((params.stop_token_ids if params else ())
+                           ) | {self.tokenizer.eos_id}
+                if item in stop:
+                    continue  # await the done marker
+                yield item
+
+        return gen()
 
     def generate(self, prompt_ids: List[int],
                  params: Optional[SamplingParams] = None) -> List[int]:

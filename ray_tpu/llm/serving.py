@@ -90,9 +90,22 @@ class LLMServer:
                 payload = json.loads(request["body"] or b"{}")
             except json.JSONDecodeError:
                 return {"error": {"message": "invalid JSON body"}}
+            if payload.get("stream") and not payload.get("stop"):
+                # OpenAI stream=true -> generator of SSE lines (the serve
+                # replica registers it; the HTTP proxy forwards as SSE).
+                # String stops need the full output for trimming, so they
+                # fall through to the non-streaming path.
+                chat = path.endswith("/chat/completions") or (
+                    "messages" in payload
+                )
+                return self.completions_stream(payload, chat=chat)
             if path.endswith("/chat/completions"):
                 return self.chat_completions(payload)
             return self.completions(payload)
+        if request.get("stream") and not request.get("stop"):
+            return self.completions_stream(
+                request, chat="messages" in request
+            )
         if "messages" in request:
             return self.chat_completions(request)
         return self.completions(request)
@@ -110,11 +123,7 @@ class LLMServer:
         return completion_response(self.config, len(ids), out, text)
 
     def chat_completions(self, payload: dict) -> dict:
-        messages: List[Dict[str, str]] = payload.get("messages", [])
-        prompt = "".join(
-            f"<{m.get('role', 'user')}>{m.get('content', '')}\n"
-            for m in messages
-        ) + "<assistant>"
+        prompt = self._chat_prompt(payload.get("messages", []))
         ids = self.engine.tokenizer.encode(prompt)
         out = self.engine.submit(ids, self._sampling(payload)).result(600)
         text = self.engine.tokenizer.decode(out)
@@ -137,6 +146,66 @@ class LLMServer:
                 "total_tokens": len(ids) + len(out),
             },
         }
+
+    def _chat_prompt(self, messages) -> str:
+        return "".join(
+            f"<{m.get('role', 'user')}>{m.get('content', '')}\n"
+            for m in messages
+        ) + "<assistant>"
+
+    def completions_stream(self, payload: dict, *, chat: bool = False):
+        """Generator of OpenAI SSE chunk lines (stream=true). Deltas are
+        detokenized incrementally; the final line is ``data: [DONE]``
+        (reference: ray.llm / vLLM streaming responses)."""
+        if chat:
+            prompt = self._chat_prompt(payload.get("messages", []))
+        else:
+            prompt = payload.get("prompt", "")
+        ids = self.engine.tokenizer.encode(prompt)
+        rid = (f"chatcmpl-{uuid.uuid4().hex[:24]}" if chat
+               else f"cmpl-{uuid.uuid4().hex[:24]}")
+        created = int(time.time())
+        obj = "chat.completion.chunk" if chat else "text_completion"
+        produced: List[int] = []
+        prev_text = ""
+        for tok in self.engine.submit_stream(ids, self._sampling(payload)):
+            produced.append(tok)
+            text = self.engine.tokenizer.decode(produced)
+            # Hold back trailing replacement chars: a partial multi-byte
+            # sequence decodes to U+FFFD that the next byte will fix —
+            # emitting it would bake the wrong char into the stream.
+            emit = text.rstrip("\ufffd")
+            delta, prev_text = emit[len(prev_text):], emit
+            if not delta:
+                continue  # partial multi-byte/merge: hold until decodable
+            if chat:
+                choice = {"index": 0, "delta": {"content": delta},
+                          "finish_reason": None}
+            else:
+                choice = {"index": 0, "text": delta, "finish_reason": None}
+            yield "data: " + json.dumps({
+                "id": rid, "object": obj, "created": created,
+                "model": self.config.model_id, "choices": [choice],
+            }) + "\n\n"
+        # flush anything held back (a genuinely invalid trailing byte in
+        # the final output emits as U+FFFD here, matching non-streaming)
+        tail = self.engine.tokenizer.decode(produced)[len(prev_text):]
+        if tail:
+            tc = ({"index": 0, "delta": {"content": tail},
+                   "finish_reason": None} if chat else
+                  {"index": 0, "text": tail, "finish_reason": None})
+            yield "data: " + json.dumps({
+                "id": rid, "object": obj, "created": created,
+                "model": self.config.model_id, "choices": [tc],
+            }) + "\n\n"
+        final = ({"index": 0, "delta": {}, "finish_reason": "stop"}
+                 if chat else
+                 {"index": 0, "text": "", "finish_reason": "stop"})
+        yield "data: " + json.dumps({
+            "id": rid, "object": obj, "created": created,
+            "model": self.config.model_id, "choices": [final],
+        }) + "\n\n"
+        yield "data: [DONE]\n\n"
 
     def health_check(self) -> bool:
         return True

@@ -73,6 +73,22 @@ class HTTPProxy:
             "method": request.method,
         }
         loop = asyncio.get_running_loop()
+        # SSE streaming: a JSON body with "stream": true rides the serve
+        # streaming protocol (replica-side generator) and is forwarded as
+        # text/event-stream chunks (reference: Serve HTTP streaming
+        # responses / OpenAI stream=true).
+        wants_stream = False
+        try:
+            parsed = json.loads(body or b"{}")
+            wants_stream = bool(
+                isinstance(parsed, dict) and parsed.get("stream")
+            )
+        except json.JSONDecodeError:
+            pass
+        if wants_stream:
+            return await self._handle_stream(
+                request, handle.options(stream=True), payload, loop
+            )
         try:
             resp = handle.remote(payload)
             out = await loop.run_in_executor(None, resp.result, 60)
@@ -92,6 +108,54 @@ class HTTPProxy:
         if isinstance(out, str):
             return web.Response(text=out)
         return web.json_response(out)
+
+    async def _handle_stream(self, request, handle, payload, loop):
+        import logging
+
+        from aiohttp import web
+
+        logger = logging.getLogger(__name__)
+        done = object()  # StopIteration cannot cross an executor Future
+        try:
+            gen = handle.remote(payload)
+            it = await loop.run_in_executor(None, iter, gen)
+            # Per-chunk deadline: a wedged replica must terminate the
+            # connection (the non-streaming path bounds result() at 60s)
+            first = await asyncio.wait_for(
+                loop.run_in_executor(None, next, it, done), timeout=300
+            )
+        except Exception as e:
+            return web.Response(status=500, text=f"{type(e).__name__}: {e}")
+        if first is not done and not isinstance(first, (str, bytes,
+                                                        bytearray)):
+            # The deployment chose not to stream (e.g. stream=true with
+            # options the endpoint serves non-incrementally): a plain
+            # object response comes back as JSON, not a broken SSE body.
+            return web.json_response(first)
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+        })
+        await resp.prepare(request)
+        chunk = first
+        try:
+            while chunk is not done:
+                if isinstance(chunk, str):
+                    chunk = chunk.encode()
+                elif not isinstance(chunk, (bytes, bytearray)):
+                    # generic generator deployments may yield objects:
+                    # frame them as JSON lines rather than dropping them
+                    chunk = (json.dumps(chunk) + "\n").encode()
+                await resp.write(chunk)
+                chunk = await asyncio.wait_for(
+                    loop.run_in_executor(None, next, it, done), timeout=300
+                )
+        except Exception:
+            # mid-stream failure: the stream ends early — log it, a silent
+            # truncation is indistinguishable from success
+            logger.exception("stream to %s ended on error", request.path)
+        await resp.write_eof()
+        return resp
 
     async def stop(self) -> bool:
         if self._runner is not None:

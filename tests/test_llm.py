@@ -481,3 +481,78 @@ def test_serving_returns_logprobs(rt_serve_cluster=None):
     assert len(lp["tokens"]) == resp["usage"]["completion_tokens"]
     assert all(v <= 0 for v in lp["token_logprobs"])
     assert all(len(d) == 2 for d in lp["top_logprobs"])
+
+
+# -------------------------------------------------------------- streaming
+
+
+def test_engine_stream_matches_generate():
+    """submit_stream yields exactly the tokens generate() returns (greedy),
+    and rejects string stops (their trim point needs the full output)."""
+    eng = _engine()
+    prompt = [5, 9, 17, 33]
+    p = SamplingParams(max_new_tokens=8)
+    expect = list(eng.generate(prompt, p))
+    got = list(eng.submit_stream(prompt, p))
+    assert got == expect
+    with pytest.raises(ValueError, match="streamable"):
+        eng.submit_stream(prompt, SamplingParams(stop=("x",)))
+
+
+def test_openai_http_streaming_sse():
+    """stream=true end-to-end over HTTP: SSE chunk lines whose concatenated
+    deltas equal the non-streaming completion text, terminated by [DONE]."""
+    import json as _json
+    import urllib.request
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.llm import build_openai_app
+
+    ray_tpu.init(num_cpus=4)
+    try:
+        config = LLMConfig(**{**_SMALL, "vocab_size": 512})
+        app = build_openai_app(config)
+        handle = serve.run(app, name="llm-stream", route_prefix="/v1")
+        port = serve.start_http_proxy(port=0)
+        base = f"http://127.0.0.1:{port}"
+
+        def post(payload):
+            req = urllib.request.Request(
+                base + "/v1/completions",
+                data=_json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            return urllib.request.urlopen(req, timeout=120)
+
+        plain = _json.loads(post(
+            {"prompt": "hi", "max_tokens": 6}
+        ).read())
+        expect_text = plain["choices"][0]["text"]
+
+        with post({"prompt": "hi", "max_tokens": 6, "stream": True}) as r:
+            assert r.headers["Content-Type"].startswith("text/event-stream")
+            raw = r.read().decode()
+        lines = [l for l in raw.split("\n\n") if l.startswith("data: ")]
+        assert lines[-1] == "data: [DONE]"
+        deltas = []
+        for line in lines[:-1]:
+            chunk = _json.loads(line[len("data: "):])
+            c = chunk["choices"][0]
+            if c["finish_reason"] is None:
+                deltas.append(c["text"])
+        assert "".join(deltas) == expect_text
+
+        # stream=true + string stops cannot stream (trim point unknown
+        # until the end): the proxy returns plain JSON, never a broken
+        # SSE body
+        with post({"prompt": "hi", "max_tokens": 6, "stream": True,
+                   "stop": ["zzz"]}) as r:
+            assert r.headers["Content-Type"].startswith("application/json")
+            body = _json.loads(r.read())
+        assert body["choices"][0]["text"]
+    finally:
+        try:
+            serve.shutdown()
+        finally:
+            ray_tpu.shutdown()
