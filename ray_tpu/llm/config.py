@@ -39,6 +39,14 @@ class LLMConfig:
     max_batch_slots: int = 8
     prefill_buckets: Sequence[int] = (64, 128, 256)
     tensor_parallel_size: int = 1  # reserved: mesh "tensor" axis size
+    # Prompt-lookup speculative decoding (vLLM spec-decode "[ngram]"
+    # parity, TPU-first rationale: each verify step amortizes one program
+    # dispatch over up to k tokens — dispatch latency dominates small-batch
+    # decode through a tunneled/jitted path). OPT-IN; greedy requests only
+    # (temperature 0 — rejection-sampling equivalence for stochastic
+    # requests is out of scope and those requests fall back to 1-token
+    # ticks). 0 disables; k = max draft tokens proposed per step.
+    speculative_ngram_k: int = 0
     # Automatic prefix caching (vLLM-APC parity): completed prompt prefills
     # are kept in an LRU; identical prompts skip prefill entirely and
     # shared prefixes (system prompts) prefill only their tail. OPT-IN

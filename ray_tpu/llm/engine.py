@@ -141,9 +141,24 @@ class DecodeEngine:
             logits, cache = model.forward_cached(params, tokens, cache, lens, cfg)
             return logits[:, -1], cache
 
+        def decode_all(params, tokens, cache, lens):
+            # speculation verify: logits at EVERY position (position j's
+            # row predicts the token after input j)
+            logits, cache = model.forward_cached(
+                params, tokens, cache, lens, cfg
+            )
+            return logits, cache
+
         self._prefill = jax.jit(prefill)
         self._insert = jax.jit(insert, donate_argnums=(0,))
         self._decode = jax.jit(decode, donate_argnums=(2,))
+        self._spec_k = max(
+            0, int(getattr(config, "speculative_ngram_k", 0) or 0)
+        )  # negatives = disabled, never a half-armed dispatch path
+        self._decode_spec = (
+            jax.jit(decode_all, donate_argnums=(2,))
+            if self._spec_k > 0 else None
+        )
         self._empty_slot_cache = lambda: model.init_kv_cache(cfg, 1, S)
 
         self._slots = [_Slot() for _ in range(B)]
@@ -161,6 +176,7 @@ class DecodeEngine:
         self.stats = {
             "requests": 0, "tokens_generated": 0, "ticks": 0,
             "prefix_hits": 0, "prefix_partial_hits": 0,
+            "spec_proposed": 0, "spec_accepted": 0,
         }
 
     # ------------------------------------------------------------- sampling
@@ -467,6 +483,112 @@ class DecodeEngine:
             slot.future = None
 
     def _tick_locked(self) -> bool:
+        if self._spec_k:
+            return self._tick_spec_locked()
+        return self._tick_plain_locked()
+
+    # ------------------------------------------- prompt-lookup speculation
+
+    def _propose_draft(self, slot, k: int):
+        """Prompt-lookup proposal (vLLM "[ngram]" speculator): find the
+        most recent earlier occurrence of the current 2-gram (then 1-gram)
+        in prompt+generated history and copy its continuation."""
+        hist = slot.prompt_ids + slot.token_ids
+        # bounded lookback (vLLM [ngram] caps this too): an O(full-history)
+        # scan per token would serialize long-context decode on the host
+        window = 512
+        if len(hist) > window:
+            hist = hist[-window:]
+        L = len(hist)
+        for n in (2, 1):
+            if L <= n:
+                continue
+            pat = hist[-n:]
+            for i in range(L - n - 1, -1, -1):
+                if hist[i:i + n] == pat:
+                    # i <= L-n-1 guarantees a non-empty continuation
+                    return hist[i + n:i + n + k]
+        return []
+
+    def _tick_spec_locked(self) -> bool:
+        """Speculative tick: verify up to k drafted tokens per GREEDY slot
+        in ONE dispatch (accepted prefix + one corrected token all come
+        from the same logits). Stochastic slots ride along with draft
+        length 0. Cache safety: forward_cached writes K/V before
+        attending and masks keys beyond each query position, and later
+        writes overwrite rejected-draft positions — stale KV can never
+        be attended."""
+        import jax.numpy as jnp
+
+        active = [i for i, s in enumerate(self._slots) if s.active]
+        if not active:
+            return False
+        K = self._spec_k
+        S = self.config.max_seq_len
+        if any(self._slots[i].length + 1 + K > S for i in active):
+            # near the sequence end the [B, 1+K] write would CLAMP inside
+            # dynamic_update_slice and overwrite valid KV — plain ticks
+            # finish the tail
+            return self._tick_plain_locked()
+        drafts: Dict[int, list] = {}
+        for i in active:
+            slot = self._slots[i]
+            if slot.params.temperature <= 0:
+                d = self._propose_draft(slot, K)
+                if d:
+                    drafts[i] = d
+                    self.stats["spec_proposed"] += len(d)
+        if not drafts:
+            # nothing to verify: the (1+K)-wide dispatch would pay ~K x
+            # attention/logits cost for zero benefit
+            return self._tick_plain_locked()
+        B = len(self._slots)
+        toks = np.zeros((B, 1 + K), np.int32)
+        lens = np.zeros((B,), np.int32)
+        for i in active:
+            slot = self._slots[i]
+            toks[i, :] = slot.last_token
+            lens[i] = slot.length
+            if i in drafts:
+                d = drafts[i]
+                toks[i, 1:1 + len(d)] = d
+        logits, self._cache = self._decode_spec(
+            self.params, jnp.asarray(toks), self._cache, jnp.asarray(lens)
+        )
+        logits = np.asarray(logits)
+        for i in active:
+            slot = self._slots[i]
+            draft = drafts.get(i, [])
+            for j in range(len(draft) + 1):
+                nxt, lp = self._sample(
+                    logits[i, j], slot.params, slot.prompt_ids,
+                    slot.token_ids, slot.rng,
+                )
+                self._emit_token_locked(i, nxt, lp)
+                if not slot.active:
+                    break  # finished mid-run (stop/max/length)
+                if j < len(draft):
+                    if nxt != draft[j]:
+                        break  # mismatch: later logits had wrong context
+                    self.stats["spec_accepted"] += 1
+        self.stats["ticks"] += 1
+        return True
+
+    def _emit_token_locked(self, i: int, nxt: int, lp) -> None:
+        """Shared per-token bookkeeping for plain and speculative ticks."""
+        slot = self._slots[i]
+        slot.token_ids.append(nxt)
+        if lp is not None:
+            slot.logprobs.append(lp)
+        if slot.stream_q is not None:
+            slot.stream_q.put(nxt)
+        slot.last_token = nxt
+        slot.produced += 1
+        slot.length += 1
+        self.stats["tokens_generated"] += 1
+        self._finish_if_done_locked(i)
+
+    def _tick_plain_locked(self) -> bool:
         import jax.numpy as jnp
 
         active = [i for i, s in enumerate(self._slots) if s.active]
@@ -488,16 +610,7 @@ class DecodeEngine:
                 logits[i], slot.params, slot.prompt_ids, slot.token_ids,
                 slot.rng,
             )
-            slot.token_ids.append(nxt)
-            if lp is not None:
-                slot.logprobs.append(lp)
-            if slot.stream_q is not None:
-                slot.stream_q.put(nxt)
-            slot.last_token = nxt
-            slot.produced += 1
-            slot.length += 1
-            self.stats["tokens_generated"] += 1
-            self._finish_if_done_locked(i)
+            self._emit_token_locked(i, nxt, lp)
         self.stats["ticks"] += 1
         return True
 
