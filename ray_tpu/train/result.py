@@ -11,6 +11,9 @@ from ray_tpu.train.checkpoint import Checkpoint
 @dataclass
 class Result:
     metrics: Dict[str, Any] = field(default_factory=dict)
+    # the trial's hyperparameters (reference: Result.config — how users
+    # read the winning configuration off get_best_result())
+    config: Dict[str, Any] = field(default_factory=dict)
     checkpoint: Optional[Checkpoint] = None
     best_checkpoint: Optional[Checkpoint] = None
     path: Optional[str] = None

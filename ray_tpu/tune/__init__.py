@@ -30,6 +30,8 @@ from ray_tpu.tune.schedulers import (
 )
 from ray_tpu.tune.search import (
     BayesOptSearch,
+    HyperOptSearch,
+    NevergradSearch,
     OptunaSearch,
     BasicVariantGenerator,
     Choice,
@@ -70,6 +72,8 @@ __all__ = [
     "get_context",
     "grid_search",
     "BayesOptSearch",
+    "HyperOptSearch",
+    "NevergradSearch",
     "OptunaSearch",
     "lograndint",
     "loguniform",

@@ -461,3 +461,238 @@ class BayesOptSearch(Searcher):
         except np.linalg.LinAlgError:
             return cands[0]
         return cands[int(np.argmax(ucb))]
+
+
+class HyperOptSearch(Searcher):
+    """HyperOpt TPE searcher (reference: ``search/hyperopt``).
+    Import-guarded: hyperopt is an optional dependency; built-ins
+    (BasicVariantGenerator, BayesOptSearch) cover the common cases
+    without it. Ask/tell rides hyperopt's Trials book-keeping the same
+    way the reference wrapper does."""
+
+    def __init__(self, param_space: Dict[str, Any], *, metric: str,
+                 mode: str = "min", num_samples: int = 16,
+                 seed: Optional[int] = None):
+        try:
+            import hyperopt  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "HyperOptSearch requires the optional 'hyperopt' package "
+                "(pip install hyperopt); built-in alternatives: "
+                "BasicVariantGenerator (random/grid) + BayesOptSearch"
+            ) from e
+        import math
+
+        import numpy as np
+        from hyperopt import hp
+
+        if not metric:
+            raise ValueError("HyperOptSearch requires metric=")
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
+        space = {}
+        self._constants: Dict[str, Any] = {}
+        for name, domain in param_space.items():
+            if isinstance(domain, dict):
+                raise ValueError(
+                    f"HyperOptSearch does not support nested/grid spaces "
+                    f"(param {name!r}); flatten the space"
+                )
+            if isinstance(domain, LogUniform):
+                space[name] = hp.loguniform(name, domain.lo, domain.hi)
+            elif isinstance(domain, QUniform):
+                space[name] = hp.quniform(
+                    name, domain.low, domain.high, domain.q
+                )
+            elif isinstance(domain, Uniform):
+                space[name] = hp.uniform(name, domain.low, domain.high)
+            elif isinstance(domain, LogRandInt):
+                # log-uniform over integers (randint would spend half the
+                # budget in the top decade)
+                space[name] = hp.qloguniform(
+                    name, math.log(domain.low),
+                    math.log(max(domain.high - 1, domain.low + 1)), 1
+                )
+            elif isinstance(domain, RandInt):
+                space[name] = hp.randint(name, domain.low, domain.high)
+            elif isinstance(domain, Choice):
+                space[name] = hp.choice(name, domain.categories)
+            elif isinstance(domain, Domain):
+                raise ValueError(
+                    f"HyperOptSearch cannot optimize param {name!r} of "
+                    f"type {type(domain).__name__}"
+                )
+            else:
+                self._constants[name] = domain
+        self._metric = metric
+        self._mode = mode
+        self._num_samples = num_samples
+        self._suggested = 0
+        self._space = space
+        self._param_space = param_space
+        import hyperopt as _hpo
+
+        self._hpo = _hpo
+        self._domain = _hpo.Domain(lambda _spc: 0, space)
+        self._trials = _hpo.Trials()
+        self._rng = np.random.default_rng(seed)
+        self._live: Dict[str, int] = {}
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        if metric:
+            self._metric = metric
+        if mode and mode != self._mode:
+            return False
+        return True
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._suggested >= self._num_samples:
+            return None
+        self._suggested += 1
+        hpo = self._hpo
+        new_id = self._trials.new_trial_ids(1)[0]
+        docs = hpo.tpe.suggest(
+            [new_id], self._domain, self._trials,
+            int(self._rng.integers(2 ** 31 - 1)),
+        )
+        self._trials.insert_trial_docs(docs)
+        self._trials.refresh()
+        trial = self._trials._dynamic_trials[-1]
+        trial["state"] = hpo.JOB_STATE_RUNNING
+        vals = {
+            k: v[0] for k, v in trial["misc"]["vals"].items() if v
+        }
+        cfg = dict(self._constants)
+        for name, domain in self._param_space.items():
+            if name not in vals:
+                continue
+            v = vals[name]
+            if isinstance(domain, Choice):
+                v = domain.categories[int(v)]
+            elif isinstance(domain, (RandInt, LogRandInt)):
+                v = int(v)
+            cfg[name] = v
+        self._live[trial_id] = new_id
+        return cfg
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict],
+                          error: bool = False):
+        hpo = self._hpo
+        tid = self._live.pop(trial_id, None)
+        if tid is None:
+            return
+        for trial in self._trials._dynamic_trials:
+            if trial["tid"] != tid:
+                continue
+            if error or not result or self._metric not in result:
+                trial["state"] = hpo.JOB_STATE_ERROR
+            else:
+                val = float(result[self._metric])
+                if self._mode == "max":
+                    val = -val
+                trial["state"] = hpo.JOB_STATE_DONE
+                trial["result"] = {"loss": val, "status": hpo.STATUS_OK}
+            break
+        self._trials.refresh()
+
+
+class NevergradSearch(Searcher):
+    """Nevergrad searcher (reference: ``search/nevergrad``).
+    Import-guarded; ask/tell maps directly onto an ``ng.optimizers``
+    optimizer over a parametrization built from the space."""
+
+    def __init__(self, param_space: Dict[str, Any], *, metric: str,
+                 mode: str = "min", num_samples: int = 16,
+                 optimizer: str = "NGOpt", seed: Optional[int] = None):
+        try:
+            import nevergrad as ng
+        except ImportError as e:
+            raise ImportError(
+                "NevergradSearch requires the optional 'nevergrad' package "
+                "(pip install nevergrad); built-in alternatives: "
+                "BasicVariantGenerator (random/grid) + BayesOptSearch"
+            ) from e
+        import math
+
+        if not metric:
+            raise ValueError("NevergradSearch requires metric=")
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode must be 'min' or 'max', got {mode!r}")
+        params = {}
+        self._constants: Dict[str, Any] = {}
+        for name, domain in param_space.items():
+            if isinstance(domain, dict):
+                raise ValueError(
+                    f"NevergradSearch does not support nested/grid spaces "
+                    f"(param {name!r}); flatten the space"
+                )
+            if isinstance(domain, LogUniform):
+                params[name] = ng.p.Log(
+                    lower=math.exp(domain.lo), upper=math.exp(domain.hi)
+                )
+            elif isinstance(domain, (Uniform, QUniform)):
+                # QUniform rides a continuous scalar; suggest() rounds to
+                # the declared q so configs stay on the quantized grid
+                params[name] = ng.p.Scalar(
+                    lower=domain.low, upper=domain.high
+                )
+            elif isinstance(domain, LogRandInt):
+                params[name] = ng.p.Log(
+                    lower=domain.low, upper=max(domain.high - 1,
+                                                domain.low + 1)
+                ).set_integer_casting()
+            elif isinstance(domain, RandInt):
+                params[name] = ng.p.Scalar(
+                    lower=domain.low, upper=domain.high - 1
+                ).set_integer_casting()
+            elif isinstance(domain, Choice):
+                params[name] = ng.p.Choice(domain.categories)
+            elif isinstance(domain, Domain):
+                raise ValueError(
+                    f"NevergradSearch cannot optimize param {name!r} of "
+                    f"type {type(domain).__name__}"
+                )
+            else:
+                self._constants[name] = domain
+        self._metric = metric
+        self._mode = mode
+        self._num_samples = num_samples
+        self._suggested = 0
+        self._param_space = param_space
+        inst = ng.p.Dict(**params)
+        if seed is not None:
+            inst.random_state.seed(seed)
+        opt_cls = ng.optimizers.registry[optimizer]
+        self._opt = opt_cls(parametrization=inst, budget=num_samples)
+        self._live: Dict[str, Any] = {}
+
+    def set_search_properties(self, metric, mode, config) -> bool:
+        if metric:
+            self._metric = metric
+        if mode and mode != self._mode:
+            return False
+        return True
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        if self._suggested >= self._num_samples:
+            return None
+        self._suggested += 1
+        cand = self._opt.ask()
+        self._live[trial_id] = cand
+        cfg = {**self._constants, **dict(cand.value)}
+        for name, domain in self._param_space.items():
+            if isinstance(domain, QUniform) and name in cfg:
+                cfg[name] = round(cfg[name] / domain.q) * domain.q
+        return cfg
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict],
+                          error: bool = False):
+        cand = self._live.pop(trial_id, None)
+        if cand is None:
+            return
+        if error or not result or self._metric not in result:
+            return  # nevergrad has no error-tell; drop the candidate
+        val = float(result[self._metric])
+        if self._mode == "max":
+            val = -val
+        self._opt.tell(cand, val)

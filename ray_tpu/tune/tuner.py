@@ -60,6 +60,7 @@ class Trial:
     def result(self) -> Result:
         return Result(
             metrics=self.last_result,
+            config=dict(self.config),
             checkpoint=(
                 Checkpoint(self.latest_checkpoint)
                 if self.latest_checkpoint else None

@@ -274,3 +274,31 @@ def test_bayesopt_with_tuner(rt_start):
     results = tuner.fit()
     best = results.get_best_result(metric="loss", mode="min")
     assert best.metrics["loss"] < 0.1
+
+
+def test_gated_searchers():
+    """HyperOpt/Nevergrad searchers: without the libs, construction
+    raises an error naming built-in alternatives; with them present,
+    the ask/tell happy path runs (reference:
+    tune/search/hyperopt|nevergrad wrappers)."""
+    from ray_tpu import tune as rt_tune
+
+    space = {"lr": rt_tune.uniform(0.0, 1.0),
+             "n": rt_tune.choice([1, 2, 3])}
+    for cls, mod in ((rt_tune.HyperOptSearch, "hyperopt"),
+                     (rt_tune.NevergradSearch, "nevergrad")):
+        try:
+            __import__(mod)
+            available = True
+        except ImportError:
+            available = False
+        if not available:
+            with pytest.raises(ImportError, match=mod):
+                cls(space, metric="loss")
+            continue
+        s = cls(space, metric="loss", num_samples=4, seed=0)
+        for i in range(4):
+            cfg = s.suggest(f"t{i}")
+            assert 0.0 <= cfg["lr"] <= 1.0 and cfg["n"] in (1, 2, 3)
+            s.on_trial_complete(f"t{i}", {"loss": (cfg["lr"] - 0.3) ** 2})
+        assert s.suggest("t5") is None  # budget exhausted
