@@ -202,6 +202,18 @@ def cmd_logs(args):
         print(f"{prefix} {rec['line']}", file=stream)
 
 
+def cmd_events(args):
+    """Structured export events (reference: event aggregator queries)."""
+    from ray_tpu.util import state
+
+    events = state.list_events(
+        _resolve_address(args), source_type=args.source_type,
+        event_type=args.event_type, limit=args.limit,
+    )
+    for ev in events:
+        print(json.dumps(ev))
+
+
 def cmd_profile(args):
     """Profile one node: sampling CPU flamegraph (collapsed stacks) or an
     XLA/TPU trace capture (reference: ray's reporter profile_manager;
@@ -342,6 +354,13 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--output", "-o", default=None,
                     help="collapsed-stacks file (cpu) or trace dir (xla)")
     sp.set_defaults(fn=cmd_profile)
+
+    sp = sub.add_parser("events", help="structured export events")
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--source-type", default=None, dest="source_type")
+    sp.add_argument("--event-type", default=None, dest="event_type")
+    sp.add_argument("--limit", type=int, default=100)
+    sp.set_defaults(fn=cmd_events)
 
     sp = sub.add_parser("logs", help="tail buffered worker logs")
     sp.add_argument("--address", default=None)

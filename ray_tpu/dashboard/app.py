@@ -40,6 +40,7 @@ class DashboardApp:
         r.add_get("/api/cluster_status", self._cluster_status)
         r.add_get("/api/stacks", self._stacks)
         r.add_get("/api/logs", self._logs)
+        r.add_get("/api/events", self._events)
         r.add_get("/metrics", self._metrics)
         self._runner = web.AppRunner(app, access_log=None)
         await self._runner.setup()
@@ -129,6 +130,22 @@ class DashboardApp:
 
         sid = request.match_info["submission_id"]
         h, _ = await self._head("stop_job", {"submission_id": sid})
+        return web.json_response(h)
+
+    async def _events(self, request):
+        """Structured export events (reference: the aggregator's event
+        query surface) — filterable by source/event type."""
+        from aiohttp import web
+
+        try:
+            limit = max(int(request.query.get("limit", "100")), 1)
+        except ValueError:
+            limit = 100
+        h, _ = await self._head("export_events", {
+            "limit": limit,
+            "source_type": request.query.get("source_type"),
+            "event_type": request.query.get("event_type"),
+        })
         return web.json_response(h)
 
     async def _logs(self, request):

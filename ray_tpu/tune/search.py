@@ -508,11 +508,14 @@ class HyperOptSearch(Searcher):
                 space[name] = hp.uniform(name, domain.low, domain.high)
             elif isinstance(domain, LogRandInt):
                 # log-uniform over integers (randint would spend half the
-                # budget in the top decade)
-                space[name] = hp.qloguniform(
-                    name, math.log(domain.low),
-                    math.log(max(domain.high - 1, domain.low + 1)), 1
-                )
+                # budget in the top decade); high is EXCLUSIVE
+                hi = domain.high - 1
+                if hi <= domain.low:
+                    self._constants[name] = domain.low  # single-value range
+                else:
+                    space[name] = hp.qloguniform(
+                        name, math.log(domain.low), math.log(hi), 1
+                    )
             elif isinstance(domain, RandInt):
                 space[name] = hp.randint(name, domain.low, domain.high)
             elif isinstance(domain, Choice):
@@ -530,11 +533,9 @@ class HyperOptSearch(Searcher):
         self._suggested = 0
         self._space = space
         self._param_space = param_space
-        import hyperopt as _hpo
-
-        self._hpo = _hpo
-        self._domain = _hpo.Domain(lambda _spc: 0, space)
-        self._trials = _hpo.Trials()
+        self._hpo = hyperopt
+        self._domain = hyperopt.Domain(lambda _spc: 0, space)
+        self._trials = hyperopt.Trials()
         self._rng = np.random.default_rng(seed)
         self._live: Dict[str, int] = {}
 
@@ -637,10 +638,13 @@ class NevergradSearch(Searcher):
                     lower=domain.low, upper=domain.high
                 )
             elif isinstance(domain, LogRandInt):
-                params[name] = ng.p.Log(
-                    lower=domain.low, upper=max(domain.high - 1,
-                                                domain.low + 1)
-                ).set_integer_casting()
+                hi = domain.high - 1  # high is EXCLUSIVE
+                if hi <= domain.low:
+                    self._constants[name] = domain.low
+                else:
+                    params[name] = ng.p.Log(
+                        lower=domain.low, upper=hi
+                    ).set_integer_casting()
             elif isinstance(domain, RandInt):
                 params[name] = ng.p.Scalar(
                     lower=domain.low, upper=domain.high - 1

@@ -77,6 +77,18 @@ def list_logs(address: Optional[str] = None, node_id: Optional[str] = None,
     )["lines"]
 
 
+def list_events(address: Optional[str] = None,
+                source_type: Optional[str] = None,
+                event_type: Optional[str] = None,
+                limit: int = 100) -> List[dict]:
+    """Structured export events from the head's recorder (reference: the
+    aggregator's event query surface; util/events.py)."""
+    return _call("export_events", {
+        "limit": limit, "source_type": source_type,
+        "event_type": event_type,
+    }, address)["events"]
+
+
 def list_tasks(address: Optional[str] = None, filters=None,
                limit: int = 1000) -> List[dict]:
     rows = _call("list_task_events", {"limit": limit}, address)["events"]

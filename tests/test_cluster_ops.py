@@ -142,10 +142,19 @@ def test_dashboard_endpoints(standalone_head):
             return json.loads(r.read())
 
     assert "ray_tpu" in get("/api/version")
-    nodes = get("/api/nodes")["nodes"]
+    # the fixture's colocated node registers asynchronously: poll briefly
+    deadline = time.time() + 15
+    nodes = []
+    while time.time() < deadline:
+        nodes = get("/api/nodes")["nodes"]
+        if nodes:
+            break
+        time.sleep(0.2)
     assert len(nodes) >= 1
     status = get("/api/cluster_status")
     assert "pending" in status and "nodes" in status
+    evs = get("/api/events?source_type=NODE")["events"]
+    assert evs and evs[0]["event_type"] == "NODE_ALIVE"
     # REST job submit + status + logs
     req = urllib.request.Request(
         base + "/api/jobs",

@@ -79,6 +79,8 @@ def test_head_emits_lifecycle_events(tmp_path, monkeypatch):
                 "PG_REMOVED"} <= types, types
     finally:
         ray_tpu.shutdown()
+    # (state API + CLI surfaces queried while the cluster was up are
+    # covered in test_events_surfaces below)
     # persisted JSONL exists under the session dir after head close
     p = str(tmp_path / "sess" / "events" / "events.jsonl")
     assert os.path.exists(p)
@@ -156,3 +158,34 @@ def test_otel_bridge_exports_registry():
     )
     assert buckets["1.0"] == 1 and buckets["+Inf"] == 2
     bridge._provider.shutdown()
+
+
+
+def test_events_surfaces(tmp_path, monkeypatch, capsys):
+    """The event pipeline's query surfaces: state.list_events and the
+    `rt events` CLI (reference: aggregator query endpoints)."""
+    monkeypatch.setenv("RT_SESSION_DIR", str(tmp_path / "sess"))
+    ray_tpu.init(num_cpus=1, num_nodes=1)
+    try:
+        import time
+
+        from ray_tpu.util import state
+
+        deadline = time.monotonic() + 10
+        evs = []
+        while time.monotonic() < deadline:
+            evs = state.list_events(source_type="NODE")
+            if evs:
+                break
+            time.sleep(0.2)
+        assert evs and all(e["source_type"] == "NODE" for e in evs)
+
+        from ray_tpu import cli
+        from ray_tpu._private.worker import get_global_worker
+
+        addr = "%s:%d" % get_global_worker().gcs_addr
+        cli.main(["events", "--address", addr, "--source-type", "NODE"])
+        out = capsys.readouterr().out.strip().splitlines()
+        assert out and json.loads(out[0])["source_type"] == "NODE"
+    finally:
+        ray_tpu.shutdown()
