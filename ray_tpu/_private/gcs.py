@@ -1444,6 +1444,15 @@ class HeadService:
         meta = self.object_dir.get(h["oid"])
         return {"found": meta is not None, "meta": meta}, []
 
+    async def rpc_object_lookup_batch(self, h, frames, conn):
+        """Multi-oid directory lookup: one round-trip resolves a whole
+        get()/wait() batch (reference: the owner-resolved directory serves
+        location batches, ``ownership_object_directory.h``). ``metas[i]``
+        is None for oids without a directory entry (inline objects live
+        only in their owner's memory store and are pulled from the owner)."""
+        d = self.object_dir
+        return {"metas": [d.get(oid) for oid in h["oids"]]}, []
+
     async def rpc_object_free(self, h, frames, conn):
         metas = [self.object_dir.pop(oid, None) for oid in h["oids"]]
         # Fan out so borrower processes evict cached copies/pins.

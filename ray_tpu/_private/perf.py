@@ -360,6 +360,33 @@ def bench_wait_1k_refs(k: int = 1000) -> float:
     return _rate(n, time.perf_counter() - t0)
 
 
+def bench_get_actor_refs(k: int = 1000, actors: int = 2) -> float:
+    """refs/s for a multi-ref get whose objects live in OTHER workers'
+    memory stores (no shm directory entry): exercises the batched
+    directory lookup + owner-coalesced pull path — O(owners) RPCs per
+    get, not O(refs)."""
+    @ray_tpu.remote
+    class Holder:
+        def make(self, n, base):
+            return [ray_tpu.put(base + i) for i in range(n)]
+
+    hs = [Holder.remote() for _ in range(actors)]
+    per = k // actors
+    refs = []
+    for j, h in enumerate(hs):
+        refs.extend(ray_tpu.get(h.make.remote(per, j * per)))
+    ray_tpu.get(refs)  # warm (pulled values are not cached; resolve repeats)
+    n = 3
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = ray_tpu.get(refs)
+    dt = time.perf_counter() - t0
+    assert out[0] == 0 and out[-1] == len(refs) - 1
+    for h in hs:
+        ray_tpu.kill(h)
+    return _rate(n * len(refs), dt)
+
+
 def bench_pg_churn(n: int = 50) -> float:
     """Placement-group create/ready/remove cycles per second (reference
     baseline: placement_group create/removal rate in BASELINE.md)."""
@@ -532,6 +559,10 @@ def run_core_benchmarks(quick: bool = False) -> Dict[str, float]:
     )
     _progress("wait_1k_refs")
     out["wait_1k_refs_per_s"] = bench_wait_1k_refs(
+        250 if quick else 1000
+    )
+    _progress("get_actor_refs")
+    out["get_actor_refs_per_s"] = bench_get_actor_refs(
         250 if quick else 1000
     )
     # Let the 10k-refs/wait legs' free backlog drain: PG churn should

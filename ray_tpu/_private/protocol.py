@@ -75,6 +75,31 @@ async def read_message(
     return header, frames[1:]
 
 
+def pack_multi_frames(frame_lists: List[List[bytes]]) -> Tuple[List[int], List[bytes]]:
+    """Flatten per-object frame lists into (counts, flat_frames) for a
+    single wire message. Batched verbs (``pull_object_batch``) carry many
+    objects' payloads in ONE framed message instead of one RPC per object;
+    the counts ride in the msgpack header, the payload frames stay
+    out-of-band and uncopied."""
+    counts = []
+    flat: List[bytes] = []
+    for fl in frame_lists:
+        counts.append(len(fl))
+        flat.extend(fl)
+    return counts, flat
+
+
+def unpack_multi_frames(counts: List[int], frames: List[bytes]) -> List[List[bytes]]:
+    """Inverse of :func:`pack_multi_frames`: split a flat frame list back
+    into per-object frame lists."""
+    out: List[List[bytes]] = []
+    pos = 0
+    for n in counts:
+        out.append(frames[pos:pos + n])
+        pos += n
+    return out
+
+
 class RpcError(Exception):
     """Remote handler failure. ``code`` is an optional machine-readable
     class (e.g. "oom") carried on the wire — callers branch on it, never on

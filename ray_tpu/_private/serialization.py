@@ -119,7 +119,32 @@ class SerializationContext:
     def deserialize(self, metadata: bytes, inband: bytes, buffers: List[Any]) -> Any:
         if metadata == METADATA_RAW:
             return bytes(buffers[0]) if not isinstance(buffers[0], bytes) else buffers[0]
-        return pickle.loads(inband, buffers=buffers)
+        from ray_tpu.object_ref import ObjectRef, _deserialization_sink
+
+        batch_hook = ObjectRef._deserialize_batch_hook
+        if batch_hook is None:
+            return pickle.loads(inband, buffers=buffers)
+        # Collect nested refs during the load and register their borrows in
+        # ONE batch-hook call: per-ref hook dispatch dominates deserializing
+        # ref-dense containers (the get-10k-refs shape), and the batch lets
+        # the worker move hex/owner bookkeeping off the calling thread.
+        refs: List[Any] = []
+        token = _deserialization_sink.set(refs)
+        try:
+            value = pickle.loads(inband, buffers=buffers)
+        finally:
+            _deserialization_sink.reset(token)
+            # Register INSIDE the finally: a loads() that raises mid-value
+            # has already materialized (and interned) the earlier refs —
+            # their GC-time decrements need the matching borrow, and a
+            # later deserialize of the same id aliases the cached ref on
+            # the assumption its borrow was registered.
+            if refs:
+                try:
+                    batch_hook(refs)
+                except Exception:
+                    pass
+        return value
 
     def deserialize_frames(self, frames: List[bytes]) -> Any:
         return self.deserialize(frames[0], frames[1], frames[2:])

@@ -642,10 +642,12 @@ class CoreWorker:
             # __del__ per element); one loop callback per ref floods the
             # event loop for seconds and starves control RPCs (observed:
             # 150x pg-churn collapse right after a 10k-ref get). Queue the
-            # hex and schedule a single drain per burst instead.
+            # ObjectID and schedule a single drain per burst — the hex
+            # conversion happens on the loop thread, off the GC'ing
+            # thread's critical path.
             if worker._shutdown or worker.loop is None:
                 return
-            worker._enqueue_ref_op(("dec", object_id.hex()))
+            worker._enqueue_ref_op(("dec", object_id))
 
         def on_deserialize(ref: ObjectRef):
             # A materialized ref must pin itself: the sender's credit dies
@@ -665,8 +667,25 @@ class CoreWorker:
             except RuntimeError:
                 worker._borrow_drain_scheduled = False
 
+        def on_deserialize_batch(refs):
+            # One queue entry + one wakeup for a whole deserialized value,
+            # however many refs it nests. Hex/owner-tuple bookkeeping for
+            # every ref moves to the loop-side drain, off the deserializing
+            # thread (the get-10k-refs hot path).
+            if worker._shutdown or worker.loop is None:
+                return
+            worker._borrow_queue.append((None, refs))
+            if worker._borrow_drain_scheduled:
+                return
+            worker._borrow_drain_scheduled = True
+            try:
+                worker.loop.call_soon_threadsafe(worker._drain_borrows)
+            except RuntimeError:
+                worker._borrow_drain_scheduled = False
+
         ObjectRef._release_hook = release
         ObjectRef._deserialize_hook = on_deserialize
+        ObjectRef._deserialize_batch_hook = on_deserialize_batch
 
     def run_sync(self, coro, timeout=None):
         fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
@@ -1359,26 +1378,42 @@ class CoreWorker:
         rec["count"] -= 1
         self._maybe_free(oid)
 
+    def _apply_borrow(self, oid: str, owner: tuple, my_addr: tuple,
+                      to_notify: Dict[tuple, List[str]]):
+        if owner == my_addr:
+            rec = self.owned.get(oid)
+            if rec is not None:
+                rec["count"] += 1  # a local materialized copy
+            return
+        b = self.borrowed.get(oid)
+        if b is None:
+            self.borrowed[oid] = {"count": 1, "owner": owner}
+            to_notify.setdefault(owner, []).append(oid)
+        else:
+            b["count"] += 1
+
     def _drain_borrows(self):
         """Register queued deserialize-time borrows (one loop callback per
-        burst; one grouped add_borrow notify per owner)."""
+        burst; one grouped add_borrow notify per owner). Entries are either
+        (oid_hex, owner_tuple) from the per-ref hook, or (None, [ObjectRef])
+        batches from the batched deserialize hook — the batch form defers
+        hex/owner-tuple work to HERE, off the deserializing thread."""
         self._borrow_drain_scheduled = False
         q = self._borrow_queue
         to_notify: Dict[tuple, List[str]] = {}
         my_addr = tuple(self.addr or ())
         while q:
             oid, owner = q.popleft()
-            if owner == my_addr:
-                rec = self.owned.get(oid)
-                if rec is not None:
-                    rec["count"] += 1  # a local materialized copy
+            if oid is None:
+                for ref in owner:  # owner slot carries the ref batch
+                    ro = ref.owner_address
+                    if not ro:
+                        continue
+                    self._apply_borrow(
+                        ref._id._bytes.hex(), tuple(ro), my_addr, to_notify
+                    )
                 continue
-            b = self.borrowed.get(oid)
-            if b is None:
-                self.borrowed[oid] = {"count": 1, "owner": owner}
-                to_notify.setdefault(owner, []).append(oid)
-            else:
-                b["count"] += 1
+            self._apply_borrow(oid, owner, my_addr, to_notify)
         for owner, oids in to_notify.items():
             self.loop.create_task(
                 self._notify_owner_many(owner, "add_borrow", oids)
@@ -1414,7 +1449,9 @@ class CoreWorker:
                     elif owner and tuple(owner) != my_addr:
                         to_add.setdefault(tuple(owner), []).append(oid)
                 continue
-            oid = payload
+            # "dec": payload is the hex, or the ObjectID when the release
+            # hook deferred the conversion off the GC'ing thread.
+            oid = payload if type(payload) is str else payload._bytes.hex()
             b = self.borrowed.get(oid)
             if b is not None:
                 b["count"] -= 1
@@ -1759,8 +1796,16 @@ class CoreWorker:
         return out
 
     async def _get_many(self, refs: List[ObjectRef], timeout: Optional[float]):
+        # ONE deadline for the whole call: the batch resolve and the
+        # per-ref paths share it, so get(refs, timeout=T) surfaces
+        # GetTimeoutError at ~T even when the batch phase consumed time.
+        deadline = None if timeout is None else time.monotonic() + timeout
+        prefetch = None
+        if len(refs) > 1:
+            prefetch = await self._batch_resolve(refs, deadline)
         results = await asyncio.gather(
-            *(self._get_one(r, timeout) for r in refs)
+            *(self._get_one(r, timeout, prefetch=prefetch,
+                            deadline=deadline) for r in refs)
         )
         out = []
         for v in results:
@@ -1769,8 +1814,85 @@ class CoreWorker:
             out.append(v)
         return out
 
-    async def _get_one(self, ref: ObjectRef, timeout: Optional[float] = None):
-        value = await self._get_one_attempt(ref, timeout)
+    async def _batch_resolve(self, refs, deadline) -> Optional[dict]:
+        """Vectorized remote resolution for a multi-ref get: ONE directory
+        round-trip for every unknown oid, then ONE pull RPC per distinct
+        owner for whatever the directory misses (reference: batched
+        location lookups + owner-grouped pulls, Wang et al. NSDI'21 §4).
+        Returns {oid_hex: store entry} for what it resolved; refs left out
+        fall back to the authoritative per-ref path, so errors/timeouts
+        keep their exact single-ref semantics. Never raises."""
+        my_addr = tuple(self.addr or ())
+        unknown: Dict[str, tuple] = {}
+        for ref in refs:
+            hex_ = ref._id._bytes.hex()
+            if hex_ in unknown or hex_ in self.memory_store:
+                continue
+            owner = tuple(ref.owner_address or ())
+            if owner == my_addr:
+                continue  # owned-but-pending: _wait_local handles it
+            unknown[hex_] = owner
+        if not unknown:
+            return None
+        resolved: Dict[str, tuple] = {}
+        oids = list(unknown)
+        try:
+            call = self.gcs.call("object_lookup_batch", {"oids": oids})
+            if deadline is not None:
+                tmo = max(deadline - time.monotonic(), 0)
+                h, _ = await asyncio.wait_for(call, tmo)
+            else:
+                h, _ = await call
+            for oid, meta in zip(oids, h.get("metas") or []):
+                if meta is not None:
+                    resolved[oid] = ("shm", meta)
+        except (asyncio.TimeoutError, protocol.RpcError,
+                protocol.ConnectionLost):
+            pass  # per-ref path retries the directory with full semantics
+        by_owner: Dict[tuple, List[str]] = {}
+        for oid, owner in unknown.items():
+            if oid not in resolved and owner:
+                by_owner.setdefault(owner, []).append(oid)
+        if by_owner:
+            await asyncio.gather(*(
+                self._pull_batch_from_owner(owner, oids_, deadline, resolved)
+                for owner, oids_ in by_owner.items()
+            ))
+        return resolved
+
+    async def _pull_batch_from_owner(self, owner, oids: List[str], deadline,
+                                     resolved: Dict[str, tuple]):
+        """Pull a whole owner's batch over a single RPC with multi-object
+        frames. Failures leave the oids unresolved (the per-ref pull
+        reproduces the exact error/timeout behavior)."""
+        try:
+            conn = await self.get_peer(owner)
+            call = conn.call("pull_object_batch", {"oids": oids})
+            if deadline is not None:
+                tmo = max(deadline - time.monotonic(), 0)
+                hh, frames = await asyncio.wait_for(call, tmo)
+            else:
+                hh, frames = await call
+        except (asyncio.TimeoutError, protocol.RpcError,
+                protocol.ConnectionLost, ConnectionRefusedError, OSError):
+            return
+        res = hh.get("res") or []
+        per_obj = protocol.unpack_multi_frames(
+            [r.get("n", 0) for r in res], frames
+        )
+        for oid, r, fl in zip(oids, res, per_obj):
+            kind = r.get("kind")
+            if kind == "shm":
+                resolved[oid] = ("shm", r["meta"])
+            elif kind == "mem":
+                resolved[oid] = ("mem", fl)
+            elif kind == "err":
+                resolved[oid] = ("err", _loads_maybe(fl))
+
+    async def _get_one(self, ref: ObjectRef, timeout: Optional[float] = None,
+                       prefetch: Optional[dict] = None, deadline=None):
+        value = await self._get_one_attempt(ref, timeout, prefetch=prefetch,
+                                            deadline=deadline)
         if isinstance(value, exc.ObjectLostError):
             initiated = self._try_reconstruct(ref)
             if initiated:
@@ -1826,14 +1948,23 @@ class CoreWorker:
         return 2
 
     async def _get_one_attempt(
-        self, ref: ObjectRef, timeout: Optional[float] = None
+        self, ref: ObjectRef, timeout: Optional[float] = None,
+        prefetch: Optional[dict] = None, deadline=None,
     ):
         hex_ = ref.id().hex()
-        deadline = None if timeout is None else time.monotonic() + timeout
+        if deadline is None:
+            deadline = (
+                None if timeout is None else time.monotonic() + timeout
+            )
         entry = self.memory_store.get(hex_)
         if entry is None and tuple(ref.owner_address or ()) == tuple(self.addr):
             # We own it but it is not ready yet: wait for local completion.
             entry = await self._wait_local(hex_, deadline)
+        if entry is None and prefetch is not None:
+            # Resolved by the batched directory lookup / owner-coalesced
+            # pull (_batch_resolve); a miss falls through to the per-ref
+            # path, which is authoritative.
+            entry = prefetch.get(hex_)
         if entry is None:
             entry = await self._fetch_remote(ref, deadline)
         kind = entry[0]
@@ -2001,15 +2132,61 @@ class CoreWorker:
     def wait(self, refs, num_returns=1, timeout=None, fetch_local=True):
         if num_returns > len(refs):
             raise ValueError("num_returns > len(refs)")
+        # Caller-thread fast path: a ref whose entry is already in the local
+        # store is ready by definition, and store reads are thread-safe. The
+        # dominant wait() shape (all or enough refs already ready — pure
+        # bookkeeping) answers with k dict probes and ZERO loop hops,
+        # futures, or RPCs; only a genuinely pending tail pays the async
+        # machinery.
+        store = self.memory_store
+        ready: List[ObjectRef] = []
+        not_ready: List[ObjectRef] = []
+        for r in refs:
+            (ready if r._id._bytes.hex() in store else not_ready).append(r)
+        if len(ready) >= num_returns or not not_ready:
+            return ready, not_ready
         return self.run_sync(self._wait(refs, num_returns, timeout))
 
     async def _wait(self, refs, num_returns, timeout):
-        pending = {id(r): r for r in refs}
-        tasks = {
-            asyncio.ensure_future(self._ready_probe(r)): r for r in refs
-        }
+        # Partition synchronously first: probe futures are spawned ONLY for
+        # genuinely pending refs (never one per ref), and every pending
+        # remote ref shares one batched poller instead of polling the
+        # directory per-ref.
         ready: List[ObjectRef] = []
+        pending: List[ObjectRef] = []
+        my_addr = tuple(self.addr or ())
+        for r in refs:
+            if r._id._bytes.hex() in self.memory_store:
+                ready.append(r)
+            else:
+                pending.append(r)
         deadline = None if timeout is None else time.monotonic() + timeout
+        tasks: Dict[Any, ObjectRef] = {}
+        pollers: List[asyncio.Task] = []
+        if len(ready) < num_returns and pending:
+            # hex -> [futures]: duplicate refs in one wait() share the id
+            # but need one future each (tasks is keyed by future).
+            remote_futs: Dict[str, List[Any]] = {}
+            by_owner: Dict[tuple, List[str]] = {}
+            for r in pending:
+                owner = tuple(r.owner_address or ())
+                if owner == my_addr:
+                    tasks[asyncio.ensure_future(
+                        self._local_ready_probe(r)
+                    )] = r
+                else:
+                    fut = self.loop.create_future()
+                    hex_ = r._id._bytes.hex()
+                    tasks[fut] = r
+                    lst = remote_futs.get(hex_)
+                    if lst is None:
+                        remote_futs[hex_] = lst = []
+                        by_owner.setdefault(owner, []).append(hex_)
+                    lst.append(fut)
+            if remote_futs:
+                pollers.append(asyncio.ensure_future(
+                    self._remote_ready_poll(remote_futs, by_owner)
+                ))
         try:
             while len(ready) < num_returns and tasks:
                 tmo = None if deadline is None else max(deadline - time.monotonic(), 0)
@@ -2031,31 +2208,106 @@ class CoreWorker:
         finally:
             for t in tasks:
                 t.cancel()
+            for p in pollers:
+                p.cancel()
         ready_set = {id(r) for r in ready}
         not_ready = [r for r in refs if id(r) not in ready_set]
         return ready, not_ready
 
-    async def _ready_probe(self, ref: ObjectRef):
+    async def _local_ready_probe(self, ref: ObjectRef):
         hex_ = ref.id().hex()
-        if hex_ in self.memory_store:
-            return True
-        if tuple(ref.owner_address or ()) == tuple(self.addr):
+        if hex_ not in self.memory_store:
             await self._wait_local(hex_, None)
-            return True
-        # remote: poll (owner pull would also work; poll keeps it cancelable)
-        while hex_ not in self.memory_store:
-            h, _ = await self.gcs.call("object_lookup", {"oid": hex_})
-            if h.get("found"):
-                return True
-            try:
-                conn = await self.get_peer(tuple(ref.owner_address))
-                hh, _ = await conn.call("contains_object", {"oid": hex_})
-                if hh.get("ready"):
-                    return True
-            except (protocol.ConnectionLost, OSError):
-                raise exc.ObjectLostError(hex_, "owner unreachable")
-            await asyncio.sleep(0.005)
         return True
+
+    async def _remote_ready_poll(self, remote_futs: Dict[str, List[Any]],
+                                 by_owner: Dict[tuple, List[str]]):
+        """ONE poller for every pending remote ref in a wait(): each cycle
+        issues a single object_lookup_batch for all unresolved oids plus one
+        contains_object_batch per owner still holding unresolved inline
+        objects — O(owners) RPCs per cycle, not O(refs). Resolves the
+        per-ref futures the wait loop selects on (duplicate refs share one
+        remote_futs slot holding each copy's future). Must never die with
+        futures unresolved — a probe failure becomes a ready-with-error
+        result, matching the per-ref probe contract."""
+        def settle(hex_, err=None):
+            for fut in remote_futs.pop(hex_, []):
+                if not fut.done():
+                    if err is not None:
+                        fut.set_exception(err)
+                    else:
+                        fut.set_result(True)
+
+        try:
+            await self._remote_ready_poll_inner(remote_futs, by_owner,
+                                               settle)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            # A poller crash must not strand the wait loop: surface the
+            # failure on every remaining ref (the old per-ref probes
+            # reported exceptions the same way, one ref at a time).
+            for hex_ in list(remote_futs):
+                settle(hex_, exc.ObjectLostError(hex_, f"probe failed: {e!r}"))
+
+    async def _remote_ready_poll_inner(self, remote_futs, by_owner, settle):
+        while remote_futs:
+            for hex_ in [h for h in remote_futs if h in self.memory_store]:
+                settle(hex_)
+            if not remote_futs:
+                return
+            oids = list(remote_futs)
+            try:
+                h, _ = await self.gcs.call(
+                    "object_lookup_batch", {"oids": oids}
+                )
+                for oid, meta in zip(oids, h.get("metas") or []):
+                    if meta is not None:
+                        settle(oid)
+            except (protocol.RpcError, protocol.ConnectionLost):
+                pass  # directory unavailable: owner probes still decide
+            for owner, hexes in list(by_owner.items()):
+                hexes = [x for x in hexes if x in remote_futs]
+                by_owner[owner] = hexes
+                if not hexes:
+                    del by_owner[owner]
+                    continue
+                if not owner:
+                    # No owner address to probe and the directory has no
+                    # entry: nothing can ever report this ref ready.
+                    for hex_ in hexes:
+                        settle(hex_, exc.ObjectLostError(
+                            hex_, "no owner address on ref"
+                        ))
+                    del by_owner[owner]
+                    continue
+                try:
+                    conn = await self.get_peer(owner)
+                    hh, _ = await conn.call(
+                        "contains_object_batch", {"oids": hexes}
+                    )
+                    for hex_, rdy in zip(hexes, hh.get("ready") or []):
+                        if rdy:
+                            settle(hex_)
+                except (protocol.ConnectionLost, ConnectionRefusedError,
+                        OSError):
+                    for hex_ in hexes:
+                        settle(hex_, exc.ObjectLostError(
+                            hex_, "owner unreachable"
+                        ))
+                    del by_owner[owner]
+                except protocol.RpcError as e:
+                    # Owner can't answer the probe: surface as ready-with-
+                    # error (the old per-ref probe let this propagate the
+                    # same way) instead of spinning two failing RPCs every
+                    # cycle forever.
+                    for hex_ in hexes:
+                        settle(hex_, exc.ObjectLostError(
+                            hex_, f"owner probe failed: {e}"
+                        ))
+                    del by_owner[owner]
+            if remote_futs:
+                await asyncio.sleep(0.005)
 
     def as_future(self, ref: ObjectRef) -> SyncFuture:
         return asyncio.run_coroutine_threadsafe(self._get_one(ref, None), self.loop)
@@ -3052,6 +3304,63 @@ class CoreWorker:
 
     async def rpc_contains_object(self, h, frames, conn):
         return {"ready": h["oid"] in self.memory_store}, []
+
+    async def rpc_contains_object_batch(self, h, frames, conn):
+        """Readiness flags for a whole oid batch (wait()'s remote poller:
+        one RPC per owner per cycle instead of one per ref)."""
+        store = self.memory_store
+        return {"ready": [oid in store for oid in h["oids"]]}, []
+
+    async def rpc_pull_object_batch(self, h, frames, conn):
+        """Serve a batch of objects we own over ONE reply with multi-object
+        frames (owner-coalesced pulls: a reader resolving N of our objects
+        pays one round-trip, not N). Blocks until every requested object is
+        ready — the caller's multi-ref get() waits for all of them anyway.
+        Per-oid layout mirrors rpc_pull_object: shm objects return their
+        meta (the reader maps the segment; ``inline`` forces bytes), mem
+        objects return frames, error entries return the pickled exception."""
+        oids = h["oids"]
+        inline = h.get("inline")
+
+        async def entry_for(hex_):
+            entry = self.memory_store.get(hex_)
+            if entry is None:
+                entry = await self._wait_local(hex_, None)
+            return entry
+
+        entries = await asyncio.gather(*(entry_for(o) for o in oids))
+        res = []
+        frame_lists: List[List[bytes]] = []
+        for hex_, entry in zip(oids, entries):
+            if entry is None:
+                res.append({"kind": "miss"})
+                frame_lists.append([])
+                continue
+            kind = entry[0]
+            if kind == "mem":
+                res.append({"kind": "mem"})
+                frame_lists.append(list(entry[1]))
+            elif kind == "shm":
+                if inline:
+                    fl = self.shm.get_frames(hex_, entry[1])
+                    if fl is None:
+                        res.append({"kind": "miss"})
+                        frame_lists.append([])
+                        continue
+                    res.append({"kind": "mem"})
+                    frame_lists.append([bytes(f) for f in fl])
+                else:
+                    res.append({"kind": "shm", "meta": entry[1]})
+                    frame_lists.append([])
+            else:  # err
+                res.append({"kind": "err"})
+                frame_lists.append(self.ctx.serialize(entry[1]).to_frames())
+        # The helper's counts ARE the wire contract for per-object frame
+        # slicing — one source of truth with the flattened payload.
+        counts, flat = protocol.pack_multi_frames(frame_lists)
+        for r, n in zip(res, counts):
+            r["n"] = n
+        return {"res": res}, flat
 
     async def rpc_add_borrow(self, h, frames, conn):
         for oid in h.get("oids") or [h["oid"]]:

@@ -521,7 +521,16 @@ class Dataset:
                     else list(label_columns))
 
         # one probe batch pins the signature (dtypes + trailing dims)
-        probe = next(self.iter_batches(batch_size=1, batch_format="numpy"))
+        try:
+            probe = next(self.iter_batches(batch_size=1, batch_format="numpy"))
+        except StopIteration:
+            # An empty dataset has no batch to derive dtypes/shapes from;
+            # StopIteration escaping a generator-adjacent call surfaces as
+            # a baffling RuntimeError far from here.
+            raise ValueError(
+                "to_tf() requires a non-empty dataset: cannot derive the "
+                "tf.data signature (dtypes/shapes) from zero rows"
+            ) from None
 
         def spec(col):
             arr = np.asarray(probe[col])

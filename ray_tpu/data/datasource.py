@@ -406,7 +406,17 @@ def read_tfrecords(paths, **kw) -> Dataset:
     import tensorflow as tf
 
     def read_one(path):
+        # Two passes: parse every record keeping raw value lists, THEN
+        # decide scalar-vs-list PER COLUMN (a column unwraps to scalars
+        # only when every record has exactly one value). Per-row unwrapping
+        # would hand arrow a column mixing scalars and arrays whenever a
+        # feature's value count varies across records, which fails table
+        # construction (reference unwraps per-column the same way). The
+        # decision is per FILE (files are the block boundary); counts that
+        # vary only across files still need a user-side schema.
         rows = []
+        kinds = {}
+        scalar_ok: dict = {}
         for raw in tf.data.TFRecordDataset([path]):
             ex = tf.train.Example()
             ex.ParseFromString(bytes(raw.numpy()))
@@ -422,10 +432,19 @@ def read_tfrecords(paths, **kw) -> Dataset:
                     vals = [int(v) for v in feat.int64_list.value]
                 else:
                     vals = [float(v) for v in feat.float_list.value]
-                row[name] = vals[0] if len(vals) == 1 else (
-                    np.asarray(vals) if kind != "bytes_list" else vals
-                )
+                row[name] = vals
+                kinds[name] = kind
+                if len(vals) != 1:
+                    scalar_ok[name] = False
+                else:
+                    scalar_ok.setdefault(name, True)
             rows.append(row)
+        for row in rows:
+            for name, vals in row.items():
+                if scalar_ok.get(name):
+                    row[name] = vals[0]
+                elif kinds.get(name) != "bytes_list":
+                    row[name] = np.asarray(vals)
         import pyarrow as _pa
 
         from ray_tpu.data.block import _to_table

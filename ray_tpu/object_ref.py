@@ -9,13 +9,30 @@ from __future__ import annotations
 
 import contextvars
 import threading
+import weakref
 from typing import Any, List, Optional
 
 from ray_tpu._private.ids import ObjectID
 
+# Live wire-materialized refs, interned by id bytes (reference analog: the
+# per-id entry in ``reference_counter.h`` — one refcount record per object,
+# however many Python handles alias it). Re-deserializing an id that is
+# already live returns the SAME ObjectRef: repeated gets of a ref-dense
+# container rebuild zero refs, register zero borrows, and enqueue zero
+# release ops for the copies they would otherwise churn.
+_live_refs: "weakref.WeakValueDictionary" = weakref.WeakValueDictionary()
+
 # While serializing a value, collects ObjectRefs discovered inside it.
 _serialization_sink: contextvars.ContextVar[Optional[List["ObjectRef"]]] = (
     contextvars.ContextVar("rt_ref_sink", default=None)
+)
+
+# While DEserializing a value, collects materialized ObjectRefs so borrow
+# registration happens ONCE per value instead of once per ref (a container
+# of 10k refs pays one batch-hook call, not 10k hook dispatches — the
+# reference batches borrow deltas the same way, ``reference_counter.h``).
+_deserialization_sink: contextvars.ContextVar[Optional[List["ObjectRef"]]] = (
+    contextvars.ContextVar("rt_deser_sink", default=None)
 )
 
 
@@ -34,6 +51,9 @@ class ObjectRef:
 
     _release_hook = None  # installed by the worker; called on __del__
     _deserialize_hook = None  # called when a ref is materialized from the wire
+    # Called ONCE with the full ref list when a deserialization sink is
+    # active (the worker's batched borrow registration).
+    _deserialize_batch_hook = None
     _lock = threading.Lock()
 
     def __init__(self, object_id: ObjectID, owner_addr: Optional[tuple] = None):
@@ -78,7 +98,10 @@ class ObjectRef:
         sink = _serialization_sink.get()
         if sink is not None:
             sink.append(self)
-        return (_deserialize_ref, (self._id, self._owner))
+        # Raw id bytes, not the ObjectID object: reconstructing the wrapped
+        # id through pickle's reconstructor + validated __init__ costs ~2x
+        # the whole ref rebuild on the 10k-nested-refs path.
+        return (_deserialize_ref, (self._id.binary(), self._owner))
 
     def __del__(self):
         hook = ObjectRef._release_hook
@@ -89,8 +112,32 @@ class ObjectRef:
                 pass
 
 
-def _deserialize_ref(object_id: ObjectID, owner: Optional[tuple]) -> ObjectRef:
-    ref = ObjectRef(object_id, owner)
+def _deserialize_ref(id_bytes, owner: Optional[tuple]) -> ObjectRef:
+    # Hot path (a value can nest 10k+ refs): raw __new__ construction skips
+    # the validated initializers, and an active deserialization sink defers
+    # ALL borrow bookkeeping to one batch-hook call after the load.
+    if isinstance(id_bytes, ObjectID):  # pre-batching pickles (same-id wire)
+        id_bytes = id_bytes.binary()
+    cached = _live_refs.get(id_bytes)
+    if cached is not None:
+        # Already live in this process: alias it. Its borrow was registered
+        # when it was first materialized and stays pinned until the LAST
+        # holder drops it, so no new registration is due.
+        return cached
+    oid = ObjectID.__new__(ObjectID)
+    oid._bytes = id_bytes
+    ref = ObjectRef.__new__(ObjectRef)
+    ref._id = oid
+    ref._owner = owner
+    ref._weakref_released = False
+    try:
+        _live_refs[id_bytes] = ref
+    except Exception:
+        pass
+    sink = _deserialization_sink.get()
+    if sink is not None:
+        sink.append(ref)
+        return ref
     hook = ObjectRef._deserialize_hook
     if hook is not None:
         try:

@@ -32,7 +32,18 @@ import numpy as np
 
 
 class ConnectorV2:
-    """One transform stage. Subclasses override ``__call__``."""
+    """One transform stage. Subclasses override ``__call__``.
+
+    module-to-env stages that map policy actions into env action bounds
+    should declare it: ``rescales_actions = True`` for a [-1,1]->bounds
+    rescale, ``clips_actions = True`` for a clip. The env runner keeps its
+    BUILT-IN rescale/clip unless the pipeline declares one — a pipeline
+    that only e.g. logs actions must not silently disable the
+    squashed-gaussian rescale, and a pipeline with its own rescale must
+    not get a second one stacked on top."""
+
+    rescales_actions = False
+    clips_actions = False
 
     def __call__(self, batch: Dict[str, np.ndarray], **kw) -> Dict[str, np.ndarray]:
         raise NotImplementedError
@@ -259,6 +270,8 @@ class FrameStack(ConnectorV2):
 class ClipActions(ConnectorV2):
     """module-to-env: clip actions into the env's Box bounds."""
 
+    clips_actions = True
+
     def __init__(self, low, high):
         self.low = np.asarray(low, np.float32)
         self.high = np.asarray(high, np.float32)
@@ -272,6 +285,8 @@ class ClipActions(ConnectorV2):
 class RescaleActions(ConnectorV2):
     """module-to-env: map [-1, 1] policy actions to the env's Box bounds
     (what squashed-gaussian policies emit)."""
+
+    rescales_actions = True
 
     def __init__(self, low, high):
         self.low = np.asarray(low, np.float32)

@@ -42,6 +42,25 @@ class SingleAgentEnvRunner:
         # pickle across the actor boundary and each runner owns its state.
         self.env_to_module = env_to_module() if env_to_module else None
         self.module_to_env = module_to_env() if module_to_env else None
+        # Built-in action-bounds handling stays on unless the user pipeline
+        # declares that it maps to env bounds itself: a module_to_env with
+        # only e.g. a ClipActions stage must not silently disable the
+        # squashed-gaussian [-1,1]->bounds rescale (raw tanh actions in a
+        # differently-bounded env corrupt training without erroring).
+        # Detection is by the ConnectorV2 rescales_actions/clips_actions
+        # declarations, so custom bound-mapping stages can opt out of the
+        # builtin by setting them (see connectors.ConnectorV2 docstring).
+        stages: List[Any] = []
+        if self.module_to_env is not None:
+            stages = list(getattr(
+                self.module_to_env, "connectors", [self.module_to_env]
+            ))
+        self._pipeline_rescales = any(
+            getattr(c, "rescales_actions", False) for c in stages
+        )
+        self._pipeline_bounds = self._pipeline_rescales or any(
+            getattr(c, "clips_actions", False) for c in stages
+        )
         self.params = None
         self.rng = jax.random.PRNGKey(seed)
         self._sample_fn = jax.jit(
@@ -114,15 +133,18 @@ class SingleAgentEnvRunner:
                 )
             for i, env in enumerate(self.envs):
                 a = env_actions[i]
-                if self.module_to_env is None and not self.config.discrete:
+                if not self.config.discrete:
                     low = env.action_space.low
                     high = env.action_space.high
                     if self.config.exploration == "squashed_gaussian":
                         # SAC: tanh actions live in [-1, 1]; rescale to the
                         # env bounds (the buffer keeps the policy-space
                         # action from act_buf, not this env-space one).
-                        a = low + (a + 1.0) * 0.5 * (high - low)
-                    else:
+                        # Applies even under a user module_to_env pipeline
+                        # unless that pipeline has its own RescaleActions.
+                        if not self._pipeline_rescales:
+                            a = low + (a + 1.0) * 0.5 * (high - low)
+                    elif not self._pipeline_bounds:
                         a = np.clip(a, low, high)
                 nobs, rew, term, trunc, _ = env.step(
                     a if not self.config.discrete else int(a)

@@ -1,0 +1,37 @@
+"""Tier-1 hot-path regression guard.
+
+Runs the ref-path microbenches at tiny k with GENEROUS wall-clock bounds:
+this is not a performance measurement (CI machines are noisy), it is a
+tripwire for accidental O(refs)-RPC or per-ref-future regressions, which
+show up as order-of-magnitude slowdowns, not percentages. A healthy build
+finishes each leg ~100x inside the bound."""
+import time
+
+import pytest
+
+from ray_tpu._private import perf
+
+# Each leg at these sizes takes well under a second on a healthy build;
+# an O(refs) RPC regression puts the wait leg alone into minutes.
+WALL_BOUND_S = 30.0
+
+
+def test_wait_refs_smoke(rt_start):
+    t0 = time.perf_counter()
+    rate = perf.bench_wait_1k_refs(k=100)
+    assert time.perf_counter() - t0 < WALL_BOUND_S
+    assert rate > 0
+
+
+def test_get_nested_refs_smoke(rt_start):
+    t0 = time.perf_counter()
+    rate = perf.bench_get_10k_refs(k=500)
+    assert time.perf_counter() - t0 < WALL_BOUND_S
+    assert rate > 0
+
+
+def test_get_actor_refs_smoke(rt_start):
+    t0 = time.perf_counter()
+    rate = perf.bench_get_actor_refs(k=100)
+    assert time.perf_counter() - t0 < WALL_BOUND_S
+    assert rate > 0
