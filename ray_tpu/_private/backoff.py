@@ -1,0 +1,65 @@
+"""Jittered, capped exponential backoff for retry/poll loops.
+
+Every fixed-period ``time.sleep(K)`` retry loop in a distributed system
+synchronizes its contenders: N workers that lost the same race all wake
+on the same tick and hammer the head again (reference: the exponential
+backoff helpers scattered through ray's GCS client reconnect paths). The
+lint rule RT204 flags constant sleeps in loops; this is the sanctioned
+replacement.
+
+Usage::
+
+    poll = Backoff(base=0.5, cap=4.0)
+    while not done():
+        poll.sleep()          # 0.5, 1, 2, 4, 4, ... (each +/- jitter)
+        if made_progress():
+            poll.reset()      # back to the fast tick
+"""
+from __future__ import annotations
+
+import random
+import time
+
+
+class Backoff:
+    """Exponential backoff with full-spread jitter.
+
+    The n-th delay is ``min(cap, base * factor**n)`` scaled uniformly
+    into ``[1 - jitter, 1]`` of itself, so contenders decorrelate instead
+    of waking in lockstep.
+    """
+
+    def __init__(self, base: float = 0.1, cap: float = 5.0,
+                 factor: float = 2.0, jitter: float = 0.5,
+                 rand=random.random, sleep=time.sleep):
+        if base <= 0 or cap < base or factor < 1 or not 0 <= jitter <= 1:
+            raise ValueError(
+                f"invalid backoff: base={base}, cap={cap}, factor={factor}, "
+                f"jitter={jitter}"
+            )
+        self.base = base
+        self.cap = cap
+        self.factor = factor
+        self.jitter = jitter
+        self._rand = rand
+        self._sleep = sleep
+        self._attempt = 0
+
+    def next_delay(self) -> float:
+        d = min(self.cap, self.base * self.factor ** self._attempt)
+        if d < self.cap:
+            # Stop growing the exponent once capped: factor**n overflows
+            # to OverflowError after ~1k attempts, which would kill
+            # long-lived poll loops (e.g. the pressure killer thread).
+            self._attempt += 1
+        return d * (1.0 - self.jitter * self._rand())
+
+    def sleep(self) -> float:
+        """Sleep for the next delay; returns the delay actually used."""
+        d = self.next_delay()
+        self._sleep(d)
+        return d
+
+    def reset(self):
+        """Progress was made: drop back to the fast tick."""
+        self._attempt = 0

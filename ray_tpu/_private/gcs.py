@@ -233,8 +233,11 @@ class HeadService:
             self.events.emit(
                 source_type, event_type, entity_id, message, **attrs
             )
-        except Exception:
-            pass
+        except Exception as e:
+            # Observability must never take down the control plane, but a
+            # persistently failing exporter should be visible in debug logs.
+            logger.debug("export-event emit (%s/%s) failed: %s",
+                         source_type, event_type, e)
 
     # WAL: durable-table mutations (KV, jobs) append a record BEFORE the
     # RPC reply, closing the between-snapshots loss window (reference:
@@ -979,8 +982,11 @@ class HeadService:
                     await node.conn.call(
                         "kill_actor", {"actor_id": info.actor_id}
                     )
-                except (protocol.RpcError, protocol.ConnectionLost):
-                    pass
+                except (protocol.RpcError, protocol.ConnectionLost) as e:
+                    logger.debug(
+                        "kill_actor %s during create-undo failed: %s",
+                        info.actor_id, e,
+                    )
                 if not strategy.get("pg_id"):
                     self._node_release(node, info.resources)
                     self._wake_waiters()
@@ -1187,8 +1193,11 @@ class HeadService:
                     await node.conn.call(
                         "kill_actor", {"actor_id": actor.actor_id}
                     )
-                except (protocol.RpcError, protocol.ConnectionLost):
-                    pass
+                except (protocol.RpcError, protocol.ConnectionLost) as e:
+                    logger.debug(
+                        "kill_actor %s on owner disconnect failed "
+                        "(node death will reap it): %s", actor.actor_id, e,
+                    )
             await self._on_actor_dead(actor, "owner disconnected")
 
     async def rpc_kill_actor(self, h, frames, conn):
@@ -1201,8 +1210,11 @@ class HeadService:
         if node is not None and node.conn is not None and actor.state == "ALIVE":
             try:
                 await node.conn.call("kill_actor", {"actor_id": actor.actor_id})
-            except (protocol.RpcError, protocol.ConnectionLost):
-                pass
+            except (protocol.RpcError, protocol.ConnectionLost) as e:
+                logger.debug(
+                    "kill_actor RPC to node %s failed (actor %s marked "
+                    "dead regardless): %s", actor.node_id, actor.actor_id, e,
+                )
         await self._on_actor_dead(actor, "killed via kill_actor")
         return {"found": True}, []
 

@@ -15,6 +15,7 @@ import sys
 import time
 from typing import Dict, List, Optional
 
+from ray_tpu._private.backoff import Backoff
 from ray_tpu._private.ids import JobID, NodeID
 
 import logging
@@ -155,11 +156,12 @@ class LocalCluster:
 
     def wait_for_nodes(self, count: int, timeout: float = 30.0):
         deadline = time.monotonic() + timeout
+        poll = Backoff(base=0.02, cap=0.1)
         while time.monotonic() < deadline:
             alive = [n for n in self.head.nodes.values() if n.alive]
             if len(alive) >= count:
                 return
-            time.sleep(0.02)
+            poll.sleep()
         raise TimeoutError(
             f"cluster: only {len([n for n in self.head.nodes.values() if n.alive])}"
             f"/{count} nodes registered"
@@ -168,11 +170,12 @@ class LocalCluster:
     def kill_node(self, handle: NodeHandle):
         handle.kill()
         deadline = time.monotonic() + 10
+        poll = Backoff(base=0.02, cap=0.1)
         while time.monotonic() < deadline:
             info = self.head.nodes.get(handle.node_id)
             if info is None or not info.alive:
                 return
-            time.sleep(0.02)
+            poll.sleep()
 
     def shutdown(self):
         atexit.unregister(self.shutdown)
@@ -185,8 +188,9 @@ class LocalCluster:
             n.terminate()
         deadline = time.monotonic() + 3
         for n in self.nodes:
+            poll = Backoff(base=0.02, cap=0.1)
             while n.alive() and time.monotonic() < deadline:
-                time.sleep(0.02)
+                poll.sleep()
             if n.alive():
                 n.kill()
         self.nodes.clear()

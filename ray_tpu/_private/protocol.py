@@ -251,8 +251,11 @@ class Connection:
             # round trip): flush now instead of waiting for the tick
             self._flush()
             await self.writer.drain()
-        except (ConnectionLost, ConnectionResetError, OSError):
-            pass
+        except (ConnectionLost, ConnectionResetError, OSError) as e:
+            logger.debug(
+                "reply for %s seq=%s dropped, peer gone: %s",
+                header.get("method"), header.get("seq"), e,
+            )
 
     def send_raw(self, header: dict, frames: List[bytes]):
         if self._closed:

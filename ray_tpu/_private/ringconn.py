@@ -286,8 +286,10 @@ class RingConnection:
         """Reply to a request (any thread)."""
         try:
             self._send_auto(header, frames)
-        except protocol.ConnectionLost:
-            pass  # peer gone; its pending future fails via teardown there
+        except protocol.ConnectionLost as e:
+            # Peer gone; its pending future fails via teardown there.
+            logger.debug("ring reply seq=%s dropped, peer gone: %s",
+                         header.get("seq"), e)
         except MessageTooBig:
             # Reply exceeds the ring: deliver an error instead so the caller
             # fails fast rather than timing out (large results normally ride

@@ -44,6 +44,7 @@ import cloudpickle
 
 from ray_tpu import exceptions as exc
 from ray_tpu._private import protocol
+from ray_tpu._private.backoff import Backoff
 from ray_tpu._private.ids import (
     ActorID,
     JobID,
@@ -557,8 +558,8 @@ class CoreWorker:
                 from ray_tpu._private.config import rt_config
 
                 rt_config.apply_system_config(_json.loads(frames[0]))
-        except (protocol.RpcError, ValueError):
-            pass
+        except (protocol.RpcError, ValueError) as e:
+            logger.debug("system-config fetch failed, using defaults: %s", e)
         if self.is_driver:
             await self.gcs.call("register_job", {"job_id": self.job_id.hex()})
         else:
@@ -1481,13 +1482,13 @@ class CoreWorker:
         if to_register:
             try:
                 self.gcs.notify("object_register", {"items": to_register})
-            except protocol.ConnectionLost:
-                pass
+            except protocol.ConnectionLost as e:
+                logger.debug("object_register batch dropped, head gone: %s", e)
         if freed:
             try:
                 self.gcs.notify("object_free", {"oids": freed})
-            except protocol.ConnectionLost:
-                pass
+            except protocol.ConnectionLost as e:
+                logger.debug("object_free batch dropped, head gone: %s", e)
 
     def _record_lineage(self, tid_hex, header, frames, resources, strategy,
                         nret):
@@ -1538,8 +1539,9 @@ class CoreWorker:
             else:
                 try:
                     self.gcs.notify("object_free", {"oids": [oid]})
-                except protocol.ConnectionLost:
-                    pass
+                except protocol.ConnectionLost as e:
+                    logger.debug("object_free %s dropped, head gone: %s",
+                                 oid, e)
         # Refs nested inside this value were pinned for its lifetime.
         if rec.get("nested"):
             self._release_borrows(rec["nested"])
@@ -1602,15 +1604,19 @@ class CoreWorker:
         try:
             conn = await self.get_peer(addr)
             conn.notify(method, {"oid": oid})
-        except (protocol.ConnectionLost, ConnectionRefusedError, OSError):
-            pass
+        except (protocol.ConnectionLost, ConnectionRefusedError,
+                OSError) as e:
+            logger.debug("%s(%s) to owner %s dropped, owner gone: %s",
+                         method, oid, addr, e)
 
     async def _notify_owner_many(self, addr, method: str, oids: List[str]):
         try:
             conn = await self.get_peer(addr)
             conn.notify(method, {"oids": oids})
-        except (protocol.ConnectionLost, ConnectionRefusedError, OSError):
-            pass
+        except (protocol.ConnectionLost, ConnectionRefusedError,
+                OSError) as e:
+            logger.debug("%s(%d oids) to owner %s dropped, owner gone: %s",
+                         method, len(oids), addr, e)
 
     # ------------------------------------------------------------ put / get
 
@@ -1847,8 +1853,10 @@ class CoreWorker:
                 if meta is not None:
                     resolved[oid] = ("shm", meta)
         except (asyncio.TimeoutError, protocol.RpcError,
-                protocol.ConnectionLost):
-            pass  # per-ref path retries the directory with full semantics
+                protocol.ConnectionLost) as e:
+            # Per-ref path retries the directory with full semantics.
+            logger.debug("batched directory lookup (%d oids) failed, "
+                         "falling back to per-ref: %s", len(oids), e)
         by_owner: Dict[tuple, List[str]] = {}
         for oid, owner in unknown.items():
             if oid not in resolved and owner:
@@ -2264,8 +2272,9 @@ class CoreWorker:
                 for oid, meta in zip(oids, h.get("metas") or []):
                     if meta is not None:
                         settle(oid)
-            except (protocol.RpcError, protocol.ConnectionLost):
-                pass  # directory unavailable: owner probes still decide
+            except (protocol.RpcError, protocol.ConnectionLost) as e:
+                # Directory unavailable: owner probes still decide.
+                logger.debug("wait() directory poll failed: %s", e)
             for owner, hexes in list(by_owner.items()):
                 hexes = [x for x in hexes if x in remote_futs]
                 by_owner[owner] = hexes
@@ -2933,8 +2942,9 @@ class CoreWorker:
                     "strategy": lease_set.strategy,
                 },
             )
-        except protocol.ConnectionLost:
-            pass
+        except protocol.ConnectionLost as e:
+            logger.debug("release_lease for node %s dropped, head gone: %s",
+                         slot.node_id, e)
 
     def _handle_task_reply(self, header, h, rframes):
         """Process a push_task reply: inline values, shm descriptors, errors."""
@@ -3379,8 +3389,9 @@ class CoreWorker:
         if freed:
             try:
                 self.gcs.notify("object_free", {"oids": freed})
-            except protocol.ConnectionLost:
-                pass
+            except protocol.ConnectionLost as e:
+                logger.debug("object_free (%d oids) on borrow release "
+                             "dropped, head gone: %s", len(freed), e)
         return {}, []
 
     async def rpc_free_object(self, h, frames, conn):
@@ -3423,11 +3434,16 @@ class CoreWorker:
         = subprocess-backed (runtime-env executor) tasks — killing the
         child actually returns its memory; in-process thread tasks cannot
         be killed and stay guarded by admission rejection + spilling."""
+        # Jittered poll: 1s ticks while pressure persists (kills stay
+        # responsive), decaying to 4s when the node is calm so N workers'
+        # monitors don't sample /proc in lockstep.
+        poll = Backoff(base=1.0, cap=4.0, jitter=0.25)
         while not self._shutdown:
-            time.sleep(1.0)
+            poll.sleep()
             try:
                 if not self._memory_monitor.is_pressing():
                     continue
+                poll.reset()
                 # Victims = tasks ACTUALLY executing inside an env child
                 # right now (ex.current_task, set under the executor's
                 # lock), and only RETRIABLE ones — killing a max_retries=0
@@ -3725,8 +3741,9 @@ class CoreWorker:
                         })
                 except protocol.ConnectionLost:
                     return
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.debug("metrics_push failed, dropping sample: %s",
+                                 e)
 
     async def rpc_push_task(self, h, frames, conn):
         """Execute a normal task (reference: ``CoreWorker::HandlePushTask``
@@ -4004,8 +4021,9 @@ class CoreWorker:
             conn.notify(
                 "stream_credit", {"tid": tid_hex, "consumed": consumed}
             )
-        except Exception:
-            pass  # producer gone: nothing left to throttle
+        except Exception as e:
+            # Producer gone: nothing left to throttle.
+            logger.debug("stream_credit for %s dropped: %s", tid_hex, e)
 
     def _abandon_stream(self, tid_hex: str, next_index: int):
         """The consumer dropped its generator: free arrived-but-unconsumed
@@ -4043,8 +4061,9 @@ class CoreWorker:
             ).hex()
             try:
                 self.gcs.notify("object_free", {"oids": [oid]})
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("object_free for dropped stream item %s "
+                             "failed: %s", oid, e)
 
     async def rpc_stream_item(self, h, frames, conn):
         """Owner side: one streamed item landed (stored like a task return;

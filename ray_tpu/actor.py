@@ -2,6 +2,7 @@
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, Dict, Optional
 
 from ray_tpu._private.worker import get_global_worker
@@ -142,6 +143,13 @@ class ActorClass:
         self._cls = cls
         self._options = dict(options or {})
         functools.update_wrapper(self, cls, updated=[])
+        # Opt-in decoration-time static analysis (RAY_TPU_LINT=1); see
+        # RemoteFunction.__init__ / ray_tpu.lint.
+        if os.environ.get("RAY_TPU_LINT"):
+            from ray_tpu.lint import check_actor_class, lint_enabled
+
+            if lint_enabled():
+                check_actor_class(cls, self._options)
 
     def __call__(self, *args, **kwargs):
         raise TypeError(

@@ -259,6 +259,18 @@ def cmd_stack(args):
         print()
 
 
+def cmd_lint(args):
+    """AST-based distributed-correctness analyzer (see ray_tpu/lint/)."""
+    from ray_tpu.lint.cli import run
+
+    select = ([s.strip() for s in args.select.split(",") if s.strip()]
+              if args.select else None)
+    sys.exit(run(
+        args.paths, json_out=args.json,
+        framework=True if args.framework else None, select=select,
+    ))
+
+
 def cmd_timeline(args):
     """Dump task events as chrome://tracing JSON (reference: ray timeline)."""
     from ray_tpu.util import state
@@ -371,6 +383,19 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("stack", help="all-thread stack dump of every node")
     sp.add_argument("--address", default=None)
     sp.set_defaults(fn=cmd_stack)
+
+    sp = sub.add_parser(
+        "lint", help="static distributed-correctness analysis "
+                     "(RT1xx: user code, RT2xx: framework self-checks)"
+    )
+    sp.add_argument("paths", nargs="+", help="files or directories")
+    sp.add_argument("--json", action="store_true",
+                    help="machine-readable findings")
+    sp.add_argument("--framework", action="store_true",
+                    help="run framework (Family B) rules on every file")
+    sp.add_argument("--select", default=None,
+                    help="comma-separated rule-id prefixes (e.g. RT2)")
+    sp.set_defaults(fn=cmd_lint)
 
     sp = sub.add_parser("timeline")
     sp.add_argument("--address", default=None)

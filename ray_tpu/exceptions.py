@@ -75,3 +75,24 @@ class PlacementGroupUnavailableError(RayTpuError):
 
 class PendingCallsLimitExceededError(RayTpuError):
     """Actor's max_pending_calls budget exhausted (backpressure signal)."""
+
+
+class LintError(RayTpuError):
+    """Static-analysis check failed at ``@remote`` decoration time.
+
+    Raised when ``RAY_TPU_LINT=1`` and ``ray_tpu.lint`` finds a
+    distributed-correctness hazard (non-picklable closure capture,
+    blocking get() in a task, unplaceable resources, ...) in the
+    decorated function/class — before the bad task ever ships.
+    ``findings`` holds the :class:`ray_tpu.lint.Finding` objects.
+    """
+
+    def __init__(self, findings):
+        self.findings = list(findings)
+        lines = [f.format() if hasattr(f, "format") else str(f)
+                 for f in self.findings]
+        super().__init__(
+            "lint failed (%d finding%s):\n%s\nSuppress a line with "
+            "'# raytpu: ignore[RULE]' or unset RAY_TPU_LINT."
+            % (len(lines), "s" if len(lines) != 1 else "", "\n".join(lines))
+        )

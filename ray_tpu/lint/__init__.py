@@ -1,0 +1,46 @@
+"""``ray_tpu.lint`` — AST-based distributed-correctness analyzer.
+
+Entry points:
+
+* CLI: ``python -m ray_tpu.lint <paths>`` / ``raytpu lint <paths>``
+  (``--json`` for machine-readable output, ``--select RT2`` to scope).
+* Decoration time: ``RAY_TPU_LINT=1`` makes ``@ray_tpu.remote`` raise
+  :class:`~ray_tpu.exceptions.LintError` on Family-A findings.
+* Self-check: ``tests/test_lint_self.py`` keeps ``ray_tpu/_private/``
+  free of Family-B findings.
+
+See ``base.py`` for the rule model and ``PARITY.md`` ("Round-7") for the
+rule catalog and suppression syntax (``# raytpu: ignore[RULE]``).
+"""
+from ray_tpu.lint import framework_rules, user_rules  # noqa: F401 (registry)
+from ray_tpu.lint.base import (
+    FAMILY_FRAMEWORK,
+    FAMILY_USER,
+    RULES,
+    Finding,
+    ModuleContext,
+    Rule,
+    lint_file,
+    lint_paths,
+    lint_source,
+)
+from ray_tpu.lint.decoration import (
+    check_actor_class,
+    check_remote_function,
+    lint_enabled,
+)
+
+__all__ = [
+    "FAMILY_FRAMEWORK",
+    "FAMILY_USER",
+    "RULES",
+    "Finding",
+    "ModuleContext",
+    "Rule",
+    "check_actor_class",
+    "check_remote_function",
+    "lint_enabled",
+    "lint_file",
+    "lint_paths",
+    "lint_source",
+]

@@ -1,0 +1,239 @@
+"""Shared lint infrastructure: findings, rule registry, suppression.
+
+``ray_tpu.lint`` is an AST-based distributed-correctness analyzer. The
+reference engine (Ray, Moritz et al., OSDI'18) catches these failure
+classes — non-serializable closures, blocked-worker deadlocks, leaked
+borrows, unplaceable resource shapes — only at runtime, deep inside a
+cluster; its own task-spec validation and ownership bookkeeping show the
+invariants are statically checkable at ``@remote`` decoration time.
+
+Two rule families:
+
+* **Family A (user code)** — rules that fire on functions/classes passed
+  to ``@ray_tpu.remote``: ``RT101``-``RT104``.
+* **Family B (framework self-analysis)** — rules that keep
+  ``ray_tpu/_private/`` honest about its own thread+lock discipline:
+  ``RT201``-``RT204``.
+
+Suppression: append ``# raytpu: ignore[RT201]`` (comma-separated ids, or
+bare ``# raytpu: ignore`` for all rules) to the flagged line.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Callable, Dict, List, Optional, Sequence
+
+FAMILY_USER = "A"
+FAMILY_FRAMEWORK = "B"
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*raytpu:\s*ignore(?:\[(?P<rules>[A-Za-z0-9_,\s]*)\])?"
+)
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    message: str
+    file: str
+    line: int
+    col: int
+
+    def format(self) -> str:
+        return f"{self.file}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class Rule:
+    rule_id: str
+    family: str
+    summary: str
+    check: Callable[["ModuleContext"], List[Finding]]
+
+
+#: rule id -> Rule. Populated by the ``@register`` decorators in
+#: user_rules.py / framework_rules.py at import time.
+RULES: Dict[str, Rule] = {}
+
+
+def register(rule_id: str, family: str, summary: str):
+    def deco(fn):
+        RULES[rule_id] = Rule(rule_id, family, summary, fn)
+        return fn
+
+    return deco
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` source text for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def terminal_name(node: ast.AST) -> Optional[str]:
+    """The last identifier of a Name/Attribute chain (``self._lock`` -> ``_lock``)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+class ModuleContext:
+    """One parsed module plus the import-alias facts rules need."""
+
+    def __init__(self, source: str, filename: str = "<string>",
+                 assume_remote: bool = False):
+        self.source = source
+        self.filename = filename
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename)
+        #: decoration-time mode: the top-level def/class IS the remote
+        #: target even though the decorator may be textually absent.
+        self.assume_remote = assume_remote
+        # Names bound to the ray_tpu module ("import ray_tpu as rt").
+        self.ray_aliases = {"ray_tpu"}
+        # Local name -> original ray_tpu attr ("from ray_tpu import get as g").
+        self.from_ray = {}
+        # Names bound to the time module / "from time import sleep".
+        self.time_aliases = {"time"}
+        self.from_time = {}
+        self._scan_imports()
+
+    def _scan_imports(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    bound = alias.asname or alias.name.split(".")[0]
+                    if alias.name == "ray_tpu":
+                        self.ray_aliases.add(bound)
+                    elif alias.name == "time":
+                        self.time_aliases.add(bound)
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "ray_tpu":
+                    for alias in node.names:
+                        self.from_ray[alias.asname or alias.name] = alias.name
+                elif node.module == "time":
+                    for alias in node.names:
+                        self.from_time[alias.asname or alias.name] = alias.name
+
+    # ---------------------------------------------------------- matchers
+    def is_ray_api_call(self, call: ast.Call, names: Sequence[str]) -> bool:
+        """Does ``call`` invoke ``ray_tpu.<name>`` (via any alias or
+        ``from ray_tpu import <name>``) for one of ``names``?"""
+        fn = call.func
+        if isinstance(fn, ast.Attribute) and fn.attr in names:
+            base = fn.value
+            return isinstance(base, ast.Name) and base.id in self.ray_aliases
+        if isinstance(fn, ast.Name):
+            return self.from_ray.get(fn.id) in names
+        return False
+
+    def is_time_sleep(self, call: ast.Call) -> bool:
+        fn = call.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "sleep":
+            base = fn.value
+            return isinstance(base, ast.Name) and base.id in self.time_aliases
+        if isinstance(fn, ast.Name):
+            return self.from_time.get(fn.id) == "sleep"
+        return False
+
+    def is_remote_decorated(self, node: ast.AST) -> bool:
+        """Is this def/class decorated with ``@remote`` / ``@ray_tpu.remote``
+        (optionally called with options)?"""
+        for dec in getattr(node, "decorator_list", []):
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            if isinstance(target, ast.Attribute) and target.attr == "remote":
+                base = target.value
+                if isinstance(base, ast.Name) and base.id in self.ray_aliases:
+                    return True
+            elif isinstance(target, ast.Name):
+                if self.from_ray.get(target.id) == "remote":
+                    return True
+        return False
+
+    # ------------------------------------------------------- suppression
+    def suppressed(self, finding: Finding) -> bool:
+        if not 1 <= finding.line <= len(self.lines):
+            return False
+        m = _SUPPRESS_RE.search(self.lines[finding.line - 1])
+        if m is None:
+            return False
+        rules = m.group("rules")
+        if rules is None or not rules.strip():
+            return True  # bare "# raytpu: ignore"
+        return finding.rule in {r.strip() for r in rules.split(",")}
+
+
+def lint_source(source: str, filename: str = "<string>",
+                families: Sequence[str] = (FAMILY_USER, FAMILY_FRAMEWORK),
+                assume_remote: bool = False,
+                select: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run the registry against one module's source. ``select`` filters by
+    rule-id prefix (``["RT2"]`` -> Family B only)."""
+    # Import for the registration side effect (idempotent).
+    from ray_tpu.lint import framework_rules, user_rules  # noqa: F401
+
+    ctx = ModuleContext(source, filename, assume_remote=assume_remote)
+    findings: List[Finding] = []
+    for rule in RULES.values():
+        if rule.family not in families:
+            continue
+        if select and not any(rule.rule_id.startswith(s) for s in select):
+            continue
+        findings.extend(rule.check(ctx))
+    findings = [f for f in findings if not ctx.suppressed(f)]
+    findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
+    return findings
+
+
+def _is_framework_path(path: str) -> bool:
+    parts = os.path.normpath(path).split(os.sep)
+    return "_private" in parts
+
+
+def lint_file(path: str, framework: Optional[bool] = None,
+              select: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Lint one file. Family A always runs; Family B runs for files under
+    ``_private/`` (framework self-analysis) or when ``framework=True``."""
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    run_b = framework if framework is not None else _is_framework_path(path)
+    families = (FAMILY_USER, FAMILY_FRAMEWORK) if run_b else (FAMILY_USER,)
+    try:
+        return lint_source(source, path, families=families, select=select)
+    except SyntaxError as exc:
+        return [Finding("RT000", f"syntax error: {exc.msg}", path,
+                        exc.lineno or 1, exc.offset or 0)]
+
+
+def lint_paths(paths: Sequence[str], framework: Optional[bool] = None,
+               select: Optional[Sequence[str]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in paths:
+        if os.path.isdir(path):
+            for root, dirs, files in os.walk(path):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d not in ("__pycache__", ".git", "node_modules")
+                )
+                for name in sorted(files):
+                    if name.endswith(".py"):
+                        findings.extend(lint_file(
+                            os.path.join(root, name), framework, select
+                        ))
+        else:
+            findings.extend(lint_file(path, framework, select))
+    return findings

@@ -1,0 +1,68 @@
+"""CLI for the analyzer: ``python -m ray_tpu.lint <paths>`` (also wired
+into the main CLI as ``raytpu lint``).
+
+Exit code 0 = clean, 1 = findings, 2 = usage error. ``--json`` emits a
+machine-readable finding list for dashboard ingestion.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional, Sequence
+
+from ray_tpu.lint.base import RULES, lint_paths
+
+
+def run(paths: Sequence[str], json_out: bool = False,
+        framework: Optional[bool] = None,
+        select: Optional[Sequence[str]] = None,
+        stream=None) -> int:
+    stream = stream if stream is not None else sys.stdout
+    findings = lint_paths(paths, framework=framework, select=select)
+    if json_out:
+        json.dump([f.to_dict() for f in findings], stream, indent=2)
+        stream.write("\n")
+    else:
+        for f in findings:
+            print(f.format(), file=stream)
+        n = len(findings)
+        print(f"{n} finding{'s' if n != 1 else ''}", file=stream)
+    return 1 if findings else 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m ray_tpu.lint",
+        description="AST-based distributed-correctness analyzer "
+                    "(rules RT1xx: user code, RT2xx: framework)",
+    )
+    p.add_argument("paths", nargs="*", help="files or directories to lint")
+    p.add_argument("--json", action="store_true", dest="json_out",
+                   help="emit findings as JSON")
+    p.add_argument("--framework", action="store_true",
+                   help="run Family B (framework) rules on every file, "
+                        "not just ray_tpu/_private/")
+    p.add_argument("--select", default=None,
+                   help="comma-separated rule-id prefixes to run "
+                        "(e.g. RT2 or RT101,RT203)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule registry and exit")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        # Ensure the registry is populated.
+        from ray_tpu.lint import framework_rules, user_rules  # noqa: F401
+
+        for rule in sorted(RULES.values(), key=lambda r: r.rule_id):
+            print(f"{rule.rule_id}  [family {rule.family}]  {rule.summary}")
+        return 0
+    if not args.paths:
+        build_parser().error("the following arguments are required: paths")
+    select = ([s.strip() for s in args.select.split(",") if s.strip()]
+              if args.select else None)
+    return run(args.paths, json_out=args.json_out,
+               framework=True if args.framework else None, select=select)

@@ -1,0 +1,188 @@
+"""Decoration-time linting: ``RAY_TPU_LINT=1`` makes ``@ray_tpu.remote``
+raise :class:`~ray_tpu.exceptions.LintError` before a bad task ever ships.
+
+Two layers, both cheap enough for import time:
+
+* AST (Family A rules) over the decorated function/class source — the
+  same rules the CLI runs, in ``assume_remote`` mode.
+* Value-based checks that AST cannot do: the *actual* closure cells and
+  referenced globals are probed against a non-picklable denylist, and the
+  merged options dict (``.options()`` chains are dynamic) is validated.
+"""
+from __future__ import annotations
+
+import dis
+import inspect
+import io
+import os
+import textwrap
+import threading
+from typing import List, Optional
+
+from ray_tpu.lint.base import _SUPPRESS_RE, FAMILY_USER, Finding, lint_source
+from ray_tpu.lint.user_rules import validate_options
+
+
+def lint_enabled() -> bool:
+    return os.environ.get("RAY_TPU_LINT") == "1"
+
+
+def _nonpicklable_desc(value) -> Optional[str]:
+    lock_types = (type(threading.Lock()), type(threading.RLock()))
+    if isinstance(value, lock_types):
+        return f"a {type(value).__name__}"
+    if isinstance(value, (threading.Condition, threading.Event,
+                          threading.Semaphore)):
+        return f"a threading.{type(value).__name__}"
+    if isinstance(value, io.IOBase):
+        return "an open file handle"
+    try:
+        import socket
+        if isinstance(value, socket.socket):
+            return "a socket"
+    except ImportError:  # pragma: no cover
+        pass
+    from ray_tpu.object_ref import ObjectRef
+    if isinstance(value, ObjectRef):
+        return "a live ObjectRef (pass it as an argument instead)"
+    return None
+
+
+def _ast_findings(target) -> List[Finding]:
+    try:
+        lines, start = inspect.getsourcelines(target)
+        filename = inspect.getsourcefile(target) or "<unknown>"
+    except (OSError, TypeError):
+        return []  # REPL / dynamically generated code: no source, no AST
+    source = textwrap.dedent("".join(lines))
+    try:
+        # RT104 is excluded here: the merged options dict is validated
+        # value-side (covers dynamic .options() chains without
+        # double-reporting constants visible in the decorator).
+        findings = lint_source(
+            source, filename, families=(FAMILY_USER,), assume_remote=True,
+            select=("RT101", "RT102", "RT103"),
+        )
+    except SyntaxError:
+        return []
+    for f in findings:
+        f.line += start - 1
+    return findings
+
+
+def _global_loads(code) -> set:
+    """Names the code object actually loads as globals (recursing into
+    nested code objects). co_names alone is wrong here: it also contains
+    attribute names, so `x.lock` would false-positive against a module
+    global named `lock`."""
+    names = set()
+    for ins in dis.get_instructions(code):
+        if ins.opname == "LOAD_GLOBAL":
+            names.add(ins.argval)
+    for const in code.co_consts:
+        if isinstance(const, type(code)):
+            names |= _global_loads(const)
+    return names
+
+
+def _closure_findings(fn) -> List[Finding]:
+    findings = []
+    code = fn.__code__
+    captured = {}
+    if fn.__closure__:
+        for name, cell in zip(code.co_freevars, fn.__closure__):
+            try:
+                captured[name] = cell.cell_contents
+            except ValueError:
+                continue  # empty cell (recursive def)
+    for name in _global_loads(code):
+        if name in fn.__globals__ and name not in captured:
+            captured[name] = fn.__globals__[name]
+    for name, value in captured.items():
+        desc = _nonpicklable_desc(value)
+        if desc is None:
+            continue
+        findings.append(Finding(
+            "RT101",
+            f"remote function '{fn.__name__}' captures {desc} ('{name}') "
+            "from its defining scope; it cannot be pickled into the task "
+            "spec",
+            code.co_filename, code.co_firstlineno, 0,
+        ))
+    return findings
+
+
+def _options_findings(target, options, where) -> List[Finding]:
+    if not options:
+        return []
+    try:
+        filename = inspect.getsourcefile(target) or "<unknown>"
+        line = (target.__code__.co_firstlineno
+                if hasattr(target, "__code__") else 1)
+    except TypeError:
+        filename, line = "<unknown>", 1
+    return [Finding("RT104", msg, filename, line, 0)
+            for msg in validate_options(options, where)]
+
+
+def _suppressed_rules(target) -> set:
+    """Rule ids suppressed anywhere in the target's source. Value-based
+    findings (closure cells, merged options) have no single source line
+    to anchor a comment to, so for them ``# raytpu: ignore[RULE]`` acts
+    at function/class scope; a bare ``ignore`` returns {"*"}."""
+    try:
+        lines, _ = inspect.getsourcelines(target)
+    except (OSError, TypeError):
+        return set()
+    rules: set = set()
+    for line in lines:
+        m = _SUPPRESS_RE.search(line)
+        if m is None:
+            continue
+        spec = m.group("rules")
+        if spec is None or not spec.strip():
+            return {"*"}
+        rules |= {r.strip() for r in spec.split(",")}
+    return rules
+
+
+def _filter_suppressed(findings: List[Finding], target) -> List[Finding]:
+    suppressed = _suppressed_rules(target)
+    if "*" in suppressed:
+        return []
+    return [f for f in findings if f.rule not in suppressed]
+
+
+def _maybe_raise(findings: List[Finding]):
+    if findings:
+        from ray_tpu.exceptions import LintError
+
+        raise LintError(findings)
+
+
+def check_remote_function(fn, options: Optional[dict] = None):
+    """Lint a function at ``@remote`` decoration time; raises LintError."""
+    # AST findings honor line-level suppression inside lint_source; the
+    # value-based probes have no comment-bearing line, so they honor
+    # function-scope suppression instead.
+    findings = _ast_findings(fn)
+    value_findings = _closure_findings(fn)
+    value_findings.extend(_options_findings(
+        fn, options, f"@remote on '{fn.__name__}'"
+    ))
+    findings.extend(_filter_suppressed(value_findings, fn))
+    _maybe_raise(findings)
+
+
+def check_actor_class(cls, options: Optional[dict] = None):
+    """Lint an actor class at ``@remote`` decoration time; raises LintError."""
+    findings = _ast_findings(cls)
+    value_findings = []
+    for name, member in vars(cls).items():
+        if inspect.isfunction(member):
+            value_findings.extend(_closure_findings(member))
+    value_findings.extend(_options_findings(
+        cls, options, f"@remote on '{cls.__name__}'"
+    ))
+    findings.extend(_filter_suppressed(value_findings, cls))
+    _maybe_raise(findings)

@@ -1,0 +1,274 @@
+"""Family B — self-analysis of the framework's own hot paths.
+
+RT201  blocking call while a threading.Lock/RLock is held
+RT202  lock-acquisition-order inversion (or non-reentrant re-acquire)
+RT203  silently swallowed exception on an RPC/reply path
+RT204  constant time.sleep() in a retry/poll loop (use _private.backoff)
+
+These run over ``ray_tpu/_private/`` (and any path passed with
+``--framework``). The lock heuristics are name-based: any with-item whose
+terminal identifier contains "lock" counts as a lock — that matches every
+lock in the codebase (``self._lock``, ``self._plock``, ``peer_lock``,
+``_cwd_lock``...) without needing type inference.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu.lint.base import (
+    FAMILY_FRAMEWORK,
+    Finding,
+    ModuleContext,
+    dotted,
+    register,
+    terminal_name,
+)
+
+# Dotted call targets that block the calling thread.
+_BLOCKING_DOTTED = {
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "select.select", "os.waitpid",
+}
+# Method names that block regardless of receiver: socket I/O and
+# subprocess handshakes. Chosen to be unambiguous in this codebase
+# (generic names like .send/.get/.join are excluded on purpose).
+_BLOCKING_ATTRS = {
+    "recv", "recvfrom", "recv_into", "accept", "sendall", "communicate",
+}
+
+
+def _is_lock_expr(node: ast.AST) -> bool:
+    name = terminal_name(node)
+    return name is not None and "lock" in name.lower()
+
+
+def _lock_names(with_node) -> List[Tuple[str, ast.AST]]:
+    out = []
+    for item in with_node.items:
+        expr = item.context_expr
+        if _is_lock_expr(expr):
+            out.append((dotted(expr) or terminal_name(expr), expr))
+    return out
+
+
+def _is_blocking_call(ctx: ModuleContext, call: ast.Call) -> Optional[str]:
+    if ctx.is_time_sleep(call):
+        return "time.sleep()"
+    name = dotted(call.func)
+    if name in _BLOCKING_DOTTED:
+        return f"{name}()"
+    if isinstance(call.func, ast.Attribute):
+        if call.func.attr in _BLOCKING_ATTRS:
+            return f".{call.func.attr}()"
+        # Future.result() with no deadline blocks indefinitely.
+        if call.func.attr == "result" and not call.args and not call.keywords:
+            return ".result()"
+    return None
+
+
+class _LockWalker(ast.NodeVisitor):
+    """Tracks the stack of held locks per function, emitting RT201
+    findings and RT202 acquisition-order edges."""
+
+    def __init__(self, ctx: ModuleContext):
+        self.ctx = ctx
+        self.findings: List[Finding] = []
+        # (class_name, outer_lock, inner_lock) -> first location
+        self.edges: Dict[Tuple[Optional[str], str, str],
+                         Tuple[int, int]] = {}
+        self._held: List[str] = []
+        self._class: Optional[str] = None
+
+    def visit_ClassDef(self, node):
+        prev, self._class = self._class, node.name
+        self.generic_visit(node)
+        self._class = prev
+
+    def _visit_fn(self, node):
+        # A nested def under a lock runs later, not under the lock.
+        held, self._held = self._held, []
+        self.generic_visit(node)
+        self._held = held
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def _visit_with(self, node, is_async):
+        locks = _lock_names(node)
+        for name, expr in locks:
+            for outer in self._held:
+                if outer == name:
+                    self.findings.append(Finding(
+                        "RT202",
+                        f"lock '{name}' re-acquired while already held — "
+                        "deadlock if it is a non-reentrant threading.Lock",
+                        self.ctx.filename, expr.lineno, expr.col_offset,
+                    ))
+                else:
+                    self.edges.setdefault(
+                        (self._class, outer, name),
+                        (expr.lineno, expr.col_offset),
+                    )
+        # RT201 applies while any lock is held — async locks park only the
+        # coroutine, but a sync blocking call inside an async-with still
+        # stalls the whole event loop, so those are flagged too.
+        self._held.extend(name for name, _ in locks)
+        for stmt in node.body:
+            self.visit(stmt)
+        if locks:
+            del self._held[len(self._held) - len(locks):]
+
+    def visit_With(self, node):
+        self._visit_with(node, is_async=False)
+
+    def visit_AsyncWith(self, node):
+        self._visit_with(node, is_async=True)
+
+    def visit_Call(self, node):
+        if self._held:
+            desc = _is_blocking_call(self.ctx, node)
+            if desc is not None:
+                self.findings.append(Finding(
+                    "RT201",
+                    f"blocking {desc} while holding lock "
+                    f"'{self._held[-1]}' — every thread contending on the "
+                    "lock stalls for the full call; move the call outside "
+                    "the critical section or snapshot state under the "
+                    "lock and operate on the copy",
+                    self.ctx.filename, node.lineno, node.col_offset,
+                ))
+        self.generic_visit(node)
+
+
+def _lock_walker(ctx: ModuleContext) -> _LockWalker:
+    """One traversal shared by RT201/RT202 (cached per module)."""
+    walker = getattr(ctx, "_lock_walker", None)
+    if walker is None:
+        walker = _LockWalker(ctx)
+        walker.visit(ctx.tree)
+        ctx._lock_walker = walker
+    return walker
+
+
+@register("RT201", FAMILY_FRAMEWORK,
+          "blocking call while holding a lock")
+def check_blocking_under_lock(ctx: ModuleContext) -> List[Finding]:
+    walker = _lock_walker(ctx)
+    return [f for f in walker.findings if f.rule == "RT201"]
+
+
+@register("RT202", FAMILY_FRAMEWORK,
+          "lock-acquisition-order inversion across the module")
+def check_lock_order(ctx: ModuleContext) -> List[Finding]:
+    walker = _lock_walker(ctx)
+    findings = [f for f in walker.findings if f.rule == "RT202"]
+    reported: Set[frozenset] = set()
+    for (cls, outer, inner), (line, col) in walker.edges.items():
+        rev = walker.edges.get((cls, inner, outer))
+        if rev is None:
+            continue
+        pair = frozenset(((cls, outer), (cls, inner)))
+        if pair in reported:
+            continue
+        reported.add(pair)
+        where = f"class {cls}" if cls else "module"
+        findings.append(Finding(
+            "RT202",
+            f"lock-order inversion in {where}: '{outer}' -> '{inner}' "
+            f"here but '{inner}' -> '{outer}' at line {rev[0]} — two "
+            "threads taking the two paths concurrently deadlock; pick "
+            "one order and enforce it",
+            ctx.filename, line, col,
+        ))
+    return findings
+
+
+_RPC_EXC_NAMES = {"RpcError", "ConnectionLost"}
+_RPC_CALL_ATTRS = {"call", "notify"}
+
+
+def _handler_types(handler: ast.ExceptHandler) -> Set[str]:
+    t = handler.type
+    out: Set[str] = set()
+    if t is None:
+        return out
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    for e in elts:
+        name = terminal_name(e)
+        if name:
+            out.add(name)
+    return out
+
+
+def _try_has_rpc_call(try_node: ast.Try) -> bool:
+    for stmt in try_node.body:
+        for node in ast.walk(stmt):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _RPC_CALL_ATTRS):
+                return True
+    return False
+
+
+@register("RT203", FAMILY_FRAMEWORK,
+          "silently swallowed exception on an RPC/reply path")
+def check_silent_swallow(ctx: ModuleContext) -> List[Finding]:
+    findings = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Try):
+            continue
+        for handler in node.handlers:
+            if not (len(handler.body) == 1
+                    and isinstance(handler.body[0], ast.Pass)):
+                continue
+            caught = _handler_types(handler)
+            rpc_exc = caught & _RPC_EXC_NAMES
+            if rpc_exc or ((not caught or "Exception" in caught)
+                           and _try_has_rpc_call(node)):
+                what = "/".join(sorted(rpc_exc)) if rpc_exc else "Exception"
+                findings.append(Finding(
+                    "RT203",
+                    f"'except {what}: pass' swallows an RPC-path failure "
+                    "with no trace — at minimum logger.debug() it so a "
+                    "dropped reply is diagnosable from logs",
+                    ctx.filename, handler.lineno, handler.col_offset,
+                ))
+    return findings
+
+
+@register("RT204", FAMILY_FRAMEWORK,
+          "constant time.sleep() in a retry/poll loop")
+def check_constant_sleep_loop(ctx: ModuleContext) -> List[Finding]:
+    findings = []
+    seen = set()
+
+    def scan(node, loop_line):
+        # Don't descend into nested defs (deferred execution) or nested
+        # loops (they report against their own line).
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.While)):
+            return
+        if (isinstance(node, ast.Call) and ctx.is_time_sleep(node)
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, (int, float))
+                and (node.lineno, node.col_offset) not in seen):
+            seen.add((node.lineno, node.col_offset))
+            findings.append(Finding(
+                "RT204",
+                f"constant time.sleep({node.args[0].value}) inside the "
+                f"loop at line {loop_line}: fixed-period retries "
+                "synchronize contenders and thundering-herd the head — "
+                "use ray_tpu._private.backoff.Backoff (jittered, capped) "
+                "instead",
+                ctx.filename, node.lineno, node.col_offset,
+            ))
+        for child in ast.iter_child_nodes(node):
+            scan(child, loop_line)
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.While):
+            for stmt in node.body:
+                scan(stmt, node.lineno)
+    return findings

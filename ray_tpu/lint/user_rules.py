@@ -1,0 +1,366 @@
+"""Family A — rules for user code passed to ``@ray_tpu.remote``.
+
+RT101  closure capture of a non-picklable / ownership-breaking value
+RT102  blocking ``ray_tpu.get()``/``wait()`` inside a task or actor method
+RT103  dropped ``.remote()`` result (lost exceptions, unawaited failures)
+RT104  resource request the scheduler can never place
+
+These mirror checks the reference engine performs at runtime (task spec
+validation, serialization failure at submission, bounded-worker deadlock
+detection) — here they fire before a bad task ever ships.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ray_tpu.lint.base import (
+    FAMILY_USER,
+    Finding,
+    ModuleContext,
+    dotted,
+    register,
+)
+
+# Constructors whose results cannot cross a pickle boundary (or, for
+# ObjectRef producers, must not cross it via closure capture). Bare names
+# cover ``from threading import Lock``-style imports.
+_NONPICKLABLE_CTORS = {
+    "threading.Lock": "a threading.Lock",
+    "threading.RLock": "a threading.RLock",
+    "threading.Semaphore": "a threading.Semaphore",
+    "threading.BoundedSemaphore": "a threading.Semaphore",
+    "threading.Condition": "a threading.Condition",
+    "threading.Event": "a threading.Event",
+    "multiprocessing.Lock": "a multiprocessing.Lock",
+    "socket.socket": "a socket",
+    "socket.create_connection": "a socket",
+    "open": "an open file handle",
+}
+
+
+def _remote_targets(ctx: ModuleContext) -> Iterator[Tuple[ast.AST, str]]:
+    """Yield (node, kind) for every remote-decorated def/class.
+
+    kind: "task" for functions, "actor" for classes. In decoration-time
+    mode (``ctx.assume_remote``) the first top-level def/class is the
+    target even without a visible decorator.
+    """
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if ctx.is_remote_decorated(node):
+                yield node, "task"
+        elif isinstance(node, ast.ClassDef):
+            if ctx.is_remote_decorated(node):
+                yield node, "actor"
+    if ctx.assume_remote:
+        for node in ctx.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if not ctx.is_remote_decorated(node):
+                    yield node, "task"
+                break
+            if isinstance(node, ast.ClassDef):
+                if not ctx.is_remote_decorated(node):
+                    yield node, "actor"
+                break
+
+
+def _local_names(fn: ast.AST) -> set:
+    """Parameters plus every name the function binds itself."""
+    names = set()
+    args = fn.args
+    for a in (args.posonlyargs + args.args + args.kwonlyargs):
+        names.add(a.arg)
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            names.add(node.id)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                names.add(alias.asname or alias.name.split(".")[0])
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)) and node is not fn:
+            names.add(node.name)
+    return names
+
+
+def _enclosing_assignments(ctx: ModuleContext,
+                           target: ast.AST) -> Dict[str, ast.expr]:
+    """name -> value expression for simple assignments in every scope that
+    lexically encloses ``target`` (module body and outer functions)."""
+    out: Dict[str, ast.expr] = {}
+
+    def collect(body):
+        for stmt in body:
+            if isinstance(stmt, ast.Assign):
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        out[tgt.id] = stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                if isinstance(stmt.target, ast.Name):
+                    out[stmt.target.id] = stmt.value
+            elif isinstance(stmt, ast.With):
+                # `with open(...) as f:` binds f to an open handle
+                for item in stmt.items:
+                    if (item.optional_vars is not None
+                            and isinstance(item.optional_vars, ast.Name)):
+                        out[item.optional_vars.id] = item.context_expr
+                collect(stmt.body)
+
+    # Walk down the enclosure chain module -> ... -> target, collecting
+    # assignments at each level above the target itself.
+    def descend(body) -> bool:
+        collect(body)
+        for stmt in body:
+            if stmt is target:
+                return True
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(n is target for n in ast.walk(stmt)):
+                    return descend(stmt.body)
+        return False
+
+    descend(ctx.tree.body)
+    return out
+
+
+def _capture_kind(ctx: ModuleContext, value: ast.expr) -> Optional[str]:
+    """If ``value`` produces a non-picklable / ownership-breaking object,
+    describe it."""
+    if not isinstance(value, ast.Call):
+        return None
+    name = dotted(value.func)
+    if name in _NONPICKLABLE_CTORS:
+        return _NONPICKLABLE_CTORS[name]
+    if name is not None and name.split(".")[-1] in ("Lock", "RLock"):
+        return "a lock"
+    if isinstance(value.func, ast.Attribute) and value.func.attr == "remote":
+        return "a live ObjectRef (from .remote())"
+    if ctx.is_ray_api_call(value, ("put",)):
+        return "a live ObjectRef (from ray_tpu.put())"
+    return None
+
+
+@register("RT101", FAMILY_USER,
+          "remote function captures a non-picklable value from an "
+          "enclosing scope")
+def check_closure_capture(ctx: ModuleContext) -> List[Finding]:
+    findings = []
+    for fn, kind in _remote_targets(ctx):
+        if kind != "task":
+            continue
+        assigns = _enclosing_assignments(ctx, fn)
+        if not assigns:
+            continue
+        locals_ = _local_names(fn)
+        seen = set()
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)):
+                continue
+            name = node.id
+            if name in locals_ or name in seen or name not in assigns:
+                continue
+            desc = _capture_kind(ctx, assigns[name])
+            if desc is None:
+                continue
+            seen.add(name)
+            hint = ("pass the ref as an argument so ownership/borrow "
+                    "bookkeeping can track it"
+                    if "ObjectRef" in desc else
+                    "create it inside the task or pass picklable state "
+                    "instead")
+            findings.append(Finding(
+                "RT101",
+                f"remote function '{fn.name}' captures {desc} "
+                f"('{name}') from an enclosing scope; it cannot be "
+                f"pickled into the task spec — {hint}",
+                ctx.filename, node.lineno, node.col_offset,
+            ))
+    return findings
+
+
+def _sync_bodies(node: ast.AST, kind: str):
+    """Yield (owner_name, body_root) for code that runs inside the task:
+    the function itself, or each method of an actor class."""
+    if kind == "task":
+        yield node.name, node
+    else:
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield f"{node.name}.{stmt.name}", stmt
+
+
+@register("RT102", FAMILY_USER,
+          "blocking get()/wait() inside a remote task or actor method")
+def check_blocking_get(ctx: ModuleContext) -> List[Finding]:
+    findings = []
+    for node, kind in _remote_targets(ctx):
+        for owner, body in _sync_bodies(node, kind):
+            for sub in ast.walk(body):
+                if not isinstance(sub, ast.Call):
+                    continue
+                if ctx.is_ray_api_call(sub, ("get", "wait")):
+                    api = (sub.func.attr if isinstance(sub.func, ast.Attribute)
+                           else ctx.from_ray.get(sub.func.id, "get"))
+                    findings.append(Finding(
+                        "RT102",
+                        f"'{owner}' calls ray_tpu.{api}() inside a remote "
+                        f"{'task' if kind == 'task' else 'actor method'}: "
+                        "with a bounded worker pool this deadlocks when "
+                        "every worker blocks waiting on tasks that cannot "
+                        "be scheduled — restructure so the driver awaits, "
+                        "or pass resolved values as arguments",
+                        ctx.filename, sub.lineno, sub.col_offset,
+                    ))
+    return findings
+
+
+@register("RT103", FAMILY_USER,
+          "dropped .remote() result — exceptions in the task are lost")
+def check_dropped_remote(ctx: ModuleContext) -> List[Finding]:
+    findings = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Expr):
+            continue
+        value = node.value
+        if not (isinstance(value, ast.Call)
+                and isinstance(value.func, ast.Attribute)
+                and value.func.attr == "remote"):
+            continue
+        findings.append(Finding(
+            "RT103",
+            "result of .remote() is discarded: the returned ObjectRef is "
+            "the only carrier of the task's exceptions — keep it and "
+            "get()/wait() it (or assign to _ and suppress deliberately)",
+            ctx.filename, node.lineno, node.col_offset,
+        ))
+    return findings
+
+
+_RESOURCE_KWARGS = ("num_cpus", "num_gpus", "num_tpus", "num_returns")
+
+
+def _const_number(node: ast.expr):
+    """Numeric value of a literal, unwrapping unary +/- (``-1`` parses as
+    UnaryOp, not Constant). None if not a numeric literal."""
+    sign = 1
+    while isinstance(node, ast.UnaryOp) and isinstance(
+            node.op, (ast.UAdd, ast.USub)):
+        if isinstance(node.op, ast.USub):
+            sign = -sign
+        node = node.operand
+    if (isinstance(node, ast.Constant)
+            and isinstance(node.value, (int, float))
+            and not isinstance(node.value, bool)):
+        return sign * node.value
+    return None
+
+
+def _resource_findings(ctx: ModuleContext, call: ast.Call,
+                       where: str) -> List[Finding]:
+    findings = []
+    for kw in call.keywords:
+        if kw.arg in _RESOURCE_KWARGS:
+            v = _const_number(kw.value)
+            if v is None:
+                continue
+            if v < 0:
+                findings.append(Finding(
+                    "RT104",
+                    f"{where}: {kw.arg}={v!r} is negative — no node can "
+                    "ever satisfy it, the task would pend forever",
+                    ctx.filename, kw.value.lineno, kw.value.col_offset,
+                ))
+            elif kw.arg == "num_tpus" and float(v) != int(v):
+                findings.append(Finding(
+                    "RT104",
+                    f"{where}: num_tpus={v!r} is fractional — TPU cores "
+                    "are whole devices; the scheduler can never place a "
+                    "fractional core request",
+                    ctx.filename, kw.value.lineno, kw.value.col_offset,
+                ))
+        elif kw.arg == "resources" and isinstance(kw.value, ast.Dict):
+            for k, v in zip(kw.value.keys, kw.value.values):
+                num = _const_number(v)
+                if num is None:
+                    continue
+                key = k.value if isinstance(k, ast.Constant) else None
+                if num < 0:
+                    findings.append(Finding(
+                        "RT104",
+                        f"{where}: resources[{key!r}]={num!r} is "
+                        "negative — unplaceable",
+                        ctx.filename, v.lineno, v.col_offset,
+                    ))
+                elif key in ("CPU", "GPU", "TPU"):
+                    findings.append(Finding(
+                        "RT104",
+                        f"{where}: pass {key} via num_{key.lower()}s=, not "
+                        "the resources dict — the explicit option wins and "
+                        "this entry is silently ambiguous",
+                        ctx.filename, v.lineno, v.col_offset,
+                    ))
+    return findings
+
+
+@register("RT104", FAMILY_USER,
+          "resource request the scheduler can never place")
+def check_resources(ctx: ModuleContext) -> List[Finding]:
+    findings = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            for dec in node.decorator_list:
+                if not isinstance(dec, ast.Call):
+                    continue
+                tgt = dec.func
+                is_remote_dec = (
+                    (isinstance(tgt, ast.Attribute) and tgt.attr == "remote"
+                     and isinstance(tgt.value, ast.Name)
+                     and tgt.value.id in ctx.ray_aliases)
+                    or (isinstance(tgt, ast.Name)
+                        and ctx.from_ray.get(tgt.id) == "remote")
+                )
+                if is_remote_dec:
+                    findings.extend(_resource_findings(
+                        ctx, dec, f"@remote on '{node.name}'"
+                    ))
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr == "options"):
+            findings.extend(_resource_findings(ctx, node, ".options()"))
+    return findings
+
+
+def validate_options(options: dict, where: str) -> List[str]:
+    """Value-based RT104 for decoration time: validate an options dict
+    directly (no AST needed — .options() merges are dynamic)."""
+    problems = []
+    for key in _RESOURCE_KWARGS:
+        v = options.get(key)
+        if v is None or isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        if v < 0:
+            problems.append(f"{where}: {key}={v!r} is negative — unplaceable")
+        elif key == "num_tpus" and float(v) != int(v):
+            problems.append(
+                f"{where}: num_tpus={v!r} is fractional — TPU cores are "
+                "whole devices"
+            )
+    res = options.get("resources")
+    if isinstance(res, dict):
+        for k, v in res.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool) and v < 0:
+                problems.append(
+                    f"{where}: resources[{k!r}]={v!r} is negative — "
+                    "unplaceable"
+                )
+            elif k in ("CPU", "GPU", "TPU"):
+                problems.append(
+                    f"{where}: pass {k} via num_{k.lower()}s=, not the "
+                    "resources dict"
+                )
+    return problems

@@ -2,6 +2,7 @@
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, Dict, Optional
 
 from ray_tpu._private.worker import get_global_worker
@@ -58,6 +59,16 @@ class RemoteFunction:
         self._fn = fn
         self._options = dict(options or {})
         functools.update_wrapper(self, fn)
+        # Opt-in decoration-time static analysis: raise LintError on
+        # distributed-correctness hazards before the task ever ships.
+        # Runs again on .options() copies so dynamically merged resource
+        # shapes are validated too. The truthy env probe keeps the lint
+        # import lazy; lint_enabled() is the authoritative gate.
+        if os.environ.get("RAY_TPU_LINT"):
+            from ray_tpu.lint import check_remote_function, lint_enabled
+
+            if lint_enabled():
+                check_remote_function(fn, self._options)
 
     def __call__(self, *args, **kwargs):
         raise TypeError(

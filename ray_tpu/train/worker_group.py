@@ -272,7 +272,9 @@ class WorkerGroup:
 
         for w in self.workers:
             try:
-                w.request_stop.remote()
+                # Deliberate fire-and-forget: the worker is being killed
+                # right after, so its stop-ack ref is never fetched.
+                _ = w.request_stop.remote()
             except Exception:
                 pass
         for w in self.workers:

@@ -1,0 +1,345 @@
+"""ray_tpu.lint — user-code rules (Family A) and the decoration-time gate.
+
+Every rule gets a positive case (minimal snippet that triggers it) and a
+negative case (the fixed form passes). The reference engine only catches
+these at runtime (serialization failure at submission, bounded-worker
+deadlock, lost exceptions); here they fire statically.
+"""
+import textwrap
+
+import pytest
+
+from ray_tpu.lint import FAMILY_FRAMEWORK, FAMILY_USER, RULES, lint_source
+
+
+def lint(src, families=(FAMILY_USER,), **kw):
+    return lint_source(textwrap.dedent(src), "<test>", families=families,
+                       **kw)
+
+
+def rule_ids(findings):
+    return sorted({f.rule for f in findings})
+
+
+def test_registry_has_both_families():
+    fams = {r.family for r in RULES.values()}
+    assert fams == {"A", "B"}
+    assert len([r for r in RULES.values() if r.family == "A"]) >= 4
+    assert len([r for r in RULES.values() if r.family == "B"]) >= 4
+
+
+# ---------------------------------------------------------------- RT101
+def test_rt101_lock_capture_flagged():
+    findings = lint("""
+        import threading
+        import ray_tpu
+
+        state_lock = threading.Lock()
+
+        @ray_tpu.remote
+        def task():
+            with state_lock:
+                return 1
+    """)
+    assert "RT101" in rule_ids(findings)
+    assert "threading.Lock" in findings[0].message
+
+
+def test_rt101_objectref_capture_flagged():
+    findings = lint("""
+        import ray_tpu
+
+        @ray_tpu.remote
+        def produce():
+            return 1
+
+        ref = produce.remote()
+
+        @ray_tpu.remote
+        def consume():
+            return ray_tpu.get(ref)
+    """)
+    assert "RT101" in rule_ids(findings)
+    [f] = [f for f in findings if f.rule == "RT101"]
+    assert "ObjectRef" in f.message
+
+
+def test_rt101_clean_when_passed_as_argument():
+    findings = lint("""
+        import threading
+        import ray_tpu
+
+        @ray_tpu.remote
+        def task(value):
+            lock = threading.Lock()  # created inside: fine
+            with lock:
+                return value
+    """)
+    assert "RT101" not in rule_ids(findings)
+
+
+# ---------------------------------------------------------------- RT102
+def test_rt102_blocking_get_in_task_flagged():
+    findings = lint("""
+        import ray_tpu
+
+        @ray_tpu.remote
+        def child():
+            return 1
+
+        @ray_tpu.remote
+        def parent():
+            return ray_tpu.get(child.remote())
+    """)
+    assert "RT102" in rule_ids(findings)
+
+
+def test_rt102_wait_in_sync_actor_method_flagged():
+    findings = lint("""
+        import ray_tpu
+
+        @ray_tpu.remote
+        class Pool:
+            def drain(self, refs):
+                done, rest = ray_tpu.wait(refs, num_returns=1)
+                return done
+    """)
+    assert "RT102" in rule_ids(findings)
+    assert "actor method" in findings[0].message
+
+
+def test_rt102_driver_get_not_flagged():
+    findings = lint("""
+        import ray_tpu
+
+        @ray_tpu.remote
+        def child():
+            return 1
+
+        def driver():
+            return ray_tpu.get(child.remote())
+    """)
+    assert "RT102" not in rule_ids(findings)
+
+
+def test_rt102_from_import_alias_detected():
+    findings = lint("""
+        import ray_tpu
+        from ray_tpu import get
+
+        @ray_tpu.remote
+        def parent(refs):
+            return get(refs)
+    """)
+    assert "RT102" in rule_ids(findings)
+
+
+# ---------------------------------------------------------------- RT103
+def test_rt103_dropped_remote_flagged():
+    findings = lint("""
+        import ray_tpu
+
+        @ray_tpu.remote
+        def side_effect():
+            return 1
+
+        def fire():
+            side_effect.remote()
+    """)
+    assert "RT103" in rule_ids(findings)
+
+
+def test_rt103_kept_ref_clean():
+    findings = lint("""
+        import ray_tpu
+
+        @ray_tpu.remote
+        def side_effect():
+            return 1
+
+        def fire():
+            refs = [side_effect.remote() for _ in range(3)]
+            return ray_tpu.get(refs)
+    """)
+    assert "RT103" not in rule_ids(findings)
+
+
+def test_rt103_suppression_comment():
+    findings = lint("""
+        import ray_tpu
+
+        @ray_tpu.remote
+        def side_effect():
+            return 1
+
+        def fire():
+            side_effect.remote()  # raytpu: ignore[RT103]
+    """)
+    assert "RT103" not in rule_ids(findings)
+
+
+# ---------------------------------------------------------------- RT104
+def test_rt104_fractional_tpus_flagged():
+    findings = lint("""
+        import ray_tpu
+
+        @ray_tpu.remote(num_tpus=0.5)
+        def step():
+            return 1
+    """)
+    assert "RT104" in rule_ids(findings)
+    assert "fractional" in findings[0].message
+
+
+def test_rt104_negative_resources_flagged():
+    findings = lint("""
+        import ray_tpu
+
+        @ray_tpu.remote(num_cpus=-1)
+        def step():
+            return 1
+
+        def submit():
+            return step.options(resources={"CPU": -2}).remote()
+    """)
+    assert [f.rule for f in findings if f.rule == "RT104"] == [
+        "RT104", "RT104"
+    ]
+
+
+def test_rt104_whole_tpus_clean():
+    findings = lint("""
+        import ray_tpu
+
+        @ray_tpu.remote(num_tpus=4, num_cpus=1)
+        def step():
+            return 1
+    """)
+    assert "RT104" not in rule_ids(findings)
+
+
+# --------------------------------------------------- decoration-time gate
+@pytest.fixture
+def lint_on(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_LINT", "1")
+
+
+def test_gate_off_by_default(monkeypatch):
+    monkeypatch.delenv("RAY_TPU_LINT", raising=False)
+    import ray_tpu
+
+    @ray_tpu.remote
+    def hazard(refs):
+        return ray_tpu.get(refs)  # would be RT102 with the gate on
+
+    assert hazard.underlying_function is not None
+
+
+def test_gate_raises_on_blocking_get(lint_on):
+    import ray_tpu
+    from ray_tpu.exceptions import LintError
+
+    with pytest.raises(LintError, match="RT102"):
+        @ray_tpu.remote
+        def parent(refs):
+            return ray_tpu.get(refs)
+
+
+def test_gate_raises_on_closure_lock(lint_on):
+    import threading
+
+    import ray_tpu
+    from ray_tpu.exceptions import LintError
+
+    held = threading.Lock()
+
+    with pytest.raises(LintError, match="RT101"):
+        @ray_tpu.remote
+        def task():
+            with held:
+                return 1
+
+
+def test_gate_raises_on_bad_options_via_options_chain(lint_on):
+    import ray_tpu
+    from ray_tpu.exceptions import LintError
+
+    @ray_tpu.remote
+    def clean():
+        return 1
+
+    with pytest.raises(LintError, match="RT104"):
+        clean.options(num_tpus=2.5)
+
+
+def test_gate_checks_actor_classes(lint_on):
+    import ray_tpu
+    from ray_tpu.exceptions import LintError
+
+    with pytest.raises(LintError, match="RT102"):
+        @ray_tpu.remote
+        class Worker:
+            def step(self, refs):
+                return ray_tpu.wait(refs)
+
+
+def test_gate_clean_task_unaffected(lint_on):
+    import ray_tpu
+
+    @ray_tpu.remote(num_cpus=1)
+    def clean(x):
+        return x + 1
+
+    @ray_tpu.remote
+    class CleanActor:
+        def step(self, x):
+            return x * 2
+
+    assert clean.underlying_function(1) == 2
+    assert CleanActor.underlying_class is not None
+
+
+def test_gate_attribute_name_does_not_false_positive(lint_on):
+    """An *attribute* access named like a denylisted module global must
+    not trip the closure probe (co_names conflates the two; the probe
+    disassembles for LOAD_GLOBAL instead)."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    def uses_attr(holder):
+        with holder.state_lock:  # attribute, not the module global below
+            return holder.value
+
+    assert uses_attr.underlying_function is not None
+
+
+# module global sharing the attribute's name; only a true LOAD_GLOBAL of
+# it from a remote fn should matter
+import threading as _threading  # noqa: E402
+
+state_lock = _threading.Lock()
+
+
+def test_gate_value_probe_honors_function_scope_suppression(lint_on):
+    import threading
+
+    import ray_tpu
+
+    deliberate = threading.Lock()
+
+    @ray_tpu.remote
+    def knows_better():  # raytpu: ignore[RT101]
+        return deliberate.locked()
+
+    assert knows_better.underlying_function is not None
+
+
+def test_gate_clean_task_executes(lint_on, rt_start):
+    """A lint-clean task must run end-to-end with the gate enabled."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    assert ray_tpu.get(double.remote(21)) == 42

@@ -1,0 +1,291 @@
+"""Framework self-analysis stays clean (Family B over ray_tpu/_private/).
+
+This is the tier-1 wiring for ``python -m ray_tpu.lint ray_tpu/``: a new
+blocking-call-under-lock, lock-order inversion, silent RPC swallow, or
+constant-sleep retry loop in the framework fails fast here, plus unit
+coverage for each Family-B rule on minimal snippets.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from ray_tpu.lint import FAMILY_FRAMEWORK, lint_paths, lint_source
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_fw(src):
+    return lint_source(textwrap.dedent(src), "<test>",
+                       families=(FAMILY_FRAMEWORK,))
+
+
+def rule_ids(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ------------------------------------------------------------ self-scan
+def test_private_tree_is_family_b_clean():
+    findings = lint_paths([os.path.join(REPO, "ray_tpu", "_private")])
+    fam_b = [f for f in findings if f.rule.startswith("RT2")]
+    assert fam_b == [], "\n".join(f.format() for f in fam_b)
+
+
+def test_cli_module_scan_json_clean():
+    """The exact tier-1 invocation: ``python -m ray_tpu.lint ray_tpu/``
+    with --json for dashboard ingestion; Family B must be silent."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.lint", "ray_tpu", "--json",
+         "--select", "RT2"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    findings = json.loads(proc.stdout)
+    assert findings == [], proc.stdout
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_cli_reports_seeded_finding(tmp_path):
+    bad = tmp_path / "bad_module.py"
+    bad.write_text(textwrap.dedent("""
+        import time
+
+        def loop(stop):
+            while not stop():
+                time.sleep(1.0)
+    """))
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.lint", str(bad), "--framework",
+         "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    findings = json.loads(proc.stdout)
+    assert [f["rule"] for f in findings] == ["RT204"]
+    assert findings[0]["line"] == 6
+
+
+# ---------------------------------------------------------------- RT201
+def test_rt201_sleep_under_lock_flagged():
+    findings = lint_fw("""
+        import threading
+        import time
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def evict(self):
+                with self._lock:
+                    time.sleep(0.1)
+    """)
+    assert "RT201" in rule_ids(findings)
+    assert "self._lock" in findings[0].message
+
+
+def test_rt201_socket_recv_under_lock_flagged():
+    findings = lint_fw("""
+        class Conn:
+            def read(self):
+                with self._lock:
+                    return self.sock.recv(4096)
+    """)
+    assert "RT201" in rule_ids(findings)
+
+
+def test_rt201_clean_outside_critical_section():
+    findings = lint_fw("""
+        import time
+
+        class Store:
+            def evict(self):
+                with self._lock:
+                    victims = list(self._entries)
+                time.sleep(0.1)
+                return victims
+    """)
+    assert "RT201" not in rule_ids(findings)
+
+
+def test_rt201_nested_def_under_lock_not_flagged():
+    findings = lint_fw("""
+        import time
+
+        class Pool:
+            def submit(self):
+                with self._lock:
+                    def later():
+                        time.sleep(1.0)  # runs on the executor, lock-free
+                    self._queue.append(later)
+    """)
+    assert "RT201" not in rule_ids(findings)
+
+
+# ---------------------------------------------------------------- RT202
+def test_rt202_lock_order_inversion_flagged():
+    findings = lint_fw("""
+        class Broker:
+            def push(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        self._q.append(1)
+
+            def drain(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        return self._q.pop()
+    """)
+    assert "RT202" in rule_ids(findings)
+    assert "inversion" in findings[0].message
+
+
+def test_rt202_reacquire_flagged():
+    findings = lint_fw("""
+        class Broker:
+            def push(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+    """)
+    assert "RT202" in rule_ids(findings)
+    assert "re-acquired" in findings[0].message
+
+
+def test_rt202_consistent_order_clean():
+    findings = lint_fw("""
+        class Broker:
+            def push(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        self._q.append(1)
+
+            def drain(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        return self._q.pop()
+    """)
+    assert "RT202" not in rule_ids(findings)
+
+
+def test_rt202_same_names_in_different_classes_clean():
+    """Each class has its own self._lock instance — no cross-class edges."""
+    findings = lint_fw("""
+        class A:
+            def f(self):
+                with self._x_lock:
+                    with self._y_lock:
+                        pass
+
+        class B:
+            def g(self):
+                with self._y_lock:
+                    with self._x_lock:
+                        pass
+    """)
+    assert "RT202" not in rule_ids(findings)
+
+
+# ---------------------------------------------------------------- RT203
+def test_rt203_swallowed_rpc_error_flagged():
+    findings = lint_fw("""
+        from ray_tpu._private import protocol
+
+        class Client:
+            def fire(self):
+                try:
+                    self.conn.notify("object_free", {})
+                except protocol.ConnectionLost:
+                    pass
+    """)
+    assert "RT203" in rule_ids(findings)
+
+
+def test_rt203_logged_handler_clean():
+    findings = lint_fw("""
+        from ray_tpu._private import protocol
+
+        class Client:
+            def fire(self):
+                try:
+                    self.conn.notify("object_free", {})
+                except protocol.ConnectionLost as e:
+                    logger.debug("notify dropped: %s", e)
+    """)
+    assert "RT203" not in rule_ids(findings)
+
+
+def test_rt203_non_rpc_pass_clean():
+    findings = lint_fw("""
+        import os
+
+        def cleanup(path):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+    """)
+    assert "RT203" not in rule_ids(findings)
+
+
+# ---------------------------------------------------------------- RT204
+def test_rt204_constant_sleep_in_retry_loop_flagged():
+    findings = lint_fw("""
+        import time
+
+        def wait_for(cond):
+            while not cond():
+                time.sleep(0.5)
+    """)
+    assert "RT204" in rule_ids(findings)
+    assert "backoff" in findings[0].message.lower()
+
+
+def test_rt204_backoff_helper_clean():
+    findings = lint_fw("""
+        from ray_tpu._private.backoff import Backoff
+
+        def wait_for(cond):
+            poll = Backoff(base=0.05, cap=0.5)
+            while not cond():
+                poll.sleep()
+    """)
+    assert "RT204" not in rule_ids(findings)
+
+
+# ----------------------------------------------------- backoff satellite
+def test_backoff_jittered_and_capped():
+    from ray_tpu._private.backoff import Backoff
+
+    slept = []
+    rands = iter([0.0, 1.0, 0.5, 0.0, 0.0, 0.0])
+    b = Backoff(base=1.0, cap=4.0, jitter=0.5,
+                rand=lambda: next(rands), sleep=slept.append)
+    assert b.sleep() == 1.0          # rand=0 -> no jitter removed
+    assert b.sleep() == 1.0          # 2.0 * (1 - 0.5*1.0)
+    assert b.sleep() == 3.0          # 4.0 * (1 - 0.5*0.5)
+    assert b.sleep() == 4.0          # capped
+    b.reset()
+    assert b.sleep() == 1.0          # back to base
+    assert slept == [1.0, 1.0, 3.0, 4.0, 1.0]
+
+
+def test_backoff_rejects_bad_params():
+    from ray_tpu._private.backoff import Backoff
+
+    with pytest.raises(ValueError):
+        Backoff(base=0)
+    with pytest.raises(ValueError):
+        Backoff(base=2.0, cap=1.0)
+
+
+def test_backoff_never_overflows():
+    """The exponent must stop growing at the cap: factor**n overflows a
+    float after ~1k attempts, which would kill a long-lived poll thread
+    (the pressure killer ticks ~forever on a calm node)."""
+    from ray_tpu._private.backoff import Backoff
+
+    b = Backoff(base=1.0, cap=4.0, jitter=0.0, sleep=lambda _d: None)
+    for _ in range(5000):
+        assert 0 < b.next_delay() <= 4.0
