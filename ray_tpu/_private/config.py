@@ -198,3 +198,27 @@ rt_config.declare(
     "health_check_period_s", float, 2.0,
     "Head liveness probe interval per node "
     "(reference: health_check_period_ms).")
+rt_config.declare(
+    "rpc_deadline_s", float, 30.0,
+    "Per-attempt deadline for head/worker control RPCs. A dropped reply "
+    "surfaces as a timeout at this horizon instead of hanging the verb "
+    "forever; retryable verbs re-issue with jittered backoff "
+    "(reference: retryable_grpc_client.cc timeouts).")
+rt_config.declare(
+    "rpc_retries", int, 2,
+    "Extra attempts for deadline-bounded head RPCs after a timeout, "
+    "connection loss, or an 'unavailable' error (reference: UNAVAILABLE "
+    "retries in retryable_grpc_client.cc). Non-idempotent verbs carry a "
+    "correlation id so a retry after a dropped reply never double-applies.")
+rt_config.declare(
+    "lease_request_timeout_s", float, 30.0,
+    "How long the head may block a lease request waiting for resources "
+    "before returning empty; the client's per-attempt RPC deadline sits "
+    "above this.")
+rt_config.declare(
+    "fault_spec", str, "",
+    "Deterministic fault injection spec "
+    "('point:kind:prob[:count[:seed]],...' — see _private/faultpoints.py "
+    "catalog). Empty disables injection entirely (hot paths pay one "
+    "boolean check). Reference: RAY_testing_rpc_failure hooks in "
+    "src/ray/rpc/grpc_client.h.")

@@ -1,0 +1,351 @@
+"""Deterministic failpoint injection plane.
+
+Reference analog: the per-RPC testing hooks the reference core threads
+through its gRPC client (``RAY_testing_rpc_failure`` consulted in
+``src/ray/rpc/grpc_client.h`` — request/reply failures injected by method
+name with a seeded probability). Random node kills (`NodeKiller`) only
+exercise whole-process death; the recovery bugs that survive production
+are the *partial* failures — a dropped reply after the verb applied, a
+slow pull, a crash mid-dispatch (lineage-driven fault injection, Alvaro
+et al. SIGMOD '15; chaos practice, Basiri et al. IEEE Software '16).
+
+Every layer that crosses a process or host boundary declares **named
+fault points** (the catalog below) and consults this module at the
+boundary. A point fires according to a spec:
+
+    RT_FAULT_SPEC="point:kind:prob[:count[:seed]],..."
+
+e.g. ``RT_FAULT_SPEC="gcs.dispatch.lease:drop:0.1:0:42"`` drops 10% of
+lease replies, deterministically (per-spec seeded RNG: the set of call
+indices that inject is a pure function of ``seed``/``prob``, so two runs
+inject at identical indices). Tests use :func:`configure` /
+:func:`stats` / :func:`clear` instead of the env var.
+
+Kinds:
+
+- ``error`` — raise a transport-shaped exception (``err`` class chosen
+  by the call site; carries ``code="unavailable"`` so retry policies can
+  distinguish injected/transient unavailability from application errors)
+- ``delay`` — inject latency (``delay_s``, default 0.05s), then proceed
+- ``drop``  — lose the message *after* side effects: the call site skips
+  the send / swallows the reply so the caller times out
+- ``crash`` — hard-exit the process (``os._exit``), the real SIGKILL test
+
+Cost when idle: every call site is gated on the module attribute
+``ACTIVE`` (``if faultpoints.ACTIVE: ...``) — with no spec configured
+the hot paths pay one attribute load and a false branch, nothing else.
+
+Thread-safety: decisions (RNG draw + counters) run under a lock; the
+injected sleep happens outside it. Determinism holds per spec as long as
+the matching point fires from one thread (true for the event-loop points
+— gcs dispatch, protocol send/reply/read; ring points fire on the pump
+thread, also single-threaded per connection).
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+KINDS = ("error", "delay", "drop", "crash")
+
+# How many injected call indices each spec records for stats()/determinism
+# assertions; beyond this only the counters keep growing.
+_MAX_INDICES = 10_000
+
+
+class DropReply(Exception):
+    """Raised by a handler AFTER its side effects to make the RPC layer
+    swallow the reply (protocol._dispatch / ringconn._handle_slow catch it
+    and send nothing). The caller sees a timeout — the classic
+    applied-but-unacknowledged partial failure."""
+
+
+# name -> (layer, supported kinds, description). Wildcard entries (name
+# ending in ``*``) cover a family of fired names, e.g. every head verb.
+CATALOG: Dict[str, tuple] = {
+    "protocol.rpc.send": (
+        "protocol", ("error", "delay", "drop", "crash"),
+        "client request send on a TCP connection "
+        "(grpc_client.h request-path hook)"),
+    "protocol.rpc.reply": (
+        "protocol", ("error", "delay", "drop", "crash"),
+        "server reply send: drop = verb applied, ack lost "
+        "(grpc_client.h reply-path hook)"),
+    "protocol.rpc.read": (
+        "protocol", ("error", "delay", "drop", "crash"),
+        "inbound frame read: error tears the connection down mid-stream"),
+    "ring.push": (
+        "ringconn", ("error", "delay", "drop", "crash"),
+        "shm-ring send (request, reply, or notify)"),
+    "ring.pop": (
+        "ringconn", ("error", "delay", "drop", "crash"),
+        "shm-ring receive: drop loses one message, error wedges the ring"),
+    "gcs.dispatch.*": (
+        "gcs", ("error", "delay", "drop", "crash"),
+        "head verb dispatch, per verb (gcs.dispatch.lease, ...): error "
+        "fails the verb before it runs, drop applies it and swallows the "
+        "reply"),
+    "gcs.lease.grant": (
+        "gcs", ("error", "delay"),
+        "lease-grant path inside rpc_lease, before any resource is "
+        "acquired"),
+    "gcs.actor.create": (
+        "gcs", ("error", "delay"),
+        "actor registration/scheduling entry (GcsActorManager "
+        "HandleCreateActor analog)"),
+    "gcs.pubsub.publish": (
+        "gcs", ("error", "delay", "drop"),
+        "head pubsub fan-out: drop/error lose the publish for every "
+        "subscriber"),
+    "worker.pull": (
+        "worker", ("error", "delay", "drop", "crash"),
+        "object pull from an owner (single and owner-coalesced batch)"),
+    "worker.task.push": (
+        "worker", ("error", "delay", "crash"),
+        "task push onto a leased slot (PushNormalTask analog)"),
+    "worker.dispatch.retry": (
+        "worker", ("error", "delay"),
+        "dispatch-retry path after a failed push attempt"),
+    "spill.write": (
+        "spill", ("error", "delay"),
+        "spill write to external storage (SpillObjects analog)"),
+    "spill.restore": (
+        "spill", ("error", "delay"),
+        "spill restore read: injected failure = missing external copy "
+        "(AsyncRestoreSpilledObject analog)"),
+}
+
+# True iff at least one spec is configured; hot-path gate.
+ACTIVE = False
+
+_lock = threading.Lock()
+_specs: List["_Spec"] = []
+
+
+class _Spec:
+    __slots__ = ("point", "kind", "prob", "count", "seed", "delay_s",
+                 "rng", "calls", "injected", "indices")
+
+    def __init__(self, point: str, kind: str, prob: float, count: int,
+                 seed: int, delay_s: float):
+        self.point = point
+        self.kind = kind
+        self.prob = prob
+        self.count = count          # max injections; 0 = unlimited
+        self.seed = seed
+        self.delay_s = delay_s
+        self.rng = random.Random(seed)
+        self.calls = 0              # matched fire()s seen
+        self.injected = 0
+        self.indices: List[int] = []  # call indices that injected
+
+    def matches(self, name: str) -> bool:
+        if self.point.endswith("*"):
+            return name.startswith(self.point[:-1])
+        return name == self.point
+
+
+def _point_known(point: str) -> bool:
+    """A spec point is valid when it names a catalog entry, is covered by
+    a wildcard catalog entry, or is itself a wildcard covering at least
+    one catalog entry."""
+    bare = point[:-1] if point.endswith("*") else None
+    for name in CATALOG:
+        if name == point:
+            return True
+        if name.endswith("*") and point.startswith(name[:-1]):
+            return True
+        if bare is not None and name.startswith(bare):
+            return True
+    return False
+
+
+def _supported_kinds(point: str) -> tuple:
+    for name, (_layer, kinds, _desc) in CATALOG.items():
+        if name == point or (name.endswith("*")
+                             and point.startswith(name[:-1])):
+            return kinds
+        if point.endswith("*") and name.startswith(point[:-1]):
+            return kinds
+    return KINDS
+
+
+def register(name: str, layer: str, kinds: tuple, description: str):
+    """Extend the catalog (tests, plugins). Names must be new."""
+    if name in CATALOG:
+        raise ValueError(f"fault point {name!r} already registered")
+    CATALOG[name] = (layer, tuple(kinds), description)
+
+
+def parse_spec(spec: str, delay_s: float = 0.05) -> List[_Spec]:
+    """``point:kind:prob[:count[:seed]],...`` -> specs. Loud on typos:
+    an unknown point or unsupported kind is a config error, not a
+    silently-never-firing chaos run."""
+    out: List[_Spec] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        if len(fields) < 2 or len(fields) > 5:
+            raise ValueError(
+                f"bad fault spec {part!r}: want point:kind:prob[:count[:seed]]"
+            )
+        point, kind = fields[0], fields[1]
+        if not _point_known(point):
+            raise ValueError(
+                f"unknown fault point {point!r} (catalog: {sorted(CATALOG)})"
+            )
+        if kind not in KINDS:
+            raise ValueError(f"unknown fault kind {kind!r} (have {KINDS})")
+        if kind not in _supported_kinds(point):
+            raise ValueError(
+                f"fault point {point!r} does not support kind {kind!r} "
+                f"(supported: {_supported_kinds(point)})"
+            )
+        try:
+            prob = float(fields[2]) if len(fields) > 2 and fields[2] else 1.0
+            count = int(fields[3]) if len(fields) > 3 and fields[3] else 0
+            seed = int(fields[4]) if len(fields) > 4 and fields[4] else 0
+        except ValueError as e:
+            raise ValueError(f"bad fault spec {part!r}: {e}") from None
+        if not 0.0 <= prob <= 1.0:
+            raise ValueError(f"bad fault prob {prob} in {part!r}")
+        out.append(_Spec(point, kind, prob, count, seed, delay_s))
+    return out
+
+
+def configure(spec, delay_s: float = 0.05):
+    """Install fault specs, replacing any current set. ``spec`` is the
+    env-var string format or an iterable of prebuilt ``_Spec``s."""
+    global ACTIVE
+    if isinstance(spec, str):
+        new = parse_spec(spec, delay_s)
+    else:
+        new = list(spec)
+    with _lock:
+        _specs[:] = new
+        ACTIVE = bool(_specs)
+    if new:
+        logger.info(
+            "fault injection active: %s",
+            ", ".join(f"{s.point}:{s.kind}:{s.prob}" for s in new),
+        )
+
+
+def clear():
+    """Remove every spec; fire() returns to the no-op fast path."""
+    global ACTIVE
+    with _lock:
+        _specs.clear()
+        ACTIVE = False
+
+
+def stats() -> List[dict]:
+    """Per-spec counters: matched calls, injections, and the call indices
+    that injected (the determinism contract: same seed/prob -> same
+    indices)."""
+    with _lock:
+        return [
+            {
+                "point": s.point, "kind": s.kind, "prob": s.prob,
+                "count": s.count, "seed": s.seed, "calls": s.calls,
+                "injected": s.injected, "indices": list(s.indices),
+            }
+            for s in _specs
+        ]
+
+
+def _decide(name: str) -> Optional[_Spec]:
+    """One RNG draw per matching spec per call (count limits must not
+    shift later draws, or determinism breaks); first hit wins."""
+    hit = None
+    with _lock:
+        for s in _specs:
+            if not s.matches(name):
+                continue
+            s.calls += 1
+            if s.rng.random() >= s.prob:
+                continue
+            if s.count and s.injected >= s.count:
+                continue
+            if hit is None:
+                s.injected += 1
+                if len(s.indices) < _MAX_INDICES:
+                    s.indices.append(s.calls - 1)
+                hit = s
+    return hit
+
+
+def _raise_injected(spec: _Spec, name: str, err):
+    e = err(
+        f"injected fault at {name} "
+        f"(spec {spec.point}:{spec.kind}, injection #{spec.injected})"
+    )
+    # Transient-unavailability class: retry policies branch on this code,
+    # never on message text (reference: UNAVAILABLE status retried by
+    # retryable_grpc_client.cc).
+    try:
+        e.code = "unavailable"
+    except AttributeError:
+        logger.debug("injected %s has no writable .code", type(e).__name__)
+    raise e
+
+
+def _resolve(spec: _Spec, name: str, err) -> Optional[str]:
+    """Shared drop/crash/error tail for fire()/async_fire(); the delay
+    kind stays with the caller (blocking sleep vs await)."""
+    if spec.kind == "drop":
+        return "drop"
+    if spec.kind == "crash":
+        logger.error("injected crash at %s", name)
+        os._exit(17)
+    _raise_injected(spec, name, err)
+
+
+def fire(name: str, err=ConnectionError) -> Optional[str]:
+    """Evaluate the point synchronously. Returns None (no injection),
+    "delay" (latency already injected), or "drop" (the call site must
+    lose the message). ``error`` raises ``err``; ``crash`` never returns."""
+    spec = _decide(name)
+    if spec is None:
+        return None
+    if spec.kind == "delay":
+        time.sleep(spec.delay_s)
+        return "delay"
+    return _resolve(spec, name, err)
+
+
+async def async_fire(name: str, err=ConnectionError) -> Optional[str]:
+    """fire() for event-loop call sites: delay awaits instead of blocking
+    the loop."""
+    spec = _decide(name)
+    if spec is None:
+        return None
+    if spec.kind == "delay":
+        await asyncio.sleep(spec.delay_s)
+        return "delay"
+    return _resolve(spec, name, err)
+
+
+def _load_env():
+    """Process-start configuration from RT_FAULT_SPEC (also reachable via
+    rt_config / _system_config propagation to spawned workers)."""
+    try:
+        from ray_tpu._private.config import rt_config
+
+        spec = rt_config.fault_spec
+    except Exception:
+        spec = os.environ.get("RT_FAULT_SPEC", "")
+    if spec:
+        configure(spec)
+
+
+_load_env()

@@ -25,11 +25,11 @@ import itertools
 import logging
 import os
 import time
-from collections import defaultdict, deque
+from collections import OrderedDict, defaultdict, deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
-from ray_tpu._private import protocol
+from ray_tpu._private import faultpoints, protocol
 from ray_tpu._private.ids import ActorID, NodeID, PlacementGroupID
 
 logger = logging.getLogger(__name__)
@@ -184,6 +184,16 @@ class HeadService:
         self.pending_demands: Dict[int, dict] = {}
         self.job_procs: Dict[str, object] = {}  # submission_id -> Popen
         self.worker_metrics: Dict[str, list] = {}  # worker -> metric snapshot
+        # Correlation-id dedup for retried non-idempotent verbs (lease,
+        # create_actor, create_pg): a retry after a DROPPED REPLY must
+        # return the original outcome, not apply the verb twice — the
+        # reference's reply-path failures are absorbed the same way by
+        # server-side request dedup. Entries are (conn serial, reply) —
+        # connection-scoped, since a disconnect rolls the outcome back —
+        # in a bounded LRU; only successful replies are cached (a failed
+        # attempt may legitimately succeed on retry).
+        self._corr_replies: "OrderedDict[str, tuple]" = OrderedDict()
+        self._CORR_CACHE = 1024
         self._task_state_counts: Dict[str, int] = {}  # FINISHED/FAILED/...
         # Native C++ scheduler (reference: the C++ ClusterResourceScheduler,
         # ``raylet/scheduling/cluster_resource_scheduler.cc:155``): fixed-point
@@ -396,7 +406,72 @@ class HeadService:
         fn = getattr(self, "rpc_" + method, None)
         if fn is None:
             raise protocol.RpcError(f"unknown head rpc {method}")
-        return await fn(header, frames, conn)
+        corr = header.get("corr")
+        fut = None
+        if corr is not None:
+            # Dedup entries are CONNECTION-scoped: a disconnect replays the
+            # ledger (leases returned, owned actors reaped), so a retry
+            # arriving on a NEW connection must re-execute the verb — the
+            # cached outcome describes state the disconnect already rolled
+            # back, and replaying e.g. grants would hand out capacity the
+            # head no longer tracks.
+            serial = self._conn_key(conn)
+            cached = self._corr_replies.get(corr)
+            if cached is not None and cached[0] != serial:
+                self._corr_replies.pop(corr, None)
+                cached = None
+            if cached is not None:
+                payload = cached[1]
+                if isinstance(payload, asyncio.Future):
+                    # Retry of a request the head is STILL executing (the
+                    # client's deadline beat a slow verb): attach to the
+                    # in-flight execution instead of double-applying it.
+                    return await asyncio.shield(payload)
+                # Retry of a request whose reply we already produced (it
+                # was dropped in flight): replay the original outcome.
+                return payload
+            fut = asyncio.get_running_loop().create_future()
+            # A failed attempt is retried for real, but its exception must
+            # count as retrieved for any attached retry (and the default
+            # handler's never-retrieved warning).
+            fut.add_done_callback(
+                lambda f: f.exception() if not f.cancelled() else None
+            )
+            self._corr_replies[corr] = (serial, fut)
+        act = None
+        if faultpoints.ACTIVE:
+            # error fails the verb BEFORE it runs (code="unavailable" so
+            # retryable clients re-issue); drop is remembered and applied
+            # AFTER — the applied-but-unacknowledged partial failure.
+            try:
+                act = await faultpoints.async_fire(f"gcs.dispatch.{method}")
+            except BaseException as e:
+                if fut is not None:
+                    self._corr_replies.pop(corr, None)
+                    fut.set_exception(e)
+                raise
+        try:
+            out = await fn(header, frames, conn)
+        except BaseException as e:
+            if fut is not None:
+                # Real failure: drop the entry so a retry re-executes.
+                self._corr_replies.pop(corr, None)
+                fut.set_exception(e)
+            raise
+        if fut is not None:
+            self._corr_replies[corr] = (serial, out)
+            fut.set_result(out)
+            # Evict oldest COMPLETED entries only: popping an in-flight
+            # future would let that request's retry double-execute — the
+            # overshoot is bounded by the number of concurrent corr verbs.
+            while len(self._corr_replies) > self._CORR_CACHE:
+                k, v = next(iter(self._corr_replies.items()))
+                if isinstance(v[1], asyncio.Future):
+                    break
+                self._corr_replies.pop(k, None)
+        if act == "drop":
+            raise faultpoints.DropReply()
+        return out
 
     # ------------------------------------------------------------------- kv
 
@@ -767,6 +842,10 @@ class HeadService:
         (``task_submission/normal_task_submitter.h:271``) against the raylet's
         ClusterLeaseManager; here the head is the single lease authority.
         """
+        if faultpoints.ACTIVE:
+            # Before ANY acquisition: an injected grant failure must leave
+            # the availability ledger untouched.
+            await faultpoints.async_fire("gcs.lease.grant")
         need = {k: float(v) for k, v in h.get("resources", {}).items()}
         strategy = h.get("strategy", {})
         count = h.get("count", 1)
@@ -875,6 +954,10 @@ class HeadService:
         """Register + schedule an actor (reference: GcsActorManager
         ``HandleRegisterActor``/``HandleCreateActor``
         ``gcs/actor/gcs_actor_manager.cc:310/:429`` + GcsActorScheduler)."""
+        if faultpoints.ACTIVE:
+            # Fires before registration: an injected failure leaves no
+            # half-created actor behind for the retry to collide with.
+            await faultpoints.async_fire("gcs.actor.create")
         actor_id = h["actor_id"]
         name = h.get("name") or None
         ns = h.get("namespace", "default")
@@ -1435,6 +1518,15 @@ class HeadService:
         return {"lines": out}, []
 
     def publish(self, channel: str, data, frames: List[bytes] = ()):
+        if faultpoints.ACTIVE:
+            try:
+                # error and drop both lose the publish for every
+                # subscriber (pubsub is fire-and-forget by contract).
+                if faultpoints.fire("gcs.pubsub.publish") == "drop":
+                    return
+            except ConnectionError as e:
+                logger.debug("injected publish loss on %s: %s", channel, e)
+                return
         for conn in list(self.subscribers.get(channel, [])):
             try:
                 conn.notify("pubsub", {"channel": channel, "data": data}, frames)

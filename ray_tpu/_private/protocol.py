@@ -22,6 +22,8 @@ from typing import Awaitable, Callable, Dict, List, Optional, Tuple
 
 import msgpack
 
+from ray_tpu._private import faultpoints
+
 logger = logging.getLogger(__name__)
 
 _HDR = struct.Struct("<I")
@@ -189,6 +191,14 @@ class Connection:
                         self.name,
                     )
                     return  # finally: _teardown closes the socket
+                if faultpoints.ACTIVE:
+                    # error = connection reset mid-stream (outer except
+                    # tears the connection down); drop = this message lost.
+                    act = await faultpoints.async_fire(
+                        "protocol.rpc.read", err=ConnectionResetError
+                    )
+                    if act == "drop":
+                        continue
                 if header.get("r"):  # reply
                     fut = self._pending.pop(header["i"], None)
                     if fut is not None and not fut.done():
@@ -236,6 +246,10 @@ class Connection:
             )
             if extras:
                 reply_header.update(extras)
+        except faultpoints.DropReply:
+            # Injected applied-but-unacknowledged failure: the handler ran
+            # to completion, the caller gets silence (then a timeout).
+            return
         except Exception as e:
             logger.debug("handler error for %s: %s", header.get("m"), e, exc_info=True)
             reply_header["e"] = f"{type(e).__name__}: {e}"
@@ -246,6 +260,15 @@ class Connection:
         if header.get("oneway"):
             return
         try:
+            if faultpoints.ACTIVE:
+                # error raises ConnectionResetError into the except below:
+                # logged, no reply — indistinguishable from a peer that
+                # vanished between request and ack.
+                act = await faultpoints.async_fire(
+                    "protocol.rpc.reply", err=ConnectionResetError
+                )
+                if act == "drop":
+                    return
             self.send_raw(reply_header, reply_frames)
             # replies are latency-critical (a sync caller is blocked on this
             # round trip): flush now instead of waiting for the tick
@@ -313,12 +336,31 @@ class Connection:
             header.update(extras)
         fut = asyncio.get_running_loop().create_future()
         self._pending[cid] = fut
-        self.send_raw(header, list(frames))
         try:
-            await self.writer.drain()
-        except (ConnectionResetError, OSError):
-            pass
-        return await fut
+            dropped = False
+            if faultpoints.ACTIVE:
+                dropped = await faultpoints.async_fire(
+                    "protocol.rpc.send", err=ConnectionLost
+                ) == "drop"
+            if not dropped:
+                # drop: the request never reaches the wire; the caller's
+                # deadline (not this coroutine) decides when to give up.
+                self.send_raw(header, list(frames))
+                try:
+                    await self.writer.drain()
+                except (ConnectionResetError, OSError):
+                    pass
+        except BaseException:
+            self._pending.pop(cid, None)
+            raise
+        try:
+            return await fut
+        finally:
+            # A cancelled wait (deadline-bounded callers wrap this in
+            # wait_for) must not leave a dead entry keyed by cid for the
+            # connection's lifetime; on the normal path the recv loop
+            # already popped it and this is a no-op.
+            self._pending.pop(cid, None)
 
     def notify(self, method: str, extras: Optional[dict] = None, frames=()):
         """Fire-and-forget request (no reply expected)."""

@@ -29,7 +29,7 @@ import logging
 import threading
 from typing import Callable, List, Optional, Tuple
 
-from ray_tpu._private import protocol
+from ray_tpu._private import faultpoints, protocol
 from ray_tpu.native.ring import (
     NativeRing,
     RingClosed,
@@ -189,6 +189,13 @@ class RingConnection:
     def _send_auto(self, header: dict, frames):
         """Route to the non-blocking loop path or the blocking thread path
         depending on the calling thread."""
+        if faultpoints.ACTIVE:
+            # drop: the message silently never enters the ring; error
+            # surfaces as the transport failure callers already handle.
+            if faultpoints.fire(
+                "ring.push", err=protocol.ConnectionLost
+            ) == "drop":
+                return
         try:
             on_loop = asyncio.get_running_loop() is self.loop
         except RuntimeError:
@@ -323,6 +330,18 @@ class RingConnection:
                 slow = []
                 fast = self.fast_dispatch
                 for m in msgs:
+                    if faultpoints.ACTIVE:
+                        try:
+                            if faultpoints.fire(
+                                "ring.pop", err=OSError
+                            ) == "drop":
+                                continue  # this message is lost in transit
+                        except OSError as e:
+                            logger.debug(
+                                "ring %s: injected recv failure: %s",
+                                self.name, e,
+                            )
+                            return  # finally: _teardown (ring wedged)
                     try:
                         header, frames = protocol.decode_message_bytes(m)
                     except Exception:
@@ -402,6 +421,8 @@ class RingConnection:
             )
             if extras:
                 reply.update(extras)
+        except faultpoints.DropReply:
+            return  # injected: verb applied, reply swallowed
         except Exception as e:
             reply["e"] = f"{type(e).__name__}: {e}"
             code = getattr(e, "code", None)

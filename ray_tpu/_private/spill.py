@@ -31,6 +31,8 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ray_tpu._private import faultpoints
+
 logger = logging.getLogger(__name__)
 
 _U32 = struct.Struct("<I")
@@ -273,6 +275,10 @@ class SpillManager:
 
     def spill(self, object_hex: str, frames: List) -> dict:
         """Write frames to the backend; returns the meta for the copy."""
+        if faultpoints.ACTIVE:
+            # error = storage write failure: spill_many logs it and keeps
+            # the object in the arena (exactly a full/unreachable bucket).
+            faultpoints.fire("spill.write", err=OSError)
         uri, total = self.storage.write(object_hex, frames)
         with self._stats_lock:
             self.stats["spilled_objects"] += 1
@@ -302,6 +308,15 @@ class SpillManager:
         uri = meta.get("spill")
         if not uri:
             return None
+        if faultpoints.ACTIVE:
+            try:
+                faultpoints.fire("spill.restore", err=OSError)
+            except OSError as e:
+                # Missing/unreadable external copy: same contract as a
+                # backend read failure — None routes callers to the
+                # fallback pull/reconstruction paths.
+                logger.debug("injected restore failure for %s: %s", uri, e)
+                return None
         frames = _storage_for_uri(self.storage, uri).read(uri)
         if frames is not None:
             with self._stats_lock:

@@ -3,14 +3,20 @@
 Reference analog: ``python/ray/_private/test_utils.py`` —
 ``ResourceKillerActor`` (:1278), ``RayletKiller`` (:1407): background
 killers that take out cluster components mid-workload so fault-tolerance
-paths get exercised for real.
+paths get exercised for real. Per-RPC fault injection (the finer-grained
+chaos plane) lives in ``_private/faultpoints.py``.
 """
 from __future__ import annotations
 
+import logging
 import random
 import threading
 import time
-from typing import List, Optional
+from typing import List, Optional, Tuple
+
+from ray_tpu._private.backoff import Backoff
+
+logger = logging.getLogger(__name__)
 
 
 class NodeKiller:
@@ -18,7 +24,9 @@ class NodeKiller:
 
     Spares the last ``min_alive`` nodes so the workload can finish. Runs in
     a thread in the driver (our cluster handle lives there; the reference
-    runs its killer as an actor for remote clusters).
+    runs its killer as an actor for remote clusters). Kills that fail are
+    logged and recorded in ``kill_errors`` — a chaos run whose killer
+    silently stopped killing proves nothing.
     """
 
     def __init__(self, cluster, interval_s: float = 1.0, min_alive: int = 1,
@@ -28,6 +36,7 @@ class NodeKiller:
         self.min_alive = min_alive
         self.max_kills = max_kills
         self.killed: List[str] = []
+        self.kill_errors: List[Tuple[str, str]] = []  # (node_id, error)
         self._rng = random.Random(seed)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -50,8 +59,10 @@ class NodeKiller:
             try:
                 self.cluster.kill_node(victim)
                 self.killed.append(victim.node_id)
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("NodeKiller: kill of node %s failed: %s",
+                             victim.node_id[:8], e)
+                self.kill_errors.append((victim.node_id, repr(e)))
 
     def stop(self):
         self._stop.set()
@@ -61,9 +72,14 @@ class NodeKiller:
 
 def wait_for_condition(fn, timeout: float = 30.0, interval: float = 0.1,
                        message: str = "condition not met"):
+    """Poll ``fn`` until truthy. ``interval`` is the BASE delay of a
+    jittered backoff (RT204: constant-period polls synchronize
+    contenders), capped a few doublings above it so a slow condition
+    doesn't turn into multi-second blind spots."""
     deadline = time.monotonic() + timeout
+    poll = Backoff(base=interval, cap=max(interval * 8, interval))
     while time.monotonic() < deadline:
         if fn():
             return
-        time.sleep(interval)
+        poll.sleep()
     raise TimeoutError(message)

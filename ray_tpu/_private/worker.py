@@ -43,7 +43,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import cloudpickle
 
 from ray_tpu import exceptions as exc
-from ray_tpu._private import protocol
+from ray_tpu._private import faultpoints, protocol
 from ray_tpu._private.backoff import Backoff
 from ray_tpu._private.ids import (
     ActorID,
@@ -534,23 +534,34 @@ class CoreWorker:
         startup and the head-restart rejoin path (reference: raylets
         reconnect to a restarted GCS and re-register,
         ``gcs_init_data.cc`` replay)."""
+        from ray_tpu._private.config import rt_config
+
+        tmo = float(rt_config.rpc_deadline_s)
         self.gcs = await protocol.connect(
             self.gcs_addr, self._handle_rpc, name="gcs-client"
         )
         self.gcs.on_close = self._on_gcs_lost
+        # Every registration call is deadline-bounded: a head that accepts
+        # the TCP connection but drops replies must kick us back into the
+        # reconnect loop, not wedge it forever mid-handshake.
         # Subscribe to EVERY channel with a registered handler (plus the
         # built-ins): a restarted head has an empty subscriber table, so
         # reconnect must restore late-registered channels too (e.g. serve
         # replica-change pushes), not just the boot-time set.
         for channel in {"object_free", "lease_reclaim",
                         *self.pubsub_handlers}:
-            await self.gcs.call("subscribe", {"channel": channel})
+            await asyncio.wait_for(
+                self.gcs.call("subscribe", {"channel": channel}), tmo
+            )
         # Cluster-wide config overrides (init(_system_config=...)) live in
         # the head KV; every process applies them at (re)connection —
         # the reference passes _system_config on raylet command lines.
         try:
-            hh, frames = await self.gcs.call(
-                "kv_get", {"ns": "__rt", "key": "system_config"}
+            hh, frames = await asyncio.wait_for(
+                self.gcs.call(
+                    "kv_get", {"ns": "__rt", "key": "system_config"}
+                ),
+                tmo,
             )
             if hh.get("found") and frames:
                 import json as _json
@@ -558,25 +569,33 @@ class CoreWorker:
                 from ray_tpu._private.config import rt_config
 
                 rt_config.apply_system_config(_json.loads(frames[0]))
-        except (protocol.RpcError, ValueError) as e:
+        except (asyncio.TimeoutError, protocol.RpcError, ValueError) as e:
             logger.debug("system-config fetch failed, using defaults: %s", e)
         if self.is_driver:
-            await self.gcs.call("register_job", {"job_id": self.job_id.hex()})
+            await asyncio.wait_for(
+                self.gcs.call(
+                    "register_job", {"job_id": self.job_id.hex()}
+                ),
+                tmo,
+            )
         else:
             hosted = [
                 {"actor_id": aid, **getattr(inst, "public_meta", {})}
                 for aid, inst in self.hosted_actors.items()
                 if not inst.exiting
             ]
-            await self.gcs.call(
-                "register_node",
-                {
-                    "node_id": self.node_id,
-                    "addr": list(self.addr),
-                    "resources": self.node_resources,
-                    "labels": self.node_labels,
-                    "hosted_actors": hosted,
-                },
+            await asyncio.wait_for(
+                self.gcs.call(
+                    "register_node",
+                    {
+                        "node_id": self.node_id,
+                        "addr": list(self.addr),
+                        "resources": self.node_resources,
+                        "labels": self.node_labels,
+                        "hosted_actors": hosted,
+                    },
+                ),
+                tmo,
             )
 
     def _on_gcs_lost(self, conn):
@@ -626,7 +645,14 @@ class CoreWorker:
                     "reconnected to head at %s:%d", *self.gcs_addr
                 )
                 return
-            except (OSError, protocol.ConnectionLost, protocol.RpcError):
+            except (asyncio.TimeoutError, OSError, protocol.ConnectionLost,
+                    protocol.RpcError):
+                # A handshake that died mid-way (e.g. subscribe deadline)
+                # leaves an open half-registered connection: close it so
+                # the next attempt starts clean instead of leaking one
+                # connection per retry.
+                if self.gcs is not None and not self.gcs._closed:
+                    await self.gcs.close()
                 await asyncio.sleep(delay)
                 delay = min(delay * 2, 2.0)
         if not self._shutdown:
@@ -690,7 +716,72 @@ class CoreWorker:
 
     def run_sync(self, coro, timeout=None):
         fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
-        return fut.result(timeout)
+        try:
+            return fut.result(timeout)
+        except SyncTimeoutError:
+            # The caller is giving up: the scheduled coroutine must not
+            # keep running (and holding store events, RPC futures, borrow
+            # pins) as an orphan on the core loop. cancel() no-ops if the
+            # coroutine won the race and completed.
+            fut.cancel()
+            raise
+
+    async def _head_call(self, method, extras=None, frames=(), *,
+                         timeout=None, retries=None, corr=False):
+        """Head RPC with a real per-attempt deadline and jittered retries.
+
+        A dropped reply used to hang the calling verb forever (the bare
+        ``gcs.call`` future only resolves on reply or connection
+        teardown); here each attempt is bounded by ``timeout``
+        (default ``rt_config.rpc_deadline_s``) and timeouts / connection
+        losses / "unavailable" errors re-issue up to ``retries`` times
+        with jittered backoff (reference: retryable_grpc_client.cc
+        retrying UNAVAILABLE under a deadline).
+
+        ``corr=True`` attaches a correlation id shared by every attempt of
+        this logical request: the head replays the original reply for a
+        retry whose predecessor was applied but unacknowledged, so
+        non-idempotent verbs (lease, create_actor, create_pg) never
+        double-apply.
+        """
+        from ray_tpu._private.config import rt_config
+
+        if timeout is None:
+            timeout = float(rt_config.rpc_deadline_s)
+        if retries is None:
+            retries = int(rt_config.rpc_retries)
+        extras = dict(extras or {})
+        if corr:
+            extras["corr"] = os.urandom(8).hex()
+        retry = Backoff(base=0.05, cap=2.0)
+        attempt = 0
+        while True:
+            try:
+                conn = self.gcs
+                if conn is None or conn._closed:
+                    raise protocol.ConnectionLost("head connection down")
+                return await asyncio.wait_for(
+                    conn.call(method, extras, list(frames)), timeout
+                )
+            except asyncio.TimeoutError as e:
+                last: Exception = e
+            except (protocol.ConnectionLost, OSError) as e:
+                last = e
+            except protocol.RpcError as e:
+                # Application errors are terminal; only the transient
+                # unavailability class is worth re-issuing.
+                if getattr(e, "code", None) != "unavailable":
+                    raise
+                last = e
+            if attempt >= retries or self._shutdown:
+                if isinstance(last, asyncio.TimeoutError):
+                    raise protocol.RpcError(
+                        f"head rpc {method!r} exceeded its {timeout}s "
+                        f"deadline {attempt + 1} time(s)", code="deadline",
+                    )
+                raise last
+            attempt += 1
+            await asyncio.sleep(retry.next_delay())
 
     # ------------------------------------------------------------ connections
 
@@ -1843,17 +1934,23 @@ class CoreWorker:
         resolved: Dict[str, tuple] = {}
         oids = list(unknown)
         try:
-            call = self.gcs.call("object_lookup_batch", {"oids": oids})
+            tmo = None
+            retries = None
             if deadline is not None:
-                tmo = max(deadline - time.monotonic(), 0)
-                h, _ = await asyncio.wait_for(call, tmo)
-            else:
-                h, _ = await call
+                # The whole retry envelope must fit the caller's budget:
+                # one attempt spanning the remaining time, no re-issues
+                # (the per-ref fallback is the retry path here).
+                tmo = max(deadline - time.monotonic(), 0.001)
+                retries = 0
+            h, _ = await self._head_call(
+                "object_lookup_batch", {"oids": oids}, timeout=tmo,
+                retries=retries,
+            )
             for oid, meta in zip(oids, h.get("metas") or []):
                 if meta is not None:
                     resolved[oid] = ("shm", meta)
         except (asyncio.TimeoutError, protocol.RpcError,
-                protocol.ConnectionLost) as e:
+                protocol.ConnectionLost, OSError) as e:
             # Per-ref path retries the directory with full semantics.
             logger.debug("batched directory lookup (%d oids) failed, "
                          "falling back to per-ref: %s", len(oids), e)
@@ -1872,15 +1969,21 @@ class CoreWorker:
                                      resolved: Dict[str, tuple]):
         """Pull a whole owner's batch over a single RPC with multi-object
         frames. Failures leave the oids unresolved (the per-ref pull
-        reproduces the exact error/timeout behavior)."""
+        reproduces the exact error/timeout behavior). The attempt is
+        always deadline-bounded: a dropped batch reply must hand over to
+        the per-ref path, not pin the whole get() forever."""
+        from ray_tpu._private.config import rt_config
+
         try:
+            if faultpoints.ACTIVE:
+                if await faultpoints.async_fire("worker.pull") == "drop":
+                    return  # reply lost; per-ref path takes over
             conn = await self.get_peer(owner)
             call = conn.call("pull_object_batch", {"oids": oids})
+            tmo = float(rt_config.rpc_deadline_s)
             if deadline is not None:
-                tmo = max(deadline - time.monotonic(), 0)
-                hh, frames = await asyncio.wait_for(call, tmo)
-            else:
-                hh, frames = await call
+                tmo = min(tmo, max(deadline - time.monotonic(), 0))
+            hh, frames = await asyncio.wait_for(call, tmo)
         except (asyncio.TimeoutError, protocol.RpcError,
                 protocol.ConnectionLost, ConnectionRefusedError, OSError):
             return
@@ -1987,10 +2090,10 @@ class CoreWorker:
                 # object to disk under memory pressure. The head's directory
                 # entry is authoritative; refresh and retry locally.
                 try:
-                    hh, _ = await self.gcs.call(
+                    hh, _ = await self._head_call(
                         "object_lookup", {"oid": hex_}
                     )
-                except protocol.ConnectionLost:
+                except (protocol.RpcError, protocol.ConnectionLost, OSError):
                     hh = {}
                 if hh.get("found") and hh["meta"] != entry[1]:
                     entry = ("shm", hh["meta"])
@@ -2098,7 +2201,7 @@ class CoreWorker:
     async def _fetch_remote(self, ref: ObjectRef, deadline):
         hex_ = ref.id().hex()
         # 1) check the shm directory (any process on this machine can attach)
-        h, _ = await self.gcs.call("object_lookup", {"oid": hex_})
+        h, _ = await self._head_call("object_lookup", {"oid": hex_})
         if h.get("found"):
             return ("shm", h["meta"])
         # 2) pull from the owner
@@ -2111,26 +2214,59 @@ class CoreWorker:
         process cannot map the shared store). ``addr`` overrides the target
         (e.g. the worker that spilled the object holds its disk copy); such
         direct pulls do not long-poll ownership."""
+        from ray_tpu._private.config import rt_config
+
         hex_ = ref.id().hex()
         owner = tuple(addr or ref.owner_address or ())
         if not owner:
             raise exc.ObjectLostError(hex_, "no owner address on ref")
-        try:
-            conn = await self.get_peer(owner)
-            timeout = None if deadline is None else max(deadline - time.monotonic(), 0)
-            call = conn.call(
-                "pull_object",
-                {"oid": hex_, "inline": inline, "direct": addr is not None},
-            )
-            hh, frames = await (
-                asyncio.wait_for(call, timeout) if timeout is not None else call
-            )
-        except asyncio.TimeoutError:
-            raise exc.GetTimeoutError(f"get() timed out pulling {hex_}")
-        except (protocol.ConnectionLost, ConnectionRefusedError, OSError):
-            raise exc.ObjectLostError(hex_, "owner unreachable")
-        except protocol.RpcError as e:
-            raise exc.ObjectLostError(hex_, str(e))
+        # Re-armed long-poll: each attempt is bounded by the RPC deadline
+        # even when get() has none, so a dropped pull reply re-issues the
+        # pull instead of hanging this getter forever; transient connection
+        # failures get a few jittered retries before ObjectLostError.
+        attempt_s = float(rt_config.rpc_deadline_s)
+        conn_failures = 0
+        retry = Backoff(base=0.05, cap=1.0)
+        while True:
+            try:
+                if faultpoints.ACTIVE:
+                    if await faultpoints.async_fire("worker.pull") == "drop":
+                        # Reply lost in transit: behave exactly like the
+                        # attempt-deadline expiring.
+                        raise asyncio.TimeoutError()
+                conn = await self.get_peer(owner)
+                tmo = attempt_s
+                if deadline is not None:
+                    tmo = min(tmo, max(deadline - time.monotonic(), 0))
+                hh, frames = await asyncio.wait_for(
+                    conn.call(
+                        "pull_object",
+                        {"oid": hex_, "inline": inline,
+                         "direct": addr is not None},
+                    ),
+                    tmo,
+                )
+                break
+            except asyncio.TimeoutError:
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise exc.GetTimeoutError(
+                        f"get() timed out pulling {hex_}"
+                    )
+                await asyncio.sleep(retry.next_delay())
+            except (protocol.ConnectionLost, ConnectionRefusedError,
+                    OSError) as e:
+                conn_failures += 1
+                if conn_failures > int(rt_config.rpc_retries):
+                    raise exc.ObjectLostError(
+                        hex_, f"owner unreachable ({e})"
+                    )
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise exc.GetTimeoutError(
+                        f"get() timed out pulling {hex_}"
+                    )
+                await asyncio.sleep(retry.next_delay())
+            except protocol.RpcError as e:
+                raise exc.ObjectLostError(hex_, str(e))
         if hh.get("kind") == "shm":
             return ("shm", hh["meta"])
         if hh.get("kind") == "err":
@@ -2259,6 +2395,8 @@ class CoreWorker:
                 settle(hex_, exc.ObjectLostError(hex_, f"probe failed: {e!r}"))
 
     async def _remote_ready_poll_inner(self, remote_futs, by_owner, settle):
+        from ray_tpu._private.config import rt_config
+
         while remote_futs:
             for hex_ in [h for h in remote_futs if h in self.memory_store]:
                 settle(hex_)
@@ -2266,13 +2404,13 @@ class CoreWorker:
                 return
             oids = list(remote_futs)
             try:
-                h, _ = await self.gcs.call(
+                h, _ = await self._head_call(
                     "object_lookup_batch", {"oids": oids}
                 )
                 for oid, meta in zip(oids, h.get("metas") or []):
                     if meta is not None:
                         settle(oid)
-            except (protocol.RpcError, protocol.ConnectionLost) as e:
+            except (protocol.RpcError, protocol.ConnectionLost, OSError) as e:
                 # Directory unavailable: owner probes still decide.
                 logger.debug("wait() directory poll failed: %s", e)
             for owner, hexes in list(by_owner.items()):
@@ -2292,12 +2430,19 @@ class CoreWorker:
                     continue
                 try:
                     conn = await self.get_peer(owner)
-                    hh, _ = await conn.call(
-                        "contains_object_batch", {"oids": hexes}
+                    # Deadline-bounded probe: a dropped probe reply costs
+                    # one cycle, not the whole wait() (next cycle re-asks).
+                    hh, _ = await asyncio.wait_for(
+                        conn.call(
+                            "contains_object_batch", {"oids": hexes}
+                        ),
+                        float(rt_config.rpc_deadline_s),
                     )
                     for hex_, rdy in zip(hexes, hh.get("ready") or []):
                         if rdy:
                             settle(hex_)
+                except asyncio.TimeoutError:
+                    continue
                 except (protocol.ConnectionLost, ConnectionRefusedError,
                         OSError):
                     for hex_ in hexes:
@@ -2531,6 +2676,8 @@ class CoreWorker:
             ):
                 if isinstance(err, exc.OutOfMemoryError):
                     await asyncio.sleep(min(0.5 * 2 ** attempt, 5.0))
+                if faultpoints.ACTIVE:
+                    await faultpoints.async_fire("worker.dispatch.retry")
                 attempt += 1
                 key = self._sched_key(resources, strategy)
                 lease_set = self.leases.get(key)
@@ -2669,20 +2816,29 @@ class CoreWorker:
             self.loop.create_task(self._lease_reaper(key, lease_set))
 
     async def _request_leases(self, key, lease_set: _LeaseSet, count):
+        from ray_tpu._private.config import rt_config
+
         try:
             now = time.monotonic()
             lease_set.avoid = {
                 n: t for n, t in lease_set.avoid.items() if t > now
             }
-            h, _ = await self.gcs.call(
+            # The head may block up to lease_request_timeout_s waiting for
+            # resources, so the per-attempt RPC deadline sits above that
+            # window. corr: a retry after a dropped GRANT reply replays
+            # the original grants instead of double-acquiring capacity.
+            wait_s = float(rt_config.lease_request_timeout_s)
+            h, _ = await self._head_call(
                 "lease",
                 {
                     "resources": lease_set.resources,
                     "strategy": lease_set.strategy,
                     "count": count,
-                    "timeout": 30.0,
+                    "timeout": wait_s,
                     "avoid": list(lease_set.avoid),
                 },
+                timeout=wait_s + max(float(rt_config.rpc_deadline_s), 2.0),
+                corr=True,
             )
             for g in h.get("grants", []):
                 lease_set.slots.append(
@@ -2690,7 +2846,7 @@ class CoreWorker:
                 )
             if h.get("grants"):
                 lease_set.saturated = False
-        except (protocol.RpcError, protocol.ConnectionLost) as e:
+        except (protocol.RpcError, protocol.ConnectionLost, OSError) as e:
             logger.warning("lease request failed: %s", e)
             # fail pending tasks if nothing can ever be granted
             if not lease_set.slots:
@@ -2710,12 +2866,19 @@ class CoreWorker:
     _PUSH_BATCH = 16
 
     def _pusher_node_lost(self, lease_set, slot, futs):
-        """Node died mid-push: drop its slots, fail the affected futures so
-        their dispatch retries elsewhere."""
+        """Node died mid-push: drop its slots and fail the affected futures
+        so their dispatch retries elsewhere. The dropped slots are RETURNED
+        to the head: if the node really died the release is a tolerated
+        no-op (its record is gone), but after a mere connection failure the
+        head would otherwise count the capacity as leased forever — this
+        driver's ledger only drains on disconnect."""
+        doomed = [s for s in lease_set.slots if s.node_id == slot.node_id]
         lease_set.slots = [
             s for s in lease_set.slots if s.node_id != slot.node_id
         ]
         lease_set.saturated = False
+        for s in doomed:
+            self._release_slot(lease_set, s)
         for fut in futs:
             if not fut.done():
                 fut.set_exception(
@@ -2801,6 +2964,13 @@ class CoreWorker:
                             chunk.append(lease_set.pending.popleft())
                     if not chunk:
                         continue
+                    if faultpoints.ACTIVE:
+                        # error = ConnectionLost into the outer handler:
+                        # slots dropped + released, every chunk future
+                        # fails as WorkerCrashedError, dispatch retries.
+                        await faultpoints.async_fire(
+                            "worker.task.push", err=protocol.ConnectionLost
+                        )
                     if len(chunk) == 1:
                         header, frames, fut = chunk[0]
                         h, rframes = await self._call_with_tcp_fallback(
@@ -3055,8 +3225,14 @@ class CoreWorker:
             }
         )
         try:
+            # Non-idempotent: corr-dedup at the head makes a retry after a
+            # dropped reply return the FIRST creation's placement instead
+            # of creating a second actor; a retry that beats a slow
+            # schedule attaches to the in-flight execution.
             h = self.run_sync(
-                self.gcs.call("create_actor", header, [spec] + frames)
+                self._head_call(
+                    "create_actor", header, [spec] + frames, corr=True,
+                )
             )[0]
         finally:
             # Creation args were materialized (or creation failed); drop the
@@ -3233,7 +3409,9 @@ class CoreWorker:
     async def _await_actor_alive(self, ch: _ActorChannel, timeout=60.0) -> bool:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            h, _ = await self.gcs.call("get_actor", {"actor_id": ch.actor_id})
+            h, _ = await self._head_call(
+                "get_actor", {"actor_id": ch.actor_id}
+            )
             if not h.get("found"):
                 ch.dead = True
                 ch.death_reason = "unknown actor"
@@ -3251,8 +3429,9 @@ class CoreWorker:
 
     def kill_actor(self, actor_id_hex: str, no_restart: bool = True):
         self.run_sync(
-            self.gcs.call(
-                "kill_actor", {"actor_id": actor_id_hex, "no_restart": no_restart}
+            self._head_call(
+                "kill_actor",
+                {"actor_id": actor_id_hex, "no_restart": no_restart},
             )
         )
 
@@ -3283,7 +3462,7 @@ class CoreWorker:
         hex_ = h["oid"]
         entry = self.memory_store.get(hex_)
         if entry is None and h.get("direct"):
-            hh, _ = await self.gcs.call("object_lookup", {"oid": hex_})
+            hh, _ = await self._head_call("object_lookup", {"oid": hex_})
             if hh.get("found"):
                 entry = ("shm", hh["meta"])
         elif entry is None:
@@ -3299,7 +3478,7 @@ class CoreWorker:
                 if frames is None:
                     # Possibly spilled by another process since we recorded
                     # the meta: the head has the authoritative copy.
-                    hh, _ = await self.gcs.call(
+                    hh, _ = await self._head_call(
                         "object_lookup", {"oid": hex_}
                     )
                     if hh.get("found"):

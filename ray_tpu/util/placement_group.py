@@ -24,7 +24,7 @@ class PlacementGroup:
         w = get_global_worker()
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            h = w.run_sync(w.gcs.call("get_pg", {"pg_id": self.id}))[0]
+            h = w.run_sync(w._head_call("get_pg", {"pg_id": self.id}))[0]
             if h.get("found") and h["pg"]["state"] == "CREATED":
                 return True
             if h.get("found") and h["pg"]["state"] == "REMOVED":
@@ -50,10 +50,19 @@ def placement_group(
         raise ValueError(f"invalid placement strategy {strategy}")
     if not bundles:
         raise ValueError("placement group requires at least one bundle")
+    from ray_tpu._private.config import rt_config
+
     w = get_global_worker()
     pg_id = PlacementGroupID.from_random().hex()
+    # corr: a retried create_pg after a dropped reply must replay the
+    # original outcome — re-running it would overwrite the registered
+    # group and leak the first commit's bundle reservations. The run_sync
+    # budget covers the full retry envelope (attempts x per-attempt
+    # deadline plus backoff) so a configured retry is never cut short.
+    attempt_s = timeout + 15
+    attempts = int(rt_config.rpc_retries) + 1
     h = w.run_sync(
-        w.gcs.call(
+        w._head_call(
             "create_pg",
             {
                 "pg_id": pg_id,
@@ -62,8 +71,10 @@ def placement_group(
                 "name": name,
                 "timeout": timeout,
             },
+            timeout=attempt_s,
+            corr=True,
         ),
-        timeout=timeout + 10,
+        timeout=attempts * (attempt_s + 2) + 10,
     )[0]
     pg = PlacementGroup(pg_id, bundles, strategy)
     if h.get("state") != "CREATED":
@@ -74,12 +85,12 @@ def placement_group(
 
 def remove_placement_group(pg: PlacementGroup):
     w = get_global_worker()
-    w.run_sync(w.gcs.call("remove_pg", {"pg_id": pg.id}))
+    w.run_sync(w._head_call("remove_pg", {"pg_id": pg.id}))
 
 
 def get_placement_group(pg_id: str) -> Optional[PlacementGroup]:
     w = get_global_worker()
-    h = w.run_sync(w.gcs.call("get_pg", {"pg_id": pg_id}))[0]
+    h = w.run_sync(w._head_call("get_pg", {"pg_id": pg_id}))[0]
     if not h.get("found"):
         return None
     info = h["pg"]

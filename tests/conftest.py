@@ -23,6 +23,13 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running matrix tests (tier-1 runs -m 'not slow')",
+    )
+
+
 @pytest.fixture(scope="session", autouse=True)
 def _assert_cpu_mesh():
     devs = jax.devices()

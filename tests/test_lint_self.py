@@ -28,6 +28,21 @@ def rule_ids(findings):
 
 
 # ------------------------------------------------------------ self-scan
+def test_faultpoints_module_is_family_b_clean():
+    """The injection plane itself must honor the framework rules: the
+    exact CLI invocation ``raytpu lint --framework`` over faultpoints.py
+    (a chaos tool that silently swallows RPC failures or constant-sleeps
+    would be the most ironic Family-B regression possible)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.lint",
+         os.path.join(REPO, "ray_tpu", "_private", "faultpoints.py"),
+         "--framework", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout) == []
+
+
 def test_private_tree_is_family_b_clean():
     findings = lint_paths([os.path.join(REPO, "ray_tpu", "_private")])
     fam_b = [f for f in findings if f.rule.startswith("RT2")]
