@@ -406,12 +406,87 @@ def bench_reference_jax_step(quick: bool = False):
     return {"gpt2_reference_impl_tokens_per_sec": best}
 
 
+def run_flight_benchmarks(quick: bool = False) -> dict:
+    """Flight-instrumented runs of the two ROADMAP perf open items
+    (``queued_*_tasks_s``, ``many_actors_per_s``): the recorder stays ON,
+    and after each leg the cluster-wide ring is drained into a per-verb
+    time-attribution table — the measured breakdown the next perf
+    tentpoles (batched lease-grant, batch create_actor) design against.
+
+    Writes ``flight_attrib.json`` next to the bench JSON and prints the
+    tables to stderr."""
+    import sys
+
+    from ray_tpu._private import flight
+    from ray_tpu._private.perf import bench_many_actors, bench_queued_tasks
+    from ray_tpu._private.worker import get_global_worker
+
+    flight.enable()
+    w = get_global_worker()
+
+    def drain():
+        h, _ = w.run_sync(w._head_call("flight_snapshot", {}), 60)
+        snaps = h["snapshots"]
+        return flight.merge_snapshots(snaps), snaps
+
+    out = {"flight": True}
+    attrib_all = {}
+    legs = (
+        ("many_actors_per_s",
+         lambda: bench_many_actors(200 if quick else 1000)),
+        ("queued_5k_tasks_s" if quick else "queued_1m_tasks_s",
+         lambda: bench_queued_tasks(5_000 if quick else 1_000_000)),
+    )
+    for key, fn in legs:
+        drain()  # discard events from the previous leg / warmup
+        print(f"[bench --flight] {key}...", file=sys.stderr, flush=True)
+        try:
+            out[key] = fn()
+        except Exception as e:
+            out[key + "_error"] = f"{type(e).__name__}: {e}"
+            continue
+        merged, snaps = drain()
+        dropped = sum(int(s.get("dropped") or 0) for s in snaps)
+        recorded = sum(int(s.get("recorded") or 0) for s in snaps)
+        attrib = flight.attribution(merged)
+        attrib_all[key] = {
+            "verbs": attrib,
+            "events_recorded": recorded,
+            "events_dropped": dropped,
+        }
+        print(f"--- per-verb attribution: {key} "
+              f"({len(merged)} spans) ---", file=sys.stderr)
+        if dropped:
+            # No silent caps: a 1M-task leg overflows the per-process
+            # rings, so the table attributes the TAIL window, not the
+            # whole run.
+            print(f"NOTE: rings kept the last {len(merged)} of "
+                  f"{recorded} events ({dropped} overwritten) — totals "
+                  f"are tail-window attribution, not the whole leg "
+                  f"(raise RT_FLIGHT_RING_SIZE for full coverage)",
+                  file=sys.stderr)
+        print(flight.format_attribution(attrib), file=sys.stderr,
+              flush=True)
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "flight_attrib.json"
+    )
+    with open(path, "w") as f:
+        json.dump(attrib_all, f, indent=1)
+    out["flight_attrib_file"] = path
+    return out
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true")
     parser.add_argument("--no-train", action="store_true")
     parser.add_argument("--train-only", action="store_true",
                         help="skip the core cluster benchmarks (debugging)")
+    parser.add_argument(
+        "--flight", action="store_true",
+        help="flight-instrumented run of queued_tasks + many_actors only: "
+             "recording ON cluster-wide, per-verb time-attribution table "
+             "emitted next to the bench JSON (flight_attrib.json)")
     args = parser.parse_args()
 
     import os
@@ -419,6 +494,10 @@ def main():
     # Sentinel, not 0.0: a --train-only line must never read as a real
     # throughput collapse to anything parsing the headline contract.
     core = {"single_client_tasks_async_per_s": None, "core_skipped": True}
+    if args.flight:
+        # Recording must be on in every process: workers inherit the env.
+        os.environ["RT_FLIGHT_ENABLED"] = "1"
+        args.no_train = True  # flight mode measures the RPC plane only
     if not args.train_only:
         import ray_tpu
         from ray_tpu._private.perf import run_core_benchmarks
@@ -433,7 +512,13 @@ def main():
         else:
             ray_tpu.init(num_cpus=max(cores, 2), num_nodes=1)
         try:
-            core = run_core_benchmarks(quick=args.quick)
+            if args.flight:
+                core = {
+                    "single_client_tasks_async_per_s": None,
+                    **run_flight_benchmarks(quick=args.quick),
+                }
+            else:
+                core = run_core_benchmarks(quick=args.quick)
         finally:
             ray_tpu.shutdown()
 

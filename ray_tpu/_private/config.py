@@ -216,6 +216,20 @@ rt_config.declare(
     "before returning empty; the client's per-attempt RPC deadline sits "
     "above this.")
 rt_config.declare(
+    "flight_enabled", bool, False,
+    "Record RPC/phase events into the per-process flight-recorder ring "
+    "(_private/flight.py): verb spans on protocol send/reply, ring "
+    "push/pop, head dispatch (queue-wait vs handler), worker "
+    "pulls/pushes. Off: every hook costs one boolean. On: events go into "
+    "a preallocated ring of flight_ring_size tuples; drain cluster-wide "
+    "with `rt flight`. Propagates to spawned workers via the "
+    "environment (RT_FLIGHT_ENABLED=1).")
+rt_config.declare(
+    "flight_ring_size", int, 16384,
+    "Events retained per process by the flight recorder (fixed "
+    "preallocated ring; oldest events are overwritten and counted as "
+    "dropped in drain output).")
+rt_config.declare(
     "fault_spec", str, "",
     "Deterministic fault injection spec "
     "('point:kind:prob[:count[:seed]],...' — see _private/faultpoints.py "

@@ -51,6 +51,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from ray_tpu._private import flight
+
 logger = logging.getLogger(__name__)
 
 KINDS = ("error", "delay", "drop", "crash")
@@ -110,6 +112,18 @@ CATALOG: Dict[str, tuple] = {
     "worker.task.push": (
         "worker", ("error", "delay", "crash"),
         "task push onto a leased slot (PushNormalTask analog)"),
+    "worker.task.exec": (
+        "worker", ("delay", "crash"),
+        "task execution entry on the EXECUTING worker (HandlePushTask "
+        "analog): crash = the worker process dies mid-dispatch, after "
+        "the lease was consumed and before any reply. No error kind: "
+        "its semantics would diverge between the ring fast path (task "
+        "result) and the TCP slow path (transport failure)"),
+    "worker.actor.push": (
+        "worker", ("error", "delay", "drop"),
+        "actor-call push attempt (PushActorTask analog): drop = the "
+        "request never reaches the actor worker; the caller's reply "
+        "deadline fires and the corr-deduped retry re-delivers"),
     "worker.dispatch.retry": (
         "worker", ("error", "delay"),
         "dispatch-retry path after a failed push attempt"),
@@ -317,6 +331,11 @@ def fire(name: str, err=ConnectionError) -> Optional[str]:
     spec = _decide(name)
     if spec is None:
         return None
+    if flight.ENABLED:
+        # Chaos forensics: every injection lands in the flight ring as an
+        # instant event AND stamps the enclosing RPC span, so a failed
+        # chaos run dumps a trace showing exactly where the plane bit.
+        flight.note_fault(name, spec.kind)
     if spec.kind == "delay":
         time.sleep(spec.delay_s)
         return "delay"
@@ -329,6 +348,8 @@ async def async_fire(name: str, err=ConnectionError) -> Optional[str]:
     spec = _decide(name)
     if spec is None:
         return None
+    if flight.ENABLED:
+        flight.note_fault(name, spec.kind)
     if spec.kind == "delay":
         await asyncio.sleep(spec.delay_s)
         return "delay"

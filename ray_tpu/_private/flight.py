@@ -1,0 +1,393 @@
+"""Cross-process RPC flight recorder: per-process event ring + trace merge.
+
+Reference shape: Dapper-class always-on sampling tracers (Sigelman et al.,
+Google TR 2010) and the reference's own chrome-trace surface (``ray
+timeline``). Task-level observability already exists (task events →
+``rt timeline``; util/metrics → Prometheus); this module records one layer
+below — the RPC **verb** plane (protocol send/reply, ring push/pop, head
+dispatch, worker pulls/pushes) — where both open perf items in ROADMAP.md
+actually spend their time.
+
+Design contract (mirrors ``faultpoints``):
+
+- **Off by default, one boolean per hook.** Every call site is gated on the
+  module attribute ``ENABLED``; disabled, the hot paths pay one attribute
+  load and a false branch.
+- **Allocation-bounded when on.** Events live in a preallocated fixed-size
+  ring (``rt_config.flight_ring_size``) as plain tuples, oldest overwritten;
+  a drain reports how many were dropped. No dicts, no unbounded lists.
+- **Lock-light.** A ``threading.Lock`` is held for exactly the slot store
+  (two statements); histogram observation happens outside it.
+
+Event tuple layout (fixed 8 fields, msgpack-able as a list)::
+
+    (verb, cid, kind, t0, t1, nbytes, outcome, queue_wait)
+
+- ``verb``: dotted hook name (``rpc.c.lease``, ``gcs.lease``, ``ring.push``,
+  ``worker.pull``, ``head.create_actor``, ...)
+- ``cid``: cross-process join key — PR 3's correlation id (``corr``) when the
+  request carries one, else a per-process flight id (``fid``) stamped into
+  the wire header so both ends of one RPC record the same key.
+- ``kind``: span category (client | server | head | ring | worker | fault)
+- ``t0``/``t1``: ``time.monotonic()`` span bounds in THIS process. Each
+  process also records a (wall, mono) anchor; the merge step maps spans onto
+  the head's wall clock with an RTT/2-corrected per-node offset.
+- ``nbytes``: payload bytes on the wire for this span (0 when not metered)
+- ``outcome``: ``ok`` | ``error:<Type>`` | ``timeout`` | ``drop_reply`` |
+  ``fault_injected:<point>:<kind>`` (stamped by the faultpoints plane)
+- ``queue_wait``: seconds between message arrival and handler start
+  (head dispatch records it; 0.0 elsewhere)
+
+The head verb ``flight_snapshot`` fans ``flight_drain`` out to every node,
+clock-aligns the events and returns the raw snapshots; ``merge_snapshots`` /
+``to_chrome_trace`` below turn them into a Chrome trace-event JSON that
+loads in Perfetto / chrome://tracing (``rt flight --output``).
+"""
+from __future__ import annotations
+
+import contextvars
+import itertools
+import logging
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+# Hot-path gate: ``if flight.ENABLED: flight.record(...)``.
+ENABLED = False
+
+_DEFAULT_RING = 16384
+
+# Latency buckets: RPC verbs span ~50us (ring push) to ~30s (deadline).
+_LATENCY_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
+class _Recorder:
+    __slots__ = ("size", "buf", "n", "lock", "anchor_wall", "anchor_mono")
+
+    def __init__(self, size: int):
+        self.size = max(int(size), 1)
+        self.buf: List[Optional[tuple]] = [None] * self.size
+        self.n = 0  # total events ever recorded (wraps the ring modulo size)
+        self.lock = threading.Lock()
+        # Wall/monotonic anchor pair: the merge step converts monotonic span
+        # bounds to wall time via ``anchor_wall + (t - anchor_mono)``.
+        self.anchor_wall = time.time()
+        self.anchor_mono = time.monotonic()
+
+
+_rec: Optional[_Recorder] = None
+_label: Optional[str] = None
+# Pending fault stamp. A ContextVar, not a threading.local: faultpoints
+# fire inside the same coroutine as the span being recorded, and
+# coroutines interleave on the event-loop thread — task-local scoping
+# keeps the stamp with the RPC it actually bit (on plain executor
+# threads it degrades to exactly thread-local behavior).
+_fault_pending: "contextvars.ContextVar[Optional[tuple]]" = (
+    contextvars.ContextVar("rt_flight_fault", default=None)
+)
+_fid_counter = itertools.count(1)
+# Process-unique token: snapshot identity across hosts (OS pids collide
+# between machines; the head dedups drained snapshots by this).
+_PROC_TOKEN = os.urandom(6).hex()
+_hist_latency = None
+_hist_qwait = None
+
+
+def set_label(label: str):
+    """Human-readable per-process label for merged traces (node id prefix,
+    "driver", "head"). Safe to call whether or not recording is enabled."""
+    global _label
+    _label = label
+
+
+def next_id() -> str:
+    """Cheap process-unique flight id stamped into wire headers (``fid``)
+    when the request has no PR-3 correlation id; both ends of the RPC then
+    record the same join key."""
+    return f"f{os.getpid():x}-{next(_fid_counter)}"
+
+
+def enable(ring_size: Optional[int] = None):
+    """Start recording into a fresh preallocated ring. Idempotent-ish: a
+    second enable with a different size replaces the ring (drains lost)."""
+    global _rec, ENABLED, _hist_latency, _hist_qwait
+    if ring_size is None:
+        try:
+            from ray_tpu._private.config import rt_config
+
+            ring_size = int(rt_config.flight_ring_size)
+        except Exception:
+            ring_size = _DEFAULT_RING
+    _rec = _Recorder(ring_size)
+    # Per-verb latency / head queue-wait histograms ride the existing
+    # metrics registry, so they reach /metrics and the dashboard through
+    # the same worker metrics_push pipeline as every other series. Both
+    # are assigned atomically (or neither): record() must never see a
+    # half-registered pair.
+    try:
+        from ray_tpu.util.metrics import Histogram
+
+        lat = Histogram(
+            "rt_rpc_latency_s",
+            description="RPC verb latency recorded by the flight recorder",
+            boundaries=_LATENCY_BUCKETS,
+            tag_keys=("verb",),
+        )
+        qw = Histogram(
+            "rt_rpc_queue_wait_s",
+            description="Head dispatch queue wait (arrival to handler start)",
+            boundaries=_LATENCY_BUCKETS,
+            tag_keys=("verb",),
+        )
+        _hist_latency, _hist_qwait = lat, qw
+    except Exception as e:
+        # Metrics must never block the recorder itself (e.g. a boundary
+        # clash with an older registration); the ring still records.
+        _hist_latency = _hist_qwait = None
+        logger.debug("flight histograms unavailable: %s", e)
+    ENABLED = True
+
+
+def disable():
+    global _rec, ENABLED
+    ENABLED = False
+    _rec = None
+    _fault_pending.set(None)
+
+
+def record(verb: str, cid, kind: str, t0: float, t1: float,
+           nbytes: int = 0, outcome: str = "ok", qw: float = 0.0):
+    """Append one span to the ring. Call sites gate on ``ENABLED`` so the
+    disabled cost stays at one attribute load; a record racing disable() is
+    simply dropped here."""
+    r = _rec
+    if r is None:
+        return
+    f = _fault_pending.get()
+    if f is not None:
+        # A fault injected in this task/thread context since this span
+        # began annotates the span (satellite contract: chaos traces show
+        # WHERE the plane bit). Faults from before the span stay with
+        # their own instant event.
+        if f[2] >= t0:
+            outcome = f"fault_injected:{f[0]}:{f[1]}"
+        _fault_pending.set(None)
+    ev = (verb, cid, kind, t0, t1, nbytes, outcome, qw)
+    with r.lock:
+        r.buf[r.n % r.size] = ev
+        r.n += 1
+    h = _hist_latency
+    if h is not None:
+        h.observe(t1 - t0, tags={"verb": verb})
+        if qw > 0.0 and _hist_qwait is not None:
+            _hist_qwait.observe(qw, tags={"verb": verb})
+
+
+def record_dispatch(verb: str, kind: str, header: dict, t_arr: float,
+                    t_run: float, nbytes: int = 0, outcome: str = "ok"):
+    """Shared server/dispatch-side span recorder for the three transports
+    (protocol._dispatch, ringconn._handle_slow, gcs._handle): one place
+    defines the join key and the queue-wait = handler start − arrival."""
+    record(verb, header.get("corr") or header.get("fid"), kind, t_arr,
+           time.monotonic(), nbytes, outcome, qw=t_run - t_arr)
+
+
+def note_fault(point: str, kind: str):
+    """Called by the faultpoints plane on every injection: records the hit
+    as an instant event and stamps the enclosing span (consumed by the
+    next ``record`` in this task/thread context whose window covers the
+    hit)."""
+    if _rec is None:
+        return
+    t = time.monotonic()
+    record(f"fault.{point}", None, "fault", t, t, 0, kind)
+    _fault_pending.set((point, kind, t))
+
+
+def _collect(r: _Recorder) -> List[tuple]:
+    if r.n <= r.size:
+        return [e for e in r.buf[: r.n]]
+    start = r.n % r.size
+    return r.buf[start:] + r.buf[:start]
+
+
+def snapshot() -> Dict[str, Any]:
+    """Non-destructive copy of this process's ring + clock anchors."""
+    return _snap(drain=False)
+
+
+def drain() -> Dict[str, Any]:
+    """Snapshot and clear the ring (the ``flight_drain`` verb)."""
+    return _snap(drain=True)
+
+
+def _snap(drain: bool) -> Dict[str, Any]:
+    r = _rec
+    base = {
+        "proc": _label or f"pid{os.getpid()}",
+        "pid": os.getpid(),
+        "token": _PROC_TOKEN,
+        "now": time.time(),
+    }
+    if r is None:
+        return {**base, "anchor_wall": base["now"],
+                "anchor_mono": time.monotonic(), "recorded": 0,
+                "dropped": 0, "events": []}
+    with r.lock:
+        events = _collect(r)
+        recorded = r.n
+        dropped = max(r.n - r.size, 0)
+        if drain:
+            r.buf = [None] * r.size
+            r.n = 0
+    return {**base, "anchor_wall": r.anchor_wall,
+            "anchor_mono": r.anchor_mono, "recorded": recorded,
+            "dropped": dropped, "events": events}
+
+
+# ------------------------------------------------------------------- merge
+
+def merge_snapshots(snaps: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Normalize per-process snapshots into one clock-aligned event list.
+
+    Each snapshot carries ``anchor_wall``/``anchor_mono`` plus an optional
+    ``offset`` (seconds to ADD to its wall times — the head computes it per
+    node from the drain RPC's midpoint vs the node's reported wall clock,
+    correcting skew between machines). Output is sorted by corrected start
+    time; each event dict carries proc/pid/verb/cid/kind/ts/dur/nbytes/
+    outcome/qw with ``ts`` in wall seconds on the head's clock."""
+    out: List[Dict[str, Any]] = []
+    for s in snaps:
+        if not s:
+            continue
+        off = float(s.get("offset") or 0.0)
+        aw = float(s.get("anchor_wall") or 0.0)
+        am = float(s.get("anchor_mono") or 0.0)
+        proc = s.get("proc") or f"pid{s.get('pid')}"
+        pid = s.get("pid")
+        for ev in s.get("events") or ():
+            verb, cid, kind, t0, t1, nbytes, outcome, qw = ev
+            out.append({
+                "proc": proc, "pid": pid, "verb": verb, "cid": cid,
+                "kind": kind, "ts": aw + (t0 - am) + off,
+                "dur": max(t1 - t0, 0.0), "nbytes": nbytes,
+                "outcome": outcome, "qw": qw,
+            })
+    out.sort(key=lambda e: e["ts"])
+    return out
+
+
+def to_chrome_trace(merged: List[Dict[str, Any]],
+                    t0: Optional[float] = None) -> List[Dict[str, Any]]:
+    """Merged events → Chrome trace-event JSON (the ``traceEvents`` array
+    form, loadable in Perfetto / chrome://tracing).
+
+    - One complete ("X") event per span: pid = process label, tid = span
+      kind, args carry cid/outcome/bytes/queue-wait.
+    - Flow ("s"/"f") event pairs stitch spans sharing a correlation id
+      across processes, so Perfetto draws the cross-process arrows.
+    - ``t0``: subtract this wall time from every timestamp. Default: the
+      earliest span (trace starts at 0); pass 0.0 to keep absolute wall
+      microseconds (``rt timeline --rpc`` interleaves with task events that
+      use absolute timestamps).
+    """
+    if not merged:
+        return []
+    if t0 is None:
+        t0 = min(e["ts"] for e in merged)
+    trace: List[Dict[str, Any]] = []
+    by_cid: Dict[str, List[dict]] = {}
+    for e in merged:
+        ts_us = (e["ts"] - t0) * 1e6
+        trace.append({
+            "name": e["verb"], "cat": e["kind"], "ph": "X",
+            "ts": ts_us, "dur": e["dur"] * 1e6,
+            "pid": e["proc"], "tid": e["kind"],
+            "args": {
+                "cid": e["cid"], "outcome": e["outcome"],
+                "bytes": e["nbytes"],
+                "queue_wait_ms": round(e["qw"] * 1e3, 3),
+            },
+        })
+        if e["cid"]:
+            by_cid.setdefault(str(e["cid"]), []).append(e)
+    for cid, evs in by_cid.items():
+        if len({e["proc"] for e in evs}) < 2:
+            continue
+        evs.sort(key=lambda e: e["ts"])
+        first = evs[0]
+        for k, nxt in enumerate(evs[1:]):
+            # One s→f chain per flow id (the trace-event format binds
+            # flows by id): a cid recorded by 3+ spans gets one distinct
+            # flow per (origin, follower) pair, not a shared id.
+            fid = f"{cid}/{k}"
+            trace.append({
+                "name": "rpc", "cat": "rpc_flow", "ph": "s", "id": fid,
+                "ts": (first["ts"] - t0) * 1e6, "pid": first["proc"],
+                "tid": first["kind"],
+            })
+            trace.append({
+                "name": "rpc", "cat": "rpc_flow", "ph": "f", "bp": "e",
+                "id": fid, "ts": (nxt["ts"] - t0) * 1e6 + 0.001,
+                "pid": nxt["proc"], "tid": nxt["kind"],
+            })
+    return trace
+
+
+def attribution(merged: List[Dict[str, Any]]) -> Dict[str, Dict[str, float]]:
+    """Per-verb time attribution over a merged event list: count, total
+    busy seconds, mean/max latency, total queue wait and bytes. This is the
+    table ``bench.py --flight`` prints next to the BENCH json."""
+    out: Dict[str, Dict[str, float]] = {}
+    for e in merged:
+        rec = out.setdefault(e["verb"], {
+            "count": 0, "total_s": 0.0, "max_ms": 0.0,
+            "queue_wait_s": 0.0, "bytes": 0,
+        })
+        rec["count"] += 1
+        rec["total_s"] += e["dur"]
+        rec["max_ms"] = max(rec["max_ms"], e["dur"] * 1e3)
+        rec["queue_wait_s"] += e["qw"]
+        rec["bytes"] += int(e["nbytes"] or 0)
+    for rec in out.values():
+        rec["mean_ms"] = (
+            rec["total_s"] * 1e3 / rec["count"] if rec["count"] else 0.0
+        )
+    return out
+
+
+def format_attribution(attrib: Dict[str, Dict[str, float]]) -> str:
+    """Fixed-width table of :func:`attribution`, heaviest verbs first."""
+    rows = sorted(attrib.items(), key=lambda kv: -kv[1]["total_s"])
+    lines = [
+        f"{'verb':<28}{'count':>9}{'total_s':>10}{'mean_ms':>9}"
+        f"{'max_ms':>9}{'qwait_s':>9}{'MB':>8}"
+    ]
+    for verb, r in rows:
+        lines.append(
+            f"{verb:<28}{r['count']:>9}{r['total_s']:>10.3f}"
+            f"{r['mean_ms']:>9.3f}{r['max_ms']:>9.1f}"
+            f"{r['queue_wait_s']:>9.3f}{r['bytes'] / 1e6:>8.1f}"
+        )
+    return "\n".join(lines)
+
+
+def _load_env():
+    """Process-start configuration (RT_FLIGHT_ENABLED / flight_enabled via
+    rt_config, propagated to spawned workers through the environment)."""
+    try:
+        from ray_tpu._private.config import rt_config
+
+        if rt_config.flight_enabled:
+            enable(int(rt_config.flight_ring_size))
+    except Exception as e:
+        logger.debug("flight env config unavailable: %s", e)
+
+
+_load_env()

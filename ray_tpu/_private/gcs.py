@@ -29,7 +29,7 @@ from collections import OrderedDict, defaultdict, deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
-from ray_tpu._private import faultpoints, protocol
+from ray_tpu._private import faultpoints, flight, protocol
 from ray_tpu._private.ids import ActorID, NodeID, PlacementGroupID
 
 logger = logging.getLogger(__name__)
@@ -403,6 +403,27 @@ class HeadService:
     # ------------------------------------------------------------- dispatcher
 
     async def _handle(self, method, header, frames, conn):
+        if not flight.ENABLED:
+            return await self._handle_inner(method, header, frames, conn)
+        # Per-verb dispatch span with queue wait (message arrival → handler
+        # start, i.e. head event-loop backlog) recorded separately from
+        # handler time — the breakdown the two ROADMAP perf items need.
+        t0 = time.monotonic()
+        arr = header.get("_fr") or t0
+        try:
+            out = await self._handle_inner(method, header, frames, conn)
+        except faultpoints.DropReply:
+            flight.record_dispatch(f"gcs.{method}", "head", header, arr,
+                                   t0, 0, "drop_reply")
+            raise
+        except BaseException as e:
+            flight.record_dispatch(f"gcs.{method}", "head", header, arr,
+                                   t0, 0, f"error:{type(e).__name__}")
+            raise
+        flight.record_dispatch(f"gcs.{method}", "head", header, arr, t0)
+        return out
+
+    async def _handle_inner(self, method, header, frames, conn):
         fn = getattr(self, "rpc_" + method, None)
         if fn is None:
             raise protocol.RpcError(f"unknown head rpc {method}")
@@ -714,6 +735,78 @@ class HeadService:
         # stack tool exists for) costs one timeout, not one per dead node
         results = await asyncio.gather(*(one(n) for n in alive))
         return {"nodes": dict(results)}, []
+
+    async def rpc_flight_snapshot(self, h, frames, conn):
+        """Fan ``flight_drain`` out to every alive node and return the
+        clock-annotated per-process snapshots (this process's ring first).
+
+        Each node snapshot gets an ``offset``: seconds to add to its wall
+        times to land on the head's clock, estimated Cristian-style from
+        the drain RPC midpoint vs. the node's reported wall clock — so the
+        merged trace (flight.merge_snapshots) is head-clock aligned."""
+        drain = bool(h.get("drain", True))
+        local = flight.drain() if drain else flight.snapshot()
+        local["offset"] = 0.0
+        # Drain every connected PROCESS, not just registered nodes:
+        # remote drivers (init(address=...)) hold the submission-side
+        # spans — exactly the costs this instrument measures. Every peer
+        # with a CoreWorker answers flight_drain; tool clients (sync CLI,
+        # dashboard) reply without a "flight" payload and are skipped.
+        targets = {}
+        for n in self.nodes.values():
+            if n.alive and n.conn is not None:
+                targets[id(n.conn)] = (n.conn, n.node_id[:8])
+        for conn in (self.server.connections if self.server else ()):
+            targets.setdefault(id(conn), (conn, None))
+
+        async def one(conn, label):
+            t_send = time.time()
+            try:
+                hh, _ = await asyncio.wait_for(
+                    conn.call("flight_drain", {"drain": drain}),
+                    timeout=10,
+                )
+            except (asyncio.TimeoutError, protocol.RpcError,
+                    protocol.ConnectionLost, OSError) as e:
+                logger.debug("flight_drain from %s failed: %s",
+                             label or conn.name, e)
+                return None
+            t_recv = time.time()
+            s = hh.get("flight")
+            if not s:
+                return None
+            s["offset"] = (t_send + t_recv) / 2.0 - float(
+                s.get("now") or t_recv
+            )
+            if label:
+                s.setdefault("proc", label)
+            elif s.get("proc") == "driver":
+                # Remote drivers: keep their track groups distinct.
+                s["proc"] = f"driver-{s.get('pid')}"
+            return s
+
+        results = await asyncio.gather(
+            *(one(conn, label) for conn, label in targets.values())
+        )
+        # One snapshot per PROCESS: a peer reachable over two connections
+        # answers the drain once with events, once empty — keep the
+        # fuller reply (and never this process twice). Keyed by the
+        # recorder's process token, not the OS pid: pids collide across
+        # hosts.
+        def skey(s):
+            return s.get("token") or ("pid", s.get("pid"))
+
+        by_proc = {skey(local): local}
+        for s in results:
+            if not s:
+                continue
+            prev = by_proc.get(skey(s))
+            if prev is None or len(s.get("events") or ()) > len(
+                prev.get("events") or ()
+            ):
+                by_proc[skey(s)] = s
+        return {"snapshots": list(by_proc.values()),
+                "enabled": flight.ENABLED}, []
 
     async def rpc_node_debug(self, h, frames, conn):
         """Relay a debug RPC (memory_profile, dump_stacks) to one node."""

@@ -18,11 +18,12 @@ from __future__ import annotations
 import asyncio
 import logging
 import struct
+import time
 from typing import Awaitable, Callable, Dict, List, Optional, Tuple
 
 import msgpack
 
-from ray_tpu._private import faultpoints
+from ray_tpu._private import faultpoints, flight
 
 logger = logging.getLogger(__name__)
 
@@ -209,6 +210,10 @@ class Connection:
                         else:
                             fut.set_result((header, frames))
                 else:
+                    if flight.ENABLED:
+                        # Arrival stamp: dispatch-side spans (and the head's
+                        # queue-wait attribution) measure from here.
+                        header["_fr"] = time.monotonic()
                     asyncio.get_running_loop().create_task(
                         self._dispatch(header, frames)
                     )
@@ -240,6 +245,12 @@ class Connection:
 
     async def _dispatch(self, header: dict, frames: List[bytes]):
         reply_header = {"i": header["i"], "r": 1}
+        fl = flight.ENABLED
+        if fl:
+            t_arr = header.get("_fr") or time.monotonic()
+            t_run = time.monotonic()
+            fl_verb = f"rpc.s.{header.get('m')}"
+            fl_out = "ok"
         try:
             extras, reply_frames = await self.handler(
                 header["m"], header, frames, self
@@ -249,6 +260,9 @@ class Connection:
         except faultpoints.DropReply:
             # Injected applied-but-unacknowledged failure: the handler ran
             # to completion, the caller gets silence (then a timeout).
+            if fl:
+                flight.record_dispatch(fl_verb, "server", header, t_arr,
+                                       t_run, 0, "drop_reply")
             return
         except Exception as e:
             logger.debug("handler error for %s: %s", header.get("m"), e, exc_info=True)
@@ -257,6 +271,13 @@ class Connection:
             if code is not None:
                 reply_header["ec"] = code
             reply_frames = []
+            if fl:
+                fl_out = f"error:{type(e).__name__}"
+        if fl:
+            flight.record_dispatch(
+                fl_verb, "server", header, t_arr, t_run,
+                sum(len(f) for f in reply_frames), fl_out,
+            )
         if header.get("oneway"):
             return
         try:
@@ -334,6 +355,15 @@ class Connection:
         header = {"i": cid, "m": method}
         if extras:
             header.update(extras)
+        fl = flight.ENABLED
+        if fl:
+            # Join key for the peer's server-side span: PR 3's correlation
+            # id when the verb carries one, else a fresh flight id.
+            fl_cid = header.get("corr") or header.get("fid")
+            if fl_cid is None:
+                fl_cid = header["fid"] = flight.next_id()
+            fl_t0 = time.monotonic()
+            fl_bytes = sum(len(f) for f in frames)
         fut = asyncio.get_running_loop().create_future()
         self._pending[cid] = fut
         try:
@@ -354,7 +384,17 @@ class Connection:
             self._pending.pop(cid, None)
             raise
         try:
-            return await fut
+            res = await fut
+            if fl:
+                flight.record(f"rpc.c.{method}", fl_cid, "client", fl_t0,
+                              time.monotonic(), fl_bytes, "ok")
+            return res
+        except RpcError as e:
+            if fl:
+                flight.record(f"rpc.c.{method}", fl_cid, "client", fl_t0,
+                              time.monotonic(), fl_bytes,
+                              f"error:{type(e).__name__}")
+            raise
         finally:
             # A cancelled wait (deadline-bounded callers wrap this in
             # wait_for) must not leave a dead entry keyed by cid for the

@@ -27,9 +27,10 @@ import asyncio
 import itertools
 import logging
 import threading
+import time
 from typing import Callable, List, Optional, Tuple
 
-from ray_tpu._private import faultpoints, protocol
+from ray_tpu._private import faultpoints, flight, protocol
 from ray_tpu.native.ring import (
     NativeRing,
     RingClosed,
@@ -189,21 +190,42 @@ class RingConnection:
     def _send_auto(self, header: dict, frames):
         """Route to the non-blocking loop path or the blocking thread path
         depending on the calling thread."""
+        fl = flight.ENABLED
+        if fl:
+            fl_t0 = time.monotonic()
+            # No fallback to the per-connection integer id: those collide
+            # across connections and would fabricate cross-process joins.
+            fl_cid = header.get("corr") or header.get("fid")
+            fl_bytes = sum(len(f) for f in frames)
         if faultpoints.ACTIVE:
             # drop: the message silently never enters the ring; error
             # surfaces as the transport failure callers already handle.
             if faultpoints.fire(
                 "ring.push", err=protocol.ConnectionLost
             ) == "drop":
+                if fl:
+                    # record() picks up the fault stamp note_fault just set
+                    flight.record("ring.push", fl_cid, "ring", fl_t0,
+                                  time.monotonic(), fl_bytes, "ok")
                 return
         try:
             on_loop = asyncio.get_running_loop() is self.loop
         except RuntimeError:
             on_loop = False
-        if on_loop:
-            self._send_from_loop(header, list(frames))
-        else:
-            self._send(header, list(frames))
+        try:
+            if on_loop:
+                self._send_from_loop(header, list(frames))
+            else:
+                self._send(header, list(frames))
+        except (protocol.RpcError, OSError) as e:
+            if fl:
+                flight.record("ring.push", fl_cid, "ring", fl_t0,
+                              time.monotonic(), fl_bytes,
+                              f"error:{type(e).__name__}")
+            raise
+        if fl:
+            flight.record("ring.push", fl_cid, "ring", fl_t0,
+                          time.monotonic(), fl_bytes, "ok")
 
     async def call(
         self, method: str, extras: Optional[dict] = None, frames=()
@@ -214,6 +236,8 @@ class RingConnection:
         header = {"i": cid, "m": method}
         if extras:
             header.update(extras)
+        if flight.ENABLED and "corr" not in header and "fid" not in header:
+            header["fid"] = flight.next_id()
         fut = self.loop.create_future()
         with self._plock:
             self._pending[cid] = fut
@@ -223,7 +247,13 @@ class RingConnection:
             with self._plock:
                 self._pending.pop(cid, None)
             raise
-        return await fut
+        try:
+            return await fut
+        finally:
+            # A deadline-bounded caller (wait_for) cancelling this wait
+            # must not leave a dead pending entry until teardown.
+            with self._plock:
+                self._pending.pop(cid, None)
 
     def notify(self, method: str, extras: Optional[dict] = None, frames=()):
         header = {"i": next(self._ids), "m": method, "oneway": 1}
@@ -246,13 +276,17 @@ class RingConnection:
         subs = []
         counts = []
         all_frames: List[bytes] = []
+        fl = flight.ENABLED
         with self._plock:
             for extras, frames in items:
                 cid = next(self._ids)
                 fut = self.loop.create_future()
                 self._pending[cid] = fut
                 futs.append(fut)
-                subs.append({"i": cid, **(extras or {})})
+                sub = {"i": cid, **(extras or {})}
+                if fl and "corr" not in sub and "fid" not in sub:
+                    sub["fid"] = flight.next_id()
+                subs.append(sub)
                 counts.append(len(frames))
                 all_frames.extend(frames)
         header = {
@@ -348,6 +382,16 @@ class RingConnection:
                         logger.exception("ring %s: undecodable message",
                                          self.name)
                         continue
+                    if flight.ENABLED:
+                        t_pop = time.monotonic()
+                        header["_fr"] = t_pop
+                        flight.record(
+                            "ring.pop",
+                            header.get("corr") or header.get("fid"),
+                            "ring", t_pop, t_pop, len(m),
+                            "reply" if header.get("r")
+                            else str(header.get("m")),
+                        )
                     if header.get("r"):
                         if "bh" in header:
                             # Batched reply: sub-replies ride one message,
@@ -367,6 +411,8 @@ class RingConnection:
                         subs = []
                         for sub, n in zip(header["bh"], header["bn"]):
                             sub["m"] = method
+                            if flight.ENABLED:
+                                sub["_fr"] = header.get("_fr")
                             subs.append((sub, frames[pos:pos + n]))
                             pos += n
                         if self.fast_batch is not None:
@@ -415,6 +461,12 @@ class RingConnection:
 
     async def _handle_slow(self, header: dict, frames: List[bytes]):
         reply = {"i": header["i"], "r": 1}
+        fl = flight.ENABLED
+        if fl:
+            t_arr = header.get("_fr") or time.monotonic()
+            t_run = time.monotonic()
+            fl_verb = f"rpc.s.{header.get('m')}"
+            fl_out = "ok"
         try:
             extras, rframes = await self.handler(
                 header["m"], header, frames, self
@@ -422,6 +474,9 @@ class RingConnection:
             if extras:
                 reply.update(extras)
         except faultpoints.DropReply:
+            if fl:
+                flight.record_dispatch(fl_verb, "server", header, t_arr,
+                                       t_run, 0, "drop_reply")
             return  # injected: verb applied, reply swallowed
         except Exception as e:
             reply["e"] = f"{type(e).__name__}: {e}"
@@ -429,6 +484,13 @@ class RingConnection:
             if code is not None:
                 reply["ec"] = code
             rframes = []
+            if fl:
+                fl_out = f"error:{type(e).__name__}"
+        if fl:
+            flight.record_dispatch(
+                fl_verb, "server", header, t_arr, t_run,
+                sum(len(f) for f in rframes), fl_out,
+            )
         if header.get("oneway"):
             return
         self.send_reply(reply, rframes)

@@ -43,7 +43,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import cloudpickle
 
 from ray_tpu import exceptions as exc
-from ray_tpu._private import faultpoints, protocol
+from ray_tpu._private import faultpoints, flight, protocol
 from ray_tpu._private.backoff import Backoff
 from ray_tpu._private.ids import (
     ActorID,
@@ -59,6 +59,9 @@ from ray_tpu._private.serialization import SerializationContext
 from ray_tpu.object_ref import ObjectRef, collect_refs_during
 
 logger = logging.getLogger(__name__)
+
+# In-flight marker for the actor-push corr-dedup cache (_apush_begin).
+_APUSH_WIP = object()
 
 
 def _lineage_bytes_limit() -> int:
@@ -343,6 +346,17 @@ class CoreWorker:
         self._memory_monitor = MemoryMonitor()
         self.runtime_env: dict = {}
         self.pubsub_handlers: Dict[str, List[Any]] = {}
+        # Correlation-id dedup for retried push_actor_task (mirrors the
+        # head's _corr_replies, but thread-safe: the ring fast paths
+        # execute and reply off-loop). corr -> _APUSH_WIP (executing) |
+        # SyncFuture (a retry is waiting on the execution) |
+        # (extras, frames) completed reply, in a bounded LRU. Only
+        # successful replies are cached; failures are retried for real.
+        self._apush_replies: "OrderedDict[str, Any]" = OrderedDict()
+        self._apush_lock = threading.Lock()
+        self._APUSH_CACHE = 256
+        # Flight-recorder process label for merged cross-process traces.
+        flight.set_label("driver" if is_driver else self.node_id[:8])
 
     @property
     def shm(self) -> HybridShmStore:
@@ -753,21 +767,47 @@ class CoreWorker:
         extras = dict(extras or {})
         if corr:
             extras["corr"] = os.urandom(8).hex()
+        fl = flight.ENABLED
+        if fl and "corr" not in extras:
+            # One flight id for every attempt of this logical request: the
+            # head-side dispatch span joins on it.
+            extras["fid"] = flight.next_id()
+        fl_cid = extras.get("corr") or extras.get("fid")
         retry = Backoff(base=0.05, cap=2.0)
         attempt = 0
         while True:
+            if fl:
+                fl_t0 = time.monotonic()
             try:
                 conn = self.gcs
                 if conn is None or conn._closed:
                     raise protocol.ConnectionLost("head connection down")
-                return await asyncio.wait_for(
+                res = await asyncio.wait_for(
                     conn.call(method, extras, list(frames)), timeout
                 )
+                if fl:
+                    flight.record(
+                        f"head.{method}", fl_cid, "client", fl_t0,
+                        time.monotonic(), 0,
+                        "ok" if attempt == 0 else f"ok:attempt{attempt + 1}",
+                    )
+                return res
             except asyncio.TimeoutError as e:
                 last: Exception = e
+                if fl:
+                    flight.record(f"head.{method}", fl_cid, "client",
+                                  fl_t0, time.monotonic(), 0, "timeout")
             except (protocol.ConnectionLost, OSError) as e:
                 last = e
+                if fl:
+                    flight.record(f"head.{method}", fl_cid, "client",
+                                  fl_t0, time.monotonic(), 0,
+                                  f"error:{type(e).__name__}")
             except protocol.RpcError as e:
+                if fl:
+                    flight.record(f"head.{method}", fl_cid, "client",
+                                  fl_t0, time.monotonic(), 0,
+                                  f"error:{type(e).__name__}")
                 # Application errors are terminal; only the transient
                 # unavailability class is worth re-issuing.
                 if getattr(e, "code", None) != "unavailable":
@@ -1120,10 +1160,25 @@ class CoreWorker:
         out: List[bytes] = []
         exited = False
         for method, (h, frames) in zip(methods, run):
+            corr = h.get("corr")
+            state, obj = self._apush_begin(corr)
+            if state != "mine":
+                # Duplicate delivery inside an admitted run (should not
+                # pass the consecutive-seq gate, but replay is always
+                # safe; "wait" twins reply from their own path).
+                if state == "replay":
+                    extras, fr = obj
+                    subs.append({"i": h["i"], **dict(extras)})
+                    counts.append(len(fr))
+                    out.extend(fr)
+                continue
             # inst.exiting: a concurrent ray-kill must stop the rest of
             # the run the way it would have cancelled still-queued
             # per-item futures.
             if exited or inst.exiting:
+                self._apush_fail(
+                    corr, protocol.RpcError("ActorMissing: actor exited")
+                )
                 subs.append(
                     {"i": h["i"], "e": "ActorMissing: actor exited"}
                 )
@@ -1132,6 +1187,9 @@ class CoreWorker:
             t0 = time.time()
             res = self._exec_actor_call(inst, method, h, frames)
             if res == "exited":
+                self._apush_fail(
+                    corr, protocol.RpcError("ActorMissing: actor exited")
+                )
                 subs.append(
                     {"i": h["i"], "e": "ActorMissing: actor exited"}
                 )
@@ -1145,6 +1203,7 @@ class CoreWorker:
                 )
             except Exception as e:
                 logger.exception("actor chunk reply packaging failed")
+                self._apush_fail(corr, e)
                 subs.append(
                     {"i": h["i"], "e": f"reply packaging failed: {e!r}"}
                 )
@@ -1162,6 +1221,7 @@ class CoreWorker:
             if big:
                 self._ring_reply_packaged(h, rets, out_frames, big, rconn)
             else:
+                self._apush_done(corr, {"rets": rets}, out_frames)
                 subs.append({"i": h["i"], "rets": rets})
                 counts.append(len(out_frames))
                 out.extend(out_frames)
@@ -1172,6 +1232,11 @@ class CoreWorker:
         """The fast-path per-task execution core, shared by the batched and
         per-item paths (they must never diverge): deserialize ref-free
         args, set task-locals, run, two-level exception guard."""
+        if faultpoints.ACTIVE:
+            # delay/crash only (catalog): both behave identically to the
+            # slow path's hook, so a chaos spec means the same thing on
+            # either transport.
+            faultpoints.fire("worker.task.exec")
         try:
             arg_slots, plain, kwargs = self.ctx.deserialize_frames(frames)
             args = [plain[i] for _k, i in arg_slots]  # eligibility: no refs
@@ -1248,6 +1313,7 @@ class CoreWorker:
             rets, out_frames, big = self._package_result_parts(h, ok, result)
         except Exception as e:
             logger.exception("ring task reply failed")
+            self._apush_fail(h.get("corr"), e)
             rconn.send_reply(
                 {"i": h["i"], "r": 1, "e": f"reply packaging failed: {e!r}"},
                 [],
@@ -1283,23 +1349,29 @@ class CoreWorker:
                                 "object_register", {"oid": oid, "meta": meta}
                             )
                     except Exception as e:
+                        self._apush_fail(h.get("corr"), e)
                         rconn.send_reply(
                             {"i": h["i"], "r": 1,
                              "e": f"result registration failed: {e!r}"},
                             [],
                         )
                         return
+                    # Cache before send: the shm metas replay cheaply.
+                    self._apush_done(h.get("corr"), {"rets": rets},
+                                     out_frames)
                     rconn.send_reply(
                         {"i": h["i"], "r": 1, "rets": rets}, out_frames
                     )
 
                 asyncio.run_coroutine_threadsafe(finish(), self.loop)
             else:
+                self._apush_done(h.get("corr"), {"rets": rets}, out_frames)
                 rconn.send_reply(
                     {"i": h["i"], "r": 1, "rets": rets}, out_frames
                 )
         except Exception as e:
             logger.exception("ring task reply failed")
+            self._apush_fail(h.get("corr"), e)
             rconn.send_reply(
                 {"i": h["i"], "r": 1, "e": f"reply packaging failed: {e!r}"},
                 [],
@@ -1366,10 +1438,24 @@ class CoreWorker:
         return True
 
     def _ring_execute_actor_task(self, inst, method, h, frames, rconn):
+        corr = h.get("corr")
+        state, obj = self._apush_begin(corr)
+        if state != "mine":
+            # A duplicate delivery raced past the seq gate: replay the
+            # finished outcome; an in-flight twin ("wait") will reply
+            # itself — never execute the method a second time.
+            if state == "replay":
+                extras, fr = obj
+                rconn.send_reply({"i": h["i"], "r": 1, **dict(extras)},
+                                 list(fr))
+            return
         t0 = time.time()
         res = self._exec_actor_call(inst, method, h, frames)
         if res == "exited":
             # exit_actor(): mirror the slow path's clean-exit protocol.
+            self._apush_fail(
+                corr, protocol.RpcError("ActorMissing: actor exited")
+            )
             rconn.send_reply(
                 {"i": h["i"], "r": 1, "e": "ActorMissing: actor exited"},
                 [],
@@ -1391,6 +1477,14 @@ class CoreWorker:
         """Coroutine twin of _ring_execute_actor_task: runs ON the dedicated
         async-actor loop, gated by the async-side semaphore (shared with the
         slow path's coroutine branch)."""
+        corr = h.get("corr")
+        state, obj = self._apush_begin(corr)
+        if state != "mine":
+            if state == "replay":
+                extras, fr = obj
+                rconn.send_reply({"i": h["i"], "r": 1, **dict(extras)},
+                                 list(fr))
+            return
         t0 = time.time()
         try:
             async with inst.async_sem:
@@ -1409,6 +1503,10 @@ class CoreWorker:
                         "actor_exited",
                         {"actor_id": h["aid"], "clean": True,
                          "reason": "exit_actor"},
+                    )
+                    self._apush_fail(
+                        corr,
+                        protocol.RpcError("ActorMissing: actor exited"),
                     )
                     rconn.send_reply(
                         {"i": h["i"], "r": 1,
@@ -1974,19 +2072,35 @@ class CoreWorker:
         the per-ref path, not pin the whole get() forever."""
         from ray_tpu._private.config import rt_config
 
+        fl = flight.ENABLED
+        if fl:
+            fl_t0 = time.monotonic()
+            fl_fid = flight.next_id()
         try:
             if faultpoints.ACTIVE:
                 if await faultpoints.async_fire("worker.pull") == "drop":
                     return  # reply lost; per-ref path takes over
             conn = await self.get_peer(owner)
-            call = conn.call("pull_object_batch", {"oids": oids})
+            extras = {"oids": oids}
+            if fl:
+                extras["fid"] = fl_fid
+            call = conn.call("pull_object_batch", extras)
             tmo = float(rt_config.rpc_deadline_s)
             if deadline is not None:
                 tmo = min(tmo, max(deadline - time.monotonic(), 0))
             hh, frames = await asyncio.wait_for(call, tmo)
         except (asyncio.TimeoutError, protocol.RpcError,
-                protocol.ConnectionLost, ConnectionRefusedError, OSError):
+                protocol.ConnectionLost, ConnectionRefusedError,
+                OSError) as e:
+            if fl:
+                flight.record("worker.pull_batch", fl_fid, "worker", fl_t0,
+                              time.monotonic(), 0,
+                              f"error:{type(e).__name__}")
             return
+        if fl:
+            flight.record("worker.pull_batch", fl_fid, "worker", fl_t0,
+                          time.monotonic(), sum(len(f) for f in frames),
+                          "ok")
         res = hh.get("res") or []
         per_obj = protocol.unpack_multi_frames(
             [r.get("n", 0) for r in res], frames
@@ -2227,7 +2341,16 @@ class CoreWorker:
         attempt_s = float(rt_config.rpc_deadline_s)
         conn_failures = 0
         retry = Backoff(base=0.05, cap=1.0)
+        pull_extras = {"oid": hex_, "inline": inline,
+                       "direct": addr is not None}
+        fl = flight.ENABLED
+        if fl:
+            # One join key for every re-armed attempt of this pull; the
+            # owner's server-side span shares it.
+            pull_extras["fid"] = flight.next_id()
         while True:
+            if fl:
+                fl_t0 = time.monotonic()
             try:
                 if faultpoints.ACTIVE:
                     if await faultpoints.async_fire("worker.pull") == "drop":
@@ -2239,15 +2362,19 @@ class CoreWorker:
                 if deadline is not None:
                     tmo = min(tmo, max(deadline - time.monotonic(), 0))
                 hh, frames = await asyncio.wait_for(
-                    conn.call(
-                        "pull_object",
-                        {"oid": hex_, "inline": inline,
-                         "direct": addr is not None},
-                    ),
+                    conn.call("pull_object", pull_extras),
                     tmo,
                 )
+                if fl:
+                    flight.record("worker.pull", pull_extras.get("fid"),
+                                  "worker", fl_t0, time.monotonic(),
+                                  sum(len(f) for f in frames), "ok")
                 break
             except asyncio.TimeoutError:
+                if fl:
+                    flight.record("worker.pull", pull_extras.get("fid"),
+                                  "worker", fl_t0, time.monotonic(), 0,
+                                  "timeout")
                 if deadline is not None and time.monotonic() >= deadline:
                     raise exc.GetTimeoutError(
                         f"get() timed out pulling {hex_}"
@@ -2931,6 +3058,7 @@ class CoreWorker:
                    and not slot.draining):
                 chunk: List[tuple] = []
                 fut = None
+                fl_t0 = time.monotonic()  # refined once the chunk is built
                 try:
                     ring = await self.get_ring(slot.addr)
                     if not lease_set.pending:
@@ -2964,6 +3092,12 @@ class CoreWorker:
                             chunk.append(lease_set.pending.popleft())
                     if not chunk:
                         continue
+                    fl = flight.ENABLED
+                    if fl:
+                        fl_t0 = time.monotonic()
+                        fl_bytes = sum(
+                            len(fr) for _h, fs, _f in chunk for fr in fs
+                        )
                     if faultpoints.ACTIVE:
                         # error = ConnectionLost into the outer handler:
                         # slots dropped + released, every chunk future
@@ -2979,6 +3113,13 @@ class CoreWorker:
                         self._handle_task_reply(header, h, rframes)
                         if not fut.done():
                             fut.set_result(None)
+                        if fl:
+                            # Span covers push → reply, i.e. dispatch +
+                            # execution on the leased slot.
+                            flight.record("worker.task.push",
+                                          header.get("tid"), "worker",
+                                          fl_t0, time.monotonic(),
+                                          fl_bytes, "ok")
                         continue
 
                     try:
@@ -3032,10 +3173,20 @@ class CoreWorker:
                         self._handle_task_reply(header, h, rframes)
                         if not fut.done():
                             fut.set_result(None)
+                    if fl:
+                        flight.record("worker.task.push",
+                                      chunk[0][0].get("tid"), "worker",
+                                      fl_t0, time.monotonic(), fl_bytes,
+                                      f"ok:batch{len(chunk)}")
                     if stop:
                         return
                 except (protocol.ConnectionLost, ConnectionRefusedError,
                         OSError):
+                    if flight.ENABLED and chunk:
+                        flight.record("worker.task.push",
+                                      chunk[0][0].get("tid"), "worker",
+                                      fl_t0, time.monotonic(), 0,
+                                      "error:ConnectionLost")
                     self._pusher_node_lost(
                         lease_set, slot, [c[2] for c in chunk]
                     )
@@ -3305,7 +3456,22 @@ class CoreWorker:
             )
 
     async def _dispatch_actor_task_inner(self, header, frames, retries):
+        from ray_tpu._private.config import rt_config
+
         ch = self.get_actor_channel(header["aid"])
+        # One correlation id per LOGICAL call, shared by every delivery
+        # attempt: the hosting worker dedups on it, so a reply dropped
+        # AFTER the method ran is replayed on retry — never re-applied
+        # (same contract as the head's lease/create_actor corr dedup).
+        header["corr"] = os.urandom(8).hex()
+        # Per-attempt reply deadline: a lost push or dropped reply used to
+        # hang until actor-liveness polling noticed; now each attempt is
+        # bounded and re-issues with jittered backoff while the actor
+        # stays ALIVE (long-running methods keep re-arming — the deadline
+        # bounds silence detection, not method runtime).
+        attempt_s = float(rt_config.rpc_deadline_s)
+        rearm = Backoff(base=0.05, cap=2.0)
+        sent_epoch = None
         attempt = 0
         while True:
             try:
@@ -3319,12 +3485,22 @@ class CoreWorker:
                 # reordering actor calls under load.)
                 async with ch.lock:
                     conn = await self._actor_conn(ch)
-                    ch.seq += 1
-                    header["seq"] = ch.seq
-                    # The ordering domain is (caller, connection epoch): a
-                    # reconnect starts a fresh contiguous seq stream and the
-                    # server must not mix it with the old stream's cursor.
-                    header["caller"] = f"{self.worker_id.hex()}:{ch.epoch}"
+                    if sent_epoch != ch.epoch:
+                        # First attempt on this ordering domain: take a
+                        # seq. A timeout-retry on the SAME connection
+                        # re-sends the SAME (caller, seq, corr) so the
+                        # server's in-order admission and dedup both see
+                        # one logical call.
+                        ch.seq += 1
+                        header["seq"] = ch.seq
+                        # The ordering domain is (caller, connection
+                        # epoch): a reconnect starts a fresh contiguous
+                        # seq stream and the server must not mix it with
+                        # the old stream's cursor.
+                        header["caller"] = (
+                            f"{self.worker_id.hex()}:{ch.epoch}"
+                        )
+                        sent_epoch = ch.epoch
                 max_msg = getattr(conn, "max_msg", None)
                 if (
                     max_msg is not None
@@ -3333,11 +3509,50 @@ class CoreWorker:
                     # Oversized for the ring: this call rides TCP. Server-side
                     # seq admission keeps ordering across the two transports.
                     conn = await self.get_peer(ch.addr)
-                h, rframes = await self._call_with_tcp_fallback(
-                    conn, ch.addr, "push_actor_task", header, frames
+                fl = flight.ENABLED
+                if fl:
+                    fl_t0 = time.monotonic()
+                if faultpoints.ACTIVE:
+                    # drop: the push never reaches the actor worker — the
+                    # reply deadline below fires and the corr-tagged retry
+                    # re-delivers exactly once.
+                    if await faultpoints.async_fire(
+                        "worker.actor.push", err=protocol.ConnectionLost
+                    ) == "drop":
+                        raise asyncio.TimeoutError()
+                h, rframes = await asyncio.wait_for(
+                    self._call_with_tcp_fallback(
+                        conn, ch.addr, "push_actor_task", header, frames
+                    ),
+                    attempt_s,
                 )
+                if fl:
+                    flight.record("worker.actor.push", header["corr"],
+                                  "worker", fl_t0, time.monotonic(), 0,
+                                  "ok")
                 self._handle_task_reply(header, h, rframes)
                 return
+            except asyncio.TimeoutError:
+                if fl:
+                    flight.record("worker.actor.push", header["corr"],
+                                  "worker", fl_t0, time.monotonic(), 0,
+                                  "timeout")
+                # No reply inside the deadline: the request or its reply
+                # was lost, or the method is still running. Either way a
+                # re-issue is safe (receiver-side corr dedup attaches to
+                # the in-flight execution or replays the finished reply),
+                # so keep re-arming while the actor is ALIVE — liveness,
+                # not a retry count, bounds this (long methods are legal).
+                alive = await self._await_actor_alive(ch)
+                if not alive:
+                    self._fail_task(
+                        header,
+                        exc.ActorDiedError(
+                            header["aid"], ch.death_reason or "died"
+                        ),
+                    )
+                    return
+                await asyncio.sleep(rearm.next_delay())
             except (protocol.ConnectionLost, ConnectionRefusedError, OSError):
                 ch.conn = None
                 alive = await self._await_actor_alive(ch)
@@ -3983,6 +4198,11 @@ class CoreWorker:
             finally:
                 self._restore_env(old)
 
+        if faultpoints.ACTIVE:
+            # crash = this worker process dies mid-dispatch (after the
+            # lease was consumed, before any reply) — the hard partial
+            # failure the chaos matrix exercises.
+            await faultpoints.async_fire("worker.task.exec")
         t0 = time.time()
         ok, result = await loop.run_in_executor(self.task_executor, run)
         self._stats["tasks_executed"] += 1
@@ -4425,6 +4645,84 @@ class CoreWorker:
                 pool.shutdown(wait=False, cancel_futures=True)
         return {}, []
 
+    # Correlation-id dedup for actor-call pushes. The sender retries a
+    # push whose reply missed its deadline; the retry re-delivers the same
+    # (corr, caller, seq). In-order admission routes such duplicates off
+    # the ring fast path (seq < cursor), so they always land in
+    # rpc_push_actor_task — which must replay the original outcome, never
+    # run the method twice.
+
+    def _apush_begin(self, corr):
+        """Dedup gate. Returns ("mine", None) for a first delivery (caller
+        executes, then _apush_done/_apush_fail), ("replay", (extras,
+        frames)) for a duplicate of a completed call, or ("wait", fut) for
+        a duplicate of a still-executing call (a SyncFuture resolved by
+        the executing path). Thread-safe: the ring fast paths call this
+        from pump/executor threads."""
+        if not corr:
+            return ("mine", None)
+        with self._apush_lock:
+            e = self._apush_replies.get(corr)
+            if e is None:
+                self._apush_replies[corr] = _APUSH_WIP
+                return ("mine", None)
+            if e is _APUSH_WIP:
+                fut = SyncFuture()
+                self._apush_replies[corr] = fut
+                return ("wait", fut)
+            if isinstance(e, SyncFuture):
+                return ("wait", e)
+            return ("replay", (e[1], e[2]))
+
+    def _apush_trim_locked(self):
+        """Evict completed entries (oldest first) — but never one younger
+        than the sender's retry horizon (its duplicate may still be in
+        flight; evicting it would re-execute a non-idempotent method),
+        and never an in-flight marker (skipped by rotation, so one
+        long-running call cannot wedge eviction behind it and grow the
+        cache without bound). Beyond the hard cap, age no longer
+        protects: memory wins over an already-pathological retry."""
+        from ray_tpu._private.config import rt_config
+
+        horizon = 2.0 * float(rt_config.rpc_deadline_s) + 5.0
+        now = time.monotonic()
+        scanned = 0
+        while (len(self._apush_replies) > self._APUSH_CACHE
+               and scanned < 16):
+            k = next(iter(self._apush_replies))
+            v = self._apush_replies[k]
+            scanned += 1
+            if v is _APUSH_WIP or isinstance(v, SyncFuture):
+                self._apush_replies.move_to_end(k)
+                continue
+            if (now - v[0] < horizon
+                    and len(self._apush_replies) < 8 * self._APUSH_CACHE):
+                break
+            self._apush_replies.pop(k, None)
+
+    def _apush_done(self, corr, extras, frames):
+        """Cache a successful reply and wake any attached retry."""
+        if not corr:
+            return
+        with self._apush_lock:
+            e = self._apush_replies.get(corr)
+            self._apush_replies[corr] = (
+                time.monotonic(), extras, list(frames)
+            )
+            self._apush_trim_locked()
+        if isinstance(e, SyncFuture) and not e.done():
+            e.set_result((extras, list(frames)))
+
+    def _apush_fail(self, corr, err):
+        """A failed delivery is retried for real (only successes replay);
+        attached retries observe the failure."""
+        if not corr:
+            return
+        with self._apush_lock:
+            e = self._apush_replies.pop(corr, None)
+        if isinstance(e, SyncFuture) and not e.done():
+            e.set_exception(err)
+
     async def _admit_in_order(self, inst: _ActorInstance, caller: str, seq: int):
         if seq <= 0:
             return
@@ -4456,7 +4754,29 @@ class CoreWorker:
 
     async def rpc_push_actor_task(self, h, frames, conn):
         """Execute an actor method (reference: direct PushActorTask gRPC +
-        ordered TaskReceiver queues ``task_execution/*_queue.h``)."""
+        ordered TaskReceiver queues ``task_execution/*_queue.h``), with
+        correlation-id dedup: a retried delivery (reply dropped or
+        deadline-raced) replays the original outcome or attaches to the
+        in-flight execution — exactly-once application per corr id."""
+        corr = h.get("corr")
+        state, obj = self._apush_begin(corr)
+        if state == "replay":
+            extras, rframes = obj
+            return dict(extras), list(rframes)
+        if state == "wait":
+            extras, rframes = await asyncio.wrap_future(obj)
+            return dict(extras), list(rframes)
+        try:
+            extras, rframes = await self._push_actor_task_inner(
+                h, frames, conn
+            )
+        except BaseException as e:
+            self._apush_fail(corr, e)
+            raise
+        self._apush_done(corr, extras, rframes)
+        return extras, rframes
+
+    async def _push_actor_task_inner(self, h, frames, conn):
         inst = self.hosted_actors.get(h["aid"])
         if inst is None:
             raise protocol.RpcError(f"ActorMissing: actor {h['aid']} not hosted here")
@@ -4587,6 +4907,13 @@ class CoreWorker:
         ready.wait(timeout=10)
         self._async_actor_loop = holder["loop"]
         return self._async_actor_loop
+
+    async def rpc_flight_drain(self, h, frames, conn):
+        """Hand this process's flight-recorder ring to the head (the
+        ``flight_snapshot`` fan-out). The reply carries our wall clock so
+        the head can offset-correct our spans onto its own."""
+        snap = flight.drain() if h.get("drain", True) else flight.snapshot()
+        return {"flight": snap, "enabled": flight.ENABLED}, []
 
     async def rpc_dump_stacks(self, h, frames, conn):
         """All-thread stack dump (reference: py-spy via the reporter agent's

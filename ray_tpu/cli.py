@@ -272,10 +272,13 @@ def cmd_lint(args):
 
 
 def cmd_timeline(args):
-    """Dump task events as chrome://tracing JSON (reference: ray timeline)."""
+    """Dump task events as chrome://tracing JSON (reference: ray timeline).
+    ``--rpc`` interleaves flight-recorder RPC spans under the task spans
+    (absolute wall-clock timestamps keep the two layers aligned)."""
     from ray_tpu.util import state
 
-    events = state.list_tasks(_resolve_address(args), limit=100_000)
+    address = _resolve_address(args)
+    events = state.list_tasks(address, limit=100_000)
     trace = []
     for e in events:
         if "start_time" not in e:
@@ -289,9 +292,44 @@ def cmd_timeline(args):
             "pid": e.get("node_id", "node")[:8],
             "tid": e.get("worker_id", e.get("actor_id", "worker"))[:8],
         })
+    nrpc = 0
+    if getattr(args, "rpc", False):
+        from ray_tpu._private import flight
+
+        # drain=False: rendering a timeline must not consume the rings
+        # (a follow-up `rt flight` still sees the events).
+        merged = flight.merge_snapshots(
+            state.flight_snapshot(address, drain=False)
+        )
+        rpc_events = flight.to_chrome_trace(merged, t0=0.0)
+        nrpc = len(rpc_events)
+        trace.extend(rpc_events)
     with open(args.output, "w") as f:
         json.dump(trace, f)
-    print(f"wrote {len(trace)} events to {args.output}")
+    extra = f" (+{nrpc} rpc spans)" if nrpc else ""
+    print(f"wrote {len(trace)} events to {args.output}{extra}")
+
+
+def cmd_flight(args):
+    """Drain the cluster-wide RPC flight recorder into a Chrome
+    trace-event JSON (load in Perfetto or chrome://tracing). Recording
+    must be on (RT_FLIGHT_ENABLED=1 / _system_config flight_enabled)."""
+    from ray_tpu._private import flight
+    from ray_tpu.util import state
+
+    snaps = state.flight_snapshot(_resolve_address(args))
+    merged = flight.merge_snapshots(snaps)
+    trace = flight.to_chrome_trace(merged)
+    with open(args.output, "w") as f:
+        json.dump(trace, f)
+    procs = sorted({e["proc"] for e in merged})
+    print(f"wrote {len(trace)} trace events from {len(snaps)} process(es) "
+          f"{procs} to {args.output}")
+    if args.attrib:
+        print(flight.format_attribution(flight.attribution(merged)))
+    if not merged:
+        print("no events recorded — enable with RT_FLIGHT_ENABLED=1 "
+              "(or _system_config={'flight_enabled': True})")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -400,7 +438,20 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("timeline")
     sp.add_argument("--address", default=None)
     sp.add_argument("--output", default="timeline.json")
+    sp.add_argument("--rpc", action="store_true",
+                    help="interleave flight-recorder RPC spans under the "
+                         "task spans (needs RT_FLIGHT_ENABLED=1)")
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser(
+        "flight", help="drain the cross-process RPC flight recorder into "
+                       "a Chrome trace-event JSON (Perfetto-loadable)"
+    )
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--output", "-o", default="flight.json")
+    sp.add_argument("--attrib", action="store_true",
+                    help="also print a per-verb time-attribution table")
+    sp.set_defaults(fn=cmd_flight)
     return p
 
 

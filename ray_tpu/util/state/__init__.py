@@ -26,6 +26,15 @@ def _call(method: str, header: dict, address: Optional[str] = None,
     return w.run_sync(w.gcs.call(method, header), timeout)[0]
 
 
+def flight_snapshot(address: Optional[str] = None,
+                    drain: bool = True) -> List[dict]:
+    """Cluster-wide flight-recorder drain: the head fans ``flight_drain``
+    out to every node and returns clock-annotated per-process snapshots
+    (see ``ray_tpu._private.flight.merge_snapshots``)."""
+    h = _call("flight_snapshot", {"drain": drain}, address, timeout=60.0)
+    return h.get("snapshots", [])
+
+
 def _apply_filters(rows: List[dict], filters) -> List[dict]:
     """filters: [(key, op, value)] with op in ("=", "!=")."""
     for key, op, value in filters or ():

@@ -30,6 +30,16 @@ def pytest_configure(config):
     )
 
 
+@pytest.hookimpl(tryfirst=True, hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    """Expose per-phase reports on the item so fixtures can act on test
+    outcome during teardown (the chaos flight-trace dump in
+    test_faultpoints.py checks ``item.rep_call.failed``)."""
+    outcome = yield
+    rep = outcome.get_result()
+    setattr(item, "rep_" + rep.when, rep)
+
+
 @pytest.fixture(scope="session", autouse=True)
 def _assert_cpu_mesh():
     devs = jax.devices()

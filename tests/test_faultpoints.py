@@ -17,6 +17,7 @@ per-RPC injected failures (``RAY_testing_rpc_failure`` hooks consulted in
   dropped first post-restore lease reply must leave the head serving).
 """
 import asyncio
+import json
 import threading
 import time
 from concurrent.futures import TimeoutError as SyncTimeoutError
@@ -25,6 +26,7 @@ import pytest
 
 import ray_tpu
 from ray_tpu._private import faultpoints as fp
+from ray_tpu._private import flight
 from ray_tpu._private.test_utils import NodeKiller, wait_for_condition
 
 
@@ -33,6 +35,29 @@ def _clean_faults():
     fp.clear()
     yield
     fp.clear()
+
+
+@pytest.fixture
+def chaos_flight_trace(request, tmp_path):
+    """Chaos forensics: record the RPC plane during the test; on assertion
+    failure dump the fault-annotated trace as flight_<test>.json into the
+    tmp dir (faultpoint hits stamp their enclosing spans, so the trace
+    shows exactly where the injection plane bit)."""
+    flight.enable()
+    yield
+    rep = getattr(request.node, "rep_call", None)
+    try:
+        if rep is not None and rep.failed:
+            snap = flight.drain()
+            snap["offset"] = 0.0
+            trace = flight.to_chrome_trace(
+                flight.merge_snapshots([snap])
+            )
+            path = tmp_path / f"flight_{request.node.name}.json"
+            path.write_text(json.dumps(trace))
+            print(f"\n[chaos] wrote annotated flight trace to {path}")
+    finally:
+        flight.disable()
 
 
 @pytest.fixture
@@ -461,15 +486,17 @@ CHAOS_SPECS = [
     "gcs.dispatch.create_actor:drop:0.1:0:106",
     "gcs.dispatch.create_pg:drop:1.0:1:107",
     "protocol.rpc.reply:delay:0.2:0:108",
+    "worker.actor.push:drop:0.2:0:109",
 ]
 
 
 @pytest.mark.slow
 @pytest.mark.parametrize("spec", CHAOS_SPECS)
-def test_chaos_matrix(spec, monkeypatch):
+def test_chaos_matrix(spec, monkeypatch, chaos_flight_trace):
     """Core workloads complete under sustained injected faults at every
     major point, and the head's lease accounting converges back to full
-    capacity (no leaked leases)."""
+    capacity (no leaked leases). A failure dumps the fault-annotated
+    flight trace (chaos_flight_trace fixture)."""
     monkeypatch.setenv("RT_RPC_DEADLINE_S", "2")
     monkeypatch.setenv("RT_LEASE_REQUEST_TIMEOUT_S", "1")
     monkeypatch.setenv("RT_RPC_RETRIES", "6")
@@ -488,6 +515,56 @@ def test_chaos_matrix(spec, monkeypatch):
                            message=f"leaked leases under {spec}")
     finally:
         fp.clear()
+        ray_tpu.shutdown()
+
+
+@pytest.mark.slow
+def test_chaos_matrix_worker_crash(monkeypatch, chaos_flight_trace):
+    """The ``crash`` fault kind, exercised for real: a worker process
+    hard-exits (os._exit, the SIGKILL-equivalent) at its first task
+    execution — after the lease was consumed, before any reply. The
+    workload must still complete (pushes fail over and retry on the
+    surviving node) and the head's lease accounting must converge with
+    zero leaked leases; the dead node lands in the tombstone cache."""
+    monkeypatch.setenv("RT_RPC_DEADLINE_S", "2")
+    monkeypatch.setenv("RT_LEASE_REQUEST_TIMEOUT_S", "1")
+    monkeypatch.setenv("RT_RPC_RETRIES", "6")
+    ray_tpu.init(num_cpus=2)
+    cluster = ray_tpu._internal_cluster()
+    try:
+        # "doom" pins the bait task to this node: the crash must fire on
+        # ITS first dispatch, not depend on how a burst happens to spread.
+        doomed = cluster.add_node(
+            resources={"CPU": 2, "doom": 2},
+            env={"RT_FAULT_SPEC": "worker.task.exec:crash:1.0:1:1"},
+        )
+
+        @ray_tpu.remote
+        def sq(x):
+            return x * x
+
+        # Fire-and-forget bait: its execution attempt kills the process,
+        # so its ref can never resolve (no other node has "doom") — we
+        # only await the plain workload, which must fail over cleanly.
+        sq.options(resources={"doom": 1}).remote(0)
+        refs = [sq.remote(i) for i in range(24)]
+        assert ray_tpu.get(refs, timeout=120) == [i * i for i in range(24)]
+        # the injected crash really killed the process, mid-dispatch
+        wait_for_condition(
+            lambda: not doomed.alive(), timeout=30,
+            message="doomed worker survived its crash faultpoint",
+        )
+        assert doomed.proc.returncode == 17  # faultpoints' os._exit code
+        # the head noticed: the node is no longer alive in its view
+        wait_for_condition(
+            lambda: doomed.node_id not in cluster.head.nodes
+            or not cluster.head.nodes[doomed.node_id].alive,
+            timeout=30, message="head never observed the crashed node",
+        )
+        # and the crash leaked no lease accounting on the survivors
+        wait_for_condition(_leases_settled, timeout=20,
+                           message="worker crash leaked leases")
+    finally:
         ray_tpu.shutdown()
 
 

@@ -43,6 +43,21 @@ def test_faultpoints_module_is_family_b_clean():
     assert json.loads(proc.stdout) == []
 
 
+def test_flight_module_is_family_b_clean():
+    """The flight recorder must honor the framework rules it observes
+    everyone else breaking: no blocking work under its ring lock, no
+    silent except-pass on the drain/merge paths (``raytpu lint
+    --framework`` over flight.py, the exact CI invocation)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.lint",
+         os.path.join(REPO, "ray_tpu", "_private", "flight.py"),
+         "--framework", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout) == []
+
+
 def test_private_tree_is_family_b_clean():
     findings = lint_paths([os.path.join(REPO, "ray_tpu", "_private")])
     fam_b = [f for f in findings if f.rule.startswith("RT2")]
