@@ -309,6 +309,16 @@ def _init_locked(address, num_cpus, num_nodes, resources, labels,
         # (CI running a whole suite) can stretch it past the old 30s —
         # and a timeout here used to strand half-initialized state.
         _cluster.wait_for_nodes(num_nodes, timeout=120.0)
+        from ray_tpu._private.config import rt_config as _rtc
+
+        if int(_rtc.warm_workers) > 0:
+            # Warm worker pool: prefork standby node processes in the
+            # background (non-blocking) — add_node consumes one instead
+            # of a cold spawn, and the head auto-activates one when
+            # demand outgrows schedulable capacity.
+            _cluster.start_warm_pool(
+                int(_rtc.warm_workers), env=_node_env
+            )
     else:
         # Explicit address on the head's own machine: the local
         # address file supplies the token (the `connect with:` hint

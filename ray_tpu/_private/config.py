@@ -230,6 +230,31 @@ rt_config.declare(
     "preallocated ring; oldest events are overwritten and counted as "
     "dropped in drain output).")
 rt_config.declare(
+    "flight_sample_n", int, 0,
+    "Flight-recorder sampling: record 1 of every N spans (deterministic "
+    "counter, not RNG — two identical runs sample identical call "
+    "indices). 0/1 = record every span. Sampling makes an always-on "
+    "recorder cheap enough for production: at N=100 the ring holds a "
+    "100x longer window for the same memory and the per-span cost is "
+    "one counter bump for the skipped 99.")
+rt_config.declare(
+    "warm_workers", int, 0,
+    "Warm worker pool: number of STANDBY node processes the local "
+    "cluster preforks at init. Standby nodes register with the head but "
+    "are excluded from scheduling until activated — the head activates "
+    "one instantly when demand outgrows schedulable capacity, and "
+    "LocalCluster.add_node consumes one instead of paying a cold "
+    "process spawn (~2-4s). 0 disables the pool (reference: idle worker "
+    "pool prestarts in worker_pool.cc).")
+rt_config.declare(
+    "actor_create_batch", bool, True,
+    "Batch anonymous actor creations into create_actor_batch head RPCs: "
+    "ActorClass.remote() returns immediately and a burst of N creations "
+    "costs O(bursts) head round-trips instead of N (reference: async "
+    "actor registration in GcsActorManager). Named / get_if_exists / "
+    "detached creations always use the synchronous per-actor verb. Off: "
+    "every creation blocks on its own head RPC (pre-round-10 behavior).")
+rt_config.declare(
     "fault_spec", str, "",
     "Deterministic fault injection spec "
     "('point:kind:prob[:count[:seed]],...' — see _private/faultpoints.py "

@@ -101,7 +101,14 @@ CATALOG: Dict[str, tuple] = {
     "gcs.actor.create": (
         "gcs", ("error", "delay"),
         "actor registration/scheduling entry (GcsActorManager "
-        "HandleCreateActor analog)"),
+        "HandleCreateActor analog); fires once per actor, including "
+        "each item of a create_actor_batch"),
+    "gcs.create_actor_batch": (
+        "gcs", ("error", "delay"),
+        "batched actor-creation verb entry, before ANY item registers: "
+        "error fails the whole batch as retryable-unavailable (the "
+        "client re-issues under its correlation id), leaving no "
+        "half-created actors behind"),
     "gcs.pubsub.publish": (
         "gcs", ("error", "delay", "drop"),
         "head pubsub fan-out: drop/error lose the publish for every "
@@ -112,6 +119,12 @@ CATALOG: Dict[str, tuple] = {
     "worker.task.push": (
         "worker", ("error", "delay", "crash"),
         "task push onto a leased slot (PushNormalTask analog)"),
+    "worker.spec.frame": (
+        "worker", ("error", "delay"),
+        "spec-template build on the submitting worker (one per "
+        "(function, options)): error degrades that submission to the "
+        "inline full-header path — framing is an optimization, never a "
+        "correctness dependency"),
     "worker.task.exec": (
         "worker", ("delay", "crash"),
         "task execution entry on the EXECUTING worker (HandlePushTask "

@@ -58,6 +58,15 @@ logger = logging.getLogger(__name__)
 # Hot-path gate: ``if flight.ENABLED: flight.record(...)``.
 ENABLED = False
 
+# Sampling: record 1 of every SAMPLE_N spans (0/1 = record all). The
+# decision is a DETERMINISTIC counter, not an RNG draw — two identical
+# runs sample identical call indices, so diffing sampled traces stays
+# meaningful. At SAMPLE_N=0 the check is one falsy comparison and the
+# counter is never touched (always-on production use pays a counter bump
+# per skipped span, nothing else).
+SAMPLE_N = 0
+_sample_count = itertools.count(1)
+
 _DEFAULT_RING = 16384
 
 # Latency buckets: RPC verbs span ~50us (ring push) to ~30s (deadline).
@@ -113,6 +122,15 @@ def next_id() -> str:
     return f"f{os.getpid():x}-{next(_fid_counter)}"
 
 
+def set_sample_n(n: int):
+    """Install the sampling divisor (``rt_config.flight_sample_n``): record
+    1/N spans via a deterministic counter; 0/1 records everything. The
+    counter restarts so the kept indices are a pure function of N."""
+    global SAMPLE_N, _sample_count
+    SAMPLE_N = max(int(n), 0)
+    _sample_count = itertools.count(1)
+
+
 def enable(ring_size: Optional[int] = None):
     """Start recording into a fresh preallocated ring. Idempotent-ish: a
     second enable with a different size replaces the ring (drains lost)."""
@@ -124,6 +142,12 @@ def enable(ring_size: Optional[int] = None):
             ring_size = int(rt_config.flight_ring_size)
         except Exception:
             ring_size = _DEFAULT_RING
+    try:
+        from ray_tpu._private.config import rt_config
+
+        set_sample_n(int(rt_config.flight_sample_n))
+    except Exception:
+        set_sample_n(0)
     _rec = _Recorder(ring_size)
     # Per-verb latency / head queue-wait histograms ride the existing
     # metrics registry, so they reach /metrics and the dashboard through
@@ -169,6 +193,21 @@ def record(verb: str, cid, kind: str, t0: float, t1: float,
     r = _rec
     if r is None:
         return
+    h = _hist_latency
+    if h is not None:
+        # /metrics histograms observe EVERY span regardless of sampling:
+        # they were the always-on cost before flight_sample_n existed, and
+        # count-based RPC-rate dashboards must not read 1/N low.
+        h.observe(t1 - t0, tags={"verb": verb})
+        if qw > 0.0 and _hist_qwait is not None:
+            _hist_qwait.observe(qw, tags={"verb": verb})
+    n = SAMPLE_N
+    if n > 1 and kind != "fault" and next(_sample_count) % n:
+        # Sampled out (deterministic 1/N keep). Fault instants always
+        # record — chaos forensics must not lose injection evidence —
+        # and a pending fault stamp stays armed for the next kept span
+        # whose window covers it.
+        return
     f = _fault_pending.get()
     if f is not None:
         # A fault injected in this task/thread context since this span
@@ -182,11 +221,6 @@ def record(verb: str, cid, kind: str, t0: float, t1: float,
     with r.lock:
         r.buf[r.n % r.size] = ev
         r.n += 1
-    h = _hist_latency
-    if h is not None:
-        h.observe(t1 - t0, tags={"verb": verb})
-        if qw > 0.0 and _hist_qwait is not None:
-            _hist_qwait.observe(qw, tags={"verb": verb})
 
 
 def record_dispatch(verb: str, kind: str, header: dict, t_arr: float,

@@ -48,6 +48,11 @@ class NodeInfo:
     # Registration epoch: a stale close event from a connection this node
     # already replaced (re-register after a blip) must not kill the node.
     epoch: int = 0
+    # Warm worker pool: a standby node is fully registered (process up,
+    # connected, rings attachable) but invisible to the scheduler until
+    # activated — the instant-capacity reserve rt_config.warm_workers
+    # preforks (reference: prestarted idle workers in worker_pool.cc).
+    standby: bool = False
 
     def to_public(self) -> dict:
         return {
@@ -57,6 +62,7 @@ class NodeInfo:
             "available": dict(self.available),
             "labels": dict(self.labels),
             "alive": self.alive,
+            "standby": self.standby,
         }
 
 
@@ -508,6 +514,22 @@ class HeadService:
         val = self.kv[h.get("ns", "")].get(h["key"])
         return {"found": val is not None}, ([val] if val is not None else [])
 
+    async def rpc_kv_get_batch(self, h, frames, conn):
+        """Multi-key kv_get in one round trip. Workers coalesce concurrent
+        function-table misses into one of these, so a burst that lands on
+        a fresh worker costs O(unique functions) head RPCs, not O(tasks)
+        (reference shape: MGET batching in the GCS table client). Reply
+        frames carry only the found values, in key order."""
+        ns = self.kv[h.get("ns", "")]
+        found = []
+        vals = []
+        for k in h.get("keys", ()):
+            v = ns.get(k)
+            found.append(v is not None)
+            if v is not None:
+                vals.append(v)
+        return {"found": found}, vals
+
     async def rpc_kv_del(self, h, frames, conn):
         existed = self.kv[h.get("ns", "")].pop(h["key"], None) is not None
         if existed:
@@ -548,14 +570,24 @@ class HeadService:
             # paths agree for non-string inputs.
             labels={k: str(v) for k, v in h.get("labels", {}).items()},
             conn=conn,
+            standby=bool(h.get("standby")),
         )
+        # Activation is sticky across re-registration: a blip + reconnect
+        # of a node the head already activated (it may hold leases and
+        # running tasks, which don't show up in hosted_actors) must not
+        # fall back into the invisible standby set.
+        prior = self.nodes.get(info.node_id)
+        if info.standby and prior is not None and not prior.standby:
+            info.standby = False
         self.nodes[info.node_id] = info
         # A fixed-id node (worker_main --node-id) may re-register after a
         # death: drop its tombstone or it would be listed both alive and
         # dead — and the autoscaler's dead_ids check would terminate the
         # healthy instance on every reconcile.
         self.dead_nodes.pop(info.node_id, None)
-        if self._nsched is not None:
+        # Standby (warm pool) nodes stay OUT of the scheduler until
+        # activated; the native scheduler learns about them at activation.
+        if self._nsched is not None and not info.standby:
             self._nsched.add_node(info.node_id, info.resources, info.labels)
         # Epoch guards the close handler: the OLD connection of a node that
         # just re-registered (blip + reconnect) must not tear down the NEW
@@ -662,8 +694,12 @@ class HeadService:
         info.alive = False
         if self._nsched is not None:
             self._nsched.set_alive(node_id, False)
+        # Planned departures (drain_node before a deliberate teardown,
+        # cluster shutdown) are expected: warning-level "node dead" lines
+        # for them read as failures in bench/CI tails and mask real ones.
         log = (
-            logger.debug if getattr(self, "_shutting_down", False)
+            logger.debug
+            if getattr(self, "_shutting_down", False) or reason == "drained"
             else logger.warning
         )
         log("node %s dead: %s", node_id[:8], reason)
@@ -866,7 +902,7 @@ class HeadService:
     def _schedulable_nodes(self, need, labels=None, node_id=None):
         out = []
         for n in self.nodes.values():
-            if not n.alive:
+            if not n.alive or n.standby:
                 continue
             if node_id is not None and n.node_id != node_id:
                 continue
@@ -894,7 +930,9 @@ class HeadService:
                 labels=strategy.get("labels"),
                 avoid=avoid or (),
             )
-            return self.nodes.get(node_id) if node_id else None
+            if node_id:
+                return self.nodes.get(node_id)
+            return self._activate_standby(need, strategy)
         cands = self._schedulable_nodes(
             need, strategy.get("labels"), strategy.get("node_id")
         )
@@ -904,7 +942,7 @@ class HeadService:
             if preferred:
                 fitting = preferred
         if not fitting:
-            return None
+            return self._activate_standby(need, strategy)
         if strategy.get("spread"):
             self._schedule_rr += 1
             return fitting[self._schedule_rr % len(fitting)]
@@ -927,6 +965,50 @@ class HeadService:
                 _acquire(reserved, need)
                 return node
         return None
+
+    def _activate_standby(self, need, strategy) -> Optional["NodeInfo"]:
+        """Warm worker pool: when demand outgrows schedulable capacity,
+        flip a fitting STANDBY node into the active set and hand it
+        straight to the caller — the first task/actor push lands on an
+        already-initialized process instead of waiting out a cold node
+        spawn. No-op (None) when the pool is empty."""
+        labels = (strategy or {}).get("labels")
+        want_id = (strategy or {}).get("node_id")
+        for n in self.nodes.values():
+            if not n.standby or not n.alive:
+                continue
+            if want_id is not None and n.node_id != want_id:
+                continue
+            if labels and any(
+                n.labels.get(k) != str(v) for k, v in labels.items()
+            ):
+                continue
+            if not _fits(n.available, need):
+                continue
+            self._activate_node(n)
+            return n
+        return None
+
+    def _activate_node(self, n: "NodeInfo"):
+        """Standby -> schedulable: register with the native scheduler,
+        announce the capacity, and wake anyone blocked on placement."""
+        n.standby = False
+        if self._nsched is not None:
+            self._nsched.add_node(n.node_id, n.resources, n.labels)
+        self._emit_event("NODE", "NODE_ACTIVATED", n.node_id,
+                         resources=n.resources)
+        self.publish("nodes", {"event": "node_added", "node": n.to_public()})
+        self._wake_waiters()
+
+    async def rpc_activate_node(self, h, frames, conn):
+        """Explicitly activate a standby node (LocalCluster.add_node's
+        warm fast path). Idempotent: activating an active node is ok."""
+        n = self.nodes.get(h.get("node_id") or "")
+        if n is None or not n.alive:
+            return {"found": False}, []
+        if n.standby:
+            self._activate_node(n)
+        return {"found": True, "node_id": n.node_id}, []
 
     async def rpc_lease(self, h, frames, conn):
         """Grant up to ``count`` leases for ``resources`` (one task slot each).
@@ -1051,6 +1133,46 @@ class HeadService:
             # Fires before registration: an injected failure leaves no
             # half-created actor behind for the retry to collide with.
             await faultpoints.async_fire("gcs.actor.create")
+        return await self._create_one_actor(h, frames, conn)
+
+    async def rpc_create_actor_batch(self, h, frames, conn):
+        """Batched actor creation: one head RPC covers a whole submission
+        burst (reference: the async registration queue in GcsActorManager —
+        N registrations amortize one RPC envelope each here). Items
+        schedule concurrently; each reports {"ok", "addr", "node_id"} or
+        {"err"} so one unschedulable actor never fails its batchmates.
+        The caller's correlation id covers the WHOLE batch: a retry after
+        a dropped reply replays every item's original outcome via the
+        dispatch-level dedup cache — no double-created actors."""
+        if faultpoints.ACTIVE:
+            # Before ANY item registers: an injected batch failure is
+            # retryable-unavailable with nothing half-applied.
+            await faultpoints.async_fire("gcs.create_actor_batch")
+        per_item = protocol.unpack_multi_frames(
+            h.get("fcounts", []), frames
+        )
+
+        async def one(item, item_frames):
+            try:
+                if faultpoints.ACTIVE:
+                    await faultpoints.async_fire("gcs.actor.create")
+                extras, _ = await self._create_one_actor(
+                    item, item_frames, conn
+                )
+                return {"ok": True, **extras}
+            except asyncio.CancelledError:
+                raise
+            except protocol.RpcError as e:
+                return {"err": str(e)}
+            except Exception as e:
+                return {"err": f"{type(e).__name__}: {e}"}
+
+        results = await asyncio.gather(
+            *(one(i, f) for i, f in zip(h.get("items", ()), per_item))
+        )
+        return {"results": list(results)}, []
+
+    async def _create_one_actor(self, h, frames, conn):
         actor_id = h["actor_id"]
         name = h.get("name") or None
         ns = h.get("namespace", "default")
@@ -1473,12 +1595,17 @@ class HeadService:
 
     def _try_place_bundles(self, pg) -> Optional[List[NodeInfo]]:
         # Work on a scratch copy of availability so it's all-or-nothing.
+        # Standby (warm pool) nodes are excluded: bundles reserve capacity
+        # long-term, which would silently consume the instant-activation
+        # reserve (lease/actor demand activates standbys via _pick_node).
         scratch = {
-            n.node_id: dict(n.available) for n in self.nodes.values() if n.alive
+            n.node_id: dict(n.available)
+            for n in self.nodes.values() if n.alive and not n.standby
         }
         chosen: List[str] = []
         nodes_sorted = sorted(
-            (n for n in self.nodes.values() if n.alive), key=lambda n: n.node_id
+            (n for n in self.nodes.values() if n.alive and not n.standby),
+            key=lambda n: n.node_id,
         )
         for i, bundle in enumerate(pg.bundles):
             placed = None

@@ -430,7 +430,10 @@ def bench_many_nodes_tasks(target_nodes: int = 32, n: int = 500) -> float:
     rt.get([noop.remote() for _ in range(n)])
     rate = _rate(n, time.perf_counter() - t0)
     for nh in added:
-        cluster.kill_node(nh)
+        # Graceful drain-then-terminate: a planned teardown must not spray
+        # warning-level "node dead: connection lost" lines into the bench
+        # tail (they read as failures and break tail parsing).
+        cluster.remove_node(nh)
     return rate
 
 

@@ -1,0 +1,116 @@
+"""Pre-framed task-spec templates + function push-through ledger.
+
+Submission-plane analog of the reference's cached ``TaskSpec`` protos
+(``common/task/task_spec.h`` — the immutable spec is built once per
+function/options pair and reused across submissions): the invariant
+portion of a ``push_task`` header (owner address, task name, runtime env,
+retry budget) is serialized to ONE msgpack blob per (function, options)
+on the submitting worker and spliced into every wire message as an opaque
+frame. The pump-thread hot path then packs a 4-key per-call delta header
+(task id, function key, return count, spec flag) instead of re-framing
+the full spec for every task in a burst; the executing side decodes each
+distinct spec blob once through :class:`SpecCache`.
+
+:class:`FnPushLedger` is the second half of the submission cache: the
+exporter keeps the cloudpickle blob of every function it has exported
+(or loaded) and piggybacks it on the FIRST ``push_task`` carrying that
+fkey to each peer (wire flag ``fb``), so a fresh worker installs the
+function from the push itself instead of issuing a ``gcs.kv_get`` — the
+function table becomes a fallback, not a hot path (reference: function
+table pushes ride the same channel as task specs in
+``core_worker/transport``).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Set, Tuple
+
+import msgpack
+
+# Keys a spec template may carry; everything else in a push_task header is
+# a per-call delta (tid, fkey, nret, argrefs, borrows, trace, corr ids).
+SPEC_KEYS = ("owner", "name", "renv", "retries")
+
+
+def pack_spec(spec: dict) -> bytes:
+    """Serialize the invariant spec fields once (template build time)."""
+    return msgpack.packb(spec, use_bin_type=True)
+
+
+class SpecCache:
+    """Receiver-side spec decode cache: spec bytes -> header-fragment dict.
+
+    A burst of K tasks of one function ships K identical spec frames but
+    costs ONE unpack here (bytes hash once, then dict hits). Bounded: at
+    capacity the oldest half is dropped (specs are tiny and re-decodable,
+    so eviction only costs a future unpack). The returned dict is shared —
+    callers must merge-copy (``{**spec, **h}``), never mutate.
+    """
+
+    def __init__(self, cap: int = 1024):
+        self._cap = max(int(cap), 2)
+        self._decoded: Dict[bytes, dict] = {}
+
+    def get(self, blob: bytes) -> dict:
+        d = self._decoded.get(blob)
+        if d is None:
+            d = msgpack.unpackb(blob, raw=False)
+            if len(self._decoded) >= self._cap:
+                # pop, not del: the ring fast path (pump thread) and the
+                # loop slow path may evict concurrently
+                for k in list(self._decoded)[: self._cap // 2]:
+                    self._decoded.pop(k, None)
+            self._decoded[blob] = d
+        return d
+
+
+class FnPushLedger:
+    """Function-blob push-through bookkeeping on the SUBMITTING side.
+
+    ``store`` keeps the pickled function bytes at export/load time;
+    ``blob_for`` returns the blob exactly once per (peer, fkey) — the
+    caller attaches it to that push and the peer installs it into its
+    function cache. A peer that never receives the blob (batch fallback,
+    connection churn) still resolves through the head KV, so this ledger
+    only ever removes RPCs, never correctness.
+
+    Thread-safe: the slot pushers run on the core loop but export/load
+    can happen from caller threads.
+    """
+
+    def __init__(self, cap: int = 256):
+        self._cap = max(int(cap), 2)
+        self._blobs: Dict[str, bytes] = {}
+        self._sent: Dict[Tuple, Set[str]] = {}
+        self._lock = threading.Lock()
+
+    def store(self, fkey: str, blob: bytes):
+        with self._lock:
+            if fkey in self._blobs:
+                return
+            if len(self._blobs) >= self._cap:
+                for k in list(self._blobs)[: self._cap // 2]:
+                    del self._blobs[k]
+            self._blobs[fkey] = blob
+
+    def blob_for(self, peer, fkey: str) -> Optional[bytes]:
+        """The blob to piggyback on this push, or None (already sent to
+        this peer, or blob unknown). Marks the peer as covered only when
+        a blob is actually returned."""
+        with self._lock:
+            sent = self._sent.get(peer)
+            if sent is not None and fkey in sent:
+                return None
+            blob = self._blobs.get(fkey)
+            if blob is None:
+                return None
+            if sent is None:
+                sent = self._sent[peer] = set()
+            sent.add(fkey)
+            return blob
+
+    def forget_peer(self, peer):
+        """Peer connection torn down: a successor process at the same
+        address must be re-covered (it lost its function cache)."""
+        with self._lock:
+            self._sent.pop(peer, None)

@@ -43,7 +43,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import cloudpickle
 
 from ray_tpu import exceptions as exc
-from ray_tpu._private import faultpoints, flight, protocol
+from ray_tpu._private import faultpoints, flight, protocol, specframe
 from ray_tpu._private.backoff import Backoff
 from ray_tpu._private.ids import (
     ActorID,
@@ -147,6 +147,26 @@ class _LeaseSet:
         self.reaper_running = False
 
 
+class _PendingActorCreate:
+    """One deferred (batched) actor creation: wire payload until the
+    batch flushes, rendezvous after. ``event`` serves caller threads
+    (handle serialization, kill); ``fut`` serves coroutines and is
+    created by the loop-side drain."""
+
+    __slots__ = ("aid", "header", "frames", "borrows", "event", "fut",
+                 "error")
+
+    def __init__(self, aid: str, header: dict, frames: List[bytes],
+                 borrows: list):
+        self.aid = aid
+        self.header = header
+        self.frames = frames
+        self.borrows = borrows
+        self.event = threading.Event()
+        self.fut: Optional[asyncio.Future] = None
+        self.error: Optional[str] = None
+
+
 class _ActorChannel:
     """Caller-side channel to one actor: ordered seq numbers + reconnect."""
 
@@ -241,8 +261,12 @@ class CoreWorker:
         node_labels: Optional[Dict[str, str]] = None,
         loop: Optional[asyncio.AbstractEventLoop] = None,
         head: Optional[object] = None,
+        standby: bool = False,
     ):
         self.is_driver = is_driver
+        # Warm worker pool membership: registered but unschedulable until
+        # the head activates this node (see gcs._activate_standby).
+        self.node_standby = standby
         self.gcs_addr = gcs_addr
         self.job_id = job_id
         self.worker_id = WorkerID.from_random()
@@ -278,6 +302,27 @@ class CoreWorker:
 
         self.fn_cache: Dict[str, Any] = {}
         self.exported_fns: set = set()
+        # --- submission plane batching & caching (round 10) ---
+        # Pre-framed push_task spec templates: (fkey, name, retries) ->
+        # packed msgpack bytes spliced into each wire message as frame 0.
+        self._spec_templates: Dict[tuple, bytes] = {}
+        # Receiver-side decode cache for those spec frames.
+        self._spec_cache = specframe.SpecCache()
+        # Function-blob push-through: blobs we can piggyback on the first
+        # push of an fkey to each peer (and per-peer coverage tracking).
+        self._fn_push = specframe.FnPushLedger()
+        # Function-table miss coalescing: fkey -> shared load future, plus
+        # the keys queued for the next batched kv_get_batch.
+        self._fn_loading: Dict[str, asyncio.Future] = {}
+        self._fn_fetch_keys: List[str] = []
+        self._fn_fetch_scheduled = False
+        # Deferred (batched) actor creations: aid -> _PendingActorCreate
+        # while the creation has not reached the head yet.
+        self._actor_creating: Dict[str, _PendingActorCreate] = {}
+        self._acreate_buf: List[_PendingActorCreate] = []
+        self._acreate_lock = threading.Lock()
+        self._acreate_scheduled = False
+        self._acreate_inflight = False
         self.leases: Dict[tuple, _LeaseSet] = {}
         self.actor_channels: Dict[str, _ActorChannel] = {}
         self.hosted_actors: Dict[str, _ActorInstance] = {}
@@ -294,7 +339,8 @@ class CoreWorker:
         # "event": asyncio.Event} (owner credits; bounds in-flight items)
         self._stream_credits: Dict[str, dict] = {}
         self._shutdown = False
-        self._stats = {"tasks_executed": 0, "tasks_submitted": 0}
+        self._stats = {"tasks_executed": 0, "tasks_submitted": 0,
+                       "spec_templates_built": 0}
         # Submission batching: driver threads enqueue dispatch coroutines
         # here; ONE call_soon_threadsafe wakes the loop per burst instead of
         # one per task (the self-pipe write is a syscall per call).
@@ -598,17 +644,21 @@ class CoreWorker:
                 for aid, inst in self.hosted_actors.items()
                 if not inst.exiting
             ]
+            reg = {
+                "node_id": self.node_id,
+                "addr": list(self.addr),
+                "resources": self.node_resources,
+                "labels": self.node_labels,
+                "hosted_actors": hosted,
+            }
+            if self.node_standby:
+                # Warm pool: registered but unschedulable until activated.
+                # Re-registration after a head restart keeps the flag only
+                # if nothing was scheduled here yet (hosted actors imply
+                # the head activated us before it restarted).
+                reg["standby"] = not hosted
             await asyncio.wait_for(
-                self.gcs.call(
-                    "register_node",
-                    {
-                        "node_id": self.node_id,
-                        "addr": list(self.addr),
-                        "resources": self.node_resources,
-                        "labels": self.node_labels,
-                        "hosted_actors": hosted,
-                    },
-                ),
+                self.gcs.call("register_node", reg),
                 tmo,
             )
 
@@ -943,6 +993,17 @@ class CoreWorker:
             return self._ring_actor_fast_dispatch(h, frames, rconn)
         if h.get("m") != "push_task":
             return False
+        if self.node_standby:
+            # Mirrors rpc_push_task: work arriving over the ring fast path
+            # also means the head activated this node — a later
+            # re-registration must not claim standby.
+            self.node_standby = False
+        if "sp" in h or "fb" in h:
+            # Pre-framed spec / piggybacked function: expand here so the
+            # eligibility gates below see the FULL header (a False return
+            # routes the ORIGINAL message to the slow path, which expands
+            # again — cache hits both times).
+            h, frames = self._expand_task_header(h, frames)
         if (
             h.get("nret", 1) < 1          # streaming (-1) stays on the loop
             or h.get("argrefs")
@@ -975,6 +1036,12 @@ class CoreWorker:
         ex = self.task_executor
         if ex is None or self._memory_monitor.is_pressing():
             return items
+        if self.node_standby and any(
+            h.get("m") in ("push_task", "push_actor_task") for h, _ in items
+        ):
+            # Same activation signal as the per-item paths (which a fully
+            # fast-path batch would never reach).
+            self.node_standby = False
         eligible = []
         leftovers = []
         # Consecutive same-actor calls from one caller execute as ONE pool
@@ -982,21 +1049,27 @@ class CoreWorker:
         # anything the run path declines falls through per-item.
         items = self._coalesce_actor_runs(items, rconn)
         for h, frames in items:
+            if h.get("m") == "push_task" and ("sp" in h or "fb" in h):
+                # Expanded view for eligibility + execution; leftovers keep
+                # the ORIGINAL message (the slow path re-expands, cached).
+                eh, ef = self._expand_task_header(h, frames)
+            else:
+                eh, ef = h, frames
             if (
-                h.get("m") != "push_task"
-                or h.get("nret", 1) < 1
-                or h.get("argrefs")
-                or h.get("borrows")
-                or h.get("renv")
-                or h.get("trace")
+                eh.get("m") != "push_task"
+                or eh.get("nret", 1) < 1
+                or eh.get("argrefs")
+                or eh.get("borrows")
+                or eh.get("renv")
+                or eh.get("trace")
             ):
                 leftovers.append((h, frames))
                 continue
-            fn = self.fn_cache.get(h["fkey"])
+            fn = self.fn_cache.get(eh["fkey"])
             if fn is None:
                 leftovers.append((h, frames))
                 continue
-            eligible.append((fn, h, frames))
+            eligible.append((fn, eh, ef))
         if not eligible:
             return leftovers
         # Work-stealing queue, not static chunks: N executor loops pop one
@@ -1541,6 +1614,9 @@ class CoreWorker:
                 self.gcs.call("kv_put", {"ns": FN_NS, "key": key}, [blob])
             )
             self.exported_fns.add(key)
+        # Keep the blob for push-through: the first push_task carrying this
+        # fkey to each peer piggybacks it, so fresh workers skip kv_get.
+        self._fn_push.store(key, blob)
         try:
             fn.__rt_fn_key__ = key
         except (AttributeError, TypeError):
@@ -1548,16 +1624,100 @@ class CoreWorker:
         self.fn_cache[key] = fn
         return key
 
+    def _install_function(self, key: str, fn, blob: Optional[bytes]):
+        """A function became known here (kv fetch or piggybacked blob):
+        cache it, and arm this worker to push it through on ITS nested
+        submissions without re-exporting (the blob is already in the head
+        KV — the original exporter put it there)."""
+        self.fn_cache[key] = fn
+        if blob is not None:
+            self._fn_push.store(key, blob)
+        self.exported_fns.add(key)
+        try:
+            fn.__rt_fn_key__ = key
+        except (AttributeError, TypeError):
+            pass
+
     async def _load_function(self, key: str):
         fn = self.fn_cache.get(key)
         if fn is not None:
             return fn
-        h, frames = await self.gcs.call("kv_get", {"ns": FN_NS, "key": key})
-        if not h.get("found"):
-            raise exc.RayTpuError(f"function {key} not found in function table")
-        fn = cloudpickle.loads(frames[0])
-        self.fn_cache[key] = fn
-        return fn
+        # Miss coalescing: a burst of fresh tasks/actors of K distinct
+        # functions issues ONE kv_get_batch, not one kv_get per slot —
+        # concurrent misses for the same key share one future, distinct
+        # keys queued in the same window ride one batched verb.
+        fut = self._fn_loading.get(key)
+        if fut is None:
+            fut = asyncio.get_running_loop().create_future()
+            # An abandoned waiter (cancelled task) must not surface a
+            # never-retrieved warning for the shared future.
+            fut.add_done_callback(
+                lambda f: f.exception() if not f.cancelled() else None
+            )
+            self._fn_loading[key] = fut
+            self._fn_fetch_keys.append(key)
+            if not self._fn_fetch_scheduled:
+                self._fn_fetch_scheduled = True
+                asyncio.get_running_loop().call_soon(self._spawn_fn_fetch)
+        return await asyncio.shield(fut)
+
+    def _spawn_fn_fetch(self):
+        """One batched fetch per miss window (loop callback)."""
+        self._fn_fetch_scheduled = False
+        keys = [k for k in self._fn_fetch_keys if k in self._fn_loading]
+        self._fn_fetch_keys.clear()
+        if keys:
+            self.loop.create_task(self._fetch_functions(keys))
+
+    async def _fetch_functions(self, keys: List[str]):
+        try:
+            h, fr = await self._head_call(
+                "kv_get_batch", {"ns": FN_NS, "keys": keys}
+            )
+        except Exception as e:
+            for k in keys:
+                fut = self._fn_loading.pop(k, None)
+                if fut is not None and not fut.done():
+                    fut.set_exception(
+                        exc.RayTpuError(f"function table fetch failed: {e}")
+                    )
+            return
+        try:
+            found = list(h.get("found") or ())
+            pos = 0
+            for k, ok in zip(keys, found):
+                blob = fr[pos] if ok and pos < len(fr) else None
+                if ok:
+                    pos += 1
+                fut = self._fn_loading.pop(k, None)
+                if fut is None or fut.done():
+                    continue
+                if blob is None:
+                    fut.set_exception(exc.RayTpuError(
+                        f"function {k} not found in function table"
+                        if not ok else
+                        f"function {k} missing from kv_get_batch reply"
+                    ))
+                    continue
+                try:
+                    fn = cloudpickle.loads(blob)
+                except Exception as e:
+                    fut.set_exception(exc.RayTpuError(
+                        f"function {k} failed to load: {e!r}"
+                    ))
+                    continue
+                self._install_function(k, fn, blob)
+                fut.set_result(fn)
+        finally:
+            # Malformed/truncated reply (or any parse error above): a
+            # leftover future must fail, never hang — it is shared by
+            # every coalesced waiter and by all future misses of its key.
+            for k in keys:
+                fut = self._fn_loading.pop(k, None)
+                if fut is not None and not fut.done():
+                    fut.set_exception(exc.RayTpuError(
+                        f"function {k} missing from kv_get_batch reply"
+                    ))
 
     # -------------------------------------------------------------- ownership
 
@@ -2644,6 +2804,47 @@ class CoreWorker:
         self._add_borrows(borrows)
         return sobj.to_frames(), ref_ids, borrows
 
+    def _spec_template(self, fn, fkey, name, retries) -> Optional[bytes]:
+        """The pre-framed invariant spec for (function, options): packed
+        ONCE, spliced into every push_task wire message as frame 0 so the
+        per-call header carries only deltas (tid/fkey/nret/argrefs). None
+        = caller uses the inline full-header path (template build failed,
+        or this process has no address yet)."""
+        key = (fkey, name, retries)
+        tmpl = self._spec_templates.get(key)
+        if tmpl is not None:
+            return tmpl
+        if self.addr is None:
+            return None
+        try:
+            if faultpoints.ACTIVE:
+                # error: framing degrades to the inline header — the spec
+                # cache is an optimization, never a correctness gate.
+                faultpoints.fire("worker.spec.frame")
+            fl = flight.ENABLED
+            if fl:
+                fl_t0 = time.monotonic()
+            tmpl = specframe.pack_spec({
+                "owner": list(self.addr),
+                "name": name or getattr(fn, "__name__", "task"),
+                "renv": self._prepare_runtime_env(None),
+                # executing side reads this for kill policy (a pressure
+                # kill must prefer tasks the owner will actually retry)
+                "retries": retries,
+            })
+        except Exception as e:
+            logger.debug("spec template for %s failed (inline header): %s",
+                         fkey[:8], e)
+            return None
+        if len(self._spec_templates) >= 512:
+            self._spec_templates.clear()  # tiny + rebuildable
+        self._spec_templates[key] = tmpl
+        self._stats["spec_templates_built"] += 1
+        if fl:
+            flight.record("worker.spec.frame", fkey[:12], "worker", fl_t0,
+                          time.monotonic(), len(tmpl), "ok")
+        return tmpl
+
     def submit_task(
         self,
         fn,
@@ -2675,19 +2876,37 @@ class CoreWorker:
             resources = dict(resources or {"CPU": 1})
             strategy = strategy or {}
             skey = None
-        header = {
-            "tid": task_id.hex(),
-            "fkey": fkey,
-            "nret": -1 if streaming else num_returns,
-            "argrefs": ref_ids,
-            "borrows": borrow_ids,
-            "owner": list(self.addr),
-            "name": name or getattr(fn, "__name__", "task"),
-            "renv": self._prepare_runtime_env(runtime_env),
-            # executing side reads this for kill policy (a pressure kill
-            # must prefer tasks the owner will actually retry)
-            "retries": max_retries,
-        }
+        # Pre-framed spec fast path: everything invariant per (function,
+        # options) rides a cached template frame; streaming and explicit
+        # runtime envs keep the authoritative inline path.
+        tmpl = (
+            self._spec_template(fn, fkey, name, max_retries)
+            if not streaming and runtime_env is None else None
+        )
+        if tmpl is not None:
+            header = {
+                "tid": task_id.hex(),
+                "fkey": fkey,
+                "nret": num_returns,
+                "sp": 1,
+            }
+            if ref_ids:
+                header["argrefs"] = ref_ids
+            if borrow_ids:
+                header["borrows"] = borrow_ids
+            frames = [tmpl] + frames
+        else:
+            header = {
+                "tid": task_id.hex(),
+                "fkey": fkey,
+                "nret": -1 if streaming else num_returns,
+                "argrefs": ref_ids,
+                "borrows": borrow_ids,
+                "owner": list(self.addr),
+                "name": name or getattr(fn, "__name__", "task"),
+                "renv": self._prepare_runtime_env(runtime_env),
+                "retries": max_retries,
+            }
         from ray_tpu.util.tracing import tracing_helper
 
         if tracing_helper.enabled():
@@ -3004,6 +3223,9 @@ class CoreWorker:
             s for s in lease_set.slots if s.node_id != slot.node_id
         ]
         lease_set.saturated = False
+        # A successor process at this address starts with an empty function
+        # cache: push-through must re-cover it.
+        self._fn_push.forget_peer(slot.addr)
         for s in doomed:
             self._release_slot(lease_set, s)
         for fut in futs:
@@ -3037,6 +3259,25 @@ class CoreWorker:
             return True
         fut.set_exception(exc.RayTpuError(str(e)))
         return False
+
+    def _fn_push_wire(self, addr, header, frames):
+        """Function push-through: on the FIRST push of an fkey to this
+        peer, splice the function blob into the wire message (flag ``fb``,
+        frame after the spec) so the executing worker installs it from the
+        push instead of round-tripping a kv_get to the head. Returns the
+        (possibly augmented) wire header/frames; the queued originals are
+        never mutated (a requeued task must re-decide for its next peer)."""
+        fkey = header.get("fkey")
+        if not fkey or "fb" in header:
+            return header, frames
+        blob = self._fn_push.blob_for(addr, fkey)
+        if blob is None:
+            return header, frames
+        h2 = dict(header)
+        h2["fb"] = 1
+        if header.get("sp"):
+            return h2, [frames[0], blob, *frames[1:]]
+        return h2, [blob, *frames]
 
     async def _call_with_tcp_fallback(self, conn, addr, method, header, frames):
         """Issue an RPC on ``conn`` (usually a ring); when the encoded
@@ -3107,8 +3348,9 @@ class CoreWorker:
                         )
                     if len(chunk) == 1:
                         header, frames, fut = chunk[0]
+                        wh, wf = self._fn_push_wire(slot.addr, header, frames)
                         h, rframes = await self._call_with_tcp_fallback(
-                            conn, slot.addr, "push_task", header, frames
+                            conn, slot.addr, "push_task", wh, wf
                         )
                         self._handle_task_reply(header, h, rframes)
                         if not fut.done():
@@ -3124,7 +3366,9 @@ class CoreWorker:
 
                     try:
                         rfuts = conn.call_batch(
-                            "push_task", [(h, f) for h, f, _ in chunk]
+                            "push_task",
+                            [self._fn_push_wire(slot.addr, h, f)
+                             for h, f, _ in chunk],
                         )
                     except MessageTooBig:
                         # Frame-size estimate missed (oversized headers):
@@ -3375,6 +3619,27 @@ class CoreWorker:
                 "argrefs": ref_ids,
             }
         )
+        from ray_tpu._private.config import rt_config
+
+        if (
+            name is None
+            and not get_if_exists
+            and lifetime != "detached"
+            and bool(rt_config.actor_create_batch)
+        ):
+            # Deferred batched creation (reference: async actor
+            # registration — creation errors surface on the handle's
+            # first use, not at .remote()): the caller gets the handle
+            # immediately; a burst of N creations coalesces into
+            # O(bursts) create_actor_batch head RPCs, and the batch
+            # reply primes the actor channel's address so the first
+            # method push skips the alive-polling round trips. Named /
+            # get_if_exists / detached creations need their reply
+            # synchronously and keep the per-actor verb.
+            self._enqueue_actor_create(
+                actor_id.hex(), header, [spec] + frames, borrows
+            )
+            return actor_id, None, False
         try:
             # Non-idempotent: corr-dedup at the head makes a retry after a
             # dropped reply return the FIRST creation's placement instead
@@ -3396,6 +3661,110 @@ class CoreWorker:
             addr = tuple(info["addr"]) if info.get("addr") else None
             return ActorID.from_hex(info["actor_id"]), addr, True
         return actor_id, tuple(h["addr"]), False
+
+    # Deferred creations per create_actor_batch RPC. Batches are
+    # self-clocking: at most ONE batch RPC is in flight per worker, so the
+    # first creation flushes at once (latency-optimal) and everything
+    # enqueued during its round trip rides the next batch (throughput-
+    # optimal) — same shape as the protocol layer's write coalescing.
+    _ACREATE_BATCH = 256
+
+    def _enqueue_actor_create(self, aid: str, header: dict,
+                              frames: List[bytes], borrows: list):
+        pc = _PendingActorCreate(aid, header, frames, borrows)
+        self._actor_creating[aid] = pc
+        with self._acreate_lock:
+            self._acreate_buf.append(pc)
+            if self._acreate_scheduled or self._acreate_inflight:
+                return
+            self._acreate_scheduled = True
+        self.loop.call_soon_threadsafe(self._drain_actor_creates)
+
+    def _drain_actor_creates(self):
+        """Flush one batch of deferred creations (loop thread)."""
+        with self._acreate_lock:
+            self._acreate_scheduled = False
+            if self._acreate_inflight or not self._acreate_buf:
+                return
+            batch = self._acreate_buf[: self._ACREATE_BATCH]
+            del self._acreate_buf[: self._ACREATE_BATCH]
+            self._acreate_inflight = True
+        for pc in batch:
+            pc.fut = self.loop.create_future()
+        self.loop.create_task(self._send_actor_create_batch(batch))
+
+    async def _send_actor_create_batch(self, batch):
+        try:
+            counts, flat = protocol.pack_multi_frames(
+                [pc.frames for pc in batch]
+            )
+            # corr covers the WHOLE batch: a retry after a dropped reply
+            # replays every item's original outcome (head dispatch dedup),
+            # so no actor is ever created twice.
+            h, _ = await self._head_call(
+                "create_actor_batch",
+                {"items": [pc.header for pc in batch], "fcounts": counts},
+                flat, corr=True,
+            )
+            results = list(h.get("results") or ())
+            for pc, res in zip(batch, results):
+                if res.get("ok"):
+                    addr = tuple(res.get("addr") or ()) or None
+                    self._finish_actor_create(pc, addr=addr)
+                else:
+                    self._finish_actor_create(
+                        pc, err=res.get("err") or "actor creation failed"
+                    )
+            for pc in batch[len(results):]:
+                self._finish_actor_create(
+                    pc, err="create_actor_batch reply truncated"
+                )
+        except Exception as e:
+            for pc in batch:
+                self._finish_actor_create(
+                    pc, err=f"create_actor_batch failed: {e}"
+                )
+        finally:
+            with self._acreate_lock:
+                self._acreate_inflight = False
+                more = bool(self._acreate_buf)
+                if more:
+                    self._acreate_scheduled = True
+            if more:
+                self.loop.call_soon(self._drain_actor_creates)
+
+    def _finish_actor_create(self, pc: _PendingActorCreate,
+                             addr=None, err: Optional[str] = None):
+        """Resolve one deferred creation (loop thread): prime or poison
+        the actor channel, release the arg borrows, wake every waiter."""
+        ch = self.get_actor_channel(pc.aid, addr)
+        if err is not None:
+            ch.dead = True
+            ch.death_reason = err
+        elif addr is not None and ch.addr is None:
+            ch.addr = tuple(addr)
+        self._actor_creating.pop(pc.aid, None)
+        self._release_borrows(pc.borrows)
+        pc.error = err
+        if pc.fut is not None and not pc.fut.done():
+            pc.fut.set_result(None)
+        pc.event.set()
+
+    def ensure_actor_created(self, aid_hex: str, timeout: float = 30.0):
+        """Block (caller threads only) until a locally-enqueued deferred
+        creation for this actor has reached the head. Used before the
+        handle crosses a process boundary (serialization) and before
+        kill — a peer resolving the handle via the head must find the
+        actor registered. No-op for non-pending actors; never blocks an
+        event-loop thread (the receiver-side not-found grace covers the
+        remaining window)."""
+        pc = self._actor_creating.get(aid_hex)
+        if pc is None:
+            return
+        try:
+            asyncio.get_running_loop()
+        except RuntimeError:
+            pc.event.wait(timeout)
 
     def get_actor_channel(self, actor_id_hex: str, addr=None) -> _ActorChannel:
         ch = self.actor_channels.get(actor_id_hex)
@@ -3623,11 +3992,50 @@ class CoreWorker:
 
     async def _await_actor_alive(self, ch: _ActorChannel, timeout=60.0) -> bool:
         deadline = time.monotonic() + timeout
+        pc = self._actor_creating.get(ch.actor_id)
+        if pc is not None:
+            # Deferred creation enqueued HERE hasn't reached the head yet:
+            # wait for the batch reply (which primes ch.addr / ch.dead)
+            # instead of polling a head that can't know the actor.
+            while pc.fut is None and not pc.event.is_set():
+                if time.monotonic() >= deadline:
+                    return False
+                await asyncio.sleep(0.001)  # drain callback races us
+            if pc.fut is not None and not pc.event.is_set():
+                try:
+                    # Bounded, not the full deadline: the batch reply is a
+                    # gather barrier at the head, so one batchmate stuck in
+                    # scheduling (30s unschedulable wait) would hold THIS
+                    # actor's already-granted address hostage. The handler
+                    # registers each item before scheduling it, so after a
+                    # short wait the head poll below can answer for this
+                    # actor while the barrier is still up.
+                    await asyncio.wait_for(
+                        asyncio.shield(pc.fut),
+                        min(1.0, max(deadline - time.monotonic(), 0.001)),
+                    )
+                except asyncio.TimeoutError:
+                    pass
+            if ch.dead:
+                return False
+            if ch.addr is not None:
+                return True
+        # Grace for not-found: a handle can cross a process boundary
+        # moments before its deferred creation lands at the head; genuine
+        # post-mortem queries still fail fast (dead actors keep a DEAD
+        # record — only never-registered ids hit this path).
+        grace = time.monotonic() + 2.0
         while time.monotonic() < deadline:
             h, _ = await self._head_call(
                 "get_actor", {"actor_id": ch.actor_id}
             )
             if not h.get("found"):
+                if (
+                    ch.actor_id in self._actor_creating
+                    or time.monotonic() < grace
+                ):
+                    await asyncio.sleep(0.05)
+                    continue
                 ch.dead = True
                 ch.death_reason = "unknown actor"
                 return False
@@ -3643,6 +4051,9 @@ class CoreWorker:
         return False
 
     def kill_actor(self, actor_id_hex: str, no_restart: bool = True):
+        # A deferred creation must land before the kill or the head would
+        # see an unknown actor (and the creation would then leak it).
+        self.ensure_actor_created(actor_id_hex)
         self.run_sync(
             self._head_call(
                 "kill_actor",
@@ -4139,9 +4550,48 @@ class CoreWorker:
                     logger.debug("metrics_push failed, dropping sample: %s",
                                  e)
 
+    def _expand_task_header(self, h, frames):
+        """Undo submission-plane framing on the executing side: merge the
+        pre-framed spec template (frame 0 when header flag ``sp``) back
+        into the per-call header — one msgpack decode per DISTINCT spec,
+        cached — and install a piggybacked function blob (flag ``fb``) into
+        the function cache so no kv_get is needed. Returns the full header
+        plus the remaining (argument) frames. Idempotent across the ring
+        fast path and the TCP slow path: a second expansion of the same
+        message hits both caches."""
+        idx = 0
+        if h.get("sp"):
+            spec = self._spec_cache.get(frames[0])
+            merged = {**spec, **h}
+            idx = 1
+        else:
+            merged = dict(h)
+        merged.pop("sp", None)
+        if merged.pop("fb", None):
+            blob = frames[idx]
+            idx += 1
+            fkey = merged.get("fkey")
+            if fkey and fkey not in self.fn_cache:
+                try:
+                    self._install_function(
+                        fkey, cloudpickle.loads(blob), blob
+                    )
+                except Exception as e:
+                    # Fall back to the function table (kv_get) — push-
+                    # through is an optimization, never authoritative.
+                    logger.debug("piggybacked function %s rejected: %s",
+                                 fkey[:8], e)
+        return merged, (frames[idx:] if idx else frames)
+
     async def rpc_push_task(self, h, frames, conn):
         """Execute a normal task (reference: ``CoreWorker::HandlePushTask``
         ``core_worker.cc:3341`` → ExecuteTask)."""
+        if self.node_standby:
+            # Work arriving means the head activated this node: a later
+            # re-registration (blip, head restart) must not claim standby.
+            self.node_standby = False
+        if "sp" in h or "fb" in h:
+            h, frames = self._expand_task_header(h, frames)
         if self._memory_monitor.is_pressing():
             # Reject at admission so this node survives; the owner retries
             # (reference: worker-killing policies under the memory monitor).
@@ -4584,6 +5034,9 @@ class CoreWorker:
 
     async def rpc_create_actor(self, h, frames, conn):
         """Instantiate an actor here (pushed by the head's actor scheduler)."""
+        if self.node_standby:
+            # Placement arriving means the head activated this node.
+            self.node_standby = False
         spec = cloudpickle.loads(frames[0])
         cls = await self._load_function(spec["class_key"])
         real_cls = getattr(cls, "__rt_wrapped_cls__", cls)

@@ -83,6 +83,9 @@ def main(argv=None):
     parser.add_argument("--job-id", required=True)
     parser.add_argument("--node-id", default="")
     parser.add_argument("--log-level", default="WARNING")
+    # Warm worker pool member: registers with the head but stays out of
+    # the scheduler until activated (gcs._activate_standby).
+    parser.add_argument("--standby", action="store_true")
     args = parser.parse_args(argv)
 
     logging.basicConfig(
@@ -134,6 +137,7 @@ def main(argv=None):
         job_id=JobID.from_hex(args.job_id),
         node_resources=resources,
         node_labels=json.loads(args.labels),
+        standby=args.standby,
     )
     if args.node_id:
         core.node_id = args.node_id

@@ -131,6 +131,14 @@ class ActorHandle:
         return f"ActorHandle({self._class_name}, {self._actor_id_hex[:16]})"
 
     def __reduce__(self):
+        # A handle crossing a process boundary must be resolvable via the
+        # head: wait out a still-batching deferred creation (no-op once
+        # the create_actor_batch reply landed; never blocks a loop
+        # thread — the receiver-side not-found grace covers that window).
+        try:
+            get_global_worker().ensure_actor_created(self._actor_id_hex)
+        except Exception:
+            pass
         return (
             ActorHandle,
             (self._actor_id_hex, self._addr, self._max_task_retries,

@@ -58,8 +58,16 @@ def test_actor_init_error(rt_start):
         def __init__(self):
             raise ValueError("bad init")
 
+        def ping(self):
+            return None
+
+    # Round-10 deferred batched creation (rt_config.actor_create_batch):
+    # .remote() returns the handle immediately; the __init__ error
+    # surfaces on the handle's first use (reference semantics — actor
+    # creation is asynchronous).
+    h = Bad.remote()
     with pytest.raises(Exception, match="bad init"):
-        Bad.remote()
+        ray_tpu.get(h.ping.remote())
 
 
 def test_named_actor(rt_start):

@@ -326,7 +326,10 @@ def test_pull_connection_errors_are_retried(rt_start, fast_rpc):
 
 def test_create_actor_reply_drop_is_deduped(rt_start, fast_rpc):
     # Reply to create_actor dropped after the actor was placed: the retry
-    # must return the ORIGINAL placement, not create a twin.
+    # must return the ORIGINAL placement, not create a twin. A NAMED
+    # actor keeps the synchronous per-actor verb (anonymous creations
+    # ride create_actor_batch since round 10 — their dropped-reply replay
+    # is pinned in test_submission_plane.py).
     fp.configure("gcs.dispatch.create_actor:drop:1.0:1:1")
 
     @ray_tpu.remote
@@ -338,7 +341,7 @@ def test_create_actor_reply_drop_is_deduped(rt_start, fast_rpc):
             self.n += 1
             return self.n
 
-    a = Counter.remote()
+    a = Counter.options(name="dedup-droptest").remote()
     assert ray_tpu.get(a.incr.remote(), timeout=60) == 1
     assert fp.stats()[0]["injected"] == 1
     head = ray_tpu._internal_cluster().head
@@ -483,10 +486,20 @@ CHAOS_SPECS = [
     "gcs.lease.grant:error:0.1:0:103",
     "worker.pull:drop:0.1:0:104",
     "worker.pull:error:0.1:0:105",
-    "gcs.dispatch.create_actor:drop:0.1:0:106",
+    # Anonymous creations ride the round-10 batched verb: a dropped batch
+    # reply must replay the ORIGINAL per-item outcomes via corr dedup (no
+    # double-created actors, no leaked placements).
+    "gcs.dispatch.create_actor_batch:drop:1.0:1:106",
     "gcs.dispatch.create_pg:drop:1.0:1:107",
     "protocol.rpc.reply:delay:0.2:0:108",
     "worker.actor.push:drop:0.2:0:109",
+    # Batch-entry failure fires BEFORE any item registers: retryable-
+    # unavailable, the client re-issues, nothing half-created.
+    "gcs.create_actor_batch:error:1.0:1:111",
+    # Spec-template build failure degrades that submission to the inline
+    # full-header path — framing is an optimization, never a correctness
+    # dependency.
+    "worker.spec.frame:error:0.5:0:110",
 ]
 
 

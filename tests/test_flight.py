@@ -85,6 +85,73 @@ def test_disabled_cluster_workload_records_zero_events(rt_start):
     assert all(not s["events"] for s in h["snapshots"])
 
 
+# -------------------------------------------------------------- sampling
+def test_sampling_records_exact_deterministic_fraction():
+    """flight_sample_n=N keeps exactly 1/N spans at counter-determined
+    indices (every Nth call, 1-based) — two identical runs sample the
+    same spans, so sampled traces diff meaningfully."""
+    flight.enable(ring_size=256)
+    flight.set_sample_n(4)
+    for i in range(100):
+        t = time.monotonic()
+        flight.record(f"v{i}", None, "client", t, t, 0, "ok")
+    events = flight.drain()["events"]
+    assert len(events) == 25
+    assert [e[0] for e in events] == [f"v{i}" for i in range(3, 100, 4)]
+    # counter restart: the kept indices are a pure function of N
+    flight.set_sample_n(4)
+    for i in range(8):
+        t = time.monotonic()
+        flight.record(f"w{i}", None, "client", t, t, 0, "ok")
+    assert [e[0] for e in flight.drain()["events"]] == ["w3", "w7"]
+
+
+def test_sampling_off_records_all_and_never_touches_counter():
+    """N=0 (and N=1) disables sampling: every span records and the
+    shared counter is never even bumped — the always-on cost of the
+    disabled mode is one falsy comparison."""
+    flight.enable(ring_size=64)
+    flight.set_sample_n(0)
+
+    class _Boom:
+        def __next__(self):
+            raise AssertionError("sample counter touched at N=0")
+
+    flight._sample_count = _Boom()
+    for i in range(10):
+        t = time.monotonic()
+        flight.record(f"v{i}", None, "client", t, t, 0, "ok")
+    assert len(flight.drain()["events"]) == 10
+    flight.set_sample_n(1)
+    flight._sample_count = _Boom()
+    t = time.monotonic()
+    flight.record("one", None, "client", t, t, 0, "ok")
+    assert len(flight.drain()["events"]) == 1
+
+
+def test_sampling_always_keeps_fault_instants():
+    """Chaos forensics must not lose injection evidence: fault instants
+    bypass the sampling divisor entirely."""
+    flight.enable(ring_size=64)
+    flight.set_sample_n(1000)
+    fp.configure("worker.pull:error:1.0:0:1")
+    with pytest.raises(ConnectionError):
+        fp.fire("worker.pull")
+    events = flight.drain()["events"]
+    assert any(e[0] == "fault.worker.pull" and e[2] == "fault"
+               for e in events)
+
+
+def test_enable_reads_sample_n_from_config(monkeypatch):
+    monkeypatch.setenv("RT_FLIGHT_SAMPLE_N", "2")
+    flight.enable(ring_size=64)
+    assert flight.SAMPLE_N == 2
+    for i in range(10):
+        t = time.monotonic()
+        flight.record(f"v{i}", None, "client", t, t, 0, "ok")
+    assert len(flight.drain()["events"]) == 5
+
+
 # ------------------------------------------------------------ fault stamp
 def test_faultpoint_hit_stamps_active_event_and_logs_instant():
     flight.enable()

@@ -58,6 +58,22 @@ def test_flight_module_is_family_b_clean():
     assert json.loads(proc.stdout) == []
 
 
+def test_specframe_module_is_family_b_clean():
+    """The round-10 submission-plane cache (spec templates + function
+    push-through ledger) holds a lock on the pusher hot path: blocking
+    work or silent swallows under it would be exactly the regression
+    Family B exists to catch (``raytpu lint --framework`` over
+    specframe.py, the exact CI invocation)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.lint",
+         os.path.join(REPO, "ray_tpu", "_private", "specframe.py"),
+         "--framework", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout) == []
+
+
 def test_private_tree_is_family_b_clean():
     findings = lint_paths([os.path.join(REPO, "ray_tpu", "_private")])
     fam_b = [f for f in findings if f.rule.startswith("RT2")]
