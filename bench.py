@@ -476,6 +476,135 @@ def run_flight_benchmarks(quick: bool = False) -> dict:
     return out
 
 
+def run_serve_benchmarks(quick: bool = False) -> dict:
+    """Closed-loop + spiky open-loop serve bench over the HTTP ingress
+    (ISSUE 6 / ROADMAP "Serving plane under production traffic"):
+
+    - ``serve_qps`` + ``serve_p50_ms``/``serve_p99_ms``: closed-loop
+      (W workers, sequential requests) steady-state throughput/latency
+      through proxy -> router -> replica and back;
+    - ``serve_spike_p99_ms`` + ``serve_spike_shed``: spiky open-loop
+      bursts (K concurrent requests at once, idle between bursts) — the
+      proxy's admission control may shed with typed 503s, which are
+      counted, not failed;
+    - ``serve_drain_dropped``: scale 4 -> 1 mid-load; graceful drain
+      must complete every in-flight request (the acceptance gate: 0).
+
+    When the flight recorder is enabled (``bench.py --serve --flight``)
+    the per-verb attribution table for the serve legs lands in
+    flight_attrib.json alongside the RPC-plane legs.
+    """
+    import http.client
+    import statistics
+    import sys
+    import threading
+
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2, max_ongoing_requests=32)
+    class Echo:
+        def __call__(self, req):
+            return {"ok": True}
+
+    serve.run(Echo.bind(), name="bench_app", route_prefix="/bench")
+    port = serve.start_http_proxy(port=0)
+
+    def one_request(lat, errs, sheds, timeout=30):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+        t0 = time.perf_counter()
+        try:
+            conn.request("GET", "/bench")
+            status = conn.getresponse().status
+            if status == 200:
+                lat.append(time.perf_counter() - t0)
+            elif status == 503:
+                sheds.append(status)  # typed shed: by design under spikes
+            else:
+                errs.append(status)
+        except Exception as e:
+            errs.append(f"{type(e).__name__}")
+        finally:
+            conn.close()
+
+    def pcts(lat):
+        if len(lat) < 2:
+            return (lat[0] * 1e3, lat[0] * 1e3) if lat else (None, None)
+        qs = statistics.quantiles(lat, n=100, method="inclusive")
+        return qs[49] * 1e3, qs[98] * 1e3
+
+    out = {}
+    # ---- leg 1: closed loop ------------------------------------------
+    print("[bench --serve] closed-loop...", file=sys.stderr, flush=True)
+    workers, duration = (4, 3.0) if quick else (8, 10.0)
+    lat, errs, sheds = [], [], []
+    stop_at = time.perf_counter() + duration
+
+    def closed_loop():
+        while time.perf_counter() < stop_at:
+            one_request(lat, errs, sheds)
+
+    threads = [threading.Thread(target=closed_loop) for _ in range(workers)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    p50, p99 = pcts(lat)
+    out.update({
+        "serve_qps": len(lat) / dt,
+        "serve_p50_ms": p50,
+        "serve_p99_ms": p99,
+        "serve_errors": len(errs),
+    })
+    # ---- leg 2: spiky open-loop bursts -------------------------------
+    print("[bench --serve] spiky bursts...", file=sys.stderr, flush=True)
+    bursts, burst_size = (3, 16) if quick else (6, 48)
+    lat, errs, sheds = [], [], []
+    for _ in range(bursts):
+        burst = [
+            threading.Thread(target=one_request, args=(lat, errs, sheds))
+            for _ in range(burst_size)
+        ]
+        for t in burst:
+            t.start()
+        for t in burst:
+            t.join()
+        time.sleep(0.3)  # open-loop idle gap between spikes
+    p50, p99 = pcts(lat)
+    out.update({
+        "serve_spike_p50_ms": p50,
+        "serve_spike_p99_ms": p99,
+        "serve_spike_shed": len(sheds),
+        "serve_spike_errors": len(errs),
+    })
+    # ---- leg 3: graceful drain under load ----------------------------
+    print("[bench --serve] graceful drain 4->1...", file=sys.stderr,
+          flush=True)
+    serve.run(Echo.options(num_replicas=4).bind(), name="bench_app",
+              route_prefix="/bench")
+    lat, errs, sheds = [], [], []
+    n_drain = 24 if quick else 80
+    drain_threads = [
+        threading.Thread(target=one_request, args=(lat, errs, sheds))
+        for _ in range(n_drain)
+    ]
+    for t in drain_threads[: n_drain // 2]:
+        t.start()
+    serve.run(Echo.options(num_replicas=1).bind(), name="bench_app",
+              route_prefix="/bench")  # scale down with the burst in flight
+    for t in drain_threads[n_drain // 2:]:
+        t.start()
+    for t in drain_threads:
+        t.join()
+    out.update({
+        "serve_drain_total": n_drain,
+        "serve_drain_dropped": len(errs) + len(sheds),
+    })
+    serve.shutdown()
+    return out
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true")
@@ -487,6 +616,13 @@ def main():
         help="flight-instrumented run of queued_tasks + many_actors only: "
              "recording ON cluster-wide, per-verb time-attribution table "
              "emitted next to the bench JSON (flight_attrib.json)")
+    parser.add_argument(
+        "--serve", action="store_true",
+        help="closed-loop serve bench only: serve_qps + p50/p99 through "
+             "the HTTP ingress, spiky open-loop bursts (admission-control "
+             "sheds counted), and a graceful-drain leg (scale 4->1 under "
+             "load; dropped must be 0). Combine with --flight for per-verb "
+             "attribution of the serving path")
     args = parser.parse_args()
 
     import os
@@ -498,6 +634,8 @@ def main():
         # Recording must be on in every process: workers inherit the env.
         os.environ["RT_FLIGHT_ENABLED"] = "1"
         args.no_train = True  # flight mode measures the RPC plane only
+    if args.serve:
+        args.no_train = True  # serve mode measures the serving path only
     if not args.train_only:
         import ray_tpu
         from ray_tpu._private.perf import run_core_benchmarks
@@ -507,12 +645,54 @@ def main():
         # vCPUs) throughput comes from multiple node processes. On tiny CI
         # hosts stay small.
         cores = os.cpu_count() or 1
-        if cores >= 8:
+        if args.serve:
+            # Serve bench: replicas/proxy/controller are IO-light actors
+            # sharing node processes — schedule on virtual CPU slots (the
+            # closed loop saturates the proxy event loop, not the cores).
+            ray_tpu.init(num_cpus=16, num_nodes=1)
+        elif cores >= 8:
             ray_tpu.init(num_cpus=4, num_nodes=min(cores // 4, 8))
         else:
             ray_tpu.init(num_cpus=max(cores, 2), num_nodes=1)
         try:
-            if args.flight:
+            if args.serve:
+                core = {
+                    "single_client_tasks_async_per_s": None,
+                    "serve_bench": True,
+                    **run_serve_benchmarks(quick=args.quick),
+                }
+                if args.flight:
+                    import sys
+
+                    from ray_tpu._private import flight
+                    from ray_tpu._private.worker import get_global_worker
+
+                    w = get_global_worker()
+                    h, _ = w.run_sync(
+                        w._head_call("flight_snapshot", {}), 60
+                    )
+                    merged = flight.merge_snapshots(h["snapshots"])
+                    attrib = flight.attribution(merged)
+                    print("--- per-verb attribution: serve bench ---",
+                          file=sys.stderr)
+                    print(flight.format_attribution(attrib),
+                          file=sys.stderr, flush=True)
+                    path = os.path.join(
+                        os.path.dirname(os.path.abspath(__file__)),
+                        "flight_attrib.json",
+                    )
+                    # merge: the core legs' attribution (plain --flight
+                    # runs) and the serve leg share the file
+                    try:
+                        with open(path) as f:
+                            existing = json.load(f)
+                    except (OSError, json.JSONDecodeError):
+                        existing = {}
+                    existing["serve_bench"] = {"verbs": attrib}
+                    with open(path, "w") as f:
+                        json.dump(existing, f, indent=1)
+                    core["flight_attrib_file"] = path
+            elif args.flight:
                 core = {
                     "single_client_tasks_async_per_s": None,
                     **run_flight_benchmarks(quick=args.quick),

@@ -255,6 +255,40 @@ rt_config.declare(
     "detached creations always use the synchronous per-actor verb. Off: "
     "every creation blocks on its own head RPC (pre-round-10 behavior).")
 rt_config.declare(
+    "serve_request_timeout_s", float, 60.0,
+    "Serve proxy per-request deadline (HTTP and gRPC ingress). A request "
+    "that has not produced a result within this horizon is failed with "
+    "504 + Retry-After (DEADLINE_EXCEEDED on gRPC) instead of holding a "
+    "proxy slot forever (reference: Serve request_timeout_s).")
+rt_config.declare(
+    "serve_max_inflight", int, 512,
+    "Serve proxy global admission cap: max requests (streams included) a "
+    "proxy holds in flight at once. Beyond it new requests are shed with "
+    "503 + Retry-After (RESOURCE_EXHAUSTED on gRPC) before any routing "
+    "work happens — saturation degrades to fast typed rejections, not "
+    "collapse. 0 = unbounded (reference: proxy backpressure semantics).")
+rt_config.declare(
+    "serve_drain_deadline_s", float, 30.0,
+    "Graceful replica drain deadline on scale-down/redeploy: the "
+    "controller stops routing to the replica, waits for in-flight "
+    "requests and open streams to finish up to this horizon, then stops "
+    "it. Requests still running at the deadline are cut (reference: "
+    "Serve graceful_shutdown_timeout_s + proxy draining).")
+rt_config.declare(
+    "serve_failover_attempts", int, 2,
+    "Extra replica picks a deployment handle tries when a request fails "
+    "BEFORE reaching user code (replica dead at submit, transport "
+    "refused). Failures after user code may have run are never replayed "
+    "transparently — they surface as a typed retryable error the client "
+    "decides about (reference: Serve router retry on "
+    "ActorUnavailable before execution).")
+rt_config.declare(
+    "serve_stream_chunk_timeout_s", float, 300.0,
+    "Per-chunk deadline for serve streaming responses (handle-side "
+    "next_chunks pull and proxy SSE forwarding): a wedged replica "
+    "terminates the stream with a typed error event instead of hanging "
+    "the client forever.")
+rt_config.declare(
     "fault_spec", str, "",
     "Deterministic fault injection spec "
     "('point:kind:prob[:count[:seed]],...' — see _private/faultpoints.py "

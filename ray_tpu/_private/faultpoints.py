@@ -140,6 +140,21 @@ CATALOG: Dict[str, tuple] = {
     "worker.dispatch.retry": (
         "worker", ("error", "delay"),
         "dispatch-retry path after a failed push attempt"),
+    "serve.replica.call": (
+        "serve", ("error", "delay"),
+        "handle->replica dispatch, client side, BEFORE the request can "
+        "reach user code: error is failed over transparently to another "
+        "replica (bounded, jittered) — the safe-retry half of the serve "
+        "request lifecycle"),
+    "serve.replica.stream": (
+        "serve", ("error", "delay"),
+        "mid-stream chunk pull on an open serve stream: error surfaces "
+        "as a typed retryable terminal error (SSE error event / gRPC "
+        "UNAVAILABLE), never a silent hang or truncation"),
+    "serve.proxy.route": (
+        "serve", ("error", "delay"),
+        "ingress proxy route-table resolution: error maps to a "
+        "retryable 503/UNAVAILABLE, not a bare 500"),
     "spill.write": (
         "spill", ("error", "delay"),
         "spill write to external storage (SpillObjects analog)"),

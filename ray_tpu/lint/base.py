@@ -200,8 +200,17 @@ def lint_source(source: str, filename: str = "<string>",
 
 
 def _is_framework_path(path: str) -> bool:
+    # Framework self-analysis trees: the core runtime AND the serve plane
+    # (its router/controller/proxies hold locks and swallow RPC failures
+    # exactly the way Family B exists to catch). "serve" alone would also
+    # match user dirs named serve/, so require it DIRECTLY under a
+    # ray_tpu parent segment.
     parts = os.path.normpath(path).split(os.sep)
-    return "_private" in parts
+    if "_private" in parts:
+        return True
+    return any(
+        a == "ray_tpu" and b == "serve" for a, b in zip(parts, parts[1:])
+    )
 
 
 def lint_file(path: str, framework: Optional[bool] = None,

@@ -30,12 +30,16 @@ from ray_tpu.serve.handle import (
     BackPressureError,
     DeploymentHandle,
     DeploymentResponse,
+    ReplicaDiedError,
+    ServeRetryableError,
 )
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 from ray_tpu.serve.replica import GangContext, batch, get_gang_context
 
 __all__ = [
     "BackPressureError",
+    "ReplicaDiedError",
+    "ServeRetryableError",
     "Application",
     "AutoscalingConfig",
     "Deployment",
@@ -145,7 +149,11 @@ def run(app: Application, *, name: str = "default",
         ),
         timeout=_blocking_timeout,
     )
-    # block until every deployment has its replicas
+    # block until every deployment has its replicas (jittered poll: many
+    # drivers deploying at once must not hammer the controller in lockstep)
+    from ray_tpu._private.backoff import Backoff
+
+    poll = Backoff(base=0.05, cap=0.5)
     deadline = time.time() + _blocking_timeout
     while time.time() < deadline:
         st = ray_tpu.get(controller.status.remote(), timeout=30)
@@ -154,7 +162,7 @@ def run(app: Application, *, name: str = "default",
             for n in order
         ):
             break
-        time.sleep(0.05)
+        poll.sleep()
     return DeploymentHandle(ingress)
 
 

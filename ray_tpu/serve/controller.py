@@ -9,8 +9,11 @@ Runs as a named actor; handles query it for the live replica set.
 from __future__ import annotations
 
 import asyncio
+import logging
 import time
 from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
 
 CONTROLLER_NAME = "__serve_controller"
 
@@ -20,6 +23,10 @@ class _DeploymentState:
         self.name = name
         self.spec = spec             # serialized target + config fields
         self.replicas: List[dict] = []  # {"actor": handle, "id": str}
+        # Replicas removed from the routable set but still finishing
+        # in-flight requests (graceful drain); entries carry
+        # "drain_deadline" and "drain_zero" (consecutive idle probes).
+        self.draining: List[dict] = []
         self.target_replicas = spec["num_replicas"]
         self.counter = 0
         self.last_scale_up = 0.0
@@ -89,10 +96,13 @@ class ServeController:
                     existing.spec = spec
                     existing.target_replicas = spec["num_replicas"]
                     if spec.get("version") != old_version:
-                        # rolling update: retire old-version replicas; the
-                        # reconcile loop will start fresh ones
+                        # rolling update: retire old-version replicas
+                        # GRACEFULLY (stop routing now, let in-flight
+                        # requests finish up to the drain deadline); the
+                        # reconcile loop starts fresh ones immediately
                         for r in existing.replicas:
-                            await self._stop_replica(r)
+                            self._begin_drain(r)
+                            existing.draining.append(r)
                         existing.replicas = []
                     elif spec.get("user_config") is not None:
                         to_reconfigure.extend(
@@ -170,8 +180,10 @@ class ServeController:
                 "publish",
                 {"channel": f"serve_replicas:{name}", "data": {}},
             )
-        except Exception:
-            pass  # push is an optimization; the poll fallback covers it
+        except Exception as e:
+            # push is an optimization; the poll fallback covers it
+            logger.debug("replica-change publish for %s dropped: %s",
+                         name, e)
 
     def get_routes(self) -> Dict[str, str]:
         return dict(self._routes)
@@ -181,6 +193,7 @@ class ServeController:
             name: {
                 "target": st.target_replicas,
                 "running": len(st.replicas),
+                "draining": len(st.draining),
                 "deleted": st.deleted,
             }
             for name, st in self._deployments.items()
@@ -190,9 +203,10 @@ class ServeController:
         self._running = False
         async with self._reconcile_lock:  # wait out an in-flight pass
             for st in self._deployments.values():
-                for r in st.replicas:
+                for r in st.replicas + st.draining:
                     await self._stop_replica(r)
                 st.replicas = []
+                st.draining = []
         return True
 
     # --------------------------------------------------------- reconcile
@@ -225,8 +239,14 @@ class ServeController:
                     break
                 st.replicas.append(r)
             while len(st.replicas) > st.target_replicas:
-                await self._stop_replica(st.replicas.pop())
-            if st.deleted and not st.replicas:
+                # Graceful scale-down: leave the routable set NOW (the
+                # publish below makes handles re-fetch), finish in-flight
+                # work, stop later — zero dropped requests.
+                r = st.replicas.pop()
+                self._begin_drain(r)
+                st.draining.append(r)
+            await self._process_draining(st)
+            if st.deleted and not st.replicas and not st.draining:
                 self._deployments.pop(st.name, None)
             if [r["id"] for r in st.replicas] != before:
                 self._publish_replica_change(st.name)
@@ -252,6 +272,62 @@ class ServeController:
             if len(alive) != len(st.replicas):
                 self._publish_replica_change(st.name)
             st.replicas = alive
+
+    def _begin_drain(self, r: dict):
+        """Stamp the drain horizon (reference: proxy/replica draining —
+        ``serve/_private/proxy_state.py`` is_drained + replica
+        graceful_shutdown_timeout_s)."""
+        from ray_tpu._private.config import rt_config
+
+        r["drain_deadline"] = (
+            time.monotonic() + float(rt_config.serve_drain_deadline_s)
+        )
+        r["drain_zero"] = 0
+
+    async def _process_draining(self, st: _DeploymentState):
+        """Stop a draining replica once idle or past its deadline. A
+        replica counts as idle only after TWO consecutive zero probes one
+        reconcile tick apart: a request routed just before the handles saw
+        the replica-change push can still be invisible in the actor
+        mailbox on the first read."""
+        async def _judge(r: dict) -> bool:
+            """True when the replica should stop now (idle twice, dead, or
+            past its deadline)."""
+            if time.monotonic() > r["drain_deadline"]:
+                return True
+            try:
+                probe = await asyncio.wait_for(
+                    self._call(r, "drain"), timeout=5
+                )
+                if probe["ongoing"] == 0 and probe["streams"] == 0:
+                    r["drain_zero"] += 1
+                else:
+                    r["drain_zero"] = 0
+            except asyncio.TimeoutError:
+                # SLOW is not DEAD: a replica busy past the probe window
+                # (GIL-bound user code, big serialization) may still be
+                # finishing real requests — cutting it here would drop
+                # them. The drain deadline is the only slowness horizon.
+                logger.debug("drain probe for %s timed out", r["id"])
+                r["drain_zero"] = 0
+            except Exception as e:
+                logger.debug("drain probe for %s failed: %s", r["id"], e)
+                return True  # replica dead/unreachable: nothing to wait for
+            return r["drain_zero"] >= 2
+
+        # Probe concurrently (style of _check_replicas): N unreachable
+        # draining replicas must cost one 5s probe window per reconcile
+        # pass, not N serialized timeouts stalling every deployment.
+        verdicts = await asyncio.gather(
+            *(_judge(r) for r in st.draining), return_exceptions=True
+        )
+        still: List[dict] = []
+        for r, stop in zip(st.draining, verdicts):
+            if isinstance(stop, BaseException) or stop:
+                await self._stop_replica(r)
+            else:
+                still.append(r)
+        st.draining = still
 
     async def _start_replica(self, st: _DeploymentState) -> Optional[dict]:
         import ray_tpu

@@ -28,6 +28,8 @@ from typing import Dict, Optional
 
 import msgpack
 
+from ray_tpu.serve.http_proxy import ProxyBase
+
 SERVICE = "rayserve.v1.RayServe"
 
 
@@ -39,14 +41,16 @@ def _unpack(data: bytes):
     return msgpack.unpackb(data, raw=False)
 
 
-class GRPCProxy:
+class GRPCProxy(ProxyBase):
     """Async actor hosting the gRPC ingress (reference: ProxyActor's gRPC
-    server sharing the Router with the HTTP side)."""
+    server sharing the Router with the HTTP side). Route resolution,
+    admission counters, and stream teardown come from ProxyBase — shared
+    with the HTTP proxy; only the protocol rendering differs."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        super().__init__()
         self._host = host
         self._port = port
-        self._handles: Dict[str, object] = {}
         self._server = None
 
     async def start(self) -> int:
@@ -84,22 +88,21 @@ class GRPCProxy:
 
     # ------------------------------------------------------------- routing
 
-    def _route_for(self, path: str) -> Optional[str]:
-        # Shared with the HTTP proxy: one longest-prefix resolver against
-        # the controller's route table.
-        from ray_tpu.serve.http_proxy import HTTPProxy
-
-        return HTTPProxy._route_for(self, path)
-
     async def _handle_for(self, req: dict, context):
         """Resolve the deployment handle + per-request options, or abort."""
         import grpc
 
         route = req.get("route") or "/"
-        # The controller RPC blocks; it must not stall the grpc.aio loop.
-        deployment = await asyncio.get_running_loop().run_in_executor(
-            None, self._route_for, route
-        )
+        try:
+            # The controller RPC blocks; it must not stall the grpc.aio loop.
+            deployment = await asyncio.get_running_loop().run_in_executor(
+                None, self._route_for, route
+            )
+        except Exception as e:
+            # Route resolution is infra: retryable UNAVAILABLE, not INTERNAL.
+            context.set_code(grpc.StatusCode.UNAVAILABLE)
+            context.set_details(f"route resolution failed: {e}")
+            return None, None
         if deployment is None:
             context.set_code(grpc.StatusCode.NOT_FOUND)
             context.set_details(f"no route for {route!r}")
@@ -117,8 +120,41 @@ class GRPCProxy:
             handle = handle.options(multiplexed_model_id=model_id)
         return handle, req.get("method") or "__call__"
 
+    def _admit(self, context) -> bool:
+        """Global in-flight admission check (ProxyBase._over_cap); sheds
+        with RESOURCE_EXHAUSTED — the gRPC analog of 503 + Retry-After."""
+        import grpc
+
+        from ray_tpu._private.config import rt_config
+
+        if self._over_cap():
+            context.set_code(grpc.StatusCode.RESOURCE_EXHAUSTED)
+            context.set_details(
+                f"proxy saturated: {self._inflight} >= "
+                f"serve_max_inflight={int(rt_config.serve_max_inflight)}"
+            )
+            return False
+        return True
+
+    @staticmethod
+    def _status_for(e: BaseException):
+        """Retryable infra -> UNAVAILABLE, deadline -> DEADLINE_EXCEEDED,
+        application error -> INTERNAL: the gRPC rendering of the shared
+        classification (one mapping to maintain, both ingresses agree)."""
+        import grpc
+
+        from ray_tpu.serve.http_proxy import _classify_error
+
+        return {
+            "retryable": grpc.StatusCode.UNAVAILABLE,
+            "deadline": grpc.StatusCode.DEADLINE_EXCEEDED,
+            "app": grpc.StatusCode.INTERNAL,
+        }[_classify_error(e)]
+
     async def _predict(self, request: bytes, context) -> bytes:
         import grpc
+
+        from ray_tpu._private.config import rt_config
 
         try:
             req = _unpack(request)
@@ -126,52 +162,95 @@ class GRPCProxy:
             context.set_code(grpc.StatusCode.INVALID_ARGUMENT)
             context.set_details(f"bad msgpack request: {e}")
             return b""
-        handle, method = await self._handle_for(req, context)
-        if handle is None:
+        if not self._admit(context):
             return b""
-        loop = asyncio.get_running_loop()
+        self._inflight += 1
         try:
-            caller = (
-                handle if method == "__call__" else getattr(handle, method)
-            )
-            resp = caller.remote(req.get("data"))
-            out = await loop.run_in_executor(None, resp.result, 60)
-        except Exception as e:
-            context.set_code(grpc.StatusCode.INTERNAL)
-            context.set_details(f"{type(e).__name__}: {e}")
-            return b""
-        return _pack(out)
+            handle, method = await self._handle_for(req, context)
+            if handle is None:
+                return b""
+            loop = asyncio.get_running_loop()
+            try:
+                caller = (
+                    handle if method == "__call__"
+                    else getattr(handle, method)
+                )
+                # Submission off-loop (router pick may briefly block);
+                # the WAIT is fully async — a blocked executor thread per
+                # in-flight request starves co-located replicas (shared
+                # per-process default executor) and deadlocks under
+                # bursts.
+                resp = await loop.run_in_executor(
+                    None, lambda: caller.remote(req.get("data"))
+                )
+                out = await resp.result_async(
+                    float(rt_config.serve_request_timeout_s)
+                )
+            except Exception as e:
+                context.set_code(self._status_for(e))
+                context.set_details(f"{type(e).__name__}: {e}")
+                return b""
+            return _pack(out)
+        finally:
+            self._inflight -= 1
 
     async def _predict_stream(self, request: bytes, context):
         import grpc
 
+        from ray_tpu._private.config import rt_config
+
         try:
             req = _unpack(request)
         except Exception as e:
             context.set_code(grpc.StatusCode.INVALID_ARGUMENT)
             context.set_details(f"bad msgpack request: {e}")
             return
-        handle, method = await self._handle_for(req, context)
-        if handle is None:
+        if not self._admit(context):
             return
-        handle = handle.options(stream=True)
-        loop = asyncio.get_running_loop()
+        self._inflight += 1
+        it = None
         try:
-            caller = (
-                handle if method == "__call__" else getattr(handle, method)
-            )
-            gen = caller.remote(req.get("data"))
-            # __iter__ resolves the response (blocking): keep it off-loop.
-            it = await loop.run_in_executor(None, iter, gen)
-            done = object()  # StopIteration cannot cross an executor Future
-            while True:
-                chunk = await loop.run_in_executor(None, next, it, done)
-                if chunk is done:
-                    break
-                yield _pack(chunk)
-        except Exception as e:
-            context.set_code(grpc.StatusCode.INTERNAL)
-            context.set_details(f"{type(e).__name__}: {e}")
+            handle, method = await self._handle_for(req, context)
+            if handle is None:
+                return
+            handle = handle.options(stream=True)
+            loop = asyncio.get_running_loop()
+            try:
+                from ray_tpu.serve.handle import _StreamIterator
+
+                caller = (
+                    handle if method == "__call__"
+                    else getattr(handle, method)
+                )
+                # Submission off-loop; registration wait and chunk pulls
+                # are async (see _predict: blocked executor threads
+                # deadlock co-located replicas).
+                gen = await loop.run_in_executor(
+                    None, lambda: caller.remote(req.get("data"))
+                )
+                # Registration is bounded by the request deadline (unary
+                # parity); chunk pulls get the streaming horizon.
+                out = await gen.result_async(
+                    float(rt_config.serve_request_timeout_s)
+                )
+                if isinstance(out, _StreamIterator):
+                    it = out
+                    async for chunk in it:
+                        yield _pack(chunk)
+                else:
+                    # non-streaming result under stream=true: a single
+                    # well-formed message, not an error
+                    yield _pack(out)
+            except Exception as e:
+                # Typed terminal status, never a hang: UNAVAILABLE tells
+                # the client a retry may succeed (replica died mid-stream).
+                context.set_code(self._status_for(e))
+                context.set_details(f"{type(e).__name__}: {e}")
+        finally:
+            # ProxyBase: settles the router slot + cancels the
+            # replica-side generator
+            self._close_stream(it)
+            self._inflight -= 1
 
     async def stop(self) -> bool:
         if self._server is not None:

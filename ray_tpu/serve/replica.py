@@ -70,6 +70,11 @@ class Replica:
         # live generator streams: stream_id -> [iter, last_access, model_id]
         self._streams: Dict[str, list] = {}
         self._stream_seq = 0
+        # Graceful drain: the controller stopped routing to this replica
+        # and is waiting for _ongoing + _streams to reach zero before
+        # stopping it (requests already in the mailbox still run — zero
+        # dropped requests on scale-down).
+        self._draining = False
         if user_config is not None:
             self.reconfigure(user_config)
 
@@ -91,7 +96,18 @@ class Replica:
 
     @_actor_method(concurrency_group="control")
     def stats(self) -> dict:
-        return {"ongoing": self._ongoing, "total": self._total}
+        return {"ongoing": self._ongoing, "total": self._total,
+                "streams": len(self._streams),
+                "draining": self._draining}
+
+    @_actor_method(concurrency_group="control")
+    def drain(self) -> dict:
+        """Controller drain probe (reference: replica graceful shutdown —
+        ``_private/replica.py`` perform_graceful_shutdown): marks the
+        replica draining and reports live load. The control concurrency
+        group keeps this answerable while request lanes are saturated."""
+        self._draining = True
+        return {"ongoing": self._ongoing, "streams": len(self._streams)}
 
     @_actor_method(concurrency_group="control")
     def multiplexed_ids(self) -> List[str]:
@@ -108,6 +124,52 @@ class Replica:
         sid = f"s{self._stream_seq}"
         self._streams[sid] = [gen, time.monotonic(), model_id]
         return {"__rt_stream__": sid}
+
+    @_actor_method(concurrency_group="control")
+    async def cancel_stream(self, stream_id: str) -> bool:
+        """Release an abandoned stream NOW (client disconnected): pop the
+        record and close the generator so its finally blocks run and the
+        slot frees immediately instead of waiting for the 10-minute idle
+        sweep. Idempotent — unknown/finished ids return False. Rides the
+        control group: when the request lanes are saturated is exactly
+        when freeing a slot matters most, so the cancel must not queue
+        behind the wedge it is relieving."""
+        rec = self._streams.pop(stream_id, None)
+        if rec is None:
+            return False
+        gen = rec[0]
+        # The cancel usually races an in-flight next_chunks pull (a
+        # stream spends most of its wall time inside __anext__): closing
+        # a RUNNING generator raises "already executing/running" and the
+        # user finally blocks would never run. Retry until the current
+        # pull yields the frame back (bounded; the idle sweep is the
+        # backstop for a generator that never yields again).
+        import logging
+
+        log = logging.getLogger(__name__)
+        deadline = time.monotonic() + 5.0
+        while True:
+            try:
+                if inspect.isasyncgen(gen):
+                    await gen.aclose()
+                elif hasattr(gen, "close"):
+                    # sync generator: close() runs its finally block; keep
+                    # any blocking cleanup off this event loop
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, gen.close
+                    )
+                return True
+            except (RuntimeError, ValueError) as e:
+                if "already" in str(e) and time.monotonic() < deadline:
+                    await asyncio.sleep(0.05)
+                    continue
+                log.debug("stream %s generator close raised: %s",
+                          stream_id, e)
+                return True
+            except Exception as e:
+                log.debug("stream %s generator close raised: %s",
+                          stream_id, e)
+                return True
 
     async def next_chunks(self, stream_id: str, max_n: int = 16):
         """Pull up to max_n chunks; returns (chunks, done). Abandoned

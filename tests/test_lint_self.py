@@ -80,6 +80,19 @@ def test_private_tree_is_family_b_clean():
     assert fam_b == [], "\n".join(f.format() for f in fam_b)
 
 
+def test_serve_tree_is_family_b_clean():
+    """The serve plane is framework code under production traffic: its
+    router holds a lock on the request hot path, its controller/proxies
+    speak RPC constantly — a blocking call under the router lock, a
+    silent except-pass on a reply path, or a constant-sleep re-resolve
+    loop there is exactly the Family-B regression class. ``serve/`` is a
+    framework path for the linter (base._is_framework_path), so the
+    plain tier-1 CLI scan covers it too; this pins it explicitly."""
+    findings = lint_paths([os.path.join(REPO, "ray_tpu", "serve")])
+    fam_b = [f for f in findings if f.rule.startswith("RT2")]
+    assert fam_b == [], "\n".join(f.format() for f in fam_b)
+
+
 def test_cli_module_scan_json_clean():
     """The exact tier-1 invocation: ``python -m ray_tpu.lint ray_tpu/``
     with --json for dashboard ingestion; Family B must be silent."""
