@@ -406,18 +406,25 @@ def bench_reference_jax_step(quick: bool = False):
     return {"gpt2_reference_impl_tokens_per_sec": best}
 
 
-def run_flight_benchmarks(quick: bool = False) -> dict:
+def run_flight_benchmarks(quick: bool = False, phases: bool = False,
+                          attrib_path: str = None) -> dict:
     """Flight-instrumented runs of the two ROADMAP perf open items
     (``queued_*_tasks_s``, ``many_actors_per_s``): the recorder stays ON,
     and after each leg the cluster-wide ring is drained into a per-verb
     time-attribution table — the measured breakdown the next perf
     tentpoles (batched lease-grant, batch create_actor) design against.
 
+    ``phases=True`` (``bench.py --phases``) additionally joins the task
+    phase spans to the task events and records the per-function phase
+    table (p50/p99 per submit/queue/exec/... phase) under ``task_phases``
+    in the bench JSON — the perf trajectory carries attribution, not just
+    totals.
+
     Writes ``flight_attrib.json`` next to the bench JSON and prints the
     tables to stderr."""
     import sys
 
-    from ray_tpu._private import flight
+    from ray_tpu._private import flight, taskpath
     from ray_tpu._private.perf import bench_many_actors, bench_queued_tasks
     from ray_tpu._private.worker import get_global_worker
 
@@ -467,7 +474,29 @@ def run_flight_benchmarks(quick: bool = False) -> dict:
                   file=sys.stderr)
         print(flight.format_attribution(attrib), file=sys.stderr,
               flush=True)
-    path = os.path.join(
+        if phases:
+            from ray_tpu.util import state
+
+            # The leg's tail events ride the workers' 0.25s flusher tick:
+            # wait for the head's event count to settle before joining
+            # names, or the table degrades to the "task" bucket.
+            events = state.list_tasks(limit=100_000)
+            settle_deadline = time.time() + 3.0
+            while time.time() < settle_deadline:
+                time.sleep(0.35)
+                nxt = state.list_tasks(limit=100_000)
+                if len(nxt) == len(events):
+                    events = nxt
+                    break
+                events = nxt
+            table = taskpath.phase_table(merged, events)
+            out.setdefault("task_phases", {})[key] = table
+            attrib_all[key]["task_phases"] = table
+            print(f"--- per-function task phases: {key} ---",
+                  file=sys.stderr)
+            print(taskpath.format_phase_table(table), file=sys.stderr,
+                  flush=True)
+    path = attrib_path or os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "flight_attrib.json"
     )
     with open(path, "w") as f:
@@ -617,6 +646,12 @@ def main():
              "recording ON cluster-wide, per-verb time-attribution table "
              "emitted next to the bench JSON (flight_attrib.json)")
     parser.add_argument(
+        "--phases", action="store_true",
+        help="implies --flight; after each leg, join the task phase spans "
+             "to the task events and record the per-function phase table "
+             "(submit/queue/exec/result p50+p99) into the bench JSON under "
+             "task_phases — the perf trajectory carries attribution")
+    parser.add_argument(
         "--serve", action="store_true",
         help="closed-loop serve bench only: serve_qps + p50/p99 through "
              "the HTTP ingress, spiky open-loop bursts (admission-control "
@@ -630,6 +665,8 @@ def main():
     # Sentinel, not 0.0: a --train-only line must never read as a real
     # throughput collapse to anything parsing the headline contract.
     core = {"single_client_tasks_async_per_s": None, "core_skipped": True}
+    if args.phases:
+        args.flight = True
     if args.flight:
         # Recording must be on in every process: workers inherit the env.
         os.environ["RT_FLIGHT_ENABLED"] = "1"
@@ -695,7 +732,8 @@ def main():
             elif args.flight:
                 core = {
                     "single_client_tasks_async_per_s": None,
-                    **run_flight_benchmarks(quick=args.quick),
+                    **run_flight_benchmarks(quick=args.quick,
+                                            phases=args.phases),
                 }
             else:
                 core = run_core_benchmarks(quick=args.quick)

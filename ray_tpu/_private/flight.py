@@ -28,7 +28,9 @@ Event tuple layout (fixed 8 fields, msgpack-able as a list)::
 - ``cid``: cross-process join key — PR 3's correlation id (``corr``) when the
   request carries one, else a per-process flight id (``fid``) stamped into
   the wire header so both ends of one RPC record the same key.
-- ``kind``: span category (client | server | head | ring | worker | fault)
+- ``kind``: span category (client | server | head | ring | worker | fault
+  | task — the taskpath plane's per-task phase spans, cid = task id; see
+  ``_private/taskpath.py``)
 - ``t0``/``t1``: ``time.monotonic()`` span bounds in THIS process. Each
   process also records a (wall, mono) anchor; the merge step maps spans onto
   the head's wall clock with an RTT/2-corrected per-node offset.

@@ -175,7 +175,13 @@ class HeadService:
         # (reference: raylet returns a dead worker's leased resources via
         # the worker-failure path, ``cluster_lease_manager.cc``).
         self._conn_leases: Dict[int, list] = {}
-        self.task_events: List[dict] = []  # bounded task-event buffer for state API
+        # Task-event ring for the state API: a bounded deque, consistent
+        # with the flight recorder's ring semantics — append is O(1),
+        # overflow drops the OLDEST event (a plain list trimmed with del
+        # slicing memmoved the whole buffer on every overflow), and the
+        # drop count is reported, never silent.
+        self.task_events: deque = deque(maxlen=10_000)
+        self._task_events_total = 0
         # Log plane: recent worker log lines per node (bounded ring), fed
         # by worker_logs notifies, served to `rt logs` + the dashboard.
         self.log_buffer: Dict[str, deque] = {}
@@ -986,6 +992,10 @@ class HeadService:
             if not _fits(n.available, need):
                 continue
             self._activate_node(n)
+            # Taskpath plane: the grant built from this pick is tagged
+            # "warm" so the driver can name a queued task's wait
+            # warm-pool-hit instead of lease-wait (popped by rpc_lease).
+            n.__dict__["_rt_warm_grant"] = True
             return n
         return None
 
@@ -1035,7 +1045,10 @@ class HeadService:
             if node is not None:
                 if not strategy.get("pg_id"):
                     self._node_acquire(node, need)
-                grants.append({"node_id": node.node_id, "addr": list(node.addr)})
+                grant = {"node_id": node.node_id, "addr": list(node.addr)}
+                if node.__dict__.pop("_rt_warm_grant", False):
+                    grant["warm"] = 1
+                grants.append(grant)
                 self._track_conn_lease(conn, node.node_id, need, strategy)
                 continue
             if grants:
@@ -1841,7 +1854,13 @@ class HeadService:
         return {
             "snapshots": {
                 wid: rec["metrics"] for wid, rec in self.worker_metrics.items()
-            }
+            },
+            # worker -> node map: the /metrics rollup aggregates series
+            # per NODE (one scrape endpoint covering the whole cluster).
+            "nodes": {
+                wid: rec.get("node_id")
+                for wid, rec in self.worker_metrics.items()
+            },
         }, []
 
     async def rpc_task_event(self, h, frames, conn):
@@ -1870,21 +1889,31 @@ class HeadService:
     async def rpc_task_events(self, h, frames, conn):
         """Task-event sink (reference: GcsTaskManager fed by the per-worker
         ``task_event_buffer.h`` in 4Hz batches); bounded ring for the state
-        API."""
+        API. Oversized string fields are clamped so one hostile event
+        cannot dominate the ring's memory."""
         events = h.get("events", [])
+        ring = self.task_events
         for e in events:
             s = e.get("state")
             if s:
                 self._task_state_counts[s] = (
                     self._task_state_counts.get(s, 0) + 1
                 )
-        self.task_events.extend(events)
-        if len(self.task_events) > 10000:
-            del self.task_events[: len(self.task_events) - 10000]
+            name = e.get("name")
+            if isinstance(name, str) and len(name) > 256:
+                e["name"] = name[:256]
+            ring.append(e)
+        self._task_events_total += len(events)
         return {}, []
 
     async def rpc_list_task_events(self, h, frames, conn):
-        return {"events": self.task_events[-h.get("limit", 1000):]}, []
+        limit = h.get("limit", 1000)
+        events = list(self.task_events)
+        return {
+            "events": events[-limit:] if limit else events,
+            "recorded": self._task_events_total,
+            "dropped": max(self._task_events_total - len(events), 0),
+        }, []
 
     # ------------------------------------------------------ job submission
     # Reference analog: dashboard/modules/job/job_manager.py:58 — submitted

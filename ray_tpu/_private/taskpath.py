@@ -1,0 +1,327 @@
+"""Task-centric critical-path analysis over the flight recorder.
+
+The flight recorder (``_private/flight.py``) attributes time to RPC
+*verbs*; the state API buffers task lifecycle *events*. This module joins
+the two planes around one key — the task id — so a single task's life is
+traceable submit→lease→push→arg-pull→exec→result→reply across processes:
+
+- **Recording**: the worker stamps ``task.<stage>`` spans into the same
+  per-process flight ring the RPC hooks use (kind ``"task"``, cid = task
+  id), and observes each stage into the ``rt_task_phase_seconds{phase,fn}``
+  histogram that rides the existing metrics_push → head ``/metrics``
+  rollup. Everything is gated on ``flight.ENABLED`` — disabled, the hot
+  paths pay the same one-boolean check as every other flight hook.
+- **Analysis**: :func:`task_breakdown` splits one task's wall time into
+  named phases with the residual reported explicitly (never silently
+  absorbed); :func:`phase_table` aggregates per-function p50/p99 phase
+  stats. Surfaces: ``rt timeline --task``, ``rt flight --task-attrib``,
+  ``state.summarize_tasks(phases=True)``, ``bench.py --phases``.
+- **Join**: :func:`task_events_to_merged` lifts the state API's task
+  events into the merged-span dict shape, so ``flight.to_chrome_trace``
+  draws task tracks WITH flow links into the RPC spans that share the id.
+
+Phase model (wall time measured on the DRIVER's clock, so clock skew can
+never corrupt the sum; executor-side contributions are pure durations):
+
+    wall      = task.submit start → task.push end
+    submit      serialize args / export fn / enqueue       (driver span)
+    submit-queue | lease-wait | warm-pool-hit               (driver span;
+                the queued span's outcome names which wait it was)
+    fn-push | kv-get                                        (executor span;
+                outcome says whether the fn blob rode push-through or a
+                head kv_get round-trip)
+    arg-pull    materialize argument refs                   (executor span)
+    exec        user function runtime                       (executor span)
+    result-push serialize + store + register results        (executor span)
+    reply-ack   push RTT not covered by the executor's serve
+                envelope: wire both ways + connection queuing (derived).
+                For chunked pushes this includes waiting behind
+                chunk-mates on the executor — the driver's per-task push
+                span starts at chunk send
+    residual    wall − sum(above) — dispatch gaps, server queueing not
+                inside any named phase. Always shown.
+
+Cold worker-spawn time surfaces under ``lease-wait`` (the head blocks the
+grant until capacity exists); a warm-pool activation is named explicitly
+because the head tags the grant that flipped a standby node.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import flight
+
+logger = logging.getLogger(__name__)
+
+# Canonical phase order for tables and rollups (residual always last).
+PHASES = (
+    "submit", "submit-queue", "lease-wait", "warm-pool-hit",
+    "fn-push", "kv-get", "arg-pull", "exec", "result-push",
+    "reply-ack", "residual",
+)
+
+# task.queued outcome -> phase name (see worker._pop_pending).
+_QUEUE_PHASES = {
+    "submit-queue": "submit-queue",
+    "lease-wait": "lease-wait",
+    "warm-pool-hit": "warm-pool-hit",
+    # actor calls: queue time is channel/creation wait, closest to lease
+    "actor-pending": "lease-wait",
+}
+
+_hist = None
+
+
+def observe_phase(phase: str, fn: str, seconds: float):
+    """One observation into ``rt_task_phase_seconds{phase,fn}``. The
+    histogram rides the per-process metrics registry, reaching the head's
+    aggregated ``/metrics`` through the same metrics_push pipeline as
+    every other series. Call sites gate on ``flight.ENABLED``."""
+    global _hist
+    h = _hist
+    if h is None:
+        try:
+            from ray_tpu.util.metrics import Histogram
+
+            h = _hist = Histogram(
+                "rt_task_phase_seconds",
+                description="Per-task phase durations (taskpath plane)",
+                boundaries=(
+                    0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1,
+                    0.5, 1.0, 5.0, 30.0,
+                ),
+                tag_keys=("phase", "fn"),
+            )
+        except Exception as e:
+            logger.debug("rt_task_phase_seconds unavailable: %s", e)
+            return
+    # Bounded tag cardinality: fn is a function/method name, not user data.
+    h.observe(seconds, tags={"phase": phase, "fn": (fn or "task")[:64]})
+
+
+def record_phase(stage: str, tid, t0: float, t1: float, *, fn: str = "",
+                 nbytes: int = 0, outcome: str = "ok",
+                 phase: Optional[str] = None):
+    """Record one ``task.<stage>`` span (cid = task id, kind ``task``)
+    and, when ``phase`` is given, observe it into the rollup histogram."""
+    flight.record(f"task.{stage}", tid, "task", t0, t1, nbytes, outcome)
+    if phase is not None:
+        observe_phase(phase, fn, t1 - t0)
+
+
+# ------------------------------------------------------------------ analysis
+
+def _by_task(merged: List[Dict[str, Any]]) -> Dict[str, List[dict]]:
+    out: Dict[str, List[dict]] = {}
+    for e in merged:
+        if e.get("kind") == "task" and e.get("cid"):
+            out.setdefault(str(e["cid"]), []).append(e)
+    return out
+
+
+def _names_by_tid(events) -> Dict[str, str]:
+    return {
+        str(ev.get("task_id")): str(ev.get("name") or "task")
+        for ev in events or ()
+        if ev.get("task_id")
+    }
+
+
+def task_breakdown(merged: List[Dict[str, Any]], task_id: str,
+                   events=None) -> Optional[Dict[str, Any]]:
+    """Split one task's wall time into named phases. Returns None when no
+    ``task.*`` span carries the id. Retried stages sum their attempts.
+
+    ``sum(phases.values()) == wall`` holds by construction: the residual
+    is an explicit phase, never silently absorbed."""
+    spans = _by_task(merged).get(str(task_id))
+    if not spans:
+        return None
+    dur: Dict[str, float] = {}
+    outcomes: Dict[str, str] = {}
+    for e in spans:
+        stage = e["verb"]
+        dur[stage] = dur.get(stage, 0.0) + float(e["dur"])
+        if e.get("outcome") and e["outcome"] != "ok":
+            outcomes[stage] = str(e["outcome"])
+    phases = {p: 0.0 for p in PHASES}
+    phases["submit"] = dur.get("task.submit", 0.0)
+    qphase = _QUEUE_PHASES.get(
+        outcomes.get("task.queued", "submit-queue"), "submit-queue"
+    )
+    phases[qphase] += dur.get("task.queued", 0.0)
+    fn_phase = (
+        "kv-get" if outcomes.get("task.fn_load", "").startswith("kv_get")
+        else "fn-push"
+    )
+    phases[fn_phase] += dur.get("task.fn_load", 0.0)
+    phases["arg-pull"] = dur.get("task.arg_pull", 0.0)
+    phases["exec"] = dur.get("task.exec", 0.0)
+    phases["result-push"] = dur.get("task.result", 0.0)
+    push = dur.get("task.push", 0.0)
+    inner = (
+        phases[fn_phase] + phases["arg-pull"] + phases["exec"]
+        + phases["result-push"]
+    )
+    serve = max(dur.get("task.serve", 0.0), inner)
+    phases["reply-ack"] = max(push - serve, 0.0)
+    # Wall: driver-clock envelope. All driver spans live in one process,
+    # so ts arithmetic is skew-free; fall back to the span extent when a
+    # stage was sampled out or overwritten in the ring.
+    starts = [e["ts"] for e in spans]
+    ends = [e["ts"] + e["dur"] for e in spans]
+    sub = [e for e in spans if e["verb"] == "task.submit"]
+    psh = [e for e in spans if e["verb"] == "task.push"]
+    t0 = min(e["ts"] for e in sub) if sub else min(starts)
+    t1 = max(e["ts"] + e["dur"] for e in psh) if psh else max(ends)
+    wall = max(t1 - t0, 0.0)
+    named = sum(v for p, v in phases.items() if p != "residual")
+    phases["residual"] = max(wall - named, 0.0)
+    name = _names_by_tid(events).get(str(task_id), "")
+    return {
+        "task_id": str(task_id),
+        "fn": name,
+        "wall_s": wall,
+        "phases": phases,
+        "outcomes": outcomes,
+        "spans": len(spans),
+    }
+
+
+def breakdown_all(merged: List[Dict[str, Any]],
+                  events=None) -> List[Dict[str, Any]]:
+    names = _names_by_tid(events)
+    out = []
+    for tid in _by_task(merged):
+        b = task_breakdown(merged, tid, events=None)
+        if b is None:
+            continue
+        b["fn"] = names.get(tid, b["fn"])
+        out.append(b)
+    return out
+
+
+def _pct(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(q * (len(sorted_vals) - 1) + 0.5), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+def phase_table(merged: List[Dict[str, Any]],
+                events=None) -> Dict[str, Dict[str, Dict[str, float]]]:
+    """Per-function phase statistics: {fn: {phase: {count, total_s,
+    p50_ms, p99_ms}}} over every task with spans in ``merged``. This is
+    the ``rt flight --task-attrib`` / ``bench.py --phases`` table."""
+    by_fn: Dict[str, Dict[str, List[float]]] = {}
+    for b in breakdown_all(merged, events):
+        fn = b["fn"] or "task"
+        rec = by_fn.setdefault(fn, {p: [] for p in PHASES})
+        for p, v in b["phases"].items():
+            rec[p].append(v)
+    out: Dict[str, Dict[str, Dict[str, float]]] = {}
+    for fn, rec in by_fn.items():
+        out[fn] = {}
+        for p, vals in rec.items():
+            if not vals or not any(v > 0.0 for v in vals):
+                continue
+            vs = sorted(vals)
+            out[fn][p] = {
+                "count": len(vs),
+                "total_s": sum(vs),
+                "p50_ms": _pct(vs, 0.50) * 1e3,
+                "p99_ms": _pct(vs, 0.99) * 1e3,
+            }
+    return out
+
+
+# ---------------------------------------------------------------- rendering
+
+def format_task_timeline(b: Dict[str, Any]) -> str:
+    """Fixed-width phase breakdown for one task (``rt timeline --task``)."""
+    wall = b["wall_s"]
+    lines = [
+        f"task {b['task_id']}"
+        + (f"  fn={b['fn']}" if b["fn"] else "")
+        + f"  wall={wall * 1e3:.3f}ms  ({b['spans']} spans)",
+        f"{'phase':<16}{'ms':>12}{'% wall':>9}",
+    ]
+    for p in PHASES:
+        v = b["phases"].get(p, 0.0)
+        if v <= 0.0 and p != "residual":
+            continue
+        pct = (v / wall * 100.0) if wall > 0 else 0.0
+        lines.append(f"{p:<16}{v * 1e3:>12.3f}{pct:>8.1f}%")
+    named = sum(b["phases"].values())
+    lines.append(f"{'sum':<16}{named * 1e3:>12.3f}"
+                 f"{(named / wall * 100.0 if wall > 0 else 0.0):>8.1f}%")
+    return "\n".join(lines)
+
+
+def format_phase_table(table: Dict[str, Dict[str, Dict[str, float]]]) -> str:
+    """Fixed-width per-function phase table, heaviest functions first."""
+    lines = [
+        f"{'fn':<20}{'phase':<16}{'count':>7}{'total_s':>9}"
+        f"{'p50_ms':>9}{'p99_ms':>9}"
+    ]
+    rows = sorted(
+        table.items(),
+        key=lambda kv: -sum(s["total_s"] for s in kv[1].values()),
+    )
+    for fn, phases in rows:
+        first = True
+        for p in PHASES:
+            s = phases.get(p)
+            if s is None:
+                continue
+            lines.append(
+                f"{(fn[:19] if first else ''):<20}{p:<16}"
+                f"{s['count']:>7}{s['total_s']:>9.3f}"
+                f"{s['p50_ms']:>9.3f}{s['p99_ms']:>9.3f}"
+            )
+            first = False
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------------------- joins
+
+def task_events_to_merged(events) -> List[Dict[str, Any]]:
+    """Lift state-API task events into the merged-span dict shape, so
+    ``flight.to_chrome_trace`` renders per-task tracks and stitches flow
+    links into every RPC span sharing the task's join key (the events
+    carry ``cid`` = task id, plus the RPC ``corr`` for actor pushes)."""
+    out: List[Dict[str, Any]] = []
+    for ev in events or ():
+        try:
+            t0 = float(ev["start_time"])
+            t1 = float(ev.get("end_time", t0))
+        except (KeyError, TypeError, ValueError):
+            continue
+        node = str(ev.get("node_id") or "node")[:8]
+        out.append({
+            "proc": f"task:{node}",
+            "pid": node,
+            "verb": f"{ev.get('name') or 'task'}"
+                    f" [{ev.get('state', '?')}]",
+            "cid": ev.get("cid") or ev.get("task_id"),
+            "kind": "task",
+            "ts": t0,
+            "dur": max(t1 - t0, 0.0),
+            "nbytes": 0,
+            "outcome": str(ev.get("state", "?")),
+            "qw": 0.0,
+        })
+        # Actor pushes also join on the RPC corr id: a second merged
+        # entry would double-count attribution, so the corr join rides
+        # a zero-duration instant at task start instead.
+        corr = ev.get("corr")
+        if corr and corr != ev.get("cid"):
+            out.append({
+                "proc": f"task:{node}", "pid": node,
+                "verb": f"{ev.get('name') or 'task'} [corr]",
+                "cid": corr, "kind": "task", "ts": t0, "dur": 0.0,
+                "nbytes": 0, "outcome": "join", "qw": 0.0,
+            })
+    out.sort(key=lambda e: e["ts"])
+    return out

@@ -43,7 +43,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import cloudpickle
 
 from ray_tpu import exceptions as exc
-from ray_tpu._private import faultpoints, flight, protocol, specframe
+from ray_tpu._private import faultpoints, flight, protocol, specframe, taskpath
 from ray_tpu._private.backoff import Backoff
 from ray_tpu._private.ids import (
     ActorID,
@@ -145,6 +145,11 @@ class _LeaseSet:
         self.avoid: Dict[str, float] = {}
         self.last_active = time.monotonic()
         self.reaper_running = False
+        # Taskpath plane: when the last lease grant landed, and whether it
+        # activated a warm-pool standby — names a queued task's wait
+        # (submit-queue vs lease-wait vs warm-pool-hit) at pop time.
+        self.last_grant_t = 0.0
+        self.last_grant_warm = False
 
 
 class _PendingActorCreate:
@@ -1258,7 +1263,15 @@ class CoreWorker:
                 counts.append(0)
                 continue
             t0 = time.time()
+            fl = flight.ENABLED
+            if fl:
+                tm0 = time.monotonic()
             res = self._exec_actor_call(inst, method, h, frames)
+            if fl:
+                taskpath.record_phase(
+                    "exec", h["tid"], tm0, time.monotonic(),
+                    fn=h["method"], phase="exec",
+                )
             if res == "exited":
                 self._apush_fail(
                     corr, protocol.RpcError("ActorMissing: actor exited")
@@ -1287,6 +1300,7 @@ class CoreWorker:
                 self._record_task_event({
                     "task_id": h["tid"], "name": h["method"],
                     "type": "ACTOR_TASK", "actor_id": h["aid"],
+                    "corr": h.get("corr"),
                     "state": "FINISHED" if ok else "FAILED",
                     "start_time": t0, "end_time": time.time(),
                     "node_id": self.node_id,
@@ -1347,7 +1361,17 @@ class CoreWorker:
             except IndexError:
                 break
             t0 = time.time()
+            fl = flight.ENABLED
+            if fl:
+                tm0 = time.monotonic()
             ok, result = self._ring_execute_one(fn, h, frames)
+            if fl:
+                tm1 = time.monotonic()
+                taskpath.record_phase(
+                    "exec", h["tid"], tm0, tm1,
+                    fn=h.get("name") or h.get("fkey", "")[:10],
+                    outcome="ok" if ok else "error", phase="exec",
+                )
             try:
                 rets, out_frames, big = self._package_result_parts(
                     h, ok, result
@@ -1369,14 +1393,40 @@ class CoreWorker:
                 subs.append({"i": h["i"], "rets": rets})
                 counts.append(len(out_frames))
                 out.extend(out_frames)
+            if fl:
+                now = time.monotonic()
+                taskpath.record_phase(
+                    "result", h["tid"], tm1, now,
+                    fn=h.get("name") or h.get("fkey", "")[:10],
+                    phase="result-push",
+                )
+                flight.record("task.serve", h["tid"], "task", tm0, now)
             self._ring_finish_task(h, ok, t0)
         if subs:
             rconn.send_reply_batch(subs, counts, out)
 
     def _ring_execute_task(self, fn, h, frames, rconn):
         t0 = time.time()
+        fl = flight.ENABLED
+        if fl:
+            tm0 = time.monotonic()
         ok, result = self._ring_execute_one(fn, h, frames)
+        if fl:
+            tm1 = time.monotonic()
+            taskpath.record_phase(
+                "exec", h["tid"], tm0, tm1,
+                fn=h.get("name") or h.get("fkey", "")[:10],
+                outcome="ok" if ok else "error", phase="exec",
+            )
         self._ring_reply_result(h, ok, result, rconn)
+        if fl:
+            now = time.monotonic()
+            taskpath.record_phase(
+                "result", h["tid"], tm1, now,
+                fn=h.get("name") or h.get("fkey", "")[:10],
+                phase="result-push",
+            )
+            flight.record("task.serve", h["tid"], "task", tm0, now)
         self._ring_finish_task(h, ok, t0)
 
     def _ring_reply_result(self, h, ok, result, rconn):
@@ -1523,7 +1573,15 @@ class CoreWorker:
                                  list(fr))
             return
         t0 = time.time()
+        fl = flight.ENABLED
+        if fl:
+            tm0 = time.monotonic()
         res = self._exec_actor_call(inst, method, h, frames)
+        if fl:
+            taskpath.record_phase(
+                "exec", h["tid"], tm0, time.monotonic(), fn=h["method"],
+                phase="exec",
+            )
         if res == "exited":
             # exit_actor(): mirror the slow path's clean-exit protocol.
             self._apush_fail(
@@ -1539,7 +1597,7 @@ class CoreWorker:
         inst.num_executed += 1
         self._record_task_event({
             "task_id": h["tid"], "name": h["method"], "type": "ACTOR_TASK",
-            "actor_id": h["aid"],
+            "actor_id": h["aid"], "corr": h.get("corr"),
             "state": "FINISHED" if ok else "FAILED",
             "start_time": t0, "end_time": time.time(),
             "node_id": self.node_id,
@@ -1559,6 +1617,9 @@ class CoreWorker:
                                  list(fr))
             return
         t0 = time.time()
+        fl = flight.ENABLED
+        if fl:
+            tm0 = time.monotonic()
         try:
             async with inst.async_sem:
                 arg_slots, plain, kwargs = self.ctx.deserialize_frames(
@@ -1591,11 +1652,16 @@ class CoreWorker:
                     ok, result = False, (e, traceback.format_exc())
         except Exception as e:
             ok, result = False, (e, traceback.format_exc())
+        if fl:
+            taskpath.record_phase(
+                "exec", h["tid"], tm0, time.monotonic(), fn=h["method"],
+                phase="exec",
+            )
         self._ring_reply_result(h, ok, result, rconn)
         inst.num_executed += 1
         self._record_task_event({
             "task_id": h["tid"], "name": h["method"], "type": "ACTOR_TASK",
-            "actor_id": h["aid"],
+            "actor_id": h["aid"], "corr": h.get("corr"),
             "state": "FINISHED" if ok else "FAILED",
             "start_time": t0, "end_time": time.time(),
             "node_id": self.node_id,
@@ -2862,6 +2928,9 @@ class CoreWorker:
         when num_returns == "streaming" (reference: generator tasks,
         ``task_manager.h`` streaming returns)."""
         streaming = num_returns == "streaming"
+        fl = flight.ENABLED
+        if fl:
+            fl_t0 = time.monotonic()
         fkey = self.export_function(fn)
         task_id = TaskID.of()
         frames, ref_ids, borrow_ids = self._serialize_args(args, kwargs)
@@ -2926,6 +2995,20 @@ class CoreWorker:
                 num_returns,
             )
         self._stats["tasks_submitted"] += 1
+        if fl:
+            # Taskpath plane: the submit span (serialize/export/enqueue)
+            # plus the queued stamp the pusher turns into a task.queued
+            # span at pop time ("_tq" never reaches the wire — popped
+            # there). The readable name rides the header so spec-framed
+            # submissions still attribute per function.
+            if "name" not in header:
+                header["name"] = name or getattr(fn, "__name__", "task")
+            now = time.monotonic()
+            taskpath.record_phase(
+                "submit", header["tid"], fl_t0, now,
+                fn=header["name"], phase="submit",
+            )
+            header["_tq"] = now
         self._enqueue_dispatch(
             self._dispatch_task_fast, (header, frames, resources, strategy,
                                        max_retries, skey)
@@ -3192,6 +3275,10 @@ class CoreWorker:
                 )
             if h.get("grants"):
                 lease_set.saturated = False
+                lease_set.last_grant_t = time.monotonic()
+                lease_set.last_grant_warm = any(
+                    g.get("warm") for g in h["grants"]
+                )
         except (protocol.RpcError, protocol.ConnectionLost, OSError) as e:
             logger.warning("lease request failed: %s", e)
             # fail pending tasks if nothing can ever be granted
@@ -3279,6 +3366,31 @@ class CoreWorker:
             return h2, [frames[0], blob, *frames[1:]]
         return h2, [blob, *frames]
 
+    def _pop_pending(self, lease_set: _LeaseSet) -> tuple:
+        """Pop the next pending task, turning its submit-time "_tq" stamp
+        into a ``task.queued`` span whose outcome NAMES the wait: a grant
+        that landed after enqueue means the task sat on a lease
+        (lease-wait — cold worker spawns surface here too, the head
+        blocks the grant until capacity exists), a warm-tagged grant
+        names the warm-pool activation, otherwise it was plain
+        submit-queue depth. The stamp never reaches the wire."""
+        item = lease_set.pending.popleft()
+        header = item[0]
+        t_enq = header.pop("_tq", None)
+        if t_enq is not None and flight.ENABLED:
+            if lease_set.last_grant_t <= t_enq:
+                tag = "submit-queue"
+            elif lease_set.last_grant_warm:
+                tag = "warm-pool-hit"
+            else:
+                tag = "lease-wait"
+            taskpath.record_phase(
+                "queued", header.get("tid"), t_enq, time.monotonic(),
+                fn=header.get("name") or header.get("fkey", "")[:10],
+                outcome=tag, phase=tag,
+            )
+        return item
+
     async def _call_with_tcp_fallback(self, conn, addr, method, header, frames):
         """Issue an RPC on ``conn`` (usually a ring); when the encoded
         message exceeds the ring limit despite the caller's size
@@ -3308,7 +3420,7 @@ class CoreWorker:
                         conn = await self.get_peer(slot.addr)
                         if not lease_set.pending:
                             break
-                        chunk = [lease_set.pending.popleft()]
+                        chunk = [self._pop_pending(lease_set)]
                     else:
                         conn = ring
                         # Pack tasks up to the batch count and the ring's
@@ -3325,12 +3437,12 @@ class CoreWorker:
                                 if not chunk:
                                     conn = await self.get_peer(slot.addr)
                                     if lease_set.pending:
-                                        chunk = [lease_set.pending.popleft()]
+                                        chunk = [self._pop_pending(lease_set)]
                                 break
                             if size + sz > budget and chunk:
                                 break
                             size += sz
-                            chunk.append(lease_set.pending.popleft())
+                            chunk.append(self._pop_pending(lease_set))
                     if not chunk:
                         continue
                     fl = flight.ENABLED
@@ -3358,10 +3470,14 @@ class CoreWorker:
                         if fl:
                             # Span covers push → reply, i.e. dispatch +
                             # execution on the leased slot.
+                            t_now = time.monotonic()
                             flight.record("worker.task.push",
                                           header.get("tid"), "worker",
-                                          fl_t0, time.monotonic(),
-                                          fl_bytes, "ok")
+                                          fl_t0, t_now, fl_bytes, "ok")
+                            taskpath.record_phase(
+                                "push", header.get("tid"), fl_t0, t_now,
+                                nbytes=fl_bytes,
+                            )
                         continue
 
                     try:
@@ -3383,6 +3499,11 @@ class CoreWorker:
                                     )
                                 )
                                 self._handle_task_reply(header, h, rframes)
+                                if fl:
+                                    taskpath.record_phase(
+                                        "push", header.get("tid"), fl_t0,
+                                        time.monotonic(),
+                                    )
                                 if not fut.done():
                                     fut.set_result(None)
                             except protocol.RpcError as e:
@@ -3415,6 +3536,15 @@ class CoreWorker:
                                 stop = True
                             continue
                         self._handle_task_reply(header, h, rframes)
+                        if fl:
+                            # Per-task push envelope (cid = task id): the
+                            # chunk-level worker.task.push verb span stays
+                            # for RPC attribution; this one anchors the
+                            # task's driver-clock wall time.
+                            taskpath.record_phase(
+                                "push", header.get("tid"), fl_t0,
+                                time.monotonic(),
+                            )
                         if not fut.done():
                             fut.set_result(None)
                     if fl:
@@ -3790,6 +3920,9 @@ class CoreWorker:
                 "methods (only plain tasks); return a list, or move the "
                 "generator into a task"
             )
+        fl = flight.ENABLED
+        if fl:
+            fl_t0 = time.monotonic()
         task_id = TaskID.of(ActorID.from_hex(actor_id_hex))
         frames, ref_ids, borrow_ids = self._serialize_args(args, kwargs)
         header = {
@@ -3810,6 +3943,16 @@ class CoreWorker:
             self._register_owned(oid.hex())
             refs.append(ObjectRef(oid, tuple(self.addr)))
         self._stats["tasks_submitted"] += 1
+        if fl:
+            # Taskpath plane: submit span + the queued stamp the dispatch
+            # loop turns into a task.queued span at first push ("_tq" is
+            # popped there, never sent).
+            now = time.monotonic()
+            taskpath.record_phase(
+                "submit", header["tid"], fl_t0, now, fn=method_name,
+                phase="submit",
+            )
+            header["_tq"] = now
         self._enqueue_dispatch(
             self._dispatch_actor_task, (header, frames, max_task_retries)
         )
@@ -3828,6 +3971,9 @@ class CoreWorker:
         from ray_tpu._private.config import rt_config
 
         ch = self.get_actor_channel(header["aid"])
+        # Submit-time queued stamp (popped here — must not ride the wire):
+        # becomes the task.queued span once the first push goes out.
+        t_enq = header.pop("_tq", None)
         # One correlation id per LOGICAL call, shared by every delivery
         # attempt: the hosting worker dedups on it, so a reply dropped
         # AFTER the method ran is replayed on retry — never re-applied
@@ -3881,6 +4027,15 @@ class CoreWorker:
                 fl = flight.ENABLED
                 if fl:
                     fl_t0 = time.monotonic()
+                    if t_enq is not None:
+                        # Actor queue time: channel resolution + creation
+                        # wait before the first wire attempt.
+                        taskpath.record_phase(
+                            "queued", header["tid"], t_enq, fl_t0,
+                            fn=header.get("method", ""),
+                            outcome="actor-pending", phase="lease-wait",
+                        )
+                        t_enq = None
                 if faultpoints.ACTIVE:
                     # drop: the push never reaches the actor worker — the
                     # reply deadline below fires and the corr-tagged retry
@@ -3896,9 +4051,13 @@ class CoreWorker:
                     attempt_s,
                 )
                 if fl:
+                    t_now = time.monotonic()
                     flight.record("worker.actor.push", header["corr"],
-                                  "worker", fl_t0, time.monotonic(), 0,
-                                  "ok")
+                                  "worker", fl_t0, t_now, 0, "ok")
+                    taskpath.record_phase(
+                        "push", header["tid"], fl_t0, t_now,
+                        fn=header.get("method", ""),
+                    )
                 self._handle_task_reply(header, h, rframes)
                 return
             except asyncio.TimeoutError:
@@ -4510,7 +4669,17 @@ class CoreWorker:
 
     def _record_task_event(self, event: dict):
         """Buffered task events for the state API (reference:
-        ``core_worker/task_event_buffer.h`` batching to GcsTaskManager)."""
+        ``core_worker/task_event_buffer.h`` batching to GcsTaskManager).
+
+        Every event carries the flight-plane join keys: ``cid`` (the task
+        id — the same key the ``task.*`` spans and per-task push spans
+        record) and, for actor pushes, the RPC ``corr`` id — so
+        ``taskpath.task_events_to_merged`` can stitch the event into the
+        flight trace with flow links."""
+        if not event.get("cid"):
+            event["cid"] = event.get("task_id")
+        if event.get("corr") is None:
+            event.pop("corr", None)
         self._task_events_buf.append(event)
 
     async def _task_event_flusher(self):
@@ -4590,6 +4759,11 @@ class CoreWorker:
             # Work arriving means the head activated this node: a later
             # re-registration (blip, head restart) must not claim standby.
             self.node_standby = False
+        fl = flight.ENABLED
+        if fl:
+            fl_srv0 = time.monotonic()
+            fb_rode = "fb" in h
+            f_cached = h.get("fkey") in self.fn_cache
         if "sp" in h or "fb" in h:
             h, frames = self._expand_task_header(h, frames)
         if self._memory_monitor.is_pressing():
@@ -4600,8 +4774,29 @@ class CoreWorker:
                 f"({self._memory_monitor.usage_string()})",
                 code="oom",
             )
+        if fl:
+            fl_name = h.get("name") or h.get("fkey", "")[:10]
+            t = time.monotonic()
         fn = await self._load_function(h["fkey"])
+        if fl:
+            # fn-push vs kv_get: the phase the submission-plane
+            # push-through exists to eliminate.
+            fn_out = (
+                "push-through" if fb_rode
+                else ("cached" if f_cached else "kv_get")
+            )
+            now = time.monotonic()
+            taskpath.record_phase(
+                "fn_load", h["tid"], t, now, fn=fl_name, outcome=fn_out,
+                phase="kv-get" if fn_out == "kv_get" else "fn-push",
+            )
+            t = now
         args, kwargs = await self._materialize_args(h, frames)
+        if fl:
+            taskpath.record_phase(
+                "arg_pull", h["tid"], t, time.monotonic(), fn=fl_name,
+                nbytes=sum(len(f) for f in frames), phase="arg-pull",
+            )
         if h.get("nret") == -1:
             return await self._execute_streaming_task(h, fn, args, kwargs, conn)
         loop = asyncio.get_running_loop()
@@ -4654,8 +4849,15 @@ class CoreWorker:
             # failure the chaos matrix exercises.
             await faultpoints.async_fire("worker.task.exec")
         t0 = time.time()
+        if fl:
+            tm = time.monotonic()
         ok, result = await loop.run_in_executor(self.task_executor, run)
         self._stats["tasks_executed"] += 1
+        if fl:
+            taskpath.record_phase(
+                "exec", h["tid"], tm, time.monotonic(), fn=fl_name,
+                outcome="ok" if ok else "error", phase="exec",
+            )
         self._record_task_event({
             "task_id": h["tid"], "name": h.get("name") or h["fkey"],
             "type": "NORMAL_TASK",
@@ -4668,7 +4870,18 @@ class CoreWorker:
             # code="oom" rejection the admission path uses — the owner
             # backs off this node and resubmits elsewhere.
             raise protocol.RpcError(str(result[0]), code="oom")
-        return await self._package_result(h, ok, result)
+        if not fl:
+            return await self._package_result(h, ok, result)
+        tm = time.monotonic()
+        out = await self._package_result(h, ok, result)
+        now = time.monotonic()
+        taskpath.record_phase(
+            "result", h["tid"], tm, now, fn=fl_name, phase="result-push",
+        )
+        # Serve envelope: arrival → reply ready; the driver derives
+        # reply-ack (wire both ways) as its push span minus this.
+        flight.record("task.serve", h["tid"], "task", fl_srv0, now)
+        return out
 
     async def _execute_streaming_task(self, h, fn, args, kwargs, conn):
         """Run a generator task, pushing each yielded item to the owner as
@@ -5242,6 +5455,9 @@ class CoreWorker:
         await self._admit_in_order(inst, caller, seq)
         loop = asyncio.get_running_loop()
         ev_start = time.time()
+        fl = flight.ENABLED
+        if fl:
+            tm0 = time.monotonic()
         try:
             if h["method"] == "__rt_apply__":
                 # Generic dispatch: run fn(instance, *args) on this actor.
@@ -5304,9 +5520,14 @@ class CoreWorker:
         finally:
             self._advance_seq(inst, caller, seq)
         inst.num_executed += 1
+        if fl:
+            taskpath.record_phase(
+                "exec", h["tid"], tm0, time.monotonic(), fn=h["method"],
+                outcome="ok" if ok else "error", phase="exec",
+            )
         self._record_task_event({
             "task_id": h["tid"], "name": h["method"], "type": "ACTOR_TASK",
-            "actor_id": h["aid"],
+            "actor_id": h["aid"], "corr": h.get("corr"),
             "state": "FINISHED" if ok else "FAILED",
             "start_time": ev_start, "end_time": time.time(),
             "node_id": self.node_id,
@@ -5446,6 +5667,19 @@ class CoreWorker:
             return
 
         async def _close():
+            if self.gcs is not None and self._task_events_buf:
+                # Clean-shutdown flush: a short-lived driver's tail events
+                # (< one 0.25s flusher tick old) must reach the head's
+                # ring before the connection drops. A call (not notify)
+                # so delivery is confirmed before teardown proceeds.
+                batch, self._task_events_buf = self._task_events_buf, []
+                try:
+                    await asyncio.wait_for(
+                        self.gcs.call("task_events", {"events": batch}),
+                        timeout=2.0,
+                    )
+                except Exception as e:
+                    logger.debug("final task-event flush failed: %s", e)
             try:
                 for rc in list(self._ring_peers.values()):
                     if rc is not False:

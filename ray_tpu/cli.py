@@ -273,37 +273,36 @@ def cmd_lint(args):
 
 def cmd_timeline(args):
     """Dump task events as chrome://tracing JSON (reference: ray timeline).
-    ``--rpc`` interleaves flight-recorder RPC spans under the task spans
-    (absolute wall-clock timestamps keep the two layers aligned)."""
+    ``--rpc`` interleaves flight-recorder RPC spans under the task spans —
+    ONE to_chrome_trace pass over both layers, so Perfetto draws flow
+    links between a task's events and every RPC span sharing its join key
+    (task id / corr). ``--task <id>`` prints that task's critical-path
+    phase breakdown instead (requires RT_FLIGHT_ENABLED=1)."""
+    from ray_tpu._private import flight, taskpath
     from ray_tpu.util import state
 
     address = _resolve_address(args)
+    if getattr(args, "task", None):
+        b = state.task_breakdown(args.task, address)
+        if b is None:
+            print(f"no flight spans recorded for task {args.task} — is "
+                  f"the recorder on (RT_FLIGHT_ENABLED=1), and is the id "
+                  f"a full task id from `rt summary tasks` / state API?")
+            sys.exit(1)
+        print(taskpath.format_task_timeline(b))
+        return
     events = state.list_tasks(address, limit=100_000)
-    trace = []
-    for e in events:
-        if "start_time" not in e:
-            continue
-        trace.append({
-            "name": e.get("name", "task"),
-            "cat": e.get("type", "task"),
-            "ph": "X",
-            "ts": e["start_time"] * 1e6,
-            "dur": (e.get("end_time", e["start_time"]) - e["start_time"]) * 1e6,
-            "pid": e.get("node_id", "node")[:8],
-            "tid": e.get("worker_id", e.get("actor_id", "worker"))[:8],
-        })
+    merged = taskpath.task_events_to_merged(events)
     nrpc = 0
     if getattr(args, "rpc", False):
-        from ray_tpu._private import flight
-
         # drain=False: rendering a timeline must not consume the rings
         # (a follow-up `rt flight` still sees the events).
-        merged = flight.merge_snapshots(
+        rpc_merged = flight.merge_snapshots(
             state.flight_snapshot(address, drain=False)
         )
-        rpc_events = flight.to_chrome_trace(merged, t0=0.0)
-        nrpc = len(rpc_events)
-        trace.extend(rpc_events)
+        nrpc = len(rpc_merged)
+        merged = sorted(merged + rpc_merged, key=lambda e: e["ts"])
+    trace = flight.to_chrome_trace(merged, t0=0.0)
     with open(args.output, "w") as f:
         json.dump(trace, f)
     extra = f" (+{nrpc} rpc spans)" if nrpc else ""
@@ -317,7 +316,8 @@ def cmd_flight(args):
     from ray_tpu._private import flight
     from ray_tpu.util import state
 
-    snaps = state.flight_snapshot(_resolve_address(args))
+    address = _resolve_address(args)
+    snaps = state.flight_snapshot(address)
     merged = flight.merge_snapshots(snaps)
     trace = flight.to_chrome_trace(merged)
     with open(args.output, "w") as f:
@@ -327,6 +327,16 @@ def cmd_flight(args):
           f"{procs} to {args.output}")
     if args.attrib:
         print(flight.format_attribution(flight.attribution(merged)))
+    if getattr(args, "task_attrib", False):
+        from ray_tpu._private import taskpath
+
+        events = state.list_tasks(address, limit=100_000)
+        table = taskpath.phase_table(merged, events)
+        if table:
+            print(taskpath.format_phase_table(table))
+        else:
+            print("no task.* spans recorded — run a workload with "
+                  "RT_FLIGHT_ENABLED=1 before draining")
     if not merged:
         print("no events recorded — enable with RT_FLIGHT_ENABLED=1 "
               "(or _system_config={'flight_enabled': True})")
@@ -441,6 +451,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--rpc", action="store_true",
                     help="interleave flight-recorder RPC spans under the "
                          "task spans (needs RT_FLIGHT_ENABLED=1)")
+    sp.add_argument("--task", default=None, metavar="TASK_ID",
+                    help="print ONE task's critical-path phase breakdown "
+                         "(submit → queue/lease → fn-push/kv-get → "
+                         "arg-pull → exec → result-push → reply-ack, "
+                         "residual explicit) instead of writing a trace")
     sp.set_defaults(fn=cmd_timeline)
 
     sp = sub.add_parser(
@@ -451,6 +466,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--output", "-o", default="flight.json")
     sp.add_argument("--attrib", action="store_true",
                     help="also print a per-verb time-attribution table")
+    sp.add_argument("--task-attrib", action="store_true",
+                    dest="task_attrib",
+                    help="also print the per-function task phase table "
+                         "(p50/p99 per phase, joined from task events)")
     sp.set_defaults(fn=cmd_flight)
     return p
 

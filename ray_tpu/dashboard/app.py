@@ -192,20 +192,31 @@ class DashboardApp:
 
     async def _metrics(self, request):
         """Prometheus exposition (reference: metrics agent scrape target):
-        user-defined series pushed by workers plus head-derived cluster
-        series (nodes/actors/demands/task counters)."""
+        user-defined series pushed by workers, head-derived cluster series
+        (nodes/actors/demands/task counters), and the cluster-wide task
+        phase rollup — ``rt_task_phase_seconds{phase,fn,node_id}``
+        aggregated across every worker so ONE scrape covers every node
+        (the serve autoscaler's and the chaos matrix's single source)."""
         from aiohttp import web
 
-        from ray_tpu.util.metrics import render_prometheus
+        from ray_tpu.util.metrics import render_prometheus, rollup_histogram
 
+        # Node-level rollup series: per-worker copies are excluded from
+        # the plain rendering so sums over the scrape never double-count.
+        ROLLUP = ("rt_task_phase_seconds",)
         h, _ = await self._head("metrics_snapshot", {})
-        text = render_prometheus(h["snapshots"])
+        snaps = h["snapshots"]
+        text = render_prometheus(snaps, exclude=ROLLUP)
+        rollup = "".join(
+            rollup_histogram(snaps, name, h.get("nodes"))
+            for name in ROLLUP
+        )
         builtin = []
         for name, value in self.head.builtin_metrics().items():
             kind = "counter" if name.endswith("_total") else "gauge"
             builtin.append(f"# TYPE {name} {kind}")
             builtin.append(f"{name} {value}")
         return web.Response(
-            text=text + "\n" + "\n".join(builtin) + "\n",
+            text=text + rollup + "\n" + "\n".join(builtin) + "\n",
             content_type="text/plain",
         )

@@ -167,22 +167,30 @@ class Histogram(Metric):
         }
 
 
-def render_prometheus(snapshots: Dict[str, List[dict]]) -> str:
-    """snapshots: {worker_id: [metric snapshot dicts]} → exposition text."""
+def _fmt_tags(tags: Dict[str, str]) -> str:
+    if not tags:
+        return ""
+    inner = ",".join(
+        f'{k}="{str(v).replace(chr(34), chr(39))}"'
+        for k, v in sorted(tags.items())
+    )
+    return "{" + inner + "}"
 
-    def fmt_tags(tags: Dict[str, str]) -> str:
-        if not tags:
-            return ""
-        inner = ",".join(
-            f'{k}="{str(v).replace(chr(34), chr(39))}"'
-            for k, v in sorted(tags.items())
-        )
-        return "{" + inner + "}"
 
+def render_prometheus(snapshots: Dict[str, List[dict]],
+                      exclude: Sequence[str] = ()) -> str:
+    """snapshots: {worker_id: [metric snapshot dicts]} → exposition text.
+    ``exclude``: metric names rendered elsewhere (e.g. the cluster-wide
+    rollup of :func:`rollup_histogram`) — emitting them per-worker too
+    would double-count in any scraper that sums the series."""
+
+    fmt_tags = _fmt_tags
     lines: List[str] = []
     seen_headers = set()
     for worker_id, metrics in snapshots.items():
         for m in metrics:
+            if m["name"] in exclude:
+                continue
             if m["name"] not in seen_headers:
                 seen_headers.add(m["name"])
                 if m.get("help"):
@@ -213,4 +221,60 @@ def render_prometheus(snapshots: Dict[str, List[dict]]) -> str:
                     lines.append(
                         f"{m['name']}{fmt_tags(tags)} {s['value']}"
                     )
+    return "\n".join(lines) + "\n"
+
+
+def rollup_histogram(snapshots: Dict[str, List[dict]], name: str,
+                     node_ids: Optional[Dict[str, str]] = None) -> str:
+    """Cluster-wide rollup of one histogram series: buckets/sum/count are
+    merged across every worker that pushed it, grouped by (node_id, tags)
+    — so the head's single ``/metrics`` endpoint exposes one bounded
+    series covering every node instead of one copy per worker process.
+    Returns exposition text ('' when no worker recorded the series)."""
+    merged: Dict[tuple, list] = {}
+    boundaries: Optional[List[float]] = None
+    help_text = ""
+    for wid, metrics in snapshots.items():
+        node = (node_ids or {}).get(wid) or "head"
+        for m in metrics:
+            if m.get("name") != name or m.get("type") != "histogram":
+                continue
+            if boundaries is None:
+                boundaries = list(m.get("boundaries") or ())
+                help_text = m.get("help", "")
+            elif list(m.get("boundaries") or ()) != boundaries:
+                # Boundary drift across processes (version skew): adding
+                # mismatched buckets would corrupt the rollup — skip, the
+                # per-worker exposition still carries the series.
+                continue
+            for s in m["samples"]:
+                key = (str(node)[:12], tuple(sorted(s["tags"].items())))
+                rec = merged.get(key)
+                if rec is None:
+                    merged[key] = [list(s["buckets"]), float(s["sum"]),
+                                   int(s["count"])]
+                else:
+                    rec[0] = [a + b for a, b in zip(rec[0], s["buckets"])]
+                    rec[1] += float(s["sum"])
+                    rec[2] += int(s["count"])
+    if not merged or boundaries is None:
+        return ""
+    lines: List[str] = []
+    if help_text:
+        lines.append(f"# HELP {name} {help_text}")
+    lines.append(f"# TYPE {name} histogram")
+    for (node, tags), (buckets, sum_, count) in sorted(merged.items()):
+        base = {**dict(tags), "node_id": node}
+        cum = 0
+        for b, n in zip(boundaries, buckets):
+            cum += n
+            lines.append(
+                f"{name}_bucket{_fmt_tags({**base, 'le': str(b)})} {cum}"
+            )
+        cum += buckets[-1]
+        lines.append(
+            f"{name}_bucket{_fmt_tags({**base, 'le': '+Inf'})} {cum}"
+        )
+        lines.append(f"{name}_sum{_fmt_tags(base)} {sum_}")
+        lines.append(f"{name}_count{_fmt_tags(base)} {count}")
     return "\n".join(lines) + "\n"

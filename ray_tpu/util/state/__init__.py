@@ -104,14 +104,21 @@ def list_tasks(address: Optional[str] = None, filters=None,
     return _apply_filters(rows, filters)[:limit]
 
 
-def summarize_tasks(address: Optional[str] = None) -> Dict[str, Any]:
-    """Counts by (name, state) (reference: ``api.py:1376``)."""
+def summarize_tasks(address: Optional[str] = None,
+                    phases: bool = False) -> Dict[str, Any]:
+    """Counts by (name, state) (reference: ``api.py:1376``).
+
+    ``phases=True`` additionally joins the flight recorder's task spans
+    to the task events and attaches a per-function critical-path table
+    (``{fn: {phase: {count, total_s, p50_ms, p99_ms}}}``) under
+    ``cluster.phases`` — requires the flight recorder to be enabled
+    (``RT_FLIGHT_ENABLED=1``); empty otherwise."""
     events = list_tasks(address, limit=100_000)
     by_name: Dict[str, Counter] = {}
     for e in events:
         name = e.get("name", "unknown")
         by_name.setdefault(name, Counter())[e.get("state", "UNKNOWN")] += 1
-    return {
+    out = {
         "cluster": {
             "summary": {
                 name: {"state_counts": dict(c)} for name, c in by_name.items()
@@ -119,6 +126,27 @@ def summarize_tasks(address: Optional[str] = None) -> Dict[str, Any]:
             "total_tasks": len(events),
         }
     }
+    if phases:
+        from ray_tpu._private import flight, taskpath
+
+        merged = flight.merge_snapshots(
+            flight_snapshot(address, drain=False)
+        )
+        out["cluster"]["phases"] = taskpath.phase_table(merged, events)
+    return out
+
+
+def task_breakdown(task_id: str, address: Optional[str] = None,
+                   drain: bool = False) -> Optional[Dict[str, Any]]:
+    """One task's critical path: named phase durations summing to the
+    task's driver-observed wall time, residual explicit (the ``rt
+    timeline --task`` surface). None when no flight span carries the id
+    (recorder off, or the span aged out of the ring)."""
+    from ray_tpu._private import flight, taskpath
+
+    merged = flight.merge_snapshots(flight_snapshot(address, drain=drain))
+    events = list_tasks(address, limit=100_000)
+    return taskpath.task_breakdown(merged, task_id, events)
 
 
 def cluster_status(address: Optional[str] = None) -> Dict[str, Any]:

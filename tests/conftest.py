@@ -61,6 +61,54 @@ def rt_start(request):
 
 
 @pytest.fixture
+def chaos_flight_trace(request, tmp_path):
+    """Chaos forensics: record the RPC plane during the test; on assertion
+    failure dump the fault-annotated trace as flight_<test>.json into the
+    tmp dir. The trace JOINS both observability planes: flight spans
+    (faultpoint hits stamp their enclosing spans) AND the task-event
+    tracks from the state API, so a matrix failure attributes to a verb
+    *and* a task phase out of the box. Prefers a cluster-wide snapshot
+    (worker rings + head task events) while the cluster is still up,
+    falling back to the local ring."""
+    import json as _json
+
+    from ray_tpu._private import flight, taskpath
+
+    flight.enable()
+    yield
+    rep = getattr(request.node, "rep_call", None)
+    try:
+        if rep is not None and rep.failed:
+            snaps, events = None, []
+            try:
+                from ray_tpu.util import state as _state
+
+                snaps = _state.flight_snapshot(drain=True)
+                events = _state.list_tasks(limit=100_000)
+            except Exception as e:
+                # Cluster already torn down by the test's finally: the
+                # local ring still holds the driver-side story.
+                print(f"[chaos] cluster-wide snapshot unavailable ({e}); "
+                      f"dumping the local ring only")
+            if not snaps:
+                snap = flight.drain()
+                snap["offset"] = 0.0
+                snaps = [snap]
+            merged = sorted(
+                flight.merge_snapshots(snaps)
+                + taskpath.task_events_to_merged(events),
+                key=lambda e: e["ts"],
+            )
+            trace = flight.to_chrome_trace(merged)
+            path = tmp_path / f"flight_{request.node.name}.json"
+            path.write_text(_json.dumps(trace))
+            print(f"\n[chaos] wrote annotated flight trace "
+                  f"({len(events)} task events joined) to {path}")
+    finally:
+        flight.disable()
+
+
+@pytest.fixture
 def rt_cluster(request):
     """Multi-node cluster fixture: yields (module, LocalCluster)."""
     import ray_tpu

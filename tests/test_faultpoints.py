@@ -17,7 +17,6 @@ per-RPC injected failures (``RAY_testing_rpc_failure`` hooks consulted in
   dropped first post-restore lease reply must leave the head serving).
 """
 import asyncio
-import json
 import threading
 import time
 from concurrent.futures import TimeoutError as SyncTimeoutError
@@ -26,7 +25,6 @@ import pytest
 
 import ray_tpu
 from ray_tpu._private import faultpoints as fp
-from ray_tpu._private import flight
 from ray_tpu._private.test_utils import NodeKiller, wait_for_condition
 
 
@@ -37,27 +35,8 @@ def _clean_faults():
     fp.clear()
 
 
-@pytest.fixture
-def chaos_flight_trace(request, tmp_path):
-    """Chaos forensics: record the RPC plane during the test; on assertion
-    failure dump the fault-annotated trace as flight_<test>.json into the
-    tmp dir (faultpoint hits stamp their enclosing spans, so the trace
-    shows exactly where the injection plane bit)."""
-    flight.enable()
-    yield
-    rep = getattr(request.node, "rep_call", None)
-    try:
-        if rep is not None and rep.failed:
-            snap = flight.drain()
-            snap["offset"] = 0.0
-            trace = flight.to_chrome_trace(
-                flight.merge_snapshots([snap])
-            )
-            path = tmp_path / f"flight_{request.node.name}.json"
-            path.write_text(json.dumps(trace))
-            print(f"\n[chaos] wrote annotated flight trace to {path}")
-    finally:
-        flight.disable()
+# chaos_flight_trace moved to conftest.py (shared with the serve chaos
+# matrix): it now joins the task-event tracks into the failure artifact.
 
 
 @pytest.fixture

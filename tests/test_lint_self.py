@@ -74,6 +74,36 @@ def test_specframe_module_is_family_b_clean():
     assert json.loads(proc.stdout) == []
 
 
+def test_taskpath_module_is_family_b_clean():
+    """The round-12 task-tracing plane records on the submit/exec hot
+    paths and aggregates on the head's /metrics rollup: a silent RPC
+    swallow or blocking work added there would be exactly the Family-B
+    regression class (``raytpu lint --framework`` over taskpath.py, the
+    exact CI invocation)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.lint",
+         os.path.join(REPO, "ray_tpu", "_private", "taskpath.py"),
+         "--framework", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout) == []
+
+
+def test_metrics_rollup_module_is_family_b_clean():
+    """util/metrics.py now carries the head-side rollup the aggregated
+    /metrics endpoint serves; it holds per-metric locks on hot observe
+    paths, so Family B must stay clean over it."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.lint",
+         os.path.join(REPO, "ray_tpu", "util", "metrics.py"),
+         "--framework", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout) == []
+
+
 def test_private_tree_is_family_b_clean():
     findings = lint_paths([os.path.join(REPO, "ray_tpu", "_private")])
     fam_b = [f for f in findings if f.rule.startswith("RT2")]

@@ -458,11 +458,13 @@ def test_sse_mid_stream_replica_crash_emits_terminal_error_event(srv):
 
 # ------------------------------------------------------------ chaos matrix
 @pytest.mark.slow
-def test_serve_chaos_matrix_mixed_faults_and_crash(monkeypatch):
+def test_serve_chaos_matrix_mixed_faults_and_crash(monkeypatch,
+                                                   chaos_flight_trace):
     """The serve request lifecycle under sustained 10% faults at the new
     serve.* points PLUS a replica crash mid-stream: every request ends in
     success or a typed retryable error (no hangs, no raw transport
-    errors), zero leaked leases, zero stranded router counts."""
+    errors), zero leaked leases, zero stranded router counts. A failure
+    dumps the joined flight + task-track trace (chaos_flight_trace)."""
     monkeypatch.setenv("RT_RPC_DEADLINE_S", "2")
     ray_tpu.init(num_cpus=4)
     try:

@@ -154,7 +154,14 @@ def test_actor_burst_is_o_bursts_head_rpcs(rt_start):
             return 1
 
     n = 100
-    actors = [A.remote() for _ in range(n)]
+    # Enqueue the opener and WAIT for its 1-item batch to reach the
+    # (gated) head before bursting the rest: whether the opener's drain
+    # callback wins the race against a tight enqueue loop is GIL
+    # preemption luck, and this test pins the batching invariant, not
+    # that race.
+    actors = [A.remote()]
+    wait_for_condition(lambda: len(executions) == 1, timeout=10)
+    actors += [A.remote() for _ in range(n - 1)]
     w.loop.call_soon_threadsafe(gate.set)
     assert ray_tpu.get([a.ping.remote() for a in actors],
                        timeout=120) == [1] * n

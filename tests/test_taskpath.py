@@ -1,0 +1,475 @@
+"""Task-centric end-to-end tracing: task events join the flight recorder.
+
+Covers the ISSUE-10 acceptance surface:
+
+- unit coverage for the critical-path analyzer (phases sum to wall with
+  the residual explicit, queue-phase naming, per-function table);
+- a real 2-node run whose task spans + task events merge into one trace
+  with cross-process flow links, per-task phase sums within 10% of the
+  driver-observed wall time;
+- disabled-mode parity: one boolean off → zero task spans recorded,
+  zero phase observations (same contract as ``flight.ENABLED``);
+- the task-event dict schema is PINNED (both the ``rt timeline`` chrome
+  exporter and the state API consumers parse these fields);
+- the head's aggregated ``/metrics`` exposes
+  ``rt_task_phase_seconds{phase,fn,node_id}`` covering every node from
+  ONE scrape on a 2-node cluster;
+- ``bench.py --phases`` records the per-function phase table.
+"""
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import flight, taskpath
+from ray_tpu._private.test_utils import wait_for_condition
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _flight_clean():
+    flight.disable()
+    yield
+    flight.disable()
+
+
+def _span(verb, cid, ts, dur, outcome="ok", kind="task", proc="driver"):
+    return {"proc": proc, "pid": 1, "verb": verb, "cid": cid, "kind": kind,
+            "ts": ts, "dur": dur, "nbytes": 0, "outcome": outcome,
+            "qw": 0.0}
+
+
+def _synthetic_task(tid="t1", queue_outcome="lease-wait",
+                    fn_outcome="kv_get"):
+    return [
+        _span("task.submit", tid, 0.000, 0.010),
+        _span("task.queued", tid, 0.010, 0.050, outcome=queue_outcome),
+        _span("task.push", tid, 0.060, 0.100),
+        _span("task.fn_load", tid, 0.065, 0.005, outcome=fn_outcome,
+              proc="n1"),
+        _span("task.arg_pull", tid, 0.070, 0.010, proc="n1"),
+        _span("task.exec", tid, 0.080, 0.050, proc="n1"),
+        _span("task.result", tid, 0.130, 0.010, proc="n1"),
+        _span("task.serve", tid, 0.063, 0.090, proc="n1"),
+    ]
+
+
+# ----------------------------------------------------------- analyzer units
+def test_breakdown_phases_sum_to_wall_with_explicit_residual():
+    b = taskpath.task_breakdown(_synthetic_task(), "t1")
+    assert b is not None
+    # wall: submit start (0.0) -> push end (0.160), driver clock
+    assert b["wall_s"] == pytest.approx(0.160)
+    p = b["phases"]
+    assert p["submit"] == pytest.approx(0.010)
+    assert p["lease-wait"] == pytest.approx(0.050)
+    assert p["kv-get"] == pytest.approx(0.005)
+    assert p["arg-pull"] == pytest.approx(0.010)
+    assert p["exec"] == pytest.approx(0.050)
+    assert p["result-push"] == pytest.approx(0.010)
+    # reply-ack = push span minus the executor's serve envelope
+    assert p["reply-ack"] == pytest.approx(0.010)
+    # residual is an EXPLICIT phase and the total is exact
+    assert "residual" in p and p["residual"] > 0
+    assert sum(p.values()) == pytest.approx(b["wall_s"])
+
+
+def test_queue_and_fn_phase_naming():
+    b = taskpath.task_breakdown(
+        _synthetic_task(queue_outcome="warm-pool-hit",
+                        fn_outcome="push-through"), "t1")
+    p = b["phases"]
+    assert p["warm-pool-hit"] == pytest.approx(0.050)
+    assert p["lease-wait"] == 0.0
+    assert p["fn-push"] == pytest.approx(0.005)
+    assert p["kv-get"] == 0.0
+    b2 = taskpath.task_breakdown(
+        _synthetic_task(queue_outcome="submit-queue"), "t1")
+    assert b2["phases"]["submit-queue"] == pytest.approx(0.050)
+
+
+def test_breakdown_unknown_task_is_none():
+    assert taskpath.task_breakdown(_synthetic_task(), "nope") is None
+    assert taskpath.task_breakdown([], "t1") is None
+
+
+def test_phase_table_groups_by_fn_and_formats():
+    merged = _synthetic_task("t1") + _synthetic_task("t2")
+    events = [
+        {"task_id": "t1", "name": "work", "state": "FINISHED"},
+        {"task_id": "t2", "name": "work", "state": "FINISHED"},
+    ]
+    table = taskpath.phase_table(merged, events)
+    assert "work" in table
+    assert table["work"]["exec"]["count"] == 2
+    assert table["work"]["exec"]["total_s"] == pytest.approx(0.100)
+    text = taskpath.format_phase_table(table)
+    assert "work" in text and "exec" in text
+    b = taskpath.task_breakdown(merged, "t1", events)
+    text2 = taskpath.format_task_timeline(b)
+    assert "t1" in text2 and "residual" in text2 and "lease-wait" in text2
+
+
+def test_task_events_to_merged_schema_and_corr_join():
+    events = [
+        {"task_id": "aa", "cid": "aa", "name": "f", "type": "NORMAL_TASK",
+         "state": "FINISHED", "start_time": 10.0, "end_time": 10.5,
+         "node_id": "node1234abcd"},
+        {"task_id": "bb", "cid": "bb", "corr": "c0ffee", "name": "m",
+         "type": "ACTOR_TASK", "state": "FAILED", "start_time": 11.0,
+         "end_time": 11.1, "node_id": "node1234abcd",
+         "actor_id": "act1"},
+    ]
+    merged = taskpath.task_events_to_merged(events)
+    # one track entry per event + one corr-join instant for the actor
+    assert len(merged) == 3
+    assert all(e["kind"] == "task" for e in merged)
+    assert {e["cid"] for e in merged} == {"aa", "bb", "c0ffee"}
+    assert merged[0]["proc"] == "task:node1234"
+    # exporter accepts them directly
+    trace = flight.to_chrome_trace(merged, t0=0.0)
+    assert all(ev["ph"] in ("X", "s", "f") for ev in trace)
+
+
+# ------------------------------------------------------------- cluster join
+def test_two_node_join_and_phase_sums(monkeypatch):
+    """Task spans + task events from a real 2-node run merge into one
+    trace with cross-process flow links; per-task phase sums land within
+    10% of the driver-observed wall time, residual explicit."""
+    monkeypatch.setenv("RT_FLIGHT_ENABLED", "1")
+    ray_tpu.init(num_cpus=2, num_nodes=2)
+    try:
+        flight.enable()
+
+        @ray_tpu.remote
+        def work(x):
+            time.sleep(0.02)
+            return x + 1
+
+        refs = [work.remote(i) for i in range(16)]
+        assert sorted(ray_tpu.get(refs, timeout=60)) == list(range(1, 17))
+        # A ref argument forces the slow executor path (arg-pull phase).
+        assert ray_tpu.get(work.remote(ray_tpu.put(5)), timeout=60) == 6
+
+        from ray_tpu._private.worker import get_global_worker
+        from ray_tpu.util import state
+
+        w = get_global_worker()
+
+        def events_ready():
+            evs = state.list_tasks(limit=100_000)
+            return sum(1 for e in evs if e.get("name") == "work") >= 17
+
+        wait_for_condition(events_ready, timeout=10)
+        events = state.list_tasks(limit=100_000)
+        h, _ = w.run_sync(w._head_call("flight_snapshot", {}), 60)
+        merged = flight.merge_snapshots(h["snapshots"])
+
+        task_spans = [e for e in merged if e["kind"] == "task"]
+        assert task_spans, "no task.* spans recorded"
+        # Cross-process join: driver-side push + executor-side exec spans
+        # share the task id.
+        procs_by_tid = {}
+        for e in task_spans:
+            procs_by_tid.setdefault(str(e["cid"]), set()).add(e["proc"])
+        assert any(len(ps) >= 2 for ps in procs_by_tid.values()), (
+            "no task id joined across processes")
+
+        checked = 0
+        for tid in procs_by_tid:
+            b = taskpath.task_breakdown(merged, tid, events)
+            if b is None or b["phases"]["exec"] <= 0 or b["wall_s"] <= 0:
+                continue
+            total = sum(b["phases"].values())
+            assert abs(total - b["wall_s"]) <= 0.1 * b["wall_s"] + 1e-6, (
+                f"phases sum {total} vs wall {b['wall_s']} for {tid}")
+            assert "residual" in b["phases"]
+            # named phases (not residual) carry the bulk of the wall
+            named = total - b["phases"]["residual"]
+            assert named >= 0.5 * b["wall_s"]
+            checked += 1
+        assert checked >= 8, f"only {checked} tasks had full breakdowns"
+
+        # per-function table joins names from the task events
+        table = taskpath.phase_table(merged, events)
+        assert "work" in table and "exec" in table["work"]
+        assert table["work"]["exec"]["count"] >= 8
+
+        # one chrome trace over BOTH planes: flow links reach the task
+        # tracks built from state-API events
+        joined = sorted(merged + taskpath.task_events_to_merged(events),
+                        key=lambda e: e["ts"])
+        trace = flight.to_chrome_trace(joined, t0=0.0)
+        flow_pids = {ev["pid"] for ev in trace if ev["ph"] in ("s", "f")}
+        assert any(str(p).startswith("task:") for p in flow_pids), (
+            "no flow link touches a task-event track")
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_disabled_mode_records_zero_task_spans():
+    """One-boolean gate parity with flight.ENABLED: recorder off → zero
+    task spans anywhere in the cluster and zero phase observations."""
+    from ray_tpu.util.metrics import registry
+
+    def _phase_count():
+        for m in registry().snapshot():
+            if m["name"] == "rt_task_phase_seconds":
+                return sum(s["count"] for s in m["samples"])
+        return 0
+
+    before = _phase_count()
+    assert not flight.ENABLED
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote
+        def f(x):
+            return x * 2
+
+        assert ray_tpu.get([f.remote(i) for i in range(8)], timeout=60) \
+            == [0, 2, 4, 6, 8, 10, 12, 14]
+
+        from ray_tpu._private.worker import get_global_worker
+
+        w = get_global_worker()
+        h, _ = w.run_sync(w._head_call("flight_snapshot", {}), 60)
+        for snap in h["snapshots"]:
+            assert snap["events"] == []
+        assert _phase_count() == before
+    finally:
+        ray_tpu.shutdown()
+
+
+# ------------------------------------------------------------ schema pinning
+REQUIRED_EVENT_FIELDS = {
+    "task_id", "name", "type", "state", "start_time", "end_time",
+    "node_id", "cid",
+}
+ALLOWED_EVENT_FIELDS = REQUIRED_EVENT_FIELDS | {"actor_id", "corr"}
+
+
+def test_task_event_schema_is_pinned():
+    """The task-event dict fields are a cross-plane contract: the state
+    API consumers (`rt summary tasks`, `rt events`-style listings), the
+    chrome-trace exporter, and the taskpath join all parse them. A new
+    producer field must be added to ALLOWED_EVENT_FIELDS here (and to
+    PARITY.md) deliberately."""
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote
+        def f(x):
+            return x
+
+        @ray_tpu.remote
+        class A:
+            def ping(self):
+                return "pong"
+
+        assert ray_tpu.get(f.remote(1), timeout=30) == 1
+        a = A.remote()
+        assert ray_tpu.get(a.ping.remote(), timeout=30) == "pong"
+
+        from ray_tpu.util import state
+
+        def both_types():
+            evs = state.list_tasks(limit=10_000)
+            types = {e.get("type") for e in evs}
+            return {"NORMAL_TASK", "ACTOR_TASK"} <= types
+
+        wait_for_condition(both_types, timeout=10)
+        events = state.list_tasks(limit=10_000)
+        assert events
+        for ev in events:
+            keys = set(ev)
+            assert REQUIRED_EVENT_FIELDS <= keys, (
+                f"missing fields {REQUIRED_EVENT_FIELDS - keys} in {ev}")
+            assert keys <= ALLOWED_EVENT_FIELDS, (
+                f"unpinned fields {keys - ALLOWED_EVENT_FIELDS} in {ev}")
+            assert isinstance(ev["task_id"], str)
+            assert ev["cid"] == ev["task_id"]
+            assert ev["type"] in ("NORMAL_TASK", "ACTOR_TASK")
+            assert ev["state"] in ("FINISHED", "FAILED")
+            assert isinstance(ev["start_time"], float)
+            assert isinstance(ev["end_time"], float)
+            assert ev["end_time"] >= ev["start_time"]
+            if ev["type"] == "ACTOR_TASK":
+                assert "actor_id" in ev
+        # both exporters parse every event without loss
+        merged = taskpath.task_events_to_merged(events)
+        assert len(merged) >= len(events)
+        trace = flight.to_chrome_trace(merged, t0=0.0)
+        assert sum(1 for e in trace if e["ph"] == "X") == len(merged)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_head_task_event_ring_is_bounded_and_reports_drops():
+    """The head buffer is a maxlen deque (O(1) overflow, oldest dropped)
+    and the drop count is reported, never silent."""
+    from collections import deque
+
+    from ray_tpu._private.gcs import HeadService
+
+    head = HeadService.__new__(HeadService)
+    head.task_events = deque(maxlen=5)
+    head._task_events_total = 0
+    head._task_state_counts = {}
+    import asyncio
+
+    async def drive():
+        evs = [{"task_id": f"t{i}", "state": "FINISHED",
+                "name": "x" * 1000} for i in range(12)]
+        await head.rpc_task_events({"events": evs}, [], None)
+        return await head.rpc_list_task_events({"limit": 100}, [], None)
+
+    h, _ = asyncio.run(drive())
+    assert len(h["events"]) == 5
+    assert h["recorded"] == 12 and h["dropped"] == 7
+    # newest kept, oldest dropped; oversized names clamped
+    assert h["events"][-1]["task_id"] == "t11"
+    assert all(len(e["name"]) <= 256 for e in h["events"])
+    assert head._task_state_counts["FINISHED"] == 12
+
+
+# ----------------------------------------------------------- metrics rollup
+def test_rollup_histogram_merges_across_workers():
+    from ray_tpu.util.metrics import rollup_histogram
+
+    def snap(count):
+        return [{
+            "name": "rt_task_phase_seconds", "type": "histogram",
+            "help": "h", "boundaries": [0.1, 1.0],
+            "samples": [{
+                "tags": {"phase": "exec", "fn": "f"},
+                "buckets": [count, 0, 0], "sum": 0.05 * count,
+                "count": count,
+            }],
+        }]
+
+    text = rollup_histogram(
+        {"w1": snap(2), "w2": snap(3), "w3": snap(5)},
+        "rt_task_phase_seconds",
+        {"w1": "nodeA", "w2": "nodeA", "w3": "nodeB"},
+    )
+    # same node merges; distinct nodes stay separate
+    assert 'node_id="nodeA"' in text and 'node_id="nodeB"' in text
+    lines = text.splitlines()
+    counts = {
+        ln.rsplit(" ", 1)[0]: ln.rsplit(" ", 1)[1]
+        for ln in lines if "_count" in ln
+    }
+    assert any(v == "5" for k, v in counts.items() if "nodeA" in k)
+    assert any(v == "5" for k, v in counts.items() if "nodeB" in k)
+
+
+def test_head_metrics_endpoint_covers_every_node(monkeypatch):
+    """Acceptance: one scrape of the head's /metrics exposes
+    rt_task_phase_seconds histograms covering every node of a 2-node
+    cluster (the per-node series are rolled up head-side)."""
+    monkeypatch.setenv("RT_FLIGHT_ENABLED", "1")
+    ray_tpu.init(num_cpus=1, num_nodes=2)
+    try:
+        flight.enable()
+
+        from ray_tpu.util.scheduling_strategies import (
+            NodeAffinitySchedulingStrategy,
+        )
+
+        @ray_tpu.remote
+        def burn(i):
+            time.sleep(0.05)
+            return i
+
+        from ray_tpu._private.worker import get_global_worker
+        from ray_tpu.dashboard import DashboardApp
+
+        cluster = ray_tpu._internal_cluster()
+        node_ids = {n.node_id[:12] for n in cluster.nodes}
+        assert len(node_ids) == 2
+        # Pin 4 tasks to EACH node: every node must observe exec phases.
+        refs = [
+            burn.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    n.node_id
+                )
+            ).remote(i)
+            for n in cluster.nodes for i in range(4)
+        ]
+        assert sorted(ray_tpu.get(refs, timeout=60)) == sorted(
+            list(range(4)) * 2
+        )
+        w = get_global_worker()
+        dash = DashboardApp(cluster.head, "127.0.0.1", 0)
+        port = w.run_sync(dash.start(), 30)
+        try:
+            def scraped():
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=5
+                ) as r:
+                    text = r.read().decode()
+                lines = [ln for ln in text.splitlines()
+                         if ln.startswith("rt_task_phase_seconds")]
+                if not lines:
+                    return False
+                covered = {nid for nid in node_ids
+                           if any(f'node_id="{nid}"' in ln
+                                  for ln in lines)}
+                # rollup series: phase+fn tags present, per-worker
+                # copies excluded (no double counting on sum())
+                assert all('worker_id=' not in ln for ln in lines)
+                return covered == node_ids and any(
+                    'phase="exec"' in ln and 'fn="burn"' in ln
+                    for ln in lines
+                )
+
+            # workers push metrics every ~2s
+            wait_for_condition(scraped, timeout=20)
+        finally:
+            w.run_sync(dash.stop(), 10)
+    finally:
+        ray_tpu.shutdown()
+
+
+# ------------------------------------------------------------ bench --phases
+def test_bench_phases_records_per_function_table(monkeypatch, tmp_path):
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    import bench
+    import ray_tpu._private.perf as perf
+
+    monkeypatch.setenv("RT_FLIGHT_ENABLED", "1")
+    ray_tpu.init(num_cpus=2)
+    try:
+        def tiny_leg(n=0):
+            @ray_tpu.remote
+            def bench_tiny(x):
+                return x + 1
+
+            assert sorted(ray_tpu.get(
+                [bench_tiny.remote(i) for i in range(20)], timeout=60
+            )) == list(range(1, 21))
+            return 1.0
+
+        monkeypatch.setattr(perf, "bench_many_actors", tiny_leg)
+        monkeypatch.setattr(perf, "bench_queued_tasks", tiny_leg)
+        out = bench.run_flight_benchmarks(
+            quick=True, phases=True,
+            attrib_path=str(tmp_path / "flight_attrib.json"),
+        )
+        assert "task_phases" in out
+        tables = out["task_phases"]
+        assert set(tables) == {"many_actors_per_s", "queued_5k_tasks_s"}
+        merged_fns = set()
+        for table in tables.values():
+            merged_fns |= set(table)
+        assert "bench_tiny" in merged_fns
+        # the table rides the attrib json too
+        data = json.loads((tmp_path / "flight_attrib.json").read_text())
+        assert "task_phases" in data["queued_5k_tasks_s"]
+    finally:
+        ray_tpu.shutdown()
