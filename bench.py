@@ -496,13 +496,40 @@ def run_flight_benchmarks(quick: bool = False, phases: bool = False,
                   file=sys.stderr)
             print(taskpath.format_phase_table(table), file=sys.stderr,
                   flush=True)
-    path = attrib_path or os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "flight_attrib.json"
-    )
+    path = attrib_path or _attrib_path()
     with open(path, "w") as f:
         json.dump(attrib_all, f, indent=1)
     out["flight_attrib_file"] = path
     return out
+
+
+def _attrib_path(output_dir: str = None) -> str:
+    """Where attribution scratch output lands: --output-dir when given,
+    else next to bench.py (gitignored — scratch files must never end up
+    committed at the repo root again)."""
+    d = output_dir or os.path.dirname(os.path.abspath(__file__))
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, "flight_attrib.json")
+
+
+def record_peak_object_store(core: dict):
+    """Record the cluster's peak object-store watermark into the bench
+    JSON (the arena's high-water mark per node, summed): the put/get
+    traffic a bench leg actually cost in store memory, alongside its
+    throughput numbers. Soft dependency — a summary failure annotates
+    instead of failing the run."""
+    try:
+        from ray_tpu.util import state
+
+        summary = state.memory_summary()
+        core["peak_object_store_bytes"] = int(
+            summary["totals"]["arena_peak_bytes"]
+        )
+        core["object_store_leak_candidates"] = int(
+            summary["totals"]["leak_candidates"]
+        )
+    except Exception as e:
+        core["peak_object_store_bytes_error"] = f"{type(e).__name__}: {e}"
 
 
 def run_serve_benchmarks(quick: bool = False) -> dict:
@@ -652,6 +679,11 @@ def main():
              "(submit/queue/exec/result p50+p99) into the bench JSON under "
              "task_phases — the perf trajectory carries attribution")
     parser.add_argument(
+        "--output-dir", default=None, dest="output_dir",
+        help="directory for attribution scratch files "
+             "(flight_attrib.json); default: next to bench.py — those "
+             "paths are gitignored scratch, never committed")
+    parser.add_argument(
         "--serve", action="store_true",
         help="closed-loop serve bench only: serve_qps + p50/p99 through "
              "the HTTP ingress, spiky open-loop bursts (admission-control "
@@ -714,10 +746,7 @@ def main():
                           file=sys.stderr)
                     print(flight.format_attribution(attrib),
                           file=sys.stderr, flush=True)
-                    path = os.path.join(
-                        os.path.dirname(os.path.abspath(__file__)),
-                        "flight_attrib.json",
-                    )
+                    path = _attrib_path(args.output_dir)
                     # merge: the core legs' attribution (plain --flight
                     # runs) and the serve leg share the file
                     try:
@@ -732,11 +761,16 @@ def main():
             elif args.flight:
                 core = {
                     "single_client_tasks_async_per_s": None,
-                    **run_flight_benchmarks(quick=args.quick,
-                                            phases=args.phases),
+                    **run_flight_benchmarks(
+                        quick=args.quick, phases=args.phases,
+                        attrib_path=_attrib_path(args.output_dir),
+                    ),
                 }
             else:
                 core = run_core_benchmarks(quick=args.quick)
+            # Peak store watermark rides every bench JSON: throughput
+            # numbers carry their object-plane memory cost.
+            record_peak_object_store(core)
         finally:
             ray_tpu.shutdown()
 

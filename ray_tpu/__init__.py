@@ -190,6 +190,14 @@ def _init_locked(address, num_cpus, num_nodes, resources, labels,
         _node_env = dict(
             rt_config.system_config_env(), **(_node_env or {})
         )
+        if "memtrack_enabled" in _system_config:
+            # The gate resolves at module import (before this apply):
+            # re-sync so a driver-side _system_config toggle takes
+            # effect in THIS process too, not just in spawned nodes.
+            from ray_tpu._private import memtrack
+
+            (memtrack.enable if rt_config.memtrack_enabled
+             else memtrack.disable)()
     # Resolve the head address like the reference's RAY_ADDRESS/"auto":
     # env var (set for submitted jobs), then the head's address file.
     if address is None:

@@ -238,6 +238,16 @@ rt_config.declare(
     "100x longer window for the same memory and the per-span cost is "
     "one counter bump for the skipped 99.")
 rt_config.declare(
+    "memtrack_enabled", bool, True,
+    "Object & memory observability plane (_private/memtrack.py): stamp "
+    "owner/node into directory registrations, answer memstat_drain with "
+    "owner-side object accounting, and push the rt_object_store_bytes / "
+    "rt_object_count / arena / spill / memory-pressure gauges every "
+    "metrics tick. Accounting is snapshot-time work over structures the "
+    "refcount plane already keeps — the put/get hot paths pay nothing — "
+    "so it defaults ON; RT_MEMTRACK_ENABLED=0 reduces every hook to one "
+    "boolean (`rt memory` and the leak SLO then report nothing).")
+rt_config.declare(
     "warm_workers", int, 0,
     "Warm worker pool: number of STANDBY node processes the local "
     "cluster preforks at init. Standby nodes register with the head but "

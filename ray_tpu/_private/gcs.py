@@ -1771,10 +1771,26 @@ class HeadService:
     async def rpc_object_register(self, h, frames, conn):
         # Owners flush registrations in batches ("items") — one notify per
         # put-burst, not per object; single oid/meta kept for compat.
+        # Each entry is stamped with the head's wall clock ("_t"): the
+        # leak detector's grace window measures age on ONE clock instead
+        # of trusting N workers' clocks (a re-registration — e.g. a spill
+        # transition — refreshes the stamp, which is correct: the entry
+        # was just proven live).
+        now = time.time()
         if "items" in h:
-            self.object_dir.update(h["items"])
+            items = h["items"]
+            # Both batch shapes are live: dict from rpc-level callers,
+            # pair list from the worker's ordered ref-op drain.
+            pairs = items.items() if isinstance(items, dict) else items
+            for oid, meta in pairs:
+                if isinstance(meta, dict):
+                    meta["_t"] = now
+                self.object_dir[oid] = meta
         else:
-            self.object_dir[h["oid"]] = h["meta"]
+            meta = h["meta"]
+            if isinstance(meta, dict):
+                meta["_t"] = now
+            self.object_dir[h["oid"]] = meta
         return {}, []
 
     async def rpc_object_lookup(self, h, frames, conn):
@@ -1821,11 +1837,45 @@ class HeadService:
         return {"jobs": list(self.jobs.values())}, []
 
     async def rpc_list_objects(self, h, frames, conn):
-        out = [
-            {"object_id": oid, "meta": meta}
-            for oid, meta in list(self.object_dir.items())[: h.get("limit", 1000)]
-        ]
-        return {"objects": out}, []
+        """Directory listing with server-side filters and honest
+        truncation: filters ([(key, op, value)], op in =/!=) run over the
+        flattened row BEFORE the limit slice, and the reply reports
+        {recorded, dropped} like ``list_task_events`` does — a truncated
+        listing is visible, never a silent slice."""
+        limit = h.get("limit", 1000)
+        filters = h.get("filters") or ()
+        rows = []
+        for oid, meta in list(self.object_dir.items()):
+            meta = meta if isinstance(meta, dict) else {}
+            row = {
+                "object_id": oid,
+                "bytes": int(meta.get("size") or 0),
+                "node": meta.get("node"),
+                "owner": meta.get("owner"),
+                "spilled": bool(meta.get("spill")),
+                "task": oid[:48],
+                "meta": meta,
+            }
+            keep = True
+            for key, op, value in filters:
+                have = str(row.get(key))
+                if op == "=":
+                    keep = have == str(value)
+                elif op == "!=":
+                    keep = have != str(value)
+                else:
+                    raise protocol.RpcError(
+                        f"unsupported filter op {op!r} (want = or !=)"
+                    )
+                if not keep:
+                    break
+            if keep:
+                rows.append(row)
+        recorded = len(rows)
+        if limit:
+            rows = rows[:limit]
+        return {"objects": rows, "recorded": recorded,
+                "dropped": max(recorded - len(rows), 0)}, []
 
     async def rpc_cluster_load(self, h, frames, conn):
         """Autoscaler feed: unsatisfied demands + pending PG bundles + the
@@ -1863,6 +1913,69 @@ class HeadService:
             },
         }, []
 
+    async def rpc_memory_summary(self, h, frames, conn):
+        """Object-plane cluster snapshot: fan ``memstat_drain`` out to
+        every connected process (the ``flight_snapshot`` pattern — remote
+        drivers own objects too; tool clients answer without a payload
+        and are skipped), and return the raw parts the memtrack join
+        needs: per-process accounting snapshots, the head's directory
+        (bounded, with honest truncation counts), the task-id → name map
+        for creating-task attribution, and the alive-node set."""
+        targets = {}
+        for n in self.nodes.values():
+            if n.alive and n.conn is not None:
+                targets[id(n.conn)] = (n.conn, n.node_id)
+        for c in (self.server.connections if self.server else ()):
+            targets.setdefault(id(c), (c, None))
+
+        async def one(c, label):
+            try:
+                hh, _ = await asyncio.wait_for(
+                    c.call("memstat_drain", {}), timeout=10,
+                )
+            except (asyncio.TimeoutError, protocol.RpcError,
+                    protocol.ConnectionLost, OSError) as e:
+                logger.debug("memstat_drain from %s failed: %s",
+                             label or c.name, e)
+                return None
+            s = hh.get("memstat")
+            if s and label:
+                s.setdefault("node", label)
+            return s
+
+        results = await asyncio.gather(
+            *(one(c, label) for c, label in targets.values())
+        )
+        # One snapshot per PROCESS (a peer reachable over two connections
+        # answers twice): keyed by worker id, keep the first.
+        by_worker = {}
+        for s in results:
+            if s:
+                by_worker.setdefault(s.get("worker") or id(s), s)
+        limit = h.get("limit", 10000)
+        directory = [
+            {"oid": oid, "meta": meta}
+            for oid, meta in itertools.islice(
+                self.object_dir.items(), limit or None
+            )
+        ]
+        names = {}
+        for e in self.task_events:
+            tid = e.get("task_id")
+            if tid:
+                names[tid] = e.get("name")
+        recorded = len(self.object_dir)
+        return {
+            "snapshots": list(by_worker.values()),
+            "directory": directory,
+            "recorded": recorded,
+            "dropped": max(recorded - len(directory), 0),
+            "tasks": names,
+            "nodes": [n.node_id for n in self.nodes.values() if n.alive],
+            "now": time.time(),
+            "enabled": bool(by_worker),
+        }, []
+
     async def rpc_task_event(self, h, frames, conn):
         return await self.rpc_task_events(
             {"events": [h["event"]]}, frames, conn
@@ -1882,6 +1995,7 @@ class HeadService:
             ),
             "rt_placement_groups": float(len(self.pgs)),
             "rt_pending_demands": float(len(self.pending_demands)),
+            "rt_object_dir_entries": float(len(self.object_dir)),
             "rt_tasks_finished_total": float(counters.get("FINISHED", 0)),
             "rt_tasks_failed_total": float(counters.get("FAILED", 0)),
         }

@@ -12,17 +12,17 @@ cgroup v2 limits are honored when present (containers), else /proc/meminfo.
 from __future__ import annotations
 
 import os
+import time
+from typing import Optional, Tuple
+
+DEFAULT_THRESHOLD = 0.95
+_CACHE_S = 0.5
 
 
 def _rt_config():
     from ray_tpu._private.config import rt_config
 
     return rt_config
-import time
-from typing import Optional, Tuple
-
-DEFAULT_THRESHOLD = 0.95
-_CACHE_S = 0.5
 
 
 def _read_int(path: str) -> Optional[int]:
@@ -82,6 +82,15 @@ def get_memory_usage() -> Tuple[int, int]:
         return 0, 1  # unknown: never report pressure
     used = total - (avail if avail is not None else total)
     return used, total
+
+
+def used_ratio() -> float:
+    """Current used/total fraction of this node's memory budget — the
+    input the OOM admission rejection compares against its threshold,
+    exported as ``rt_node_memory_used_ratio`` so pressure is observable
+    BEFORE rejections fire (memtrack gauge tick)."""
+    used, total = get_memory_usage()
+    return used / total if total > 0 else 0.0
 
 
 class MemoryMonitor:

@@ -37,6 +37,18 @@ _HDR_LEN = struct.Struct("<Q")
 _graveyard: List[shared_memory.SharedMemory] = []
 
 
+def graveyard_stats() -> dict:
+    """Count/bytes of freed-but-still-mapped segments in this process —
+    deliberately unreclaimed memory that MUST be visible to the metrics
+    plane (rt_arena_graveyard_* gauges), or zero-copy-heavy workloads
+    read as mystery RSS growth."""
+    n = b = 0
+    for shm in list(_graveyard):
+        n += 1
+        b += int(getattr(shm, "size", 0) or 0)
+    return {"segments": n, "bytes": b}
+
+
 def _align(n: int) -> int:
     return (n + _ALIGN - 1) & ~(_ALIGN - 1)
 
@@ -134,6 +146,24 @@ class LocalShmStore:
 
     def contains(self, object_hex: str) -> bool:
         return object_hex in self._segments
+
+    def created_stats(self) -> dict:
+        """Count/bytes of segments this process created and still holds —
+        the per-process contribution to node store utilization (segments
+        attached read-only are the creator's bytes, not ours)."""
+        n = b = 0
+        for hex_, created in list(self._created.items()):
+            if not created:
+                continue
+            shm = self._segments.get(hex_)
+            if shm is None:
+                continue
+            n += 1
+            b += int(getattr(shm, "size", 0) or 0)
+        return {"objects": n, "bytes": b}
+
+    def created_oids(self) -> List[str]:
+        return [h for h, c in list(self._created.items()) if c]
 
     def free(self, object_hex: str, meta: Optional[dict] = None):
         shm = self._segments.pop(object_hex, None)

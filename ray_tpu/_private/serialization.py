@@ -16,11 +16,14 @@ TPU-native analog of the reference serialization layer (reference:
 """
 from __future__ import annotations
 
+import logging
 import pickle
 from dataclasses import dataclass
 from typing import Any, Callable, List, Optional
 
 import cloudpickle
+
+logger = logging.getLogger(__name__)
 
 try:
     import numpy as _np
@@ -142,8 +145,15 @@ class SerializationContext:
             if refs:
                 try:
                     batch_hook(refs)
-                except Exception:
-                    pass
+                except Exception as e:
+                    # A dropped borrow registration risks a premature free
+                    # at the owner — the exact class of silent failure
+                    # the memtrack leak detector exists to surface; never
+                    # swallow it without a trace.
+                    logger.debug(
+                        "batched borrow registration for %d ref(s) "
+                        "failed: %s", len(refs), e,
+                    )
         return value
 
     def deserialize_frames(self, frames: List[bytes]) -> Any:

@@ -254,6 +254,13 @@ class SpillManager:
             "restored_objects": 0, "restored_bytes": 0,
         }
 
+    def stats_snapshot(self) -> dict:
+        """Consistent copy of the spill/restore counters for the metrics
+        plane (IO-pool threads mutate them concurrently; a torn read
+        could pair a new spilled_objects with an old spilled_bytes)."""
+        with self._stats_lock:
+            return dict(self.stats)
+
     @property
     def pool(self) -> ThreadPoolExecutor:
         if self._pool is None:

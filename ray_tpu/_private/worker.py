@@ -43,7 +43,14 @@ from typing import Any, Dict, List, Optional, Tuple
 import cloudpickle
 
 from ray_tpu import exceptions as exc
-from ray_tpu._private import faultpoints, flight, protocol, specframe, taskpath
+from ray_tpu._private import (
+    faultpoints,
+    flight,
+    memtrack,
+    protocol,
+    specframe,
+    taskpath,
+)
 from ray_tpu._private.backoff import Backoff
 from ray_tpu._private.ids import (
     ActorID,
@@ -453,10 +460,13 @@ class CoreWorker:
             freed += meta["size"]
             # "addr" routes readers that cannot open the uri (other hosts,
             # different backend) to this worker's RPC service, which
-            # serves the spilled bytes.
+            # serves the spilled bytes. "owner" keeps the directory entry
+            # attributable after the spill flips its kind (leak detection
+            # matches on it).
             meta = dict(
                 meta, node=self.node_id,
                 addr=list(self.addr) if self.addr else None,
+                owner=list(self.addr or ()),
             )
             if hex_ in self.memory_store:
                 self.memory_store[hex_] = ("shm", meta)
@@ -2480,9 +2490,18 @@ class CoreWorker:
 
     def _with_xfer(self, meta: dict) -> dict:
         """Stamp shm metadata with this worker's transfer-server address so
-        any process that cannot map the segment can bulk-fetch it natively."""
+        any process that cannot map the segment can bulk-fetch it natively.
+
+        When the memtrack plane is on, also stamp the storing node and
+        owner address — every registration path funnels through here, so
+        the head directory can attribute each entry to a node (for the
+        per-node store gauges/reconciliation) and to an owner (for leak
+        detection when that owner dies)."""
         if meta is not None and self.xfer_addr is not None:
             meta = dict(meta, xfer=list(self.xfer_addr))
+        if memtrack.ENABLED and meta is not None:
+            meta = dict(meta, node=self.node_id,
+                        owner=list(self.addr or ()))
         return meta
 
     async def _native_fetch(self, hex_: str, meta: dict, deadline=None):
@@ -4701,11 +4720,22 @@ class CoreWorker:
                     if self._shm is not None:
                         # Spill-plane counters ride the same pipeline
                         # (reference: spill stats in the metrics agent).
-                        for k, v in self._shm.spill.stats.items():
+                        for k, v in self._shm.spill.stats_snapshot().items():
                             Gauge(
                                 f"spill_{k}",
                                 description="object spill counter",
                             ).set(float(v))
+                    if memtrack.ENABLED:
+                        # Object-plane gauges (store bytes by kind, ref
+                        # states, arena/graveyard, memory pressure) ride
+                        # the same push; the head /metrics rolls them up
+                        # per node. On an executor thread: the aggregate
+                        # pass is O(owned), and a 1M-task burst must not
+                        # stall the core loop for its duration (GIL
+                        # interleaving beats a solid loop stall).
+                        await asyncio.get_running_loop().run_in_executor(
+                            None, memtrack.push_gauges, self
+                        )
                     snap = registry().snapshot()
                     if snap:
                         self.gcs.notify("metrics_push", {
@@ -5588,6 +5618,19 @@ class CoreWorker:
         the head can offset-correct our spans onto its own."""
         snap = flight.drain() if h.get("drain", True) else flight.snapshot()
         return {"flight": snap, "enabled": flight.ENABLED}, []
+
+    async def rpc_memstat_drain(self, h, frames, conn):
+        """Hand this process's object/memory accounting to the head (the
+        ``memory_summary`` fan-out). Disabled plane answers without a
+        payload — same contract as tool clients on ``flight_drain``. The
+        snapshot pass is O(owned) and runs on an executor thread so an
+        operator summary mid-burst never stalls the core loop."""
+        if not memtrack.ENABLED:
+            return {"enabled": False}, []
+        snap = await asyncio.get_running_loop().run_in_executor(
+            None, memtrack.local_snapshot, self
+        )
+        return {"memstat": snap, "enabled": True}, []
 
     async def rpc_dump_stacks(self, h, frames, conn):
         """All-thread stack dump (reference: py-spy via the reporter agent's

@@ -309,6 +309,33 @@ def cmd_timeline(args):
     print(f"wrote {len(trace)} events to {args.output}{extra}")
 
 
+def cmd_memory(args):
+    """Cluster-wide object & memory accounting (reference: ``ray
+    memory`` joining the ownership tables to the plasma store). Prints
+    owner-attributed object rows with per-node directory-vs-arena
+    reconciliation; ``--group-by`` aggregates, ``--leaks`` exits
+    nonzero when leak candidates exist (CI gate: directory entries past
+    the grace window that no live process owns, stores, or borrows)."""
+    from ray_tpu._private import memtrack
+
+    address = _resolve_address(args)
+    summary = memtrack.memory_summary(
+        address=address, group_by=args.group_by, grace_s=args.grace,
+    )
+    if args.json:
+        print(json.dumps(summary, indent=2, default=str))
+    else:
+        print(memtrack.format_summary(summary, limit=args.limit))
+    if args.leaks:
+        leaks = summary.get("leaks") or []
+        if leaks:
+            print(f"\nerror: {len(leaks)} leaked object(s) "
+                  f"(older than {args.grace}s, owner gone, no borrower)",
+                  file=sys.stderr)
+            sys.exit(1)
+        print("no leaked objects")
+
+
 def cmd_flight(args):
     """Drain the cluster-wide RPC flight recorder into a Chrome
     trace-event JSON (load in Perfetto or chrome://tracing). Recording
@@ -457,6 +484,27 @@ def build_parser() -> argparse.ArgumentParser:
                          "arg-pull → exec → result-push → reply-ack, "
                          "residual explicit) instead of writing a trace")
     sp.set_defaults(fn=cmd_timeline)
+
+    sp = sub.add_parser(
+        "memory", help="cluster-wide object & memory accounting: "
+                       "owner-attributed rows, per-node reconciliation, "
+                       "leak candidates (`ray memory` analog)"
+    )
+    sp.add_argument("--address", default=None)
+    sp.add_argument("--group-by", default=None, dest="group_by",
+                    choices=["owner", "node", "fn", "state", "kind",
+                             "task"],
+                    help="aggregate rows instead of listing them")
+    sp.add_argument("--leaks", action="store_true",
+                    help="exit 1 when leak candidates exist (CI gate)")
+    sp.add_argument("--grace", type=float, default=5.0,
+                    help="leak grace window in seconds (directory "
+                         "entries younger than this are never flagged)")
+    sp.add_argument("--limit", type=int, default=30,
+                    help="max rows/groups printed (--json prints all)")
+    sp.add_argument("--json", action="store_true",
+                    help="machine-readable full summary")
+    sp.set_defaults(fn=cmd_memory)
 
     sp = sub.add_parser(
         "flight", help="drain the cross-process RPC flight recorder into "

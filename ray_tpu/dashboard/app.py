@@ -37,6 +37,7 @@ class DashboardApp:
         r.add_get("/api/jobs/{submission_id}/logs", self._job_logs)
         r.add_post("/api/jobs/{submission_id}/stop", self._stop_job)
         r.add_get("/api/tasks", self._tasks)
+        r.add_get("/api/objects", self._objects)
         r.add_get("/api/cluster_status", self._cluster_status)
         r.add_get("/api/stacks", self._stacks)
         r.add_get("/api/logs", self._logs)
@@ -167,6 +168,28 @@ class DashboardApp:
         h, _ = await self._head("list_task_events", {"limit": limit})
         return web.json_response(h)
 
+    async def _objects(self, request):
+        """Objects page data: the memory_summary fan-out joined head-side
+        (object rows, per-node reconciliation, leak candidates). Query:
+        ``group_by`` aggregates, ``grace`` tunes the leak window."""
+        from aiohttp import web
+
+        from ray_tpu._private import memtrack
+
+        try:
+            grace = float(request.query.get("grace", "5"))
+        except ValueError:
+            grace = 5.0
+        h, _ = await self._head("memory_summary", {})
+        summary = memtrack.build_summary(h, grace_s=grace)
+        group_by = request.query.get("group_by")
+        if group_by in memtrack.GROUP_KEYS:
+            summary["groups"] = memtrack.group_rows(
+                summary["rows"], group_by
+            )
+            summary["group_by"] = group_by
+        return web.json_response(summary)
+
     async def _cluster_status(self, request):
         from aiohttp import web
 
@@ -199,17 +222,38 @@ class DashboardApp:
         (the serve autoscaler's and the chaos matrix's single source)."""
         from aiohttp import web
 
-        from ray_tpu.util.metrics import render_prometheus, rollup_histogram
+        from ray_tpu.util.metrics import (
+            render_prometheus,
+            rollup_gauge,
+            rollup_histogram,
+        )
 
         # Node-level rollup series: per-worker copies are excluded from
         # the plain rendering so sums over the scrape never double-count.
-        ROLLUP = ("rt_task_phase_seconds",)
+        ROLLUP_HIST = ("rt_task_phase_seconds",)
+        # Object-plane gauges roll up per node too: "sum" for
+        # owner-attributed series, "max" for node-shared readings every
+        # process reports identically (arena counters, memory pressure).
+        ROLLUP_GAUGE = {
+            "rt_object_store_bytes": "sum",
+            "rt_object_count": "sum",
+            "rt_spill_bytes_total": "sum",
+            "rt_restore_bytes_total": "sum",
+            "rt_arena_graveyard_segments": "sum",
+            "rt_arena_graveyard_bytes": "sum",
+            "rt_arena_bytes": "max",
+            "rt_node_memory_used_ratio": "max",
+        }
+        exclude = ROLLUP_HIST + tuple(ROLLUP_GAUGE)
         h, _ = await self._head("metrics_snapshot", {})
         snaps = h["snapshots"]
-        text = render_prometheus(snaps, exclude=ROLLUP)
+        text = render_prometheus(snaps, exclude=exclude)
         rollup = "".join(
             rollup_histogram(snaps, name, h.get("nodes"))
-            for name in ROLLUP
+            for name in ROLLUP_HIST
+        ) + "".join(
+            rollup_gauge(snaps, name, h.get("nodes"), agg=agg)
+            for name, agg in ROLLUP_GAUGE.items()
         )
         builtin = []
         for name, value in self.head.builtin_metrics().items():

@@ -47,6 +47,7 @@ INDEX_HTML = """<!doctype html>
   <section><h2>Placement groups</h2><div id="pgs"></div></section>
   <section><h2>Jobs</h2><div id="jobs"></div></section>
   <section><h2>Tasks (recent)</h2><div id="tasks"></div></section>
+  <section><h2>Objects &amp; memory</h2><div id="objects"></div></section>
   <section><h2>Worker logs (recent)</h2><div id="logs"></div></section>
 </main>
 <footer>auto-refreshes every 2s · JSON API under /api/*</footer>
@@ -72,11 +73,13 @@ function util(res, avail) {
   }).join("<br>");
 }
 async function j(url) { const r = await fetch(url); return r.json(); }
+function mb(n) { return (n / 1048576).toFixed(2) + " MiB"; }
 async function refresh() {
   try {
-    const [nodes, actors, pgs, jobs, tasks, logs] = await Promise.all([
+    const [nodes, actors, pgs, jobs, tasks, logs, objs] = await Promise.all([
       j("/api/nodes"), j("/api/actors"), j("/api/placement_groups"),
-      j("/api/jobs"), j("/api/tasks"), j("/api/logs?tail=100")]);
+      j("/api/jobs"), j("/api/tasks"), j("/api/logs?tail=100"),
+      j("/api/objects")]);
     const ns = nodes.nodes || [];
     $("meta").textContent =
       `${ns.filter(n => n.alive).length} alive node(s), ` +
@@ -117,6 +120,26 @@ async function refresh() {
         : (t.state === "FAILED" ? '<span class=bad>FAILED</span>'
                                 : esc(t.state))],
       ["node", t => esc((t.node_id || "").slice(0, 10))]]);
+    const t = objs.totals || {};
+    const leaks = objs.leaks || [];
+    const head =
+      `objects: ${t.objects ?? 0} · inline ${mb(t.inline_bytes || 0)}` +
+      ` · shm ${mb(t.shm_bytes || 0)} · spilled ${mb(t.spilled_bytes || 0)}` +
+      ` · directory ${t.directory_entries ?? 0}` +
+      (leaks.length
+        ? ` · <span class=bad>${leaks.length} leak candidate(s)</span>`
+        : ' · <span class=ok>no leaks</span>');
+    const rows = (objs.rows || [])
+      .slice().sort((a, b) => (b.bytes || 0) - (a.bytes || 0)).slice(0, 15);
+    $("objects").innerHTML = `<p>${head}</p>` + table(rows, [
+      ["object", o => esc((o.oid || "").slice(0, 10))],
+      ["kind", o => esc(o.kind || "")],
+      ["state", o => o.state === "pinned"
+        ? '<span class=ok>pinned</span>' : esc(o.state || "")],
+      ["bytes", o => mb(o.bytes || 0)],
+      ["node", o => esc((o.node || "").slice(0, 10))],
+      ["fn", o => esc(o.fn || "")],
+      ["task", o => esc((o.task || "").slice(0, 10))]]);
     const ls = (logs.lines || []).slice(-40);
     $("logs").innerHTML = ls.length
       ? "<pre>" + ls.map(l =>

@@ -169,7 +169,9 @@ class NativeArenaStore:
             # in kCreated holding its allocation.
             self._lib.rt_obj_delete(self._h, object_hex.encode())
             raise RuntimeError(f"obj_seal({object_hex}): errno {-rc}")
-        self._created[object_hex] = True
+        # Value is the sealed size (truthy — callers only gate on presence):
+        # per-process created-bytes accounting for the memtrack plane.
+        self._created[object_hex] = total
         return {"arena": self.name, "size": total}
 
     def get_frames(self, object_hex: str, meta: dict) -> Optional[List[memoryview]]:
@@ -228,6 +230,18 @@ class NativeArenaStore:
     def _view(self, off: int, size: int) -> memoryview:
         arr = (ctypes.c_char * size).from_address(self._base + off)
         return memoryview(arr).cast("B")
+
+    def created_stats(self) -> dict:
+        """This process's contribution to the shared arena: objects it
+        created (and still holds) with their sealed sizes."""
+        n = b = 0
+        for v in list(self._created.values()):
+            n += 1
+            b += int(v)
+        return {"objects": n, "bytes": b}
+
+    def created_oids(self) -> List[str]:
+        return list(self._created)
 
     def stats(self) -> dict:
         used = ctypes.c_uint64()
@@ -333,6 +347,32 @@ class HybridShmStore:
             self.spill.delete({"spill": self.spill.key_uri(object_hex)})
         if meta is None:
             self.fallback.free(object_hex)
+
+    def stats(self) -> dict:
+        """Store-plane accounting for the memtrack gauges: node-wide arena
+        counters (None without the native toolchain), this process's
+        fallback-segment and graveyard bytes, and the spill counters."""
+        from ray_tpu._private.object_store import graveyard_stats
+
+        return {
+            "arena": self.arena.stats() if self.arena is not None else None,
+            "arena_created": (
+                self.arena.created_stats() if self.arena is not None
+                else {"objects": 0, "bytes": 0}
+            ),
+            "fallback": self.fallback.created_stats(),
+            "graveyard": graveyard_stats(),
+            "spill": self.spill.stats_snapshot(),
+        }
+
+    def created_oids(self) -> List[str]:
+        """Objects this process created and still holds in either store —
+        the 'a live mapping still backs this directory entry' signal the
+        leak detector checks before flagging an orphan."""
+        oids = self.fallback.created_oids()
+        if self.arena is not None:
+            oids += self.arena.created_oids()
+        return oids
 
     def close_all(self):
         if self.arena is not None:

@@ -224,6 +224,57 @@ def render_prometheus(snapshots: Dict[str, List[dict]],
     return "\n".join(lines) + "\n"
 
 
+def rollup_gauge(snapshots: Dict[str, List[dict]], name: str,
+                 node_ids: Optional[Dict[str, str]] = None,
+                 agg: str = "sum") -> str:
+    """Cluster-wide rollup of one gauge series, grouped by (node_id,
+    tags). ``agg="sum"`` for owner-attributed series (each worker reports
+    only what it owns — per-node sums never double-count);
+    ``agg="max"`` for node-shared readings every process on the node
+    reports identically (arena utilization, memory pressure) where a sum
+    would multiply by the process count. Returns exposition text (''
+    when no worker pushed the series)."""
+    if agg not in ("sum", "max"):
+        raise ValueError(f"agg must be 'sum' or 'max', got {agg!r}")
+    merged: Dict[tuple, float] = {}
+    help_text = ""
+    found = False
+    for wid, metrics in snapshots.items():
+        node = (node_ids or {}).get(wid) or "head"
+        for m in metrics:
+            if m.get("name") != name or m.get("type") != "gauge":
+                continue
+            found = True
+            help_text = help_text or m.get("help", "")
+            for s in m["samples"]:
+                # A sample-level "node" tag wins over the pushing
+                # worker's node: owner-attributed series may account
+                # bytes that physically live on another node's store
+                # (a task return is owned by the driver but its segment
+                # sits in the executing node's arena).
+                tags = dict(s["tags"])
+                snode = tags.pop("node", None) or node
+                key = (str(snode)[:12], tuple(sorted(tags.items())))
+                v = float(s["value"])
+                if key not in merged:
+                    merged[key] = v
+                elif agg == "max":
+                    merged[key] = max(merged[key], v)
+                else:
+                    merged[key] += v
+    if not found:
+        return ""
+    lines: List[str] = []
+    if help_text:
+        lines.append(f"# HELP {name} {help_text}")
+    lines.append(f"# TYPE {name} gauge")
+    for (node, tags), v in sorted(merged.items()):
+        lines.append(
+            f"{name}{_fmt_tags({**dict(tags), 'node_id': node})} {v}"
+        )
+    return "\n".join(lines) + "\n"
+
+
 def rollup_histogram(snapshots: Dict[str, List[dict]], name: str,
                      node_ids: Optional[Dict[str, str]] = None) -> str:
     """Cluster-wide rollup of one histogram series: buckets/sum/count are

@@ -73,8 +73,32 @@ def list_jobs(address: Optional[str] = None, filters=None,
 
 def list_objects(address: Optional[str] = None, filters=None,
                  limit: int = 1000) -> List[dict]:
-    rows = _call("list_objects", {"limit": limit}, address)["objects"]
-    return _apply_filters(rows, filters)[:limit]
+    """Object-directory listing. Filters are applied SERVER-side (the
+    head evaluates them over the flattened row — object_id/bytes/node/
+    owner/spilled/task — before the limit slice, so a filtered listing
+    is never starved by truncation); the local pass only covers heads
+    predating the server-side path."""
+    h = _call("list_objects", {
+        "limit": limit,
+        "filters": [list(f) for f in (filters or ())],
+    }, address)
+    return _apply_filters(h["objects"], filters)[:limit]
+
+
+def memory_summary(address: Optional[str] = None,
+                   group_by: Optional[str] = None,
+                   grace_s: float = 5.0) -> Dict[str, Any]:
+    """Cluster-wide object & memory accounting (the ``rt memory``
+    surface): owner-attributed object rows {oid, bytes, kind, state,
+    node, owner, task, fn}, per-node directory-vs-arena reconciliation,
+    and leak candidates (directory entries past the grace window that no
+    live process owns, stores, or borrows). See
+    ``_private/memtrack.py``."""
+    from ray_tpu._private import memtrack
+
+    return memtrack.memory_summary(
+        address=address, group_by=group_by, grace_s=grace_s
+    )
 
 
 def list_logs(address: Optional[str] = None, node_id: Optional[str] = None,

@@ -247,6 +247,15 @@ def _leases_settled():
     )
 
 
+def _no_leaked_objects():
+    """Zero leaked objects (the memtrack plane's chaos SLO, joined to the
+    zero-leaked-leases one): no directory entry past the grace window
+    that no live process owns, stores, or borrows."""
+    from ray_tpu.util import state
+
+    return state.memory_summary(grace_s=1.0)["leaks"] == []
+
+
 def test_lease_reply_drop_is_retried_and_deduped(rt_start, fast_rpc):
     # The FIRST lease reply is swallowed after the head applied the grant;
     # the client's deadline fires, the retry carries the same correlation
@@ -505,6 +514,8 @@ def test_chaos_matrix(spec, monkeypatch, chaos_flight_trace):
         fp.clear()
         wait_for_condition(_leases_settled, timeout=20,
                            message=f"leaked leases under {spec}")
+        wait_for_condition(_no_leaked_objects, timeout=20,
+                           message=f"leaked objects under {spec}")
     finally:
         fp.clear()
         ray_tpu.shutdown()
@@ -553,9 +564,13 @@ def test_chaos_matrix_worker_crash(monkeypatch, chaos_flight_trace):
             or not cluster.head.nodes[doomed.node_id].alive,
             timeout=30, message="head never observed the crashed node",
         )
-        # and the crash leaked no lease accounting on the survivors
+        # and the crash leaked no lease accounting on the survivors —
+        # nor any object: whatever the dead node registered must either
+        # be borrower-held or gone from the directory
         wait_for_condition(_leases_settled, timeout=20,
                            message="worker crash leaked leases")
+        wait_for_condition(_no_leaked_objects, timeout=20,
+                           message="worker crash leaked objects")
     finally:
         ray_tpu.shutdown()
 
@@ -570,3 +585,5 @@ def test_chaos_smoke(rt_start, fast_rpc):
     fp.clear()
     wait_for_condition(_leases_settled, timeout=15,
                        message="chaos smoke leaked leases")
+    wait_for_condition(_no_leaked_objects, timeout=15,
+                       message="chaos smoke leaked objects")

@@ -90,6 +90,22 @@ def test_taskpath_module_is_family_b_clean():
     assert json.loads(proc.stdout) == []
 
 
+def test_memtrack_module_is_family_b_clean():
+    """The round-13 object-accounting plane snapshots refcount state and
+    talks to the head's fan-out verb: a silent RPC swallow on the drain
+    path or blocking work added under a lock there is exactly the
+    Family-B regression class (``raytpu lint --framework`` over
+    memtrack.py, the exact CI invocation)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.lint",
+         os.path.join(REPO, "ray_tpu", "_private", "memtrack.py"),
+         "--framework", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout) == []
+
+
 def test_metrics_rollup_module_is_family_b_clean():
     """util/metrics.py now carries the head-side rollup the aggregated
     /metrics endpoint serves; it holds per-metric locks on hot observe

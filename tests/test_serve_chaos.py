@@ -61,6 +61,14 @@ def _zero_stranded(router):
     return sum(snap.values()) == 0, snap
 
 
+def _no_leaked_objects():
+    """Zero leaked objects (memtrack plane SLO, same contract as the
+    core chaos matrix): no orphaned directory entries past grace."""
+    from ray_tpu.util import state
+
+    return state.memory_summary(grace_s=1.0)["leaks"] == []
+
+
 # ------------------------------------------------- pre-dispatch failover
 @pytest.mark.parametrize("rt_start", [{"num_cpus": 8}], indirect=True)
 def test_failover_before_user_code_is_transparent(srv):
@@ -529,6 +537,8 @@ def test_serve_chaos_matrix_mixed_faults_and_crash(monkeypatch,
         serve.shutdown()  # releases replica leases
         wait_for_condition(_leases_settled, timeout=30,
                            message="serve chaos leaked leases")
+        wait_for_condition(_no_leaked_objects, timeout=20,
+                           message="serve chaos leaked objects")
     finally:
         fp.clear()
         ray_tpu.shutdown()
