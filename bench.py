@@ -24,6 +24,10 @@ os.environ.setdefault(
 )
 
 BASELINE_TASKS_ASYNC = 7096.8  # reference release/perf_metrics/microbenchmark.json
+# End-to-end regression guard for the device plane: GPT-2 train throughput
+# measured in BENCH_r05 on this hardware. A device-plane change that taxes
+# the hot path shows up here before anything else.
+BASELINE_GPT2_TOKENS_PER_SEC_PER_CHIP = 86_200.0  # BENCH_r05.json
 
 
 def measure_achievable_tflops() -> float:
@@ -811,6 +815,11 @@ def main():
             for k, v in extra.items()
         },
     }
+    gpt2 = extra.get("gpt2_train_tokens_per_sec_per_chip")
+    if isinstance(gpt2, (int, float)) and gpt2 > 0:
+        result["gpt2_vs_r05_baseline"] = round(
+            gpt2 / BASELINE_GPT2_TOKENS_PER_SEC_PER_CHIP, 3
+        )
     print(json.dumps(result))
 
 

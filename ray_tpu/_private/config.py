@@ -248,6 +248,17 @@ rt_config.declare(
     "so it defaults ON; RT_MEMTRACK_ENABLED=0 reduces every hook to one "
     "boolean (`rt memory` and the leak SLO then report nothing).")
 rt_config.declare(
+    "device_objects", bool, True,
+    "Device-plane object store (_private/devstore.py): put() of a "
+    "top-level jax.Array registers structured metadata {dtype, shape, "
+    "sharding, placement, nbytes} in the head directory while the bytes "
+    "stay on device; get() moves shards peer-to-peer (jax.device_put "
+    "over ICI for same-slice peers, per-shard host buffers over "
+    "pull_device_shards for cross-slice/DCN) and materializes with the "
+    "consumer's sharding. Effective only when jax is importable; OFF "
+    "(RT_DEVICE_OBJECTS=0) restores the byte-identical host cloudpickle "
+    "path for jax arrays.")
+rt_config.declare(
     "warm_workers", int, 0,
     "Warm worker pool: number of STANDBY node processes the local "
     "cluster preforks at init. Standby nodes register with the head but "

@@ -1,0 +1,470 @@
+"""Device-plane object store: first-class ``jax.Array`` objects.
+
+The framework exists for device workloads, yet until this plane every
+``jax.Array`` crossing put/get was staged device→host by cloudpickle's
+``__reduce__`` and shipped over the host ring — the one workload class a
+TPU-native runtime is for paid the full host-serialization tax. Reference
+shape: the plasma store holds payload bytes while the owner-resolved
+directory holds locations; here the "payload" never leaves the device —
+only structured metadata crosses the control plane.
+
+Contract (SURVEY.md §object-store; ROADMAP "Device-plane object store"):
+
+- **put()** of a top-level ``jax.Array`` registers a directory entry
+  carrying ``{dtype, shape, sharding spec, placement (per-shard
+  device/node), nbytes}`` through the same ordered ref-op path host
+  objects use (``worker._register_object_async`` → ``object_register``,
+  memtrack ``kind="device"``). Bytes stay on device in the owner's
+  per-process device table; zero cloudpickle of the payload.
+- **get()** resolves locally first: the owner (or a caching consumer)
+  answers from its device table — for same-slice peers a reshard is a
+  ``jax.device_put`` riding ICI, no host staging. A cross-process/
+  cross-slice consumer pulls per-shard HOST buffers from the owner over
+  ONE ``pull_device_shards`` RPC (the DCN leg), reassembles, and
+  materializes a ``jax.Array`` with the consumer's layout —
+  producer-equivalent by default, or any requested ``NamedSharding`` via
+  :func:`get_array`/:func:`reshard`.
+- **Host fallback**: with JAX absent or the consumer on a different
+  platform than the producer, get() returns the assembled numpy array —
+  so ``JAX_PLATFORMS=cpu`` tier-1 exercises the full wire protocol.
+- **Gate**: ``rt_config.device_objects`` (``RT_DEVICE_OBJECTS``), default
+  ON and effective only when JAX is importable; disabled, the host
+  cloudpickle path is byte-identical to the pre-plane behavior.
+
+Fault points (chaos matrix): ``devstore.register`` (directory
+registration is an *optimization* — on error/drop the reader falls back
+to pull-from-owner, which the owner can always serve),
+``devstore.shard_pull`` (consumer retries against the owner with jittered
+backoff; a drop behaves like a lost reply and re-arms — never a hang,
+never a half-materialized array), ``devstore.reshard``.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+# --------------------------------------------------------------- gating
+
+def enabled() -> bool:
+    """Device plane on? Config gate AND jax importable (a process that
+    never imported jax cannot be holding a device array to route)."""
+    try:
+        from ray_tpu._private.config import rt_config
+
+        if not bool(rt_config.device_objects):
+            return False
+    except Exception as e:  # config bootstrap orders vary in tools
+        logger.debug("device_objects config unavailable: %s", e)
+    return sys.modules.get("jax") is not None
+
+
+def is_device_array(value: Any) -> bool:
+    """True for a *concrete* ``jax.Array`` (tracers stay with the normal
+    serializer — a traced put is user error the host path reports).
+    getattr-guarded: callers can run while jax itself is mid-import."""
+    jax_mod = sys.modules.get("jax")
+    jax_array = getattr(jax_mod, "Array", None)
+    if jax_array is None or not isinstance(value, jax_array):
+        return False
+    tracer = getattr(getattr(jax_mod, "core", None), "Tracer", None)
+    return tracer is None or not isinstance(value, tracer)
+
+
+def is_device_meta(meta: Any) -> bool:
+    """Directory/store metadata describing a device-plane object."""
+    return isinstance(meta, dict) and "device" in meta
+
+
+# ------------------------------------------------- host-staging ledger
+
+_staged_lock = threading.Lock()
+_host_staged = {"count": 0, "bytes": 0}
+
+
+def note_host_staged(value: Any) -> None:
+    """A device array went through HOST serialization anyway (plane off,
+    or nested inside a container put/task arg): record the staged bytes
+    so the memory plane can attribute host rows that are really device
+    payloads instead of double-counting them as host-born data."""
+    try:
+        nbytes = int(value.nbytes)
+    except (AttributeError, TypeError):
+        nbytes = 0
+    with _staged_lock:
+        _host_staged["count"] += 1
+        _host_staged["bytes"] += nbytes
+
+
+def host_staged_stats() -> Dict[str, int]:
+    with _staged_lock:
+        return dict(_host_staged)
+
+
+# ----------------------------------------------------------- metadata
+
+def _index_to_wire(index: Tuple, shape: Tuple[int, ...]) -> List[List[int]]:
+    """Per-shard global-index slices → [[start, stop], ...] (step-1 only,
+    which is what shard indices are)."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = int(dim) if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def _describe_sharding(arr) -> Dict[str, Any]:
+    from jax.sharding import NamedSharding, SingleDeviceSharding
+
+    sh = arr.sharding
+    if isinstance(sh, SingleDeviceSharding):
+        return {"type": "single"}
+    if isinstance(sh, NamedSharding):
+        spec = []
+        for p in tuple(sh.spec):
+            if p is None:
+                spec.append(None)
+            elif isinstance(p, tuple):
+                spec.append([str(a) for a in p])
+            else:
+                spec.append([str(p)])
+        return {
+            "type": "named",
+            "axes": [
+                [str(name), int(size)]
+                for name, size in zip(sh.mesh.axis_names, sh.mesh.devices.shape)
+            ],
+            "spec": spec,
+        }
+    # GSPMD/positional/etc: consumers fall back to a single-device (or
+    # host) materialization; the placement list still pins correctness.
+    return {"type": "other", "repr": repr(sh)[:160]}
+
+
+def describe(arr, node_id: Optional[str] = None) -> Dict[str, Any]:
+    """Structured directory metadata for a device array. This is the
+    PINNED device-metadata schema (PARITY.md Round-14): payload bytes are
+    deliberately absent — the directory knows shape/layout/placement,
+    never data."""
+    placement = []
+    for i, s in enumerate(arr.addressable_shards):
+        placement.append({
+            "shard": i,
+            "device": int(getattr(s.device, "id", 0)),
+            "node": node_id,
+            "index": _index_to_wire(s.index, arr.shape),
+        })
+    devs = list(arr.devices())
+    return {
+        "dtype": str(arr.dtype),
+        "shape": [int(d) for d in arr.shape],
+        "nbytes": int(arr.nbytes),
+        "platform": devs[0].platform if devs else "cpu",
+        "sharding": _describe_sharding(arr),
+        "placement": placement,
+    }
+
+
+def _sharding_from_spec(spec: Dict[str, Any], jax_mod):
+    """Rebuild a producer-equivalent NamedSharding on THIS process's
+    devices, or None when the layout can't be reproduced locally (fewer
+    devices, non-named sharding) — callers then materialize single-device."""
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    sh = spec.get("sharding") or {}
+    if sh.get("type") != "named":
+        return None
+    axes = sh.get("axes") or []
+    n = 1
+    for _, size in axes:
+        n *= int(size)
+    devs = jax_mod.devices()
+    if n == 0 or n > len(devs):
+        return None
+    mesh = Mesh(
+        np.array(devs[:n]).reshape([int(size) for _, size in axes]),
+        tuple(str(name) for name, _ in axes),
+    )
+    parts = []
+    for p in sh.get("spec") or ():
+        if p is None:
+            parts.append(None)
+        else:
+            parts.append(p[0] if len(p) == 1 else tuple(p))
+    return NamedSharding(mesh, PartitionSpec(*parts))
+
+
+# ------------------------------------------------------------ put path
+
+def put_device(worker, value) -> Any:
+    """Store a device array as a first-class object: metadata to the
+    directory, bytes pinned on device in the owner's table. Mirrors
+    worker.put()'s ordering contract — ownership records only after the
+    store succeeded, registration rides the ordered ref-op queue so a
+    free can never overtake it."""
+    from ray_tpu._private import faultpoints
+    from ray_tpu.object_ref import ObjectRef
+
+    oid = worker._next_put_id()
+    hex_ = oid.hex()
+    spec = describe(value, node_id=worker.node_id)
+    worker._device_objects[hex_] = value
+    worker._register_owned(hex_)
+    worker.memory_store[hex_] = ("dev", spec)
+    worker._signal_store_event(hex_)
+    meta = worker._with_xfer({
+        "device": spec,
+        "size": int(spec["nbytes"]),
+        "node": worker.node_id,
+        "owner": list(worker.addr or ()),
+    })
+    register = True
+    if faultpoints.ACTIVE:
+        try:
+            if faultpoints.fire("devstore.register") == "drop":
+                register = False
+        except ConnectionError as e:
+            # Registration is an optimization: a directory miss degrades
+            # readers to pull-from-owner, which we can always serve.
+            logger.debug("device-object registration for %s failed: %s",
+                         hex_[:12], e)
+            register = False
+    if register:
+        worker._register_object_async(hex_, meta)
+    return ObjectRef(oid, tuple(worker.addr))
+
+
+# ------------------------------------------------------- owner serving
+
+def pack_shards(value) -> Tuple[List[dict], List[bytes]]:
+    """Owner-side wire form: one host buffer per addressable shard plus
+    its global index, so any consumer can reassemble without knowing the
+    producer's mesh. Device→host copies happen HERE, per shard, only when
+    a remote consumer actually pulls."""
+    import numpy as np
+
+    shards: List[dict] = []
+    frames: List[Any] = []
+
+    def add(host: np.ndarray, index):
+        shards.append({
+            "dtype": str(host.dtype),
+            "shape": [int(d) for d in host.shape],
+            "index": index,
+        })
+        # memoryview, not tobytes(): the wire encoder copies exactly once
+        # into the socket buffer — a bytes() here would double that. The
+        # ndarray stays referenced via the view until the reply is sent.
+        frames.append(memoryview(host).cast("B"))
+
+    if is_device_array(value):
+        shape = value.shape
+        for s in value.addressable_shards:
+            add(np.ascontiguousarray(s.data),
+                _index_to_wire(s.index, shape))
+    else:  # host-fallback value cached in the table
+        add(np.ascontiguousarray(np.asarray(value)), None)
+    return shards, frames
+
+
+def assemble(spec: Dict[str, Any], shards: List[dict],
+             frames: List[Any]):
+    """Consumer-side reassembly of pulled shard buffers into ONE host
+    ndarray in global shape. Pure function; runs on an executor thread
+    (multi-MB memcpys must not block the event loop)."""
+    import numpy as np
+
+    shape = tuple(spec["shape"])
+    if len(shards) == 1 and tuple(shards[0]["shape"]) == shape:
+        # Single shard covering the whole value (single-device, replicated
+        # or host-fallback producer): the received buffer IS the array —
+        # zero-copy view instead of an alloc + memcpy.
+        return np.frombuffer(
+            frames[0], dtype=np.dtype(shards[0]["dtype"])
+        ).reshape(shape)
+    out = np.empty(shape, dtype=np.dtype(spec["dtype"]))
+    for sh, buf in zip(shards, frames):
+        piece = np.frombuffer(
+            buf, dtype=np.dtype(sh["dtype"])
+        ).reshape(tuple(sh["shape"]))
+        idx = sh.get("index")
+        if idx is None:
+            out[...] = piece
+        else:
+            out[tuple(slice(a, b) for a, b in idx)] = piece
+    return out
+
+
+# ----------------------------------------------------------- get path
+
+async def _pull_shards(worker, hex_: str, owner: Tuple, deadline):
+    """One RPC pulls every shard the owner holds (O(owners) economics,
+    like pull_object_batch). Re-armed long-poll + jittered retries mirror
+    worker._pull_from_owner: a dropped reply behaves like the attempt
+    deadline expiring, transient transport failures and typed retryable
+    (code="unavailable") handler errors re-issue against the owner, and a
+    persistent failure surfaces ObjectLostError — never a hang, never a
+    partially-applied materialization (assembly happens only after a
+    complete reply)."""
+    from ray_tpu import exceptions as exc
+    from ray_tpu._private import faultpoints, protocol
+    from ray_tpu._private.backoff import Backoff
+    from ray_tpu._private.config import rt_config
+
+    if not owner:
+        raise exc.ObjectLostError(hex_, "device object has no owner address")
+    attempt_s = float(rt_config.rpc_deadline_s)
+    retry = Backoff(base=0.05, cap=1.0)
+    failures = 0
+    max_failures = int(rt_config.rpc_retries)
+    while True:
+        try:
+            if faultpoints.ACTIVE:
+                fired = await faultpoints.async_fire("devstore.shard_pull")
+                if fired == "drop":
+                    # Reply lost in transit: exactly the attempt-deadline
+                    # expiring.
+                    raise asyncio.TimeoutError()
+            conn = await worker.get_peer(owner)
+            tmo = attempt_s
+            if deadline is not None:
+                tmo = min(tmo, max(deadline - time.monotonic(), 0))
+            hh, frames = await asyncio.wait_for(
+                conn.call("pull_device_shards", {"oid": hex_}), tmo
+            )
+            return hh, frames
+        except asyncio.TimeoutError:
+            if deadline is not None and time.monotonic() >= deadline:
+                raise exc.GetTimeoutError(
+                    f"get() timed out pulling device shards of {hex_}"
+                )
+            await asyncio.sleep(retry.next_delay())
+        except (protocol.ConnectionLost, ConnectionRefusedError,
+                OSError) as e:
+            failures += 1
+            if failures > max_failures:
+                raise exc.ObjectLostError(
+                    hex_, f"device-object owner unreachable ({e})"
+                )
+            if deadline is not None and time.monotonic() >= deadline:
+                raise exc.GetTimeoutError(
+                    f"get() timed out pulling device shards of {hex_}"
+                )
+            await asyncio.sleep(retry.next_delay())
+        except protocol.RpcError as e:
+            if getattr(e, "code", None) == "unavailable":
+                # Typed retryable failure at the owner (injected or
+                # transient): retry against the owner, bounded.
+                failures += 1
+                if failures > max_failures:
+                    raise exc.ObjectLostError(
+                        hex_, f"device shard pull kept failing ({e})"
+                    )
+                await asyncio.sleep(retry.next_delay())
+                continue
+            raise exc.ObjectLostError(hex_, str(e))
+
+
+def _host_to_device(np_value, spec: Dict[str, Any]):
+    """Materialize a pulled host array on THIS process's devices with a
+    producer-equivalent layout. Host fallback (plain ndarray) when JAX is
+    absent or the local platform differs from the producer's."""
+    try:
+        import jax as jax_mod
+    except ImportError:
+        return np_value
+    try:
+        if spec.get("platform") and jax_mod.default_backend() != spec["platform"]:
+            return np_value
+        target = _sharding_from_spec(spec, jax_mod)
+        if target is None:
+            return jax_mod.device_put(np_value)
+        return jax_mod.device_put(np_value, target)
+    except Exception as e:
+        # A local mesh/layout problem must degrade to the host value the
+        # protocol already delivered, not fail the get().
+        logger.debug("device materialization fell back to host for "
+                     "%s-shaped %s: %s", spec.get("shape"),
+                     spec.get("dtype"), e)
+        return np_value
+
+
+async def materialize(worker, hex_: str, meta: Any, ref, deadline):
+    """Resolve a device-plane object for THIS process.
+
+    Local table hit (owner, or a consumer that already pulled): the array
+    is returned as-is — for a same-slice peer wanting another layout,
+    :func:`reshard` is a pure ``jax.device_put`` over ICI. Otherwise pull
+    the shard buffers from the owner (the DCN leg), reassemble off-loop,
+    land them on local devices, and cache."""
+    value = worker._device_objects.get(hex_)
+    if value is not None:
+        return value
+    spec = (meta or {}).get("device") if is_device_meta(meta) else meta
+    owner: Tuple = ()
+    if isinstance(meta, dict):
+        owner = tuple(meta.get("owner") or ())
+    if not owner:
+        owner = tuple(getattr(ref, "owner_address", None) or ())
+    hh, frames = await _pull_shards(worker, hex_, owner, deadline)
+    spec = hh.get("spec") or spec or {}
+    loop = asyncio.get_running_loop()
+    np_value = await loop.run_in_executor(
+        None, assemble, spec, hh.get("shards") or [], frames
+    )
+    value = await loop.run_in_executor(None, _host_to_device, np_value, spec)
+    # Cache: repeated gets resolve locally (and serve further consumers
+    # via the direct path); the owner's object_free fan-out evicts this.
+    worker._device_objects[hex_] = value
+    worker.memory_store[hex_] = ("dev", dict(spec))
+    return value
+
+
+def reshard(value, sharding):
+    """Re-lay a device value to the CONSUMER's requested sharding (a pure
+    ``jax.device_put`` — ICI traffic on a slice, never host staging).
+    No-op for host-fallback values or ``sharding=None``."""
+    if sharding is None:
+        return value
+    if not is_device_array(value):
+        # Host-fallback value with a device request: land it now if a
+        # local jax exists (covers numpy ground-truth tests).
+        jax_mod = sys.modules.get("jax")
+        if jax_mod is None:
+            return value
+        return jax_mod.device_put(value, sharding)
+    from ray_tpu._private import faultpoints
+    from ray_tpu._private.backoff import Backoff
+
+    jax_mod = sys.modules["jax"]
+    retry = Backoff(base=0.01, cap=0.2)
+    attempts = 0
+    while True:
+        try:
+            if faultpoints.ACTIVE:
+                faultpoints.fire("devstore.reshard")
+            return jax_mod.device_put(value, sharding)
+        except ConnectionError as e:
+            # Injected/transient unavailability: bounded jittered retry;
+            # anything else (a real layout error) propagates typed.
+            if getattr(e, "code", None) != "unavailable" or attempts >= 3:
+                raise
+            attempts += 1
+            time.sleep(retry.next_delay())
+
+
+def get_array(ref, sharding=None, timeout: Optional[float] = None):
+    """``get()`` a device-plane object and materialize it with the
+    consumer's requested sharding (the public resharding surface)."""
+    from ray_tpu._private.worker import get_global_worker
+
+    value = get_global_worker().get(ref, timeout=timeout)
+    return reshard(value, sharding)

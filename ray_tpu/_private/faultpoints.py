@@ -155,6 +155,24 @@ CATALOG: Dict[str, tuple] = {
         "serve", ("error", "delay"),
         "ingress proxy route-table resolution: error maps to a "
         "retryable 503/UNAVAILABLE, not a bare 500"),
+    "devstore.register": (
+        "devstore", ("error", "delay", "drop"),
+        "device-object directory registration at put(): error/drop lose "
+        "the directory entry — readers degrade to pull-from-owner, which "
+        "the owner can always serve (registration is an optimization, "
+        "never a correctness dependency)"),
+    "devstore.shard_pull": (
+        "devstore", ("error", "delay", "drop"),
+        "device-shard pull between a consumer and the owner (fires on "
+        "both sides): error surfaces as a typed retryable "
+        "code=unavailable failure retried against the owner, drop = the "
+        "reply is lost and the attempt deadline re-arms — never a hang "
+        "or a half-materialized array"),
+    "devstore.reshard": (
+        "devstore", ("error", "delay"),
+        "consumer-side reshard (jax.device_put to the requested "
+        "sharding): injected unavailability is retried bounded with "
+        "jittered backoff"),
     "spill.write": (
         "spill", ("error", "delay"),
         "spill write to external storage (SpillObjects analog)"),

@@ -49,7 +49,7 @@ ROW_FIELDS = (
     "task", "fn", "count", "borrows",
 )
 
-OBJECT_KINDS = ("inline", "shm", "spilled")
+OBJECT_KINDS = ("inline", "shm", "spilled", "device")
 OBJECT_STATES = ("owned", "pinned", "pending", "error", "borrowed")
 
 GROUP_KEYS = ("owner", "node", "fn", "state", "kind", "task")
@@ -82,6 +82,16 @@ def disable():
 
 # ------------------------------------------------------------ worker side
 
+def _device_staged_stats() -> Dict[str, int]:
+    try:
+        from ray_tpu._private import devstore
+
+        return devstore.host_staged_stats()
+    except Exception as e:  # devstore never blocks accounting
+        logger.debug("devstore staging stats unavailable: %s", e)
+        return {"count": 0, "bytes": 0}
+
+
 def _object_row(oid: str, rec: dict, entry, node_id: str) -> Dict[str, Any]:
     """One owner-side accounting row from the refcount record + the
     memory-store entry (None while a task return is still in flight)."""
@@ -99,6 +109,11 @@ def _object_row(oid: str, rec: dict, entry, node_id: str) -> Dict[str, Any]:
             # segment (a task return lives where it executed), not the
             # owner's node.
             node = meta.get("node") or node
+        elif k == "dev":
+            # Device-plane object: bytes live on the owner's accelerators
+            # (devstore), never in a host arena.
+            kind = "device"
+            nbytes = int((entry[1] or {}).get("nbytes") or 0)
         else:
             kind = "error"
     return {
@@ -156,6 +171,10 @@ def local_snapshot(worker,
             kind = "spilled" if "spill" in meta else "shm"
             nbytes = int(meta.get("size") or 0)
             node = str(meta.get("node") or my_node)[:12]
+        elif k == "dev":
+            kind = "device"
+            nbytes = int((entry[1] or {}).get("nbytes") or 0)
+            node = my_node
         else:
             by_state["error"] += 1
             if len(objects) < max_rows:
@@ -190,6 +209,11 @@ def local_snapshot(worker,
         "fallback": {"objects": 0, "bytes": 0},
         "graveyard": {"segments": 0, "bytes": 0},
         "spill": {},
+        # Device arrays that went through HOST serialization anyway
+        # (plane off / nested in containers): their bytes already count
+        # in the inline/shm rows above — this ledger says how much of
+        # that host traffic is really device payload.
+        "device_host_staged": _device_staged_stats(),
         "mem_used_ratio": memory_monitor.used_ratio(),
         "now": time.time(),
     }
@@ -382,14 +406,19 @@ def build_summary(raw: Dict[str, Any], grace_s: float = 5.0,
     for d in directory:
         oid, meta = d["oid"], d.get("meta") or {}
         node = str(meta.get("node") or "")[:12] or "?"
-        kind = "spilled" if meta.get("spill") else "shm"
+        if meta.get("device"):
+            kind = "device"
+        elif meta.get("spill"):
+            kind = "spilled"
+        else:
+            kind = "shm"
         size = float(meta.get("size") or 0)
         pn = dir_bytes_by_node.setdefault(
             node, {"directory_shm_bytes": 0.0,
-                   "directory_spilled_bytes": 0.0}
+                   "directory_spilled_bytes": 0.0,
+                   "directory_device_bytes": 0.0}
         )
-        pn["directory_spilled_bytes" if kind == "spilled"
-           else "directory_shm_bytes"] += size
+        pn[f"directory_{kind}_bytes"] += size
         if oid in owned_at:
             owned_at[oid].setdefault("locations", []).append(node)
             continue
@@ -416,8 +445,9 @@ def build_summary(raw: Dict[str, Any], grace_s: float = 5.0,
     def pn(node) -> Dict[str, float]:
         return reconcile.setdefault(str(node or "?")[:12], {
             "owner_inline_bytes": 0.0, "owner_shm_bytes": 0.0,
-            "owner_spilled_bytes": 0.0, "directory_shm_bytes": 0.0,
-            "directory_spilled_bytes": 0.0, "arena_bytes_in_use": 0.0,
+            "owner_spilled_bytes": 0.0, "owner_device_bytes": 0.0,
+            "directory_shm_bytes": 0.0, "directory_spilled_bytes": 0.0,
+            "directory_device_bytes": 0.0, "arena_bytes_in_use": 0.0,
             "arena_peak_bytes": 0.0, "delta_shm_bytes": 0.0,
         })
 
@@ -428,10 +458,13 @@ def build_summary(raw: Dict[str, Any], grace_s: float = 5.0,
             pn(node)["owner_shm_bytes"] += v
         elif kind == "spilled":
             pn(node)["owner_spilled_bytes"] += v
+        elif kind == "device":
+            pn(node)["owner_device_bytes"] += v
     for node, d in dir_bytes_by_node.items():
         rec = pn(node)
         rec["directory_shm_bytes"] += d["directory_shm_bytes"]
         rec["directory_spilled_bytes"] += d["directory_spilled_bytes"]
+        rec["directory_device_bytes"] += d.get("directory_device_bytes", 0.0)
     for s in snaps:
         arena = s.get("arena")
         if not arena:
@@ -460,6 +493,9 @@ def build_summary(raw: Dict[str, Any], grace_s: float = 5.0,
         ),
         "spilled_bytes": sum(
             v for (k, _n), v in agg_bytes.items() if k == "spilled"
+        ),
+        "device_bytes": sum(
+            v for (k, _n), v in agg_bytes.items() if k == "device"
         ),
         "directory_entries": int(
             raw.get("recorded") or len(directory)
@@ -543,6 +579,7 @@ def format_summary(s: Dict[str, Any], limit: int = 30) -> str:
         f"objects={t['objects']}  inline={_fmt_bytes(t['inline_bytes'])}  "
         f"shm={_fmt_bytes(t['shm_bytes'])}  "
         f"spilled={_fmt_bytes(t['spilled_bytes'])}  "
+        f"device={_fmt_bytes(t.get('device_bytes', 0))}  "
         f"directory={t['directory_entries']} entr"
         f"{'y' if t['directory_entries'] == 1 else 'ies'}  "
         f"leak-candidates={t['leak_candidates']}",

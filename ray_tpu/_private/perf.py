@@ -181,6 +181,51 @@ def bench_put_gigabytes(total_gb: float = 2.0) -> float:
     return n * chunk.nbytes / (1024 ** 3) / dt
 
 
+def bench_put_get_device(total_gb: float = 0.5) -> float:
+    """Device-plane put/get throughput: a sharded jax.Array crosses
+    put()→get() into ANOTHER process (the pull_device_shards DCN leg —
+    the same-process path is a table hit and measures nothing). Recorded
+    as ``put_get_device_gb_per_s`` next to ``single_client_put_gb_per_s``
+    so the device plane's trajectory rides the same bench JSON."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    devs = jax.devices()
+    n_shard = min(len(devs), 4)
+    mesh = Mesh(np.array(devs[:n_shard]), ("x",))
+    rows = 64 * 1024 * n_shard  # ~64MB float32 at 256 cols
+    arr = jax.device_put(
+        jnp.ones((rows, 256), jnp.float32),
+        NamedSharding(mesh, PartitionSpec("x")),
+    )
+    nbytes = int(arr.nbytes)
+
+    @ray_tpu.remote(num_cpus=1)
+    class Consumer:
+        def consume(self, ref):
+            import numpy as _np
+
+            v = ray_tpu.get(ref[0])
+            return int(_np.asarray(v).shape[0])
+
+    c = Consumer.remote()
+    warm = ray_tpu.put(arr)
+    assert ray_tpu.get(c.consume.remote([warm]), timeout=120) == rows
+    del warm
+    n = max(int(total_gb * (1024 ** 3) / nbytes), 1)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ref = ray_tpu.put(arr)
+        # Consumer caches per-oid, and each put is a fresh oid: every
+        # round pays the full shard pull.
+        ray_tpu.get(c.consume.remote([ref]), timeout=120)
+        del ref
+    dt = time.perf_counter() - t0
+    ray_tpu.kill(c)
+    return n * nbytes / (1024 ** 3) / dt
+
+
 def bench_get_calls(n: int = 2000) -> float:
     ref = ray_tpu.put(np.zeros(1000, np.float64))  # ~8KB, memory-store path
     ray_tpu.get(ref)
@@ -548,6 +593,14 @@ def run_core_benchmarks(quick: bool = False) -> Dict[str, float]:
     out["single_client_put_gb_per_s"] = bench_put_gigabytes(
         0.5 if quick else 2.0
     )
+    try:
+        _progress("put_get_device")
+        out["put_get_device_gb_per_s"] = bench_put_get_device(
+            0.125 if quick else 0.5
+        )
+    except Exception as e:
+        # jax-less / device-less hosts record the miss, never sink the run
+        out["put_get_device_error"] = f"{type(e).__name__}: {e}"
     _progress("get_calls")
     out["single_client_get_calls_per_s"] = bench_get_calls(
         int(2000 * scale)

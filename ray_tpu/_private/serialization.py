@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import logging
 import pickle
+import sys
 from dataclasses import dataclass
 from typing import Any, Callable, List, Optional
 
@@ -66,12 +67,43 @@ METADATA_PICKLE5 = b"pickle5"
 METADATA_RAW = b"raw"  # payload is a single raw bytes buffer
 
 
-def _stage_jax_arrays(value: Any) -> Any:
-    """Nothing to do eagerly: __reduce__ on jax.Array already copies to host.
+class DeviceObjectIntercept(Exception):
+    """Control-flow signal: a top-level device array must be routed to the
+    device-plane object store (``_private/devstore.py``) instead of host
+    pickling. Raised only when the caller opted in (``allow_device=True``
+    — worker.put does) and the device-objects plane is enabled; carries
+    the value so the catcher can hand it to ``devstore.put_device``."""
 
-    Kept as an explicit hook so the device-buffer fast path (dlpack into the
-    shm store) can slot in here later without touching callers.
+    def __init__(self, value: Any):
+        super().__init__("device array routed to devstore")
+        self.value = value
+
+
+def _stage_jax_arrays(value: Any, allow_device: bool = False) -> Any:
+    """Interception point for device arrays entering host serialization.
+
+    With the device-objects plane enabled and the caller opted in
+    (worker.put), a top-level ``jax.Array`` never reaches cloudpickle:
+    :class:`DeviceObjectIntercept` routes it to the devstore and the
+    payload bytes stay on device. A top-level device array that stays on
+    the host path (plane off, or a non-put serialization like a task
+    return) is host-staged by ``jax.Array.__reduce__`` as before — but
+    the staged bytes are RECORDED (``devstore.note_host_staged``) so the
+    memory plane can attribute host rows that are really device payloads
+    instead of double-counting them. Arrays nested inside containers ride
+    cloudpickle wholesale, below this interception point.
     """
+    jax_mod = sys.modules.get("jax")
+    # getattr guard: serialize can run WHILE jax itself is importing
+    # (sys.modules holds a partially initialized module then).
+    jax_array = getattr(jax_mod, "Array", None)
+    if jax_array is None or not isinstance(value, jax_array):
+        return value
+    from ray_tpu._private import devstore
+
+    if allow_device and devstore.enabled() and devstore.is_device_array(value):
+        raise DeviceObjectIntercept(value)
+    devstore.note_host_staged(value)
     return value
 
 
@@ -91,7 +123,8 @@ class SerializationContext:
         self.ref_pickler = ref_pickler
         self.ref_unpickler = ref_unpickler
 
-    def serialize(self, value: Any) -> SerializedObject:
+    def serialize(self, value: Any,
+                  allow_device: bool = False) -> SerializedObject:
         if isinstance(value, bytes):
             # Fast path: raw bytes stored as a single out-of-band buffer.
             return SerializedObject(
@@ -115,7 +148,7 @@ class SerializationContext:
                 value, protocol=5, buffer_callback=buffer_cb
             )
             return SerializedObject(METADATA_PICKLE5, inband, buffers, [])
-        value = _stage_jax_arrays(value)
+        value = _stage_jax_arrays(value, allow_device=allow_device)
         inband = cloudpickle.dumps(value, protocol=5, buffer_callback=buffer_cb)
         return SerializedObject(METADATA_PICKLE5, inband, buffers, contained)
 

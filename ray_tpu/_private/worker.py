@@ -44,10 +44,12 @@ import cloudpickle
 
 from ray_tpu import exceptions as exc
 from ray_tpu._private import (
+    devstore,
     faultpoints,
     flight,
     memtrack,
     protocol,
+    serialization,
     specframe,
     taskpath,
 )
@@ -303,8 +305,13 @@ class CoreWorker:
         # Built lazily (see .shm): the arena name is derived from the head
         # address, which for the in-process head is only known post-start.
         self._shm: Optional[HybridShmStore] = None
-        # object hex -> ("mem", header, frames) | ("shm", meta) | ("err", exception)
+        # object hex -> ("mem", header, frames) | ("shm", meta) |
+        # ("dev", device spec) | ("err", exception)
         self.memory_store: Dict[str, tuple] = {}
+        # Device-plane values (jax.Array, or the host-fallback ndarray a
+        # pull materialized): oid hex -> value. The store entry ("dev",
+        # spec) carries only metadata; the bytes live here, on device.
+        self._device_objects: Dict[str, Any] = {}
         self.store_events: Dict[str, asyncio.Event] = {}
         # ownership: object hex -> {"count": local refs, "borrows": int}
         self.owned: Dict[str, dict] = {}
@@ -1956,9 +1963,13 @@ class CoreWorker:
         self._drop_lineage_for(oid)
         entry = self.memory_store.pop(oid, None)
         self.store_events.pop(oid, None)
-        if entry is not None and entry[0] == "shm":
-            meta = entry[1]
-            self.shm.free(oid, meta)
+        if entry is not None and entry[0] in ("shm", "dev"):
+            if entry[0] == "shm":
+                self.shm.free(oid, entry[1])
+            else:
+                # Device plane: dropping the table entry releases the
+                # last host-side reference; jax frees the device buffers.
+                self._device_objects.pop(oid, None)
             if free_sink is not None:
                 free_sink.append(oid)  # caller sends one grouped notify
             else:
@@ -2057,8 +2068,16 @@ class CoreWorker:
     def put(self, value: Any) -> ObjectRef:
         if isinstance(value, ObjectRef):
             raise TypeError("put() does not accept ObjectRef (matches reference)")
+        try:
+            sobj, nested_refs = collect_refs_during(
+                lambda: self.ctx.serialize(value, allow_device=True)
+            )
+        except serialization.DeviceObjectIntercept as d:
+            # Device plane: the payload never reaches cloudpickle — only
+            # structured metadata crosses the control plane, and the
+            # array stays pinned on device in _device_objects.
+            return devstore.put_device(self, d.value)
         oid = self._next_put_id()
-        sobj, nested_refs = collect_refs_during(lambda: self.ctx.serialize(value))
         nested = [
             (r.id().hex(), list(r.owner_address or ())) for r in nested_refs
         ]
@@ -2210,12 +2229,23 @@ class CoreWorker:
                 if frames is None:
                     return None  # spilled/moved: slow path refreshes
                 resolved.append(("mem", frames))
+            elif kind == "dev":
+                # Device plane: the value itself is in the device table
+                # (owner put, or a consumer's cached pull) — no frames,
+                # no deserialization.
+                arr = self._device_objects.get(ref.id().hex())
+                if arr is None:
+                    return None  # evicted under us: slow path re-pulls
+                resolved.append(("devval", arr))
             elif kind in ("mem", "err"):
                 resolved.append(entry)
             else:
                 return None
         out = []
         for kind, payload in resolved:
+            if kind == "devval":
+                out.append(payload)
+                continue
             try:
                 if kind == "err":
                     raise payload
@@ -2345,6 +2375,8 @@ class CoreWorker:
             kind = r.get("kind")
             if kind == "shm":
                 resolved[oid] = ("shm", r["meta"])
+            elif kind == "dev":
+                resolved[oid] = ("dev", r["spec"])
             elif kind == "mem":
                 resolved[oid] = ("mem", fl)
             elif kind == "err":
@@ -2429,6 +2461,17 @@ class CoreWorker:
         if entry is None:
             entry = await self._fetch_remote(ref, deadline)
         kind = entry[0]
+        if kind == "shm" and devstore.is_device_meta(entry[1]):
+            # Directory hit for a device-plane object: the meta carries
+            # layout + owner, never bytes — route to the device pull.
+            kind = "dev"
+        if kind == "dev":
+            try:
+                return await devstore.materialize(
+                    self, hex_, entry[1], ref, deadline
+                )
+            except exc.RayTpuError as e:
+                return e
         if kind == "err":
             return entry[1]
         if kind == "mem":
@@ -2641,6 +2684,11 @@ class CoreWorker:
                 raise exc.ObjectLostError(hex_, str(e))
         if hh.get("kind") == "shm":
             return ("shm", hh["meta"])
+        if hh.get("kind") == "dev":
+            # Device-plane object whose directory entry was missed (e.g.
+            # a dropped registration): the owner's spec routes the getter
+            # to the device pull.
+            return ("dev", hh["spec"])
         if hh.get("kind") == "err":
             return ("err", _loads_maybe(frames))
         return ("mem", frames)
@@ -4292,6 +4340,10 @@ class CoreWorker:
                     raise protocol.RpcError(f"object {hex_} lost at owner")
                 return {"kind": "mem"}, [bytes(f) for f in frames]
             return {"kind": "shm", "meta": entry[1]}, []
+        if kind == "dev":
+            # Metadata only: the puller re-issues a pull_device_shards
+            # for the bytes (keeps this long-poll verb payload-free).
+            return {"kind": "dev", "spec": entry[1]}, []
         sobj = self.ctx.serialize(entry[1])
         return {"kind": "err"}, sobj.to_frames()
 
@@ -4345,6 +4397,9 @@ class CoreWorker:
                 else:
                     res.append({"kind": "shm", "meta": entry[1]})
                     frame_lists.append([])
+            elif kind == "dev":
+                res.append({"kind": "dev", "spec": entry[1]})
+                frame_lists.append([])
             else:  # err
                 res.append({"kind": "err"})
                 frame_lists.append(self.ctx.serialize(entry[1]).to_frames())
@@ -4354,6 +4409,39 @@ class CoreWorker:
         for r, n in zip(res, counts):
             r["n"] = n
         return {"res": res}, flat
+
+    async def rpc_pull_device_shards(self, h, frames, conn):
+        """Serve a device-plane object we hold: ONE reply carries every
+        addressable shard as a host buffer plus its global index (the
+        cross-slice/DCN leg — same-slice consumers resolve from their own
+        device table and never reach this verb). The device→host copies
+        run on an executor thread; a multi-GB staging must not stall the
+        event loop serving other pulls."""
+        hex_ = h["oid"]
+        if faultpoints.ACTIVE:
+            if await faultpoints.async_fire(
+                    "devstore.shard_pull", protocol.RpcError) == "drop":
+                # Shards were available, reply lost: the classic
+                # applied-but-unacknowledged partial failure — the
+                # consumer's attempt deadline re-arms the pull.
+                raise faultpoints.DropReply()
+        value = self._device_objects.get(hex_)
+        if value is None and hex_ not in self.memory_store:
+            # Owner still producing (a consumer raced the put):
+            # long-poll like pull_object does.
+            await self._wait_local(hex_, None)
+            value = self._device_objects.get(hex_)
+        if value is None:
+            raise protocol.RpcError(f"device object {hex_} unknown to owner")
+        spec = None
+        store_entry = self.memory_store.get(hex_)
+        if store_entry is not None and store_entry[0] == "dev":
+            spec = store_entry[1]
+        loop = asyncio.get_running_loop()
+        shards, shard_frames = await loop.run_in_executor(
+            None, devstore.pack_shards, value
+        )
+        return {"spec": spec, "shards": shards}, shard_frames
 
     async def rpc_add_borrow(self, h, frames, conn):
         for oid in h.get("oids") or [h["oid"]]:
@@ -4389,6 +4477,7 @@ class CoreWorker:
             if oid in self.owned:
                 continue
             self.memory_store.pop(oid, None)
+            self._device_objects.pop(oid, None)  # cached consumer copies
             if self._shm is not None:
                 self._shm.free(oid)
 

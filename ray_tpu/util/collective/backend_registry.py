@@ -46,9 +46,11 @@ def register_collective_backend(name: str):
 
 def get_collective_backend(name: str):
     # Import built-ins lazily so registration happens on first use.
+    # xla_backend (the shard_map-lowered "xla" backend) imports — and
+    # falls back to — xla_collective_group's host-staged machinery.
     from ray_tpu.util.collective.collective_group import (  # noqa: F401
         host_collective_group,
-        xla_collective_group,
+        xla_backend,
     )
 
     return _registry.get(name)

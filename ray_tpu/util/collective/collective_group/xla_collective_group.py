@@ -18,11 +18,10 @@ from __future__ import annotations
 
 import numpy as np
 
-from ray_tpu.util.collective.backend_registry import register_collective_backend
 from ray_tpu.util.collective.collective_group.host_collective_group import (
     HostCollectiveGroup,
 )
-from ray_tpu.util.collective.types import Backend, ReduceOp
+from ray_tpu.util.collective.types import ReduceOp
 
 # --------------------------------------------------------------------------
 # In-jit helpers: use inside pjit/shard_map with a named mesh axis.
@@ -106,12 +105,15 @@ def _like(result: np.ndarray, template):
     return result
 
 
-@register_collective_backend(Backend.XLA)
 class XlaCollectiveGroup(HostCollectiveGroup):
     """Host-staged collectives for jax arrays outside jit.
 
     Inherits the exchange machinery; overrides tensor conversion so jax
     arrays round-trip device→host→device and land back on their device.
+    NOTE: no longer the registered ``"xla"`` backend — that is
+    :class:`xla_backend.XlaBackendGroup`, which lowers the reductions to
+    jitted ``shard_map`` collectives and uses THIS class as its
+    host-staged fallback/base.
     """
 
     def allreduce(self, tensor, opts=None):

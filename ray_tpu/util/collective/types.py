@@ -23,10 +23,13 @@ class Backend:
     - ``HOST``: host-level collectives via the coordinator actor (control
       plane over DCN). Works for numpy and host-staged jax arrays. This is
       the TPU-era stand-in for the reference's torch-gloo backend.
-    - ``XLA``: TPU/ICI backend. In-jit collectives are sharding-induced XLA
-      ops (``psum``/``all_gather``/``ppermute``); host-level (out-of-jit)
-      tensors are staged device→host, moved over the control plane, and
-      restored device-side. Replaces the reference's NCCL backend
+    - ``XLA``: TPU/ICI backend (``collective_group/xla_backend.py``).
+      allreduce/allgather/reduce_scatter/broadcast lower to jitted
+      ``jax.lax.psum``/``lax.all_gather``/``lax.psum_scatter`` under
+      ``shard_map`` over the group's mesh; in-jit collectives are
+      sharding-induced XLA ops (the ``ici_*`` helpers). Cross-process
+      movement outside a multi-controller mesh stages over the control
+      plane. Replaces the reference's NCCL backend
       (``collective_group/nccl_collective_group.py``).
     - ``AUTO``: XLA if the input is a jax array on TPU, else HOST.
     """

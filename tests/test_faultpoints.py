@@ -468,6 +468,41 @@ def _workload_pg():
     remove_placement_group(pg)
 
 
+def _workload_device_objects():
+    """Device plane under chaos, both directions: driver-owned sharded
+    array consumed by a task (owner-side shard serving), task-owned
+    device object pulled by the driver (consumer-side pull + retry)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("x",))
+    arr = jax.device_put(
+        jnp.arange(32, dtype=jnp.float32).reshape(4, 8),
+        NamedSharding(mesh, P("x")),
+    )
+    ref = ray_tpu.put(arr)
+
+    @ray_tpu.remote
+    def consume(v):
+        import numpy as _np
+
+        return float(_np.asarray(v).sum())
+
+    @ray_tpu.remote
+    def produce():
+        import jax.numpy as _jnp
+
+        return ray_tpu.put(_jnp.ones((8, 8), _jnp.float32))
+
+    expect = float(np.asarray(arr).sum())
+    assert ray_tpu.get(consume.remote(ref), timeout=120) == expect
+    inner = ray_tpu.get(produce.remote(), timeout=120)
+    v = ray_tpu.get(inner, timeout=120)
+    assert float(np.asarray(v).sum()) == 64.0
+
+
 CHAOS_SPECS = [
     "gcs.dispatch.lease:drop:0.1:0:101",
     "gcs.dispatch.lease:error:0.1:0:102",
@@ -488,6 +523,12 @@ CHAOS_SPECS = [
     # full-header path — framing is an optimization, never a correctness
     # dependency.
     "worker.spec.frame:error:0.5:0:110",
+    # Device plane: a failed/lost shard pull is retried against the owner
+    # as a typed retryable error (never a hang, never a half-materialized
+    # array); a lost registration degrades readers to pull-from-owner.
+    "devstore.shard_pull:error:0.3:0:112",
+    "devstore.shard_pull:drop:1.0:1:113",
+    "devstore.register:drop:1.0:1:114",
 ]
 
 
@@ -508,6 +549,7 @@ def test_chaos_matrix(spec, monkeypatch, chaos_flight_trace):
         _workload_actor_roundtrip()
         _workload_multiref_get_wait()
         _workload_pg()
+        _workload_device_objects()
         assert sum(s["calls"] for s in fp.stats()) > 0, (
             "chaos spec never matched a fired point"
         )

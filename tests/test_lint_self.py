@@ -106,6 +106,38 @@ def test_memtrack_module_is_family_b_clean():
     assert json.loads(proc.stdout) == []
 
 
+def test_devstore_module_is_family_b_clean():
+    """The round-14 device-plane object store retries shard pulls and
+    fans device→host copies onto executor threads: a constant-sleep
+    retry loop or a silent RPC swallow on the pull path is exactly the
+    Family-B regression class (``raytpu lint --framework`` over
+    devstore.py, the exact CI invocation)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.lint",
+         os.path.join(REPO, "ray_tpu", "_private", "devstore.py"),
+         "--framework", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout) == []
+
+
+def test_xla_backend_module_is_family_b_clean():
+    """The registered "xla" collective backend caches jitted shard_map
+    programs and falls back to host staging on mesh failure: a silent
+    except-pass there would hide real lowering breakage (``raytpu lint
+    --framework`` over xla_backend.py, the exact CI invocation)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.lint",
+         os.path.join(REPO, "ray_tpu", "util", "collective",
+                      "collective_group", "xla_backend.py"),
+         "--framework", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout) == []
+
+
 def test_metrics_rollup_module_is_family_b_clean():
     """util/metrics.py now carries the head-side rollup the aggregated
     /metrics endpoint serves; it holds per-metric locks on hot observe
