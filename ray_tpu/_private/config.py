@@ -276,6 +276,60 @@ rt_config.declare(
     "detached creations always use the synchronous per-actor verb. Off: "
     "every creation blocks on its own head RPC (pre-round-10 behavior).")
 rt_config.declare(
+    "reply_batching", bool, True,
+    "Reply-plane batching: executor-side results from the same peer "
+    "connection coalesce into multi-result frames flushed by a "
+    "self-clocking window (first result flushes immediately, the rest "
+    "ride the in-flight frame's ack — specframe.ReplyWindow, the "
+    "create_actor_batch discipline mirrored onto replies), and plain "
+    "push_task gains per-task corr dedup + deadline re-arm so a dropped "
+    "frame replays recorded outcomes instead of hanging or re-executing. "
+    "Off (RT_REPLY_BATCHING=0): every result is acked one by one on the "
+    "pre-round-15 per-task reply path, byte-identically.")
+rt_config.declare(
+    "reply_window_max", int, 128,
+    "Max results one reply window accumulates before flushing mid-ack "
+    "(memory/latency cap on coalescing; the byte cap below also applies).")
+rt_config.declare(
+    "reply_window_bytes", int, 256 << 10,
+    "Max buffered result bytes per reply window before a forced flush — "
+    "kept well under the shm ring's message limit so a coalesced frame "
+    "never degrades to the per-item too-big fallback.")
+rt_config.declare(
+    "reply_window_horizon_s", float, 1.0,
+    "Ack horizon for an in-flight TCP reply window: if the receiving "
+    "pump's mrack is lost, the next completing result re-arms the window "
+    "after this long instead of buffering forever.")
+rt_config.declare(
+    "reply_window_gap_s", float, 0.001,
+    "Flush pacing for ring reply windows (timer-clocked: results within "
+    "one gap of the last flush coalesce, a deferred tail flush covers "
+    "the stragglers). Ring windows pace by time instead of mrack acks "
+    "because the ack traffic contends with the pusher on the ring send "
+    "lock; this is also the worst case added to a lone result's reply "
+    "latency.")
+rt_config.declare(
+    "arg_interning", bool, True,
+    "Per-peer argument interning on the push path: small argument frames "
+    "are content-hashed and shipped ONCE per (peer, digest) the way "
+    "FnPushLedger piggybacks function blobs; subsequent pushes carry only "
+    "the digest and the executor re-inserts the exact bytes from its "
+    "bounded LRU (miss/eviction => typed arg_intern_miss, pusher re-sends "
+    "the blob). Off (RT_ARG_INTERNING=0): every push carries full arg "
+    "frames, byte-identically to the pre-round-15 wire.")
+rt_config.declare(
+    "arg_intern_min_bytes", int, 128,
+    "Smallest argument frame worth interning (digest + header entry "
+    "overhead must stay well under the bytes saved).")
+rt_config.declare(
+    "arg_intern_max_bytes", int, 256 << 10,
+    "Largest argument frame the interning plane will cache per peer "
+    "(bigger payloads should ride refs/shm, not per-task frames).")
+rt_config.declare(
+    "arg_intern_cache_bytes", int, 64 << 20,
+    "Executing-side interned-argument LRU capacity in bytes; eviction "
+    "only costs a re-send of the blob on the next digest-only push.")
+rt_config.declare(
     "serve_request_timeout_s", float, 60.0,
     "Serve proxy per-request deadline (HTTP and gRPC ingress). A request "
     "that has not produced a result within this horizon is failed with "

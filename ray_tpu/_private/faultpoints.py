@@ -140,6 +140,21 @@ CATALOG: Dict[str, tuple] = {
     "worker.dispatch.retry": (
         "worker", ("error", "delay"),
         "dispatch-retry path after a failed push attempt"),
+    "worker.reply.window": (
+        "worker", ("error", "delay", "drop"),
+        "coalesced multi-result reply flush on the EXECUTING worker "
+        "(reply-plane sibling of worker.task.push): drop/error = the "
+        "whole window frame is lost in transit — every rider's push "
+        "deadline re-arms and the corr-deduped re-push replays the "
+        "recorded outcome, never re-executes"),
+    "worker.arg.intern": (
+        "worker", ("error", "delay", "drop"),
+        "argument interning, both sides: on the PUSHER error degrades "
+        "that push to full arg frames and drop resets the peer's "
+        "coverage (blobs re-sent, exercising re-cover); on the EXECUTOR "
+        "error forces — and drop really performs — an interned-frame "
+        "eviction right before lookup, so the typed arg_intern_miss "
+        "error makes the pusher re-send the exact bytes"),
     "serve.replica.call": (
         "serve", ("error", "delay"),
         "handle->replica dispatch, client side, BEFORE the request can "

@@ -78,6 +78,14 @@ async def read_message(
     return header, frames[1:]
 
 
+# Sentinel a handler returns (as its ``extras``) when it has taken
+# ownership of replying — e.g. the worker's reply window will deliver the
+# result inside a coalesced multi-result frame. The dispatcher sends
+# nothing; the handler MUST eventually answer the request's correlation
+# id itself or the caller's deadline fires.
+REPLY_HANDLED = object()
+
+
 def pack_multi_frames(frame_lists: List[List[bytes]]) -> Tuple[List[int], List[bytes]]:
     """Flatten per-object frame lists into (counts, flat_frames) for a
     single wire message. Batched verbs (``pull_object_batch``) carry many
@@ -201,14 +209,27 @@ class Connection:
                     if act == "drop":
                         continue
                 if header.get("r"):  # reply
-                    fut = self._pending.pop(header["i"], None)
-                    if fut is not None and not fut.done():
-                        if header.get("e") is not None:
-                            fut.set_exception(
-                                RpcError(header["e"], code=header.get("ec"))
-                            )
-                        else:
-                            fut.set_result((header, frames))
+                    if "bh" in header:
+                        # Coalesced multi-result frame: sub-replies ride
+                        # one message, each under its own correlation id
+                        # — N futures settle in this one wakeup.
+                        pos = 0
+                        for sub, n in zip(header["bh"], header["bn"]):
+                            self._settle_reply(sub, frames[pos:pos + n])
+                            pos += n
+                        if header.get("wa"):
+                            # Window ack: the sender's reply window clocks
+                            # its next flush on this (the reply-side
+                            # create_actor_batch discipline).
+                            try:
+                                self.notify("mrack")
+                            except (RpcError, OSError) as e:
+                                logger.debug(
+                                    "window ack dropped (%s): %s",
+                                    self.name, e,
+                                )
+                    else:
+                        self._settle_reply(header, frames)
                 else:
                     if flight.ENABLED:
                         # Arrival stamp: dispatch-side spans (and the head's
@@ -223,6 +244,16 @@ class Connection:
             logger.exception("rpc recv loop error (%s)", self.name)
         finally:
             self._teardown()
+
+    def _settle_reply(self, header: dict, frames: List[bytes]):
+        fut = self._pending.pop(header["i"], None)
+        if fut is not None and not fut.done():
+            if header.get("e") is not None:
+                fut.set_exception(
+                    RpcError(header["e"], code=header.get("ec"))
+                )
+            else:
+                fut.set_result((header, frames))
 
     def _teardown(self):
         if self._closed:
@@ -255,6 +286,13 @@ class Connection:
             extras, reply_frames = await self.handler(
                 header["m"], header, frames, self
             )
+            if extras is REPLY_HANDLED:
+                # The handler routed its result into a coalesced reply
+                # frame (worker reply window); nothing to send here.
+                if fl:
+                    flight.record_dispatch(fl_verb, "server", header, t_arr,
+                                           t_run, 0, "windowed")
+                return
             if extras:
                 reply_header.update(extras)
         except faultpoints.DropReply:
@@ -300,6 +338,27 @@ class Connection:
                 "reply for %s seq=%s dropped, peer gone: %s",
                 header.get("method"), header.get("seq"), e,
             )
+
+    def send_reply_batch(self, subs: List[dict], counts: List[int],
+                         frames: List[bytes], extras: Optional[dict] = None):
+        """Reply to many requests in ONE wire message (any thread).
+        ``subs[k]`` carries its request's correlation id under ``i`` (and
+        per-item ``e``/``ec`` for failures); ``counts[k]`` frames belong
+        to it. The receiver's reply branch settles every sub-future in a
+        single recv wakeup."""
+        header = {"r": 1, "bh": subs, "bn": counts}
+        if extras:
+            header.update(extras)
+        self.send_raw(header, list(frames))
+        try:
+            on_loop = asyncio.get_running_loop() is self._loop
+        except RuntimeError:
+            on_loop = False
+        if on_loop:
+            # Replies stay latency-critical even coalesced: flush this
+            # tick. Off-loop callers already marshalled the enqueue; the
+            # scheduled tick flush covers them.
+            self._flush()
 
     def send_raw(self, header: dict, frames: List[bytes]):
         if self._closed:

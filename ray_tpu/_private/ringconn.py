@@ -303,16 +303,22 @@ class RingConnection:
         return futs
 
     def send_reply_batch(self, subs: List[dict], counts: List[int],
-                         frames: List[bytes]):
+                         frames: List[bytes],
+                         extras: Optional[dict] = None):
         """Reply to many requests in ONE ring message (any thread).
 
         ``subs[k]`` must carry its request's correlation id under ``i``;
-        ``counts[k]`` frames belong to it. When the combined message
-        exceeds the ring, each sub-reply is sent individually (whose own
-        too-big handling degrades to an inline error) — a batch that
-        cannot be correlated must never leave sub-futures hanging."""
+        ``counts[k]`` frames belong to it. ``extras`` merges into the
+        batch header (e.g. the reply window's ``wa`` ack request). When
+        the combined message exceeds the ring, each sub-reply is sent
+        individually (whose own too-big handling degrades to an inline
+        error) — a batch that cannot be correlated must never leave
+        sub-futures hanging."""
+        header = {"r": 1, "bh": subs, "bn": counts}
+        if extras:
+            header.update(extras)
         try:
-            self._send_auto({"r": 1, "bh": subs, "bn": counts}, frames)
+            self._send_auto(header, frames)
             return
         except MessageTooBig:
             pass
@@ -400,6 +406,20 @@ class RingConnection:
                             for sub, n in zip(header["bh"], header["bn"]):
                                 replies.append((sub, frames[pos:pos + n]))
                                 pos += n
+                            if header.get("wa"):
+                                # Ack the sender's reply window so the
+                                # results that completed behind this
+                                # frame flush as the next one.
+                                try:
+                                    self._send_auto(
+                                        {"i": next(self._ids),
+                                         "m": "mrack", "oneway": 1}, [],
+                                    )
+                                except (protocol.RpcError, OSError) as e:
+                                    logger.debug(
+                                        "ring %s: window ack dropped: %s",
+                                        self.name, e,
+                                    )
                         else:
                             replies.append((header, frames))
                         continue
@@ -471,6 +491,13 @@ class RingConnection:
             extras, rframes = await self.handler(
                 header["m"], header, frames, self
             )
+            if extras is protocol.REPLY_HANDLED:
+                # Result routed into a coalesced reply frame (worker
+                # reply window); the window answers this correlation id.
+                if fl:
+                    flight.record_dispatch(fl_verb, "server", header,
+                                           t_arr, t_run, 0, "windowed")
+                return
             if extras:
                 reply.update(extras)
         except faultpoints.DropReply:

@@ -19,11 +19,31 @@ function from the push itself instead of issuing a ``gcs.kv_get`` — the
 function table becomes a fallback, not a hot path (reference: function
 table pushes ride the same channel as task specs in
 ``core_worker/transport``).
+
+Round 15 adds the REPLY-side siblings, so result delivery amortizes the
+way submission does (reference: the core worker's reply path batches
+task results onto the submission channel; plasma inline-object returns):
+
+- :class:`ReplyWindow` coalesces small execution results from one peer
+  connection into a single multi-result frame with the same self-clocking
+  discipline as ``create_actor_batch``: the first result flushes
+  immediately, everything completing while that frame's ack is in flight
+  rides the next frame — O(bursts) reply messages for a queued burst,
+  and chunk-mates never serialize behind each other's acks.
+- :class:`ArgLedger` is the FnPushLedger discipline applied to argument
+  bytes: a repeated small argument frame (the "same config dict to 10k
+  tasks" shape) is content-hashed at push time and shipped ONCE per
+  (peer, digest); subsequent pushes carry only the digest.
+- :class:`ArgInternCache` is the executing side's bounded LRU for those
+  interned frames; an evicted digest surfaces as a typed miss the pusher
+  answers by re-sending the exact bytes.
 """
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional, Set, Tuple
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 import msgpack
 
@@ -114,3 +134,257 @@ class FnPushLedger:
         address must be re-covered (it lost its function cache)."""
         with self._lock:
             self._sent.pop(peer, None)
+
+
+class ReplyWindow:
+    """Self-clocking coalescer for executor-side result replies.
+
+    Reply-plane sibling of the ``create_actor_batch`` client window: the
+    first result added to an idle window flushes immediately (a
+    multi-result frame of one — latency is never traded away when the
+    path is quiet), and every result completing while that frame's ack is
+    still in flight buffers and rides the NEXT frame, flushed by
+    :meth:`on_ack` when the receiving pump acknowledges (``mrack``). A
+    queued burst therefore costs O(bursts) reply messages instead of one
+    per task, and a fast chunk-mate's result is never parked behind a
+    whole executor queue drain.
+
+    Bounds: ``max_items``/``max_bytes`` force a flush mid-window (memory
+    and transport-frame caps win over coalescing), and ``horizon_s``
+    re-arms a window whose ack was lost — a dropped frame degrades to
+    per-deadline replay at the pusher, never a wedged window.
+
+    Thread-safe: results arrive from executor threads, acks from the
+    transport pump or the event loop. ``send(items)`` runs OUTSIDE the
+    lock with ``items`` = [(sub_header, frames, tag)]; the caller owns
+    transport errors (a peer that vanished mid-flush loses the frame the
+    same way it loses any reply — its pusher's deadline recovers).
+    """
+
+    def __init__(self, send: Callable[[List[tuple]], None],
+                 max_items: int = 128, max_bytes: int = 256 * 1024,
+                 horizon_s: float = 1.0, gap_s: Optional[float] = None,
+                 defer: Optional[Callable[[float, Callable], None]] = None):
+        self._send = send
+        self._max_items = max(int(max_items), 1)
+        self._max_bytes = max(int(max_bytes), 1)
+        self._horizon_s = float(horizon_s)
+        # Clock mode. Ack (gap_s None): flushes ride the peer's ``mrack``
+        # — right for TCP, where reply rates are low and the ack is one
+        # more asyncio write. Timer (gap_s + defer): flushes are paced by
+        # a minimum gap with a deferred tail flush — right for the shm
+        # ring, where per-flush mracks measurably contend with the
+        # pusher's sends on the ring's send lock (profiled on a 1-core
+        # A/B box: the ack traffic alone cost ~5% of queued throughput).
+        self._gap_s = None if gap_s is None else float(gap_s)
+        self._defer = defer
+        self._timer_armed = False
+        self._lock = threading.Lock()
+        self._buf: List[tuple] = []
+        self._buf_bytes = 0
+        self._inflight = False
+        self._inflight_t = 0.0
+        self.flushes = 0
+        self.coalesced = 0
+
+    def add(self, sub: dict, frames: List[bytes], tag: Any = None):
+        self.add_many(((sub, frames, tag),))
+
+    def add_many(self, items) -> None:
+        """Insert one or many results under ONE lock (and at most one
+        emit): an executor drain loop hands over its micro-batch every
+        few completions/ms, so per-result window cost stays off the task
+        hot path while the flush semantics (immediate first flush,
+        ack/gap riding, caps, horizon re-arm) are identical."""
+        if not items:
+            return
+        nbytes = 0
+        for _s, frames, _t in items:
+            for f in frames:
+                nbytes += len(f)
+        now = time.monotonic()
+        fire = None
+        with self._lock:
+            if self._gap_s is not None:
+                gap_left = self._gap_s - (now - self._inflight_t)
+                if gap_left <= 0:
+                    # Quiet window: this batch goes out now (with any
+                    # stragglers a timer hasn't picked up yet).
+                    batch = self._buf + list(items)
+                    self._buf, self._buf_bytes = [], 0
+                    self._inflight_t = now
+                else:
+                    self._buf.extend(items)
+                    self._buf_bytes += nbytes
+                    if (len(self._buf) < self._max_items
+                            and self._buf_bytes < self._max_bytes):
+                        if not self._timer_armed:
+                            # Tail guarantee: if no later add crosses the
+                            # gap, the deferred callback flushes what
+                            # buffered here.
+                            self._timer_armed = True
+                            fire = gap_left
+                        batch = None
+                    else:
+                        batch, self._buf, self._buf_bytes = self._buf, [], 0
+                        self._inflight_t = now
+            elif (self._inflight
+                    and (now - self._inflight_t) < self._horizon_s):
+                self._buf.extend(items)
+                self._buf_bytes += nbytes
+                if (len(self._buf) < self._max_items
+                        and self._buf_bytes < self._max_bytes):
+                    batch = None  # rides the in-flight frame's ack
+                else:
+                    batch, self._buf, self._buf_bytes = self._buf, [], 0
+                    self._inflight = True
+                    self._inflight_t = now
+            else:
+                # Idle window (or the ack horizon lapsed — lost ack):
+                # whatever accumulated goes out WITH this result, now.
+                batch = self._buf + list(items)
+                self._buf, self._buf_bytes = [], 0
+                self._inflight = True
+                self._inflight_t = now
+        if fire is not None and self._defer is not None:
+            self._defer(fire, self._flush_timer)
+        if batch:
+            self._emit(batch)
+
+    def _flush_timer(self):
+        """Deferred tail flush (timer mode): whatever buffered inside the
+        gap goes out even if no further result ever arrives. While
+        results keep flowing the timer re-arms itself — it runs on the
+        receiver loop where ``call_later`` is a heap push, so the
+        steady-state clock costs no cross-thread wakeups (arming from an
+        executor thread pays one; that now happens only on an
+        idle->busy transition)."""
+        with self._lock:
+            if not self._buf:
+                self._timer_armed = False  # quiesced: next add re-arms
+                return
+            batch, self._buf, self._buf_bytes = self._buf, [], 0
+            self._inflight_t = time.monotonic()
+        self._emit(batch)
+        if self._defer is not None:
+            self._defer(self._gap_s, self._flush_timer)
+
+    def on_ack(self):
+        """The peer acknowledged the in-flight frame: flush what
+        accumulated behind it, or go idle. No-op in timer mode (the gap
+        clock paces flushes; there are no acks to ride)."""
+        if self._gap_s is not None:
+            return
+        with self._lock:
+            if not self._buf:
+                self._inflight = False
+                return
+            batch, self._buf, self._buf_bytes = self._buf, [], 0
+            self._inflight_t = time.monotonic()  # window stays clocked
+        self._emit(batch)
+
+    def flush(self):
+        """Unconditional drain (shutdown / graceful node drain): buffered
+        results must never die with the window."""
+        with self._lock:
+            if not self._buf:
+                return
+            batch, self._buf, self._buf_bytes = self._buf, [], 0
+            self._inflight = True
+            self._inflight_t = time.monotonic()
+        self._emit(batch)
+
+    def _emit(self, batch: List[tuple]):
+        self.flushes += 1
+        self.coalesced += len(batch)
+        self._send(batch)
+
+
+class ArgLedger:
+    """Sender-side (peer, digest) coverage for interned argument frames —
+    the :class:`FnPushLedger` discipline applied to argument bytes. The
+    first push carrying a digest to a peer ships the blob (wire key
+    ``aib``) and marks coverage; later pushes carry only the digest
+    (``ai``). Coverage is bounded per peer (oldest digests lapse — the
+    blob is simply re-sent) and reset wholesale on slot loss, because a
+    successor process at the same address starts with an empty cache.
+
+    Thread-safe: slot pushers run on the core loop, but retry paths may
+    reset coverage from other coroutines interleaved with them."""
+
+    def __init__(self, per_peer_cap: int = 4096):
+        self._cap = max(int(per_peer_cap), 2)
+        self._sent: Dict[Any, "OrderedDict[bytes, None]"] = {}
+        self._lock = threading.Lock()
+
+    def covered(self, peer, digest: bytes) -> bool:
+        """True when this peer already holds the blob for ``digest``.
+        Otherwise marks it covered — the caller ships the blob on THIS
+        push — and returns False."""
+        with self._lock:
+            sent = self._sent.get(peer)
+            if sent is None:
+                sent = self._sent[peer] = OrderedDict()
+            if digest in sent:
+                sent.move_to_end(digest)
+                return True
+            if len(sent) >= self._cap:
+                sent.popitem(last=False)
+            sent[digest] = None
+            return False
+
+    def forget_peer(self, peer):
+        """Slot lost / typed intern miss: assume the peer's cache is gone
+        and re-cover it from scratch (blobs re-sent, never correctness)."""
+        with self._lock:
+            self._sent.pop(peer, None)
+
+
+class ArgInternCache:
+    """Executing-side store for interned argument frames: digest ->
+    exact frame bytes, LRU-bounded by total bytes. A miss (eviction,
+    process restart, injected loss) is never silent — the caller raises
+    the typed ``arg_intern_miss`` error and the pusher re-sends the
+    blob, so the bytes that reach ``deserialize_frames`` are always
+    byte-identical to what the submitter framed.
+
+    Thread-safe: the ring pump expands fast-path headers while the event
+    loop expands slow-path ones."""
+
+    def __init__(self, cap_bytes: int = 64 << 20):
+        self._cap = max(int(cap_bytes), 1)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[bytes, bytes]" = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    def put(self, digest: bytes, blob: bytes):
+        with self._lock:
+            old = self._entries.pop(digest, None)
+            if old is not None:
+                self._bytes -= len(old)
+            self._entries[digest] = blob
+            self._bytes += len(blob)
+            while self._bytes > self._cap and self._entries:
+                _, evicted = self._entries.popitem(last=False)
+                self._bytes -= len(evicted)
+
+    def get(self, digest: bytes) -> Optional[bytes]:
+        with self._lock:
+            blob = self._entries.get(digest)
+            if blob is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(digest)
+            self.hits += 1
+            return blob
+
+    def purge(self, digests):
+        """Drop specific digests (the chaos ``drop`` kind simulates an
+        eviction exactly where a lookup was about to hit)."""
+        with self._lock:
+            for d in digests:
+                blob = self._entries.pop(d, None)
+                if blob is not None:
+                    self._bytes -= len(blob)

@@ -31,13 +31,22 @@ never corrupt the sum; executor-side contributions are pure durations):
                 outcome says whether the fn blob rode push-through or a
                 head kv_get round-trip)
     arg-pull    materialize argument refs                   (executor span)
+    exec-queue  executor-side wait between arrival and the first
+                instrumented serve work — ring chunks queueing in the
+                executor pool behind earlier chunks, loop scheduling on
+                the slow path (derived: serve − inner durations). Before
+                Round-15 this hid inside reply-ack
     exec        user function runtime                       (executor span)
     result-push serialize + store + register results        (executor span)
-    reply-ack   push RTT not covered by the executor's serve
-                envelope: wire both ways + connection queuing (derived).
-                For chunked pushes this includes waiting behind
-                chunk-mates on the executor — the driver's per-task push
-                span starts at chunk send
+    reply-window time a packaged result sat in the executor's coalescing
+                reply window before its multi-result frame went out
+                (executor span; zero when reply batching is off or the
+                result opened an idle window)
+    reply-ack   push RTT not covered by the executor's serve envelope or
+                the reply window: wire both ways + connection queuing
+                (derived). For chunked pushes this includes waiting
+                behind chunk-mates on the executor — the driver's
+                per-task push span starts at chunk send
     residual    wall − sum(above) — dispatch gaps, server queueing not
                 inside any named phase. Always shown.
 
@@ -57,8 +66,8 @@ logger = logging.getLogger(__name__)
 # Canonical phase order for tables and rollups (residual always last).
 PHASES = (
     "submit", "submit-queue", "lease-wait", "warm-pool-hit",
-    "fn-push", "kv-get", "arg-pull", "exec", "result-push",
-    "reply-ack", "residual",
+    "fn-push", "kv-get", "arg-pull", "exec-queue", "exec", "result-push",
+    "reply-window", "reply-ack", "residual",
 )
 
 # task.queued outcome -> phase name (see worker._pop_pending).
@@ -159,13 +168,23 @@ def task_breakdown(merged: List[Dict[str, Any]], task_id: str,
     phases["arg-pull"] = dur.get("task.arg_pull", 0.0)
     phases["exec"] = dur.get("task.exec", 0.0)
     phases["result-push"] = dur.get("task.result", 0.0)
+    # Window dwell is measured executor-side (a duration, skew-free) so
+    # reply-ack stays what its name says — wire both ways + connection
+    # queuing — even when the result rode a coalesced frame.
+    phases["reply-window"] = dur.get("task.reply_window", 0.0)
     push = dur.get("task.push", 0.0)
     inner = (
         phases[fn_phase] + phases["arg-pull"] + phases["exec"]
         + phases["result-push"]
     )
     serve = max(dur.get("task.serve", 0.0), inner)
-    phases["reply-ack"] = max(push - serve, 0.0)
+    # The serve envelope starts at ARRIVAL on every path (Round-15 moved
+    # the ring spans from exec-start to the pump's chunk stamp), so the
+    # executor-side wait before instrumented work — chunks queueing in
+    # the executor pool — is its own truthful phase instead of hiding in
+    # the derived reply-ack. All durations, skew-free.
+    phases["exec-queue"] = max(serve - inner, 0.0)
+    phases["reply-ack"] = max(push - serve - phases["reply-window"], 0.0)
     # Wall: driver-clock envelope. All driver spans live in one process,
     # so ts arithmetic is skew-free; fall back to the span extent when a
     # stage was sampled out or overwritten in the ring.

@@ -72,6 +72,12 @@ logger = logging.getLogger(__name__)
 # In-flight marker for the actor-push corr-dedup cache (_apush_begin).
 _APUSH_WIP = object()
 
+# Reply-window dwell below this records no ``reply-window`` phase span:
+# the ring hot path's normal dwell is one sink micro-batch (~1ms) and a
+# per-result span there is pure instrumentation tax; the unrecorded
+# sliver stays inside derived reply-ack (never disappears from the sum).
+_WINDOW_DWELL_MIN_S = 0.002
+
 
 def _lineage_bytes_limit() -> int:
     from ray_tpu._private.config import rt_config
@@ -120,6 +126,20 @@ def current_actor_id_hex() -> Optional[str]:
 def _loads_maybe(frames):
     ctx = SerializationContext()
     return ctx.deserialize_frames(frames)
+
+
+def _intern_worthy(a) -> bool:
+    """Cheap pre-serialization shape test for per-arg framing: splitting
+    an argument into its own frames costs one extra serialize per call,
+    so only shapes that can plausibly repeat at or above
+    ``arg_intern_min_bytes`` (the "same config dict to 10k tasks" shape)
+    earn a section. Varying scalars and tiny strings stay inline in the
+    skeleton — they would never intern anyway."""
+    if isinstance(a, (dict, list, tuple, set, frozenset)):
+        return bool(a)
+    if isinstance(a, (str, bytes, bytearray)):
+        return len(a) >= 64
+    return not isinstance(a, (bool, int, float, complex, type(None)))
 
 
 @dataclass(eq=False)  # identity eq: `slot in slots` must not field-compare
@@ -330,6 +350,31 @@ class CoreWorker:
         # Function-blob push-through: blobs we can piggyback on the first
         # push of an fkey to each peer (and per-peer coverage tracking).
         self._fn_push = specframe.FnPushLedger()
+        # --- reply-plane batching & arg interning (round 15) ---
+        from ray_tpu._private.config import rt_config as _rtc
+
+        # Gates cached once: these sit on per-task hot paths where an
+        # env lookup per call would cost more than the feature saves.
+        self._reply_batching = bool(_rtc.reply_batching)
+        self._arg_interning = bool(_rtc.arg_interning)
+        self._arg_intern_min = int(_rtc.arg_intern_min_bytes)
+        self._arg_intern_max = int(_rtc.arg_intern_max_bytes)
+        # Sender-side (peer, digest) coverage + executing-side byte-LRU
+        # for interned argument frames (specframe siblings of
+        # FnPushLedger/SpecCache).
+        self._arg_ledger = specframe.ArgLedger()
+        self._arg_intern = specframe.ArgInternCache(
+            int(_rtc.arg_intern_cache_bytes)
+        )
+        # Connections with an open ReplyWindow (shutdown must flush them:
+        # buffered results never die with the process).
+        self._reply_windows: List[Any] = []
+        # Hot-path caches: rt_config attribute reads parse the env per
+        # call — far too dear for once-per-task sites (re-arm deadline,
+        # dedup-cache trim horizon).
+        self._push_deadline_s = float(_rtc.rpc_deadline_s)
+        self._apush_horizon_s = 2.0 * self._push_deadline_s + 5.0
+        self._apush_done_n = 0
         # Function-table miss coalescing: fkey -> shared load future, plus
         # the keys queued for the next batched kv_get_batch.
         self._fn_loading: Dict[str, asyncio.Future] = {}
@@ -359,7 +404,15 @@ class CoreWorker:
         self._stream_credits: Dict[str, dict] = {}
         self._shutdown = False
         self._stats = {"tasks_executed": 0, "tasks_submitted": 0,
-                       "spec_templates_built": 0}
+                       "spec_templates_built": 0,
+                       # reply-plane economics (tests assert O(bursts))
+                       "reply_windows_flushed": 0,
+                       "reply_results_coalesced": 0,
+                       # arg-interning economics (bytes that stayed home)
+                       "arg_frames_interned": 0,
+                       "arg_intern_bytes_saved": 0,
+                       "arg_blobs_pushed": 0,
+                       "arg_intern_miss_retries": 0}
         # Submission batching: driver threads enqueue dispatch coroutines
         # here; ONE call_soon_threadsafe wakes the loop per burst instead of
         # one per task (the self-pipe write is a syscall per call).
@@ -1013,6 +1066,14 @@ class CoreWorker:
         the caller's next in-order call (``_ring_actor_fast_dispatch``)."""
         if h.get("m") == "push_actor_task":
             return self._ring_actor_fast_dispatch(h, frames, rconn)
+        if h.get("m") == "mrack":
+            # Reply-window ack: clock the next coalesced flush right on
+            # the pump thread (no loop hop — the flush itself is a ring
+            # send this thread can make).
+            w = getattr(rconn, "_rt_reply_window", None)
+            if w is not None:
+                w.on_ack()
+            return True
         if h.get("m") != "push_task":
             return False
         if self.node_standby:
@@ -1020,12 +1081,17 @@ class CoreWorker:
             # also means the head activated this node — a later
             # re-registration must not claim standby.
             self.node_standby = False
-        if "sp" in h or "fb" in h:
-            # Pre-framed spec / piggybacked function: expand here so the
-            # eligibility gates below see the FULL header (a False return
-            # routes the ORIGINAL message to the slow path, which expands
-            # again — cache hits both times).
-            h, frames = self._expand_task_header(h, frames)
+        if "sp" in h or "fb" in h or "ai" in h or "aib" in h:
+            # Pre-framed spec / piggybacked function / interned args:
+            # expand here so the eligibility gates below see the FULL
+            # header (a False return routes the ORIGINAL message to the
+            # slow path, which expands again — cache hits both times).
+            try:
+                h, frames = self._expand_task_header(h, frames)
+            except protocol.RpcError:
+                # Interned-arg miss: the slow path raises it as the typed
+                # error the pusher recovers from (blob re-sent).
+                return False
         if (
             h.get("nret", 1) < 1          # streaming (-1) stays on the loop
             or h.get("argrefs")
@@ -1042,7 +1108,8 @@ class CoreWorker:
         ex = self.task_executor
         if ex is None:
             return False
-        ex.submit(self._ring_execute_task, fn, h, frames, rconn)
+        ex.submit(self._ring_execute_task, fn, h, frames, rconn,
+                  t_arr=time.monotonic())
         return True
 
     def _ring_fast_dispatch_batch(self, items, rconn):
@@ -1055,6 +1122,7 @@ class CoreWorker:
         eligible (actor pushes, refs, runtime envs, uncached functions) is
         returned for the per-item fast/slow paths, whose semantics are
         authoritative."""
+        t_arr = time.monotonic()
         ex = self.task_executor
         if ex is None or self._memory_monitor.is_pressing():
             return items
@@ -1071,10 +1139,17 @@ class CoreWorker:
         # anything the run path declines falls through per-item.
         items = self._coalesce_actor_runs(items, rconn)
         for h, frames in items:
-            if h.get("m") == "push_task" and ("sp" in h or "fb" in h):
+            if h.get("m") == "push_task" and (
+                "sp" in h or "fb" in h or "ai" in h or "aib" in h
+            ):
                 # Expanded view for eligibility + execution; leftovers keep
                 # the ORIGINAL message (the slow path re-expands, cached).
-                eh, ef = self._expand_task_header(h, frames)
+                try:
+                    eh, ef = self._expand_task_header(h, frames)
+                except protocol.RpcError:
+                    # Interned-arg miss: slow path raises the typed error.
+                    leftovers.append((h, frames))
+                    continue
             else:
                 eh, ef = h, frames
             if (
@@ -1094,6 +1169,13 @@ class CoreWorker:
             eligible.append((fn, eh, ef))
         if not eligible:
             return leftovers
+        if self._reply_batching:
+            # Claim the whole chunk's corr ids in ONE dedup pass;
+            # duplicates of completed tasks answer as one replayed
+            # multi-result frame right here on the pump thread.
+            eligible = self._ring_claim_chunk(eligible, rconn)
+            if not eligible:
+                return leftovers
         # Work-stealing queue, not static chunks: N executor loops pop one
         # task at a time, so a slow task never serializes the fast tasks
         # behind it (head-of-line blocking) while sibling threads idle —
@@ -1102,16 +1184,54 @@ class CoreWorker:
         nloops = min(len(eligible), max(self.num_task_slots, 1))
         for c in range(nloops):
             try:
-                ex.submit(self._ring_execute_queue, dq, rconn)
+                ex.submit(self._ring_execute_queue, dq, rconn, t_arr)
             except RuntimeError:
                 # Executor shut down. Loops already submitted will drain
                 # the whole queue, so leftovers only exist when NONE got
                 # in; re-dispatching otherwise would double-execute.
                 if c == 0:
+                    # Release the dispatch-time corr claims: the slow
+                    # path these re-route to runs its own dedup, and a
+                    # stale WIP entry would wrongly attach it.
+                    with self._apush_lock:
+                        for _fn, h, _fr in dq:
+                            corr = h.get("corr")
+                            if (corr and self._apush_replies.get(corr)
+                                    is _APUSH_WIP):
+                                self._apush_replies.pop(corr, None)
                     leftovers.extend((h, fr) for _fn, h, fr in dq)
                     dq.clear()
                 break
         return leftovers
+
+    def _ring_claim_chunk(self, eligible, rconn):
+        """Claim a fast-path chunk's corr ids in one dedup pass (pump
+        thread). Items claimed "mine" return for execution; duplicates
+        answer here — completed outcomes replay as ONE coalesced frame,
+        in-flight twins attach to the execution's own reply."""
+        corrs = [h.get("corr") for _fn, h, _f in eligible]
+        if not any(corrs):
+            # Pusher didn't arm the corr plane (mixed gates): nothing to
+            # claim, nothing can replay.
+            return eligible
+        states = self._apush_begin_many(corrs)
+        keep = []
+        subs: List[dict] = []
+        counts: List[int] = []
+        flat: List[bytes] = []
+        for item, (state, obj) in zip(eligible, states):
+            if state == "mine":
+                keep.append(item)
+            elif state == "replay":
+                extras, fr = obj
+                subs.append({"i": item[1]["i"], **dict(extras)})
+                counts.append(len(fr))
+                flat.extend(fr)
+            else:  # wait
+                self._attach_dup_reply(obj, item[1]["i"], rconn)
+        if subs:
+            rconn.send_reply_batch(subs, counts, list(flat))
+        return keep
 
     def _coalesce_actor_runs(self, items, rconn):
         """Group consecutive eligible actor calls (same actor, same
@@ -1322,7 +1442,10 @@ class CoreWorker:
                     "start_time": t0, "end_time": time.time(),
                     "node_id": self.node_id,
                 })
-            if big:
+            if big or self._reply_batching:
+                # big → individual shm-registration path; small with
+                # reply batching on → the connection's shared reply
+                # window (cross-run coalescing + ack clocking).
                 self._ring_reply_packaged(h, rets, out_frames, big, rconn)
             else:
                 self._apush_done(corr, {"rets": rets}, out_frames)
@@ -1342,8 +1465,9 @@ class CoreWorker:
             # either transport.
             faultpoints.fire("worker.task.exec")
         try:
-            arg_slots, plain, kwargs = self.ctx.deserialize_frames(frames)
-            args = [plain[i] for _k, i in arg_slots]  # eligibility: no refs
+            arg_slots, plain, kwargs, sep = self._decode_arg_frames(h, frames)
+            args = [sep[i] if k == "sv" else plain[i]
+                    for k, i in arg_slots]  # eligibility: no refs
             self.current_task_id.value = TaskID.from_hex(h["tid"])
             self.current_actor_id.value = None
             self.put_counter.value = 0
@@ -1364,11 +1488,90 @@ class CoreWorker:
             "node_id": self.node_id,
         })
 
-    def _ring_execute_queue(self, dq: deque, rconn):
+    def _ring_execute_queue(self, dq: deque, rconn, t_arr=None):
         """One executor loop of the batched fast path: pop tasks until the
-        shared queue drains; small results coalesce into one batched
-        reply, oversized ones fall back to the individual shm-reply
-        path."""
+        shared queue drains. With reply batching on, each completion goes
+        straight into the connection's self-clocking ReplyWindow — the
+        first result flushes the moment it exists and chunk-mates ride
+        the in-flight frame's ack, instead of every result waiting for
+        the WHOLE queue drain before one end-of-loop batch reply. With
+        the gate off, the pre-round-15 accumulate-then-reply shape is
+        kept byte-identically; oversized results always fall back to the
+        individual shm-reply path.
+
+        ``t_arr`` is the pump's arrival stamp for this chunk: the serve
+        span starts there (slow-path semantics), so the analyzer can
+        carve executor queue wait (arrival → exec start) into its own
+        ``exec-queue`` phase instead of leaving it inside reply-ack."""
+        if self._reply_batching:
+            # Small results collect in a local sink handed to the window
+            # every few completions (or ~1ms, whichever first): one
+            # window lock + at most one frame per micro-batch instead of
+            # per result, without parking a slow task's result behind
+            # the whole drain. Dedup bookkeeping batches the same way —
+            # the chunk's corr ids were claimed in ONE pass at dispatch
+            # (_ring_claim_chunk), completions record in one pass here
+            # (_apush_done_many): per-task lock traffic was a measured
+            # slice of the 1M-noop drain profile. The flight-off body is
+            # flattened inline — the _ring_execute_task →
+            # _ring_reply_result → _ring_reply_packaged chain showed up
+            # as pure call overhead in the drain-thread profile at 100k
+            # noops; the full helper keeps serving the instrumented and
+            # edge paths.
+            sink: List[tuple] = []
+            dones: List[tuple] = []
+            sink_t0 = 0.0
+            while True:
+                try:
+                    fn, h, frames = dq.popleft()
+                except IndexError:
+                    if dones:
+                        self._apush_done_many(dones)
+                    if sink:
+                        self._reply_window(rconn).add_many(sink)
+                    return
+                if flight.ENABLED:
+                    self._ring_execute_task(fn, h, frames, rconn,
+                                            sink=sink, dones=dones,
+                                            claimed=True, t_arr=t_arr)
+                else:
+                    t0 = time.time()
+                    ok, result = self._ring_execute_one(fn, h, frames)
+                    try:
+                        rets, out_frames, big = self._package_result_parts(
+                            h, ok, result
+                        )
+                    except Exception as e:
+                        logger.exception("ring task reply failed")
+                        self._apush_fail(h.get("corr"), e)
+                        rconn.send_reply(
+                            {"i": h["i"], "r": 1,
+                             "e": f"reply packaging failed: {e!r}"}, [],
+                        )
+                        self._ring_finish_task(h, ok, t0)
+                        continue
+                    if big:
+                        self._ring_reply_packaged(h, rets, out_frames,
+                                                  big, rconn)
+                    else:
+                        corr = h.get("corr")
+                        if corr:
+                            dones.append((corr, {"rets": rets},
+                                          out_frames))
+                        sink.append(({"i": h["i"], "rets": rets},
+                                     out_frames, None))
+                    self._ring_finish_task(h, ok, t0)
+                if sink:
+                    now = time.monotonic()
+                    if sink_t0 == 0.0:
+                        sink_t0 = now
+                    if len(sink) >= 32 or (now - sink_t0) >= 0.001:
+                        if dones:
+                            self._apush_done_many(dones)
+                            dones = []
+                        self._reply_window(rconn).add_many(sink)
+                        sink = []
+                        sink_t0 = 0.0
         subs = []
         counts = []
         out: List[bytes] = []
@@ -1377,6 +1580,20 @@ class CoreWorker:
                 fn, h, frames = dq.popleft()
             except IndexError:
                 break
+            corr = h.get("corr")
+            if corr:
+                # Mixed-gate safety: a pusher that arms per-task corr ids
+                # must never double-execute here even with windows off.
+                state, obj = self._apush_begin(corr)
+                if state != "mine":
+                    if state == "replay":
+                        extras, fr = obj
+                        subs.append({"i": h["i"], **dict(extras)})
+                        counts.append(len(fr))
+                        out.extend(fr)
+                    elif state == "wait":
+                        self._attach_dup_reply(obj, h["i"], rconn)
+                    continue
             t0 = time.time()
             fl = flight.ENABLED
             if fl:
@@ -1395,6 +1612,7 @@ class CoreWorker:
                 )
             except Exception as e:
                 logger.exception("ring chunk reply packaging failed")
+                self._apush_fail(h.get("corr"), e)
                 subs.append(
                     {"i": h["i"], "e": f"reply packaging failed: {e!r}"}
                 )
@@ -1407,6 +1625,7 @@ class CoreWorker:
                 # nested-ref borrows twice and re-serialize the value)
                 self._ring_reply_packaged(h, rets, out_frames, big, rconn)
             else:
+                self._apush_done(h.get("corr"), {"rets": rets}, out_frames)
                 subs.append({"i": h["i"], "rets": rets})
                 counts.append(len(out_frames))
                 out.extend(out_frames)
@@ -1417,12 +1636,28 @@ class CoreWorker:
                     fn=h.get("name") or h.get("fkey", "")[:10],
                     phase="result-push",
                 )
-                flight.record("task.serve", h["tid"], "task", tm0, now)
+                flight.record("task.serve", h["tid"], "task",
+                              t_arr if t_arr is not None else tm0, now)
             self._ring_finish_task(h, ok, t0)
         if subs:
             rconn.send_reply_batch(subs, counts, out)
 
-    def _ring_execute_task(self, fn, h, frames, rconn):
+    def _ring_execute_task(self, fn, h, frames, rconn, sink=None,
+                           dones=None, claimed=False, t_arr=None):
+        if not claimed:
+            corr = h.get("corr")
+            if corr:
+                # Plain tasks carry corr (= task id) when reply batching
+                # arms deadline re-arm on the pusher: a re-delivered
+                # duplicate (dropped window frame, deadline race) replays
+                # the recorded outcome or attaches to the in-flight twin
+                # — never runs the function a second time. Chunked
+                # deliveries claim their corr ids in one pass at dispatch
+                # (_ring_claim_chunk) and arrive here claimed.
+                state, obj = self._apush_begin(corr)
+                if state != "mine":
+                    self._ring_reply_dup(state, obj, h, rconn)
+                    return
         t0 = time.time()
         fl = flight.ENABLED
         if fl:
@@ -1435,7 +1670,8 @@ class CoreWorker:
                 fn=h.get("name") or h.get("fkey", "")[:10],
                 outcome="ok" if ok else "error", phase="exec",
             )
-        self._ring_reply_result(h, ok, result, rconn)
+        self._ring_reply_result(h, ok, result, rconn, sink=sink,
+                                dones=dones)
         if fl:
             now = time.monotonic()
             taskpath.record_phase(
@@ -1443,10 +1679,22 @@ class CoreWorker:
                 fn=h.get("name") or h.get("fkey", "")[:10],
                 phase="result-push",
             )
-            flight.record("task.serve", h["tid"], "task", tm0, now)
+            flight.record("task.serve", h["tid"], "task",
+                          t_arr if t_arr is not None else tm0, now)
         self._ring_finish_task(h, ok, t0)
 
-    def _ring_reply_result(self, h, ok, result, rconn):
+    def _ring_reply_dup(self, state, obj, h, rconn):
+        """Answer a duplicate ring delivery (dedup said not-"mine"):
+        replay the recorded outcome, or attach to the in-flight twin."""
+        if state == "replay":
+            extras, fr = obj
+            rconn.send_reply({"i": h["i"], "r": 1, **dict(extras)},
+                             list(fr))
+        elif state == "wait":
+            self._attach_dup_reply(obj, h["i"], rconn)
+
+    def _ring_reply_result(self, h, ok, result, rconn, sink=None,
+                           dones=None):
         """Package + send an execution result from an executor thread
         (shared by the task and actor ring fast paths)."""
         try:
@@ -1459,9 +1707,11 @@ class CoreWorker:
                 [],
             )
             return
-        self._ring_reply_packaged(h, rets, out_frames, big, rconn)
+        self._ring_reply_packaged(h, rets, out_frames, big, rconn, sink=sink,
+                                  dones=dones)
 
-    def _ring_reply_packaged(self, h, rets, out_frames, big, rconn):
+    def _ring_reply_packaged(self, h, rets, out_frames, big, rconn,
+                             sink=None, dones=None):
         """Send an ALREADY-packaged result (from an executor thread).
         Packaging must happen exactly once per execution — it registers
         nested-ref borrows, and a second pass would leak them."""
@@ -1505,10 +1755,32 @@ class CoreWorker:
 
                 asyncio.run_coroutine_threadsafe(finish(), self.loop)
             else:
-                self._apush_done(h.get("corr"), {"rets": rets}, out_frames)
-                rconn.send_reply(
-                    {"i": h["i"], "r": 1, "rets": rets}, out_frames
-                )
+                corr = h.get("corr")
+                if dones is not None and corr:
+                    # Drain-loop micro-batch: the dedup record rides the
+                    # sink flush (_apush_done_many, one lock) and is
+                    # written before the window frame leaves.
+                    dones.append((corr, {"rets": rets}, out_frames))
+                else:
+                    self._apush_done(corr, {"rets": rets}, out_frames)
+                if self._reply_batching:
+                    # Small result: coalesce into the connection's reply
+                    # window — first result of an idle window flushes
+                    # immediately, the rest ride the in-flight frame's
+                    # ack (O(bursts) reply messages, and a chunk-mate
+                    # never queues behind a sibling's ack). A drain loop
+                    # passes a sink so many results share one window
+                    # hand-off (add_many).
+                    item = ({"i": h["i"], "rets": rets}, out_frames,
+                            self._window_tag(h))
+                    if sink is not None:
+                        sink.append(item)
+                    else:
+                        self._reply_window(rconn).add(*item)
+                else:
+                    rconn.send_reply(
+                        {"i": h["i"], "r": 1, "rets": rets}, out_frames
+                    )
         except Exception as e:
             logger.exception("ring task reply failed")
             self._apush_fail(h.get("corr"), e)
@@ -1516,6 +1788,173 @@ class CoreWorker:
                 {"i": h["i"], "r": 1, "e": f"reply packaging failed: {e!r}"},
                 [],
             )
+
+    # ------------------------------------------------ reply-plane batching
+
+    def _attach_dup_reply(self, fut, rid, rconn):
+        """A duplicate delivery raced a still-running execution (the
+        pusher's deadline re-arm cancelled its earlier attempt, so the
+        in-flight twin's own reply will land on a dead correlation id —
+        THIS duplicate is the live one): answer its id the moment the
+        execution finishes. Long-running tasks therefore deliver at
+        completion, not one re-arm period later."""
+
+        def _done(f, rid=rid, rconn=rconn):
+            try:
+                extras, fr = f.result()
+            except BaseException as e:
+                try:
+                    rconn.send_reply(
+                        {"i": rid, "r": 1,
+                         "e": f"TaskError: delivery failed: {e!r}"}, [],
+                    )
+                except Exception as e2:
+                    logger.debug("duplicate-attach error reply lost: %s", e2)
+                return
+            try:
+                rconn.send_reply({"i": rid, "r": 1, **dict(extras)},
+                                 list(fr))
+            except Exception as e2:
+                logger.debug("duplicate-attach reply lost: %s", e2)
+
+        fut.add_done_callback(_done)
+
+    def _reply_window(self, conn):
+        """The connection's ReplyWindow, created on first use. One window
+        per peer connection (ring or TCP): every execution path feeding
+        results back over ``conn`` shares it, so coalescing crosses
+        chunk/run boundaries. Ring windows run timer-clocked (gap-paced
+        flushes, deferred tail flush on this worker's loop — no mrack
+        traffic to contend with the pusher on the ring send lock); TCP
+        windows keep the ack clock."""
+        w = getattr(conn, "_rt_reply_window", None)
+        if w is None:
+            from ray_tpu._private.config import rt_config
+            from ray_tpu._private.ringconn import RingConnection
+
+            is_ring = isinstance(conn, RingConnection)
+
+            def _defer(delay, cb):
+                loop = self.loop
+                try:
+                    if asyncio.get_running_loop() is loop:
+                        loop.call_later(delay, cb)  # on-loop: heap push
+                        return
+                except RuntimeError:
+                    pass
+                try:
+                    loop.call_soon_threadsafe(loop.call_later, delay, cb)
+                except RuntimeError:  # loop closed: flush inline
+                    cb()
+
+            w = specframe.ReplyWindow(
+                lambda items, _c=conn, _a=not is_ring: (
+                    self._reply_window_send(_c, items, ack=_a)
+                ),
+                max_items=int(rt_config.reply_window_max),
+                max_bytes=int(rt_config.reply_window_bytes),
+                horizon_s=float(rt_config.reply_window_horizon_s),
+                gap_s=(float(rt_config.reply_window_gap_s)
+                       if is_ring else None),
+                defer=_defer if is_ring else None,
+            )
+            conn._rt_reply_window = w
+            # Keep for the shutdown flush; prune dead connections so
+            # churn stays bounded (same discipline as _served_rings).
+            self._reply_windows = [
+                c for c in self._reply_windows
+                if not getattr(c, "_closed", True)
+            ] + [conn]
+        return w
+
+    def _window_tag(self, h):
+        """Per-result taskpath annotation carried through the window (the
+        dwell becomes the task's ``reply-window`` phase). None when the
+        recorder is off — the hot path then carries no tuple at all."""
+        if not flight.ENABLED:
+            return None
+        return (h.get("tid"), time.monotonic(),
+                h.get("name") or h.get("method")
+                or h.get("fkey", "")[:10])
+
+    def _reply_window_send(self, conn, items, ack=True):
+        """Flush one coalesced multi-result frame: [(sub, frames, tag)]
+        -> a single ``bh`` reply message, with the ``wa`` ack request
+        that clocks ack-mode (TCP) windows; timer-mode (ring) flushes
+        carry no ack request. Transport loss is the peer's problem to
+        notice (its per-task deadlines re-arm and the corr-deduped
+        re-push replays) — exactly like any other dropped reply."""
+        fl = flight.ENABLED
+        if fl:
+            t0 = time.monotonic()
+        counts, flat = protocol.pack_multi_frames(
+            [list(f) for _s, f, _t in items]
+        )
+        subs = [s for s, _f, _t in items]
+        nbytes = sum(len(f) for f in flat)
+        if faultpoints.ACTIVE:
+            try:
+                act = faultpoints.fire(
+                    "worker.reply.window", err=protocol.ConnectionLost
+                )
+            except protocol.ConnectionLost as e:
+                logger.debug("injected reply-window loss: %s", e)
+                act = "drop"
+            if act == "drop":
+                # The whole frame is lost in transit: every rider's push
+                # deadline fires at the driver and the corr-tagged
+                # re-push replays the recorded outcomes.
+                if fl:
+                    flight.record("worker.reply.window", None, "worker",
+                                  t0, time.monotonic(), nbytes,
+                                  f"drop:batch{len(subs)}")
+                return
+        try:
+            conn.send_reply_batch(subs, counts, flat,
+                                  extras={"wa": 1} if ack else None)
+        except (protocol.ConnectionLost, OSError) as e:
+            logger.debug("reply window flush dropped, peer gone: %s", e)
+        self._stats["reply_windows_flushed"] += 1
+        self._stats["reply_results_coalesced"] += len(subs)
+        if fl:
+            now = time.monotonic()
+            flight.record("worker.reply.window", None, "worker", t0, now,
+                          nbytes, f"ok:batch{len(subs)}")
+            for _sub, _fr, tag in items:
+                # Sub-threshold dwell (the ring hot path's normal case —
+                # results leave with their micro-batch) is delivery
+                # noise, not parking: skipping the span keeps the +1
+                # record_phase/task tax off the drain loop (a measured
+                # ~12us/record at 1M noops) and the unrecorded sliver
+                # lands in derived reply-ack, never vanishes. Genuinely
+                # parked results (ack-clocked TCP windows, stragglers)
+                # still get their truthful reply-window phase.
+                if tag is not None and now - tag[1] >= _WINDOW_DWELL_MIN_S:
+                    taskpath.record_phase(
+                        "reply_window", tag[0], tag[1], now, fn=tag[2],
+                        phase="reply-window",
+                    )
+
+    def _flush_reply_windows(self):
+        """Drain every open reply window (shutdown / graceful node
+        drain): buffered results must not die with the process — the
+        PR 7 tail-event flush discipline, applied to the reply plane."""
+        for conn in self._reply_windows:
+            w = getattr(conn, "_rt_reply_window", None)
+            if w is None:
+                continue
+            try:
+                w.flush()
+            except Exception as e:
+                logger.debug("reply-window flush at shutdown failed: %s", e)
+
+    async def rpc_mrack(self, h, frames, conn):
+        """Reply-window ack (oneway): the peer's pump settled our last
+        coalesced frame — flush whatever completed behind it."""
+        w = getattr(conn, "_rt_reply_window", None)
+        if w is not None:
+            w.on_ack()
+        return {}, []
 
     def _ring_actor_fast_dispatch(self, h, frames, rconn) -> bool:
         """Pump-thread fast path for actor calls: a plain (non-async) method
@@ -2908,34 +3347,58 @@ class CoreWorker:
     # empty tuple per call costs more than the whole wire framing.
     _EMPTY_ARGS_FRAMES: Optional[List[bytes]] = None
 
-    def _serialize_args(self, args, kwargs):
+    def _serialize_args(self, args, kwargs, split: bool = False):
         """Top-level ObjectRef args are passed by reference and materialized by
-        the executor (reference semantics); nested refs ride along as borrows."""
+        the executor (reference semantics); nested refs ride along as borrows.
+
+        With ``split`` (plain-task submit while arg interning is on),
+        plain args whose serialized form could plausibly repeat across
+        tasks get their OWN frame section appended after the skeleton —
+        the returned ``an`` lists each section's frame count (wire key
+        ``an``). The shared config dict of a parameter sweep then
+        produces byte-identical frames on every push, which is exactly
+        what the per-peer :class:`specframe.ArgLedger` digests; varying
+        scalars keep riding the skeleton inline."""
         if not args and not kwargs:
             frames = CoreWorker._EMPTY_ARGS_FRAMES
             if frames is None:
                 frames = CoreWorker._EMPTY_ARGS_FRAMES = self.ctx.serialize(
                     ((), [], {})
                 ).to_frames()
-            return list(frames), [], []
+            return list(frames), [], [], None
         arg_slots = []
         ref_ids = []
         plain = []
+        sep = []
         for a in args:
             if isinstance(a, ObjectRef):
                 arg_slots.append(("ref", len(ref_ids)))
                 ref_ids.append((a.id().hex(), list(a.owner_address or ())))
+            elif split and _intern_worthy(a):
+                arg_slots.append(("sv", len(sep)))
+                sep.append(a)
             else:
                 arg_slots.append(("val", len(plain)))
                 plain.append(a)
-        (sobj, nested) = collect_refs_during(
-            lambda: self.ctx.serialize((arg_slots, plain, kwargs))
-        )
+
+        def _ser():
+            sk = self.ctx.serialize((arg_slots, plain, kwargs))
+            return sk, [self.ctx.serialize(a) for a in sep]
+
+        ((sk, sobjs), nested) = collect_refs_during(_ser)
+        frames = sk.to_frames()
+        an = None
+        if sobjs:
+            an = []
+            for so in sobjs:
+                fr = so.to_frames()
+                an.append(len(fr))
+                frames.extend(fr)
         borrows = list(ref_ids) + [
             (r.id().hex(), list(r.owner_address or ())) for r in nested
         ]
         self._add_borrows(borrows)
-        return sobj.to_frames(), ref_ids, borrows
+        return frames, ref_ids, borrows, an
 
     def _spec_template(self, fn, fkey, name, retries) -> Optional[bytes]:
         """The pre-framed invariant spec for (function, options): packed
@@ -3000,7 +3463,11 @@ class CoreWorker:
             fl_t0 = time.monotonic()
         fkey = self.export_function(fn)
         task_id = TaskID.of()
-        frames, ref_ids, borrow_ids = self._serialize_args(args, kwargs)
+        # Per-arg framing rides only the plain-task push path (the one
+        # _arg_intern_wire digests); actor calls keep the single skeleton.
+        frames, ref_ids, borrow_ids, an = self._serialize_args(
+            args, kwargs, split=self._arg_interning
+        )
         if not resources and not strategy:
             # Hot path: the shared default dict + precomputed sched key skip
             # a dict copy and a sorted-tuple build per call. Never mutated
@@ -3043,6 +3510,8 @@ class CoreWorker:
                 "renv": self._prepare_runtime_env(runtime_env),
                 "retries": max_retries,
             }
+        if an:
+            header["an"] = an
         from ray_tpu.util.tracing import tracing_helper
 
         if tracing_helper.enabled():
@@ -3378,8 +3847,9 @@ class CoreWorker:
         ]
         lease_set.saturated = False
         # A successor process at this address starts with an empty function
-        # cache: push-through must re-cover it.
+        # cache AND an empty interned-arg cache: both must be re-covered.
         self._fn_push.forget_peer(slot.addr)
+        self._arg_ledger.forget_peer(slot.addr)
         for s in doomed:
             self._release_slot(lease_set, s)
         for fut in futs:
@@ -3433,6 +3903,72 @@ class CoreWorker:
             return h2, [frames[0], blob, *frames[1:]]
         return h2, [blob, *frames]
 
+    def _arg_intern_wire(self, addr, header, frames):
+        """Per-peer argument interning at wire-build time: each small arg
+        frame is content-hashed; a digest this peer already holds is
+        OMITTED from the wire (header key ``ai`` = [[pos, digest]...] in
+        arg-frame positions) while a first-seen digest ships its bytes
+        and asks the executor to intern them (``aib``). The queued
+        originals are never mutated — a requeued task re-decides for its
+        next peer, exactly like ``_fn_push_wire``."""
+        if not self._arg_interning:
+            return header, frames
+        if faultpoints.ACTIVE:
+            # error: this push degrades to full frames (interning is an
+            # optimization, never a correctness gate). drop: the peer's
+            # coverage is reset — every blob re-ships, exercising
+            # re-cover exactly like a slot loss would.
+            try:
+                if faultpoints.fire("worker.arg.intern") == "drop":
+                    self._arg_ledger.forget_peer(addr)
+            except Exception as e:
+                logger.debug("arg interning degraded to full frames: %s", e)
+                return header, frames
+        start = 1 if header.get("sp") else 0
+        min_b, max_b = self._arg_intern_min, self._arg_intern_max
+        ai = None
+        aib = None
+        wire = None
+        for pos in range(start, len(frames)):
+            f = frames[pos]
+            n = len(f)
+            if n < min_b or n > max_b:
+                if wire is not None:
+                    wire.append(f)
+                continue
+            digest = hashlib.blake2b(f, digest_size=16).digest()
+            if wire is None:
+                wire = list(frames[:pos])
+            if self._arg_ledger.covered(addr, digest):
+                # Peer holds these bytes: send the digest, keep the frame
+                # home. O(unique args) arg bytes per (peer, burst).
+                if ai is None:
+                    ai = []
+                ai.append([pos - start, digest])
+                self._stats["arg_frames_interned"] += 1
+                self._stats["arg_intern_bytes_saved"] += n
+            else:
+                if aib is None:
+                    aib = []
+                aib.append([pos - start, digest])
+                wire.append(f)
+                self._stats["arg_blobs_pushed"] += 1
+        if wire is None or (ai is None and aib is None):
+            return header, frames
+        h2 = dict(header)
+        if ai:
+            h2["ai"] = ai
+        if aib:
+            h2["aib"] = aib
+        return h2, wire
+
+    def _task_wire(self, addr, header, frames):
+        """Wire form of one queued push for one peer: interned argument
+        frames first (positions are arg-relative, so the later splices
+        don't disturb them), then the function push-through blob."""
+        h2, f2 = self._arg_intern_wire(addr, header, frames)
+        return self._fn_push_wire(addr, h2, f2)
+
     def _pop_pending(self, lease_set: _LeaseSet) -> tuple:
         """Pop the next pending task, turning its submit-time "_tq" stamp
         into a ``task.queued`` span whose outcome NAMES the wait: a grant
@@ -3443,6 +3979,12 @@ class CoreWorker:
         submit-queue depth. The stamp never reaches the wire."""
         item = lease_set.pending.popleft()
         header = item[0]
+        if self._reply_batching and "corr" not in header:
+            # Per-task correlation id (the task id — already unique per
+            # logical task): arms receiver-side dedup, so a deadline-
+            # re-armed re-push after a dropped reply window replays the
+            # recorded outcome instead of executing twice.
+            header["corr"] = header["tid"]
         t_enq = header.pop("_tq", None)
         if t_enq is not None and flight.ENABLED:
             if lease_set.last_grant_t <= t_enq:
@@ -3468,6 +4010,89 @@ class CoreWorker:
         except MessageTooBig:
             tcp = await self.get_peer(addr)
             return await tcp.call(method, header, frames)
+
+    async def _await_chunk_settled(self, rfs, conn, addr, chunk):
+        """Settle EVERY reply future of one pushed chunk under a shared
+        deadline: ONE ``asyncio.wait`` (one timer) covers the whole
+        chunk per attempt window, instead of a per-task
+        ``asyncio.wait_for`` — per-task timers were a measured drag on
+        the saturated driver loop at 100k+ queued tasks, and chunk-mates
+        settle together anyway (their replies ride coalesced frames).
+        On a deadline, every straggler is cancelled and re-pushed under
+        its SAME corr id with jittered backoff — receiver-side dedup
+        replays or attaches, never re-executes. Returns the (possibly
+        re-issued) future list; every entry is done. Per-item errors
+        (incl. the typed ``arg_intern_miss``) stay in the futures for
+        the caller's in-order processing."""
+        rfs = list(rfs)
+        pending_idx = [i for i, rf in enumerate(rfs) if not rf.done()]
+        attempt_s = self._push_deadline_s
+        rearm = None
+        while pending_idx:
+            await asyncio.wait({rfs[i] for i in pending_idx},
+                               timeout=attempt_s)
+            pending_idx = [i for i in pending_idx if not rfs[i].done()]
+            if not pending_idx:
+                break
+            if rearm is None:
+                rearm = Backoff(base=0.05, cap=2.0)
+            await asyncio.sleep(rearm.next_delay())
+            for i in pending_idx:
+                rfs[i].cancel()  # the re-push's reply is the live one
+                header, frames, _fut = chunk[i]
+                wh, wf = self._task_wire(addr, header, frames)
+                rfs[i] = asyncio.ensure_future(
+                    self._call_with_tcp_fallback(
+                        conn, addr, "push_task", wh, wf
+                    )
+                )
+        return rfs
+
+    async def _await_push_reply(self, rf, conn, addr, header, frames):
+        """Await one push_task reply. Without a corr id (reply batching
+        off) this is the plain unbounded wait. With one, the wait is
+        deadline-bounded the way actor pushes already are: silence (a
+        dropped coalesced reply frame, a lost push) re-issues the SAME
+        corr with jittered backoff — receiver-side dedup replays the
+        recorded outcome or attaches to the in-flight execution, never
+        re-runs the task; a long-running task just keeps re-arming. A
+        typed ``arg_intern_miss`` (receiver evicted an interned frame)
+        resets the peer's coverage and re-pushes the exact bytes."""
+        corr = header.get("corr")
+        if not corr:
+            return await rf
+        attempt_s = self._push_deadline_s
+        rearm = None
+        while True:
+            try:
+                if asyncio.isfuture(rf) and rf.done():
+                    # Chunk-mates settle together (their replies ride one
+                    # coalesced frame), so by the time the in-order await
+                    # loop reaches this item its reply usually already
+                    # landed with a sibling's — skip the deadline timer;
+                    # result() raises exactly what await would.
+                    return rf.result()
+                return await asyncio.wait_for(rf, attempt_s)
+            except asyncio.TimeoutError:
+                if rearm is None:
+                    rearm = Backoff(base=0.05, cap=2.0)
+                await asyncio.sleep(rearm.next_delay())
+                wh, wf = self._task_wire(addr, header, frames)
+                rf = self._call_with_tcp_fallback(
+                    conn, addr, "push_task", wh, wf
+                )
+            except protocol.RpcError as e:
+                if getattr(e, "code", None) != "arg_intern_miss":
+                    raise
+                self._stats["arg_intern_miss_retries"] += 1
+                self._arg_ledger.forget_peer(addr)
+                # Re-push with FULL argument frames (no interning): the
+                # receiver re-interns from the ``aib``-less wire and the
+                # bytes reaching deserialize are the submitter's exactly.
+                wh, wf = self._fn_push_wire(addr, header, frames)
+                rf = self._call_with_tcp_fallback(
+                    conn, addr, "push_task", wh, wf
+                )
 
     async def _slot_pusher(self, key, lease_set, slot):
         """Drains pending tasks onto one leased slot until the queue (or the
@@ -3527,9 +4152,12 @@ class CoreWorker:
                         )
                     if len(chunk) == 1:
                         header, frames, fut = chunk[0]
-                        wh, wf = self._fn_push_wire(slot.addr, header, frames)
-                        h, rframes = await self._call_with_tcp_fallback(
-                            conn, slot.addr, "push_task", wh, wf
+                        wh, wf = self._task_wire(slot.addr, header, frames)
+                        h, rframes = await self._await_push_reply(
+                            self._call_with_tcp_fallback(
+                                conn, slot.addr, "push_task", wh, wf
+                            ),
+                            conn, slot.addr, header, frames,
                         )
                         self._handle_task_reply(header, h, rframes)
                         if not fut.done():
@@ -3550,7 +4178,7 @@ class CoreWorker:
                     try:
                         rfuts = conn.call_batch(
                             "push_task",
-                            [self._fn_push_wire(slot.addr, h, f)
+                            [self._task_wire(slot.addr, h, f)
                              for h, f, _ in chunk],
                         )
                     except MessageTooBig:
@@ -3559,11 +4187,12 @@ class CoreWorker:
                         # the ring ride TCP. Futures must never be dropped.
                         for i, (header, frames, fut) in enumerate(chunk):
                             try:
-                                h, rframes = (
-                                    await self._call_with_tcp_fallback(
+                                h, rframes = await self._await_push_reply(
+                                    self._call_with_tcp_fallback(
                                         conn, slot.addr, "push_task",
                                         header, frames,
-                                    )
+                                    ),
+                                    conn, slot.addr, header, frames,
                                 )
                                 self._handle_task_reply(header, h, rframes)
                                 if fl:
@@ -3590,7 +4219,20 @@ class CoreWorker:
                         zip(chunk, rfuts)
                     ):
                         try:
-                            h, rframes = await rf
+                            if (asyncio.isfuture(rf) and rf.done()
+                                    and not rf.cancelled()
+                                    and rf.exception() is None):
+                                # Chunk-mates settle together (coalesced
+                                # reply frames): skip the await wrapper
+                                # — and its coroutine — entirely for the
+                                # common already-settled case. Errors
+                                # keep the full path (deadline re-arm,
+                                # intern-miss re-push).
+                                h, rframes = rf.result()
+                            else:
+                                h, rframes = await self._await_push_reply(
+                                    rf, conn, slot.addr, header, frames
+                                )
                         except protocol.ConnectionLost:
                             self._pusher_node_lost(
                                 lease_set, slot, [c[2] for c in chunk[i:]]
@@ -3785,7 +4427,7 @@ class CoreWorker:
             )
         actor_id = ActorID.of(self.job_id)
         cls_key = self.export_function(cls)
-        frames, ref_ids, borrows = self._serialize_args(args, kwargs)
+        frames, ref_ids, borrows, _an = self._serialize_args(args, kwargs)
         header = {
             "actor_id": actor_id.hex(),
             "class_key": cls_key,
@@ -3991,7 +4633,7 @@ class CoreWorker:
         if fl:
             fl_t0 = time.monotonic()
         task_id = TaskID.of(ActorID.from_hex(actor_id_hex))
-        frames, ref_ids, borrow_ids = self._serialize_args(args, kwargs)
+        frames, ref_ids, borrow_ids, _an = self._serialize_args(args, kwargs)
         header = {
             "tid": task_id.hex(),
             "aid": actor_id_hex,
@@ -4481,8 +5123,28 @@ class CoreWorker:
             if self._shm is not None:
                 self._shm.free(oid)
 
+    def _decode_arg_frames(self, header, frames):
+        """Argument payload of one push back to
+        ``(arg_slots, plain, kwargs, split_vals)``: the skeleton tuple,
+        then one deserialize per per-arg section (header ``an`` = frame
+        counts — the submit-side split that lets repeated args intern
+        per peer)."""
+        an = header.get("an")
+        if not an:
+            arg_slots, plain, kwargs = self.ctx.deserialize_frames(frames)
+            return arg_slots, plain, kwargs, ()
+        cut = len(frames) - sum(an)
+        arg_slots, plain, kwargs = self.ctx.deserialize_frames(frames[:cut])
+        sep = []
+        for n in an:
+            sep.append(self.ctx.deserialize_frames(frames[cut:cut + n]))
+            cut += n
+        return arg_slots, plain, kwargs, sep
+
     async def _materialize_args(self, header, frames):
-        arg_slots, plain, kwargs = self.ctx.deserialize_frames(frames)
+        arg_slots, plain, kwargs, sep = self._decode_arg_frames(
+            header, frames
+        )
         ref_vals = []
         for rid, owner in header.get("argrefs", []):
             ref = ObjectRef(ObjectID.from_hex(rid), tuple(owner) if owner else None)
@@ -4493,7 +5155,12 @@ class CoreWorker:
             fetched = []
         args = []
         for kind, idx in arg_slots:
-            args.append(fetched[idx] if kind == "ref" else plain[idx])
+            if kind == "ref":
+                args.append(fetched[idx])
+            elif kind == "sv":
+                args.append(sep[idx])
+            else:
+                args.append(plain[idx])
         return args, kwargs
 
     def _pressure_killer_loop(self):
@@ -4842,11 +5509,16 @@ class CoreWorker:
         """Undo submission-plane framing on the executing side: merge the
         pre-framed spec template (frame 0 when header flag ``sp``) back
         into the per-call header — one msgpack decode per DISTINCT spec,
-        cached — and install a piggybacked function blob (flag ``fb``) into
-        the function cache so no kv_get is needed. Returns the full header
-        plus the remaining (argument) frames. Idempotent across the ring
-        fast path and the TCP slow path: a second expansion of the same
-        message hits both caches."""
+        cached — install a piggybacked function blob (flag ``fb``) into
+        the function cache so no kv_get is needed, and re-insert interned
+        argument frames (keys ``ai``/``aib``) from the bounded LRU so
+        ``deserialize_frames`` sees exactly the bytes the submitter
+        framed. Returns the full header plus the (argument) frames.
+        Idempotent across the ring fast path and the TCP slow path: a
+        second expansion of the same message hits every cache (an ``aib``
+        re-store is a no-op overwrite). An evicted ``ai`` digest raises
+        the typed ``arg_intern_miss`` error — the pusher answers by
+        re-sending the exact bytes."""
         idx = 0
         if h.get("sp"):
             spec = self._spec_cache.get(frames[0])
@@ -4869,11 +5541,103 @@ class CoreWorker:
                     # through is an optimization, never authoritative.
                     logger.debug("piggybacked function %s rejected: %s",
                                  fkey[:8], e)
-        return merged, (frames[idx:] if idx else frames)
+        ai = merged.pop("ai", None)
+        aib = merged.pop("aib", None)
+        out = frames[idx:] if idx else frames
+        if ai or aib:
+            out = self._arg_intern_expand(ai, aib, out)
+        return merged, out
+
+    def _arg_intern_expand(self, ai, aib, frames):
+        """Rebuild the full argument-frame list: wire frames fill the
+        non-interned positions in order, ``ai`` positions come from the
+        intern cache (miss => typed error, pusher re-sends), ``aib``
+        frames are stored under their digest for the bursts behind this
+        push."""
+        if ai and faultpoints.ACTIVE:
+            # error: force a miss even though the bytes are cached; drop:
+            # REALLY evict them first — both funnel into the same typed
+            # recovery (re-sent blob, byte-exact round trip).
+            forced = False
+            try:
+                if faultpoints.fire("worker.arg.intern") == "drop":
+                    self._arg_intern.purge([d for _p, d in ai])
+            except Exception:
+                forced = True
+            if forced:
+                raise protocol.RpcError(
+                    "injected interned-arg loss", code="arg_intern_miss"
+                )
+        ai_map = {p: d for p, d in (ai or ())}
+        aib_map = dict(aib) if aib else {}
+        total = len(frames) + len(ai_map)
+        out = []
+        it = iter(frames)
+        for pos in range(total):
+            digest = ai_map.get(pos)
+            if digest is not None:
+                blob = self._arg_intern.get(digest)
+                if blob is None:
+                    raise protocol.RpcError(
+                        f"interned arg frame missing at position {pos} "
+                        f"(evicted or never covered)",
+                        code="arg_intern_miss",
+                    )
+                out.append(blob)
+                continue
+            f = next(it)
+            store = aib_map.get(pos)
+            if store is not None:
+                self._arg_intern.put(store, bytes(f))
+            out.append(f)
+        out.extend(it)
+        return out
 
     async def rpc_push_task(self, h, frames, conn):
         """Execute a normal task (reference: ``CoreWorker::HandlePushTask``
-        ``core_worker.cc:3341`` → ExecuteTask)."""
+        ``core_worker.cc:3341`` → ExecuteTask), with the round-15 reply
+        plane wrapped around the execution core: per-task corr dedup (a
+        deadline-re-armed re-push after a dropped coalesced reply frame
+        replays the recorded outcome — exactly-once application, the
+        ``rpc_push_actor_task`` contract extended to plain tasks) and
+        small-result routing into the connection's ReplyWindow (the
+        dispatcher sends nothing; the coalesced ``bh`` frame answers this
+        correlation id). Big results — any shm-registered return — and
+        streaming keep the direct per-task reply path."""
+        corr = h.get("corr")
+        if corr:
+            state, obj = self._apush_begin(corr)
+            if state == "replay":
+                extras, rframes = obj
+                return dict(extras), list(rframes)
+            if state == "wait":
+                extras, rframes = await asyncio.wrap_future(obj)
+                return dict(extras), list(rframes)
+        try:
+            extras, rframes = await self._push_task_inner(h, frames, conn)
+        except BaseException as e:
+            # Failed deliveries are retried for real (only successes
+            # replay); a DropReply injection lands here too — its retry
+            # re-executes, same as the pre-corr contract.
+            self._apush_fail(corr, e)
+            raise
+        self._apush_done(corr, extras, rframes)
+        if (
+            self._reply_batching
+            and isinstance(extras, dict)
+            and "rets" in extras
+            and all(
+                not (isinstance(r, dict) and r.get("kind") == "shm")
+                for r in extras["rets"]
+            )
+        ):
+            self._reply_window(conn).add(
+                {"i": h["i"], **extras}, rframes, tag=self._window_tag(h)
+            )
+            return protocol.REPLY_HANDLED, []
+        return extras, rframes
+
+    async def _push_task_inner(self, h, frames, conn):
         if self.node_standby:
             # Work arriving means the head activated this node: a later
             # re-registration (blip, head restart) must not claim standby.
@@ -4883,7 +5647,7 @@ class CoreWorker:
             fl_srv0 = time.monotonic()
             fb_rode = "fb" in h
             f_cached = h.get("fkey") in self.fn_cache
-        if "sp" in h or "fb" in h:
+        if "sp" in h or "fb" in h or "ai" in h or "aib" in h:
             h, frames = self._expand_task_header(h, frames)
         if self._memory_monitor.is_pressing():
             # Reject at admission so this node survives; the owner retries
@@ -5466,14 +6230,20 @@ class CoreWorker:
         and never an in-flight marker (skipped by rotation, so one
         long-running call cannot wedge eviction behind it and grow the
         cache without bound). Beyond the hard cap, age no longer
-        protects: memory wins over an already-pathological retry."""
-        from ray_tpu._private.config import rt_config
-
-        horizon = 2.0 * float(rt_config.rpc_deadline_s) + 5.0
+        protects: memory wins over an already-pathological retry.
+        Called every 32nd completion (plus at the hard cap) — per-call
+        it was a measurable slice of the task hot path once plain tasks
+        joined the corr plane. Hard-cap evictions drain a full
+        ``_APUSH_CACHE`` band in one pass: evicting a single entry would
+        leave the cache AT the cap, re-firing the trim on every
+        subsequent completion (the equilibrium that put this function at
+        ~1 call/task in the drain-thread profile)."""
+        horizon = self._apush_horizon_s
+        hard_lo = 7 * self._APUSH_CACHE
         now = time.monotonic()
         scanned = 0
         while (len(self._apush_replies) > self._APUSH_CACHE
-               and scanned < 16):
+               and scanned < 512):
             k = next(iter(self._apush_replies))
             v = self._apush_replies[k]
             scanned += 1
@@ -5481,7 +6251,7 @@ class CoreWorker:
                 self._apush_replies.move_to_end(k)
                 continue
             if (now - v[0] < horizon
-                    and len(self._apush_replies) < 8 * self._APUSH_CACHE):
+                    and len(self._apush_replies) < hard_lo):
                 break
             self._apush_replies.pop(k, None)
 
@@ -5491,12 +6261,66 @@ class CoreWorker:
             return
         with self._apush_lock:
             e = self._apush_replies.get(corr)
-            self._apush_replies[corr] = (
-                time.monotonic(), extras, list(frames)
-            )
-            self._apush_trim_locked()
+            # Stored by reference: every caller hands a freshly built
+            # frame list it never mutates, and replay sites copy at send.
+            self._apush_replies[corr] = (time.monotonic(), extras, frames)
+            self._apush_done_n += 1
+            if (self._apush_done_n & 31) == 0 or (
+                len(self._apush_replies) >= 8 * self._APUSH_CACHE
+            ):
+                self._apush_trim_locked()
         if isinstance(e, SyncFuture) and not e.done():
-            e.set_result((extras, list(frames)))
+            e.set_result((extras, frames))
+
+    def _apush_begin_many(self, corrs):
+        """One-lock batch of :meth:`_apush_begin` for a chunk's corr ids
+        (``None``/empty entries yield ``("mine", None)`` untouched) —
+        per-task begin/done lock traffic was a measured slice of the
+        drain profile once plain tasks joined the corr plane."""
+        out = []
+        with self._apush_lock:
+            replies = self._apush_replies
+            for corr in corrs:
+                if not corr:
+                    out.append(("mine", None))
+                    continue
+                e = replies.get(corr)
+                if e is None:
+                    replies[corr] = _APUSH_WIP
+                    out.append(("mine", None))
+                elif e is _APUSH_WIP:
+                    fut = SyncFuture()
+                    replies[corr] = fut
+                    out.append(("wait", fut))
+                elif isinstance(e, SyncFuture):
+                    out.append(("wait", e))
+                else:
+                    out.append(("replay", (e[1], e[2])))
+        return out
+
+    def _apush_done_many(self, entries):
+        """One-lock batch of :meth:`_apush_done`: ``entries`` =
+        [(corr, extras, frames)]. Attached retries wake outside the
+        lock; the trim check amortizes over the whole batch."""
+        if not entries:
+            return
+        wake = []
+        now = time.monotonic()
+        with self._apush_lock:
+            replies = self._apush_replies
+            for corr, extras, frames in entries:
+                e = replies.get(corr)
+                replies[corr] = (now, extras, frames)
+                if isinstance(e, SyncFuture):
+                    wake.append((e, extras, frames))
+            self._apush_done_n += len(entries)
+            if (self._apush_done_n & 31) < len(entries) or (
+                len(replies) >= 8 * self._APUSH_CACHE
+            ):
+                self._apush_trim_locked()
+        for fut, extras, frames in wake:
+            if not fut.done():
+                fut.set_result((extras, frames))
 
     def _apush_fail(self, corr, err):
         """A failed delivery is retried for real (only successes replay);
@@ -5559,6 +6383,21 @@ class CoreWorker:
             self._apush_fail(corr, e)
             raise
         self._apush_done(corr, extras, rframes)
+        if (
+            self._reply_batching
+            and isinstance(extras, dict)
+            and "rets" in extras
+            and all(
+                not (isinstance(r, dict) and r.get("kind") == "shm")
+                for r in extras["rets"]
+            )
+        ):
+            # Small actor results coalesce the same way task results do;
+            # shm-registered returns keep the direct per-call reply.
+            self._reply_window(conn).add(
+                {"i": h["i"], **extras}, rframes, tag=self._window_tag(h)
+            )
+            return protocol.REPLY_HANDLED, []
         return extras, rframes
 
     async def _push_actor_task_inner(self, h, frames, conn):
@@ -5782,6 +6621,11 @@ class CoreWorker:
 
     def shutdown(self):
         self._shutdown = True
+        # Reply windows first, while every transport is still up: results
+        # buffered behind an in-flight ack (short-lived executors, a
+        # graceful remove_node drain) must reach their submitters before
+        # connections start tearing down.
+        self._flush_reply_windows()
         ObjectRef._release_hook = None
         with self._env_exec_lock:
             for ex in self._env_executors.values():

@@ -481,8 +481,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--task", default=None, metavar="TASK_ID",
                     help="print ONE task's critical-path phase breakdown "
                          "(submit → queue/lease → fn-push/kv-get → "
-                         "arg-pull → exec → result-push → reply-ack, "
-                         "residual explicit) instead of writing a trace")
+                         "arg-pull → exec-queue → exec → result-push → "
+                         "reply-window → reply-ack, residual explicit) "
+                         "instead of writing a trace")
     sp.set_defaults(fn=cmd_timeline)
 
     sp = sub.add_parser(

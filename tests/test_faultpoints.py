@@ -529,6 +529,18 @@ CHAOS_SPECS = [
     "devstore.shard_pull:error:0.3:0:112",
     "devstore.shard_pull:drop:1.0:1:113",
     "devstore.register:drop:1.0:1:114",
+    # Reply plane (round 15): a dropped coalesced multi-result frame
+    # loses EVERY rider's reply at once — each per-task deadline must
+    # re-arm and the corr-deduped re-push must replay recorded outcomes
+    # (exactly-once application), with zero leaked leases/objects.
+    "worker.reply.window:drop:1.0:1:115",
+    "worker.reply.window:error:0.1:0:116",
+    # Arg interning, both sides: pusher-side error degrades that push to
+    # full frames / drop resets peer coverage; executor-side error forces
+    # — and drop really performs — an interned-frame eviction, so the
+    # typed arg_intern_miss retry re-sends the exact bytes.
+    "worker.arg.intern:error:0.2:0:117",
+    "worker.arg.intern:drop:0.3:0:118",
 ]
 
 
@@ -537,11 +549,16 @@ CHAOS_SPECS = [
 def test_chaos_matrix(spec, monkeypatch, chaos_flight_trace):
     """Core workloads complete under sustained injected faults at every
     major point, and the head's lease accounting converges back to full
-    capacity (no leaked leases). A failure dumps the fault-annotated
-    flight trace (chaos_flight_trace fixture)."""
+    capacity (no leaked leases). The spec rides RT_FAULT_SPEC into the
+    spawned node processes too (they configure at import), so
+    executor-side points — the reply-window flush, the interned-arg
+    lookup — inject where they actually live, not just in the driver. A
+    failure dumps the fault-annotated flight trace (chaos_flight_trace
+    fixture)."""
     monkeypatch.setenv("RT_RPC_DEADLINE_S", "2")
     monkeypatch.setenv("RT_LEASE_REQUEST_TIMEOUT_S", "1")
     monkeypatch.setenv("RT_RPC_RETRIES", "6")
+    monkeypatch.setenv("RT_FAULT_SPEC", spec)
     ray_tpu.init(num_cpus=2)
     try:
         fp.configure(spec)
@@ -550,9 +567,22 @@ def test_chaos_matrix(spec, monkeypatch, chaos_flight_trace):
         _workload_multiref_get_wait()
         _workload_pg()
         _workload_device_objects()
-        assert sum(s["calls"] for s in fp.stats()) > 0, (
-            "chaos spec never matched a fired point"
-        )
+        calls = sum(s["calls"] for s in fp.stats())
+        if not calls:
+            # Executor-side-only point: its hits live in the node
+            # processes — probe one (any node of this cluster carries
+            # the env-configured spec).
+            @ray_tpu.remote
+            def _node_fp_stats():
+                from ray_tpu._private import faultpoints as fpp
+
+                return fpp.stats()
+
+            calls = sum(
+                s["calls"]
+                for s in ray_tpu.get(_node_fp_stats.remote(), timeout=60)
+            )
+        assert calls > 0, "chaos spec never matched a fired point"
         fp.clear()
         wait_for_condition(_leases_settled, timeout=20,
                            message=f"leaked leases under {spec}")

@@ -314,7 +314,7 @@ def test_duplicate_actor_push_is_replayed_not_reapplied(rt_start):
     assert ray_tpu.get(a.add.remote(1), timeout=60) == 1
     w = get_global_worker()
     ch = w.get_actor_channel(a._actor_id_hex)
-    frames, ref_ids, borrow_ids = w._serialize_args((5,), {})
+    frames, ref_ids, borrow_ids, _an = w._serialize_args((5,), {})
     tid = TaskID.of(ActorID.from_hex(a._actor_id_hex))
     header = {
         "tid": tid.hex(), "aid": a._actor_id_hex, "method": "add",

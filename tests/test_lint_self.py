@@ -60,10 +60,11 @@ def test_flight_module_is_family_b_clean():
 
 def test_specframe_module_is_family_b_clean():
     """The round-10 submission-plane cache (spec templates + function
-    push-through ledger) holds a lock on the pusher hot path: blocking
-    work or silent swallows under it would be exactly the regression
-    Family B exists to catch (``raytpu lint --framework`` over
-    specframe.py, the exact CI invocation)."""
+    push-through ledger) and its round-15 reply-plane siblings
+    (ReplyWindow, ArgLedger, ArgInternCache) all hold locks on push/reply
+    hot paths: blocking work or silent swallows under them would be
+    exactly the regression Family B exists to catch (``raytpu lint
+    --framework`` over specframe.py, the exact CI invocation)."""
     proc = subprocess.run(
         [sys.executable, "-m", "ray_tpu.lint",
          os.path.join(REPO, "ray_tpu", "_private", "specframe.py"),
