@@ -440,6 +440,42 @@ def run_flight_benchmarks(quick: bool = False, phases: bool = False,
         snaps = h["snapshots"]
         return flight.merge_snapshots(snaps), snaps
 
+    def transit_stats():
+        """Cluster transit-pacing snapshot: the DRIVER contributes the
+        per-peer push windows + its settle stats; node processes are
+        probed for the executor-side pump drain histogram (deduped by
+        node id — a handful of spread probes covers small clusters).
+        BENCH_r09's attribution needs these three series: peak/steady
+        push-window per peer, pump messages-per-drain, and frames
+        settled per driver recv wakeup."""
+        import ray_tpu
+
+        stats = {"driver": w.transit_stats()}
+
+        @ray_tpu.remote
+        def _probe(_i):
+            from ray_tpu._private.worker import get_global_worker
+
+            gw = get_global_worker()
+            return (
+                gw.node_id,
+                gw.transit_stats(),
+                {k: v for k, v in gw._stats.items()
+                 if k.startswith("pump_")},
+            )
+
+        nodes = {}
+        try:
+            for nid, ts, ps in ray_tpu.get(
+                [_probe.remote(i) for i in range(8)], timeout=60
+            ):
+                ts["pump_exec"] = ps
+                nodes[nid] = ts
+        except Exception as e:
+            stats["probe_error"] = f"{type(e).__name__}: {e}"
+        stats["nodes"] = nodes
+        return stats
+
     out = {"flight": True}
     attrib_all = {}
     legs = (
@@ -460,10 +496,13 @@ def run_flight_benchmarks(quick: bool = False, phases: bool = False,
         dropped = sum(int(s.get("dropped") or 0) for s in snaps)
         recorded = sum(int(s.get("recorded") or 0) for s in snaps)
         attrib = flight.attribution(merged)
+        transit = transit_stats()
+        out.setdefault("transit", {})[key] = transit
         attrib_all[key] = {
             "verbs": attrib,
             "events_recorded": recorded,
             "events_dropped": dropped,
+            "transit": transit,
         }
         print(f"--- per-verb attribution: {key} "
               f"({len(merged)} spans) ---", file=sys.stderr)

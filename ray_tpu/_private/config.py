@@ -330,6 +330,59 @@ rt_config.declare(
     "Executing-side interned-argument LRU capacity in bytes; eviction "
     "only costs a re-send of the blob on the next digest-only push.")
 rt_config.declare(
+    "push_window", bool, True,
+    "Adaptive in-flight push windows (specframe.PushWindow): each leased "
+    "slot paces how many tasks sit between the driver's pending queue "
+    "and the executor pool by an AIMD congestion window clocked on "
+    "observed chunk-settle latency — additive grow on clean drains, "
+    "multiplicative shrink when transit/exec-queue latency inflates — "
+    "instead of the fixed 16-pusher x 16-task fan-out. The live window "
+    "is exported as rt_push_window{peer} on /metrics. Off "
+    "(RT_PUSH_WINDOW=0): the pre-round-16 static fan-out, "
+    "byte-identically.")
+rt_config.declare(
+    "push_window_initial", int, 64,
+    "Starting push-window size per leased slot, in tasks (four full "
+    "ring chunks: pipelining from the first pump, headroom to ramp).")
+rt_config.declare(
+    "push_window_floor", int, 4,
+    "Smallest push window a saturated slot shrinks to: enough to keep "
+    "one chunk on the wire while the previous settles, small enough "
+    "that a wedged executor never accumulates parked chunks.")
+rt_config.declare(
+    "push_window_ceiling", int, 256,
+    "Largest push window a slot grows to — the pre-round-16 static "
+    "worst case (16 pushers x 16-task chunks), so pacing can only "
+    "remove queueing, never add fan-out beyond what the fixed plan "
+    "allowed.")
+rt_config.declare(
+    "push_window_latency_factor", float, 6.0,
+    "Chunk push->reply-arrival latency above this multiple of the "
+    "tracked clean baseline reads as congestion (multiplicative "
+    "shrink). The baseline tracks the minimum observed latency with a "
+    "slow upward drift so a durably slower workload re-baselines "
+    "instead of shrinking forever. Measured on the 1-core A/B box: 3.0 "
+    "over-shrank (the window thrashed at ~37 against a 2ms base while "
+    "the executor still had headroom), 6.0 settles at 40-100 with "
+    "single-digit shrinks per 5k burst.")
+rt_config.declare(
+    "pump_batch_drain", bool, True,
+    "Batched ring-pump handoff: the pump thread hands EVERY message of "
+    "one ring drain to the executor-side batch dispatch in one pass — "
+    "one corr-claim pass and O(task slots) executor wakeups per drain "
+    "instead of per message. Off (RT_PUMP_BATCH_DRAIN=0): per-message "
+    "dispatch, the pre-round-16 pump behavior.")
+rt_config.declare(
+    "settle_batching", bool, True,
+    "Multi-frame driver settling: inside a get()/wait() window the "
+    "driver's TCP recv loop drains every already-buffered reply frame "
+    "before yielding, so one loop wakeup settles several coalesced "
+    "frames' futures (the ring pump already batches per drain). "
+    "Disabled automatically while fault injection is active so chaos "
+    "specs keep their per-message determinism. Off "
+    "(RT_SETTLE_BATCHING=0): one frame per recv wakeup, the "
+    "pre-round-16 loop.")
+rt_config.declare(
     "serve_request_timeout_s", float, 60.0,
     "Serve proxy per-request deadline (HTTP and gRPC ingress). A request "
     "that has not produced a result within this horizon is failed with "

@@ -140,6 +140,14 @@ CATALOG: Dict[str, tuple] = {
     "worker.dispatch.retry": (
         "worker", ("error", "delay"),
         "dispatch-retry path after a failed push attempt"),
+    "worker.push.window": (
+        "worker", ("error", "delay", "drop"),
+        "adaptive push-window pacing decision on the SUBMITTING worker "
+        "(one per packed chunk): error degrades that chunk to the fixed "
+        "pre-round-16 fan-out — pacing is an optimization, never a "
+        "correctness gate; drop resets the slot's window to its floor "
+        "(forces a cold re-ramp through the AIMD grow path); delay "
+        "stalls the grant before the chunk packs"),
     "worker.reply.window": (
         "worker", ("error", "delay", "drop"),
         "coalesced multi-result reply flush on the EXECUTING worker "

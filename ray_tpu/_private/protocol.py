@@ -56,6 +56,32 @@ def decode_message_bytes(data: bytes) -> Tuple[dict, List[bytes]]:
     return header, frames[1:]
 
 
+def _parse_buffered(buf) -> Optional[Tuple[dict, List[bytes], int]]:
+    """Parse ONE complete wire message from the head of a bytearray (the
+    asyncio ``StreamReader`` buffer, for the multi-frame settle drain):
+    returns ``(header, frames, bytes_consumed)``, or None while the
+    buffer holds only a partial message. Never consumes — the caller
+    owns the ``del buf[:consumed]``."""
+    blen = len(buf)
+    if blen < 4:
+        return None
+    nframes = _HDR.unpack_from(buf, 0)[0]
+    pos = 4
+    spans = []
+    for _ in range(nframes):
+        if pos + 4 > blen:
+            return None
+        ln = _HDR.unpack_from(buf, pos)[0]
+        pos += 4
+        if pos + ln > blen:
+            return None
+        spans.append((pos, ln))
+        pos += ln
+    frames = [bytes(buf[p:p + ln]) for p, ln in spans]
+    header = msgpack.unpackb(frames[0], raw=False)
+    return header, frames[1:], pos
+
+
 async def read_message(
     reader: asyncio.StreamReader, max_bytes: Optional[int] = None,
 ) -> Tuple[dict, List[bytes]]:
@@ -167,6 +193,18 @@ class Connection:
         self._out_buf: List[bytes] = []
         self._out_bytes = 0
         self._flush_scheduled = False
+        # Round 16: multi-frame settling — inside a get()/wait() window
+        # the recv loop drains every ALREADY-BUFFERED reply frame before
+        # yielding, so one loop wakeup settles several coalesced frames'
+        # futures. Gate read once per connection.
+        from ray_tpu._private.config import rt_config
+
+        self._settle_batching = bool(rt_config.settle_batching)
+        # Settle economics (bench/tests): recv wakeups, frames settled,
+        # frames drained beyond the first per wakeup, largest batch.
+        self.settle_stats: Dict[str, int] = {
+            "wakeups": 0, "frames": 0, "drained": 0, "max_batch": 0,
+        }
 
     FLUSH_BYTES = 256 * 1024
 
@@ -208,42 +246,103 @@ class Connection:
                     )
                     if act == "drop":
                         continue
-                if header.get("r"):  # reply
-                    if "bh" in header:
-                        # Coalesced multi-result frame: sub-replies ride
-                        # one message, each under its own correlation id
-                        # — N futures settle in this one wakeup.
-                        pos = 0
-                        for sub, n in zip(header["bh"], header["bn"]):
-                            self._settle_reply(sub, frames[pos:pos + n])
-                            pos += n
-                        if header.get("wa"):
-                            # Window ack: the sender's reply window clocks
-                            # its next flush on this (the reply-side
-                            # create_actor_batch discipline).
-                            try:
-                                self.notify("mrack")
-                            except (RpcError, OSError) as e:
-                                logger.debug(
-                                    "window ack dropped (%s): %s",
-                                    self.name, e,
-                                )
-                    else:
-                        self._settle_reply(header, frames)
-                else:
-                    if flight.ENABLED:
-                        # Arrival stamp: dispatch-side spans (and the head's
-                        # queue-wait attribution) measure from here.
-                        header["_fr"] = time.monotonic()
-                    asyncio.get_running_loop().create_task(
-                        self._dispatch(header, frames)
-                    )
+                batch = 1 + self._process_message(header, frames)
+                if (self._settle_batching
+                        and not faultpoints.ACTIVE
+                        and self.require_auth_token is None):
+                    # Multi-frame settling: everything the transport has
+                    # ALREADY buffered settles in this same wakeup —
+                    # several coalesced reply frames' futures per loop
+                    # iteration inside a get()/wait() window. Chaos runs
+                    # skip the drain so every message keeps riding the
+                    # injected per-message read path (determinism).
+                    batch += self._drain_buffered()
+                st = self.settle_stats
+                st["wakeups"] += 1
+                st["frames"] += batch
+                if batch > 1:
+                    st["drained"] += batch - 1
+                if batch > st["max_batch"]:
+                    st["max_batch"] = batch
         except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
             pass
         except Exception:
             logger.exception("rpc recv loop error (%s)", self.name)
         finally:
             self._teardown()
+
+    def _process_message(self, header: dict, frames: List[bytes]) -> int:
+        """Apply one inbound message (loop thread): settle replies, spawn
+        dispatch tasks. Returns the number of EXTRA frames it settled
+        beyond itself (always 0 — the return shape matches
+        ``_drain_buffered`` call sites)."""
+        if header.get("r"):  # reply
+            # Arrival stamp, ALWAYS on for replies: the driver's push
+            # windows clock their AIMD on push->arrival latency, and the
+            # flight plane carves the arrival->settle dwell into the
+            # pump-queue phase (both ends on the driver's clock).
+            header.setdefault("_fr", time.monotonic())
+            if "bh" in header:
+                # Coalesced multi-result frame: sub-replies ride
+                # one message, each under its own correlation id
+                # — N futures settle in this one wakeup.
+                pos = 0
+                fr_t = header.get("_fr")
+                for sub, n in zip(header["bh"], header["bn"]):
+                    if fr_t is not None:
+                        sub["_fr"] = fr_t
+                    self._settle_reply(sub, frames[pos:pos + n])
+                    pos += n
+                if header.get("wa"):
+                    # Window ack: the sender's reply window clocks
+                    # its next flush on this (the reply-side
+                    # create_actor_batch discipline).
+                    try:
+                        self.notify("mrack")
+                    except (RpcError, OSError) as e:
+                        logger.debug(
+                            "window ack dropped (%s): %s",
+                            self.name, e,
+                        )
+            else:
+                self._settle_reply(header, frames)
+        else:
+            if flight.ENABLED:
+                # Arrival stamp: dispatch-side spans (and the head's
+                # queue-wait attribution) measure from here.
+                header["_fr"] = time.monotonic()
+            self._loop.create_task(
+                self._dispatch(header, frames)
+            )
+        return 0
+
+    def _drain_buffered(self) -> int:
+        """Settle every COMPLETE message already sitting in the stream
+        reader's buffer without yielding to the loop (no await, no
+        readexactly coroutine per frame). Returns how many messages were
+        drained. Falls back to 0 — the plain per-message path — when the
+        reader's internals are not the expected CPython shape."""
+        reader = self.reader
+        buf = getattr(reader, "_buffer", None)
+        if buf is None:
+            return 0
+        drained = 0
+        while not self._closed:
+            parsed = _parse_buffered(buf)
+            if parsed is None:
+                break
+            header, frames, consumed = parsed
+            del buf[:consumed]
+            drained += 1
+            self._process_message(header, frames)
+        if drained:
+            try:
+                # Consuming from the buffer directly must re-open the
+                # transport's flow control exactly like read() would.
+                reader._maybe_resume_transport()
+            except Exception as e:
+                logger.debug("flow-control resume skipped: %s", e)
+        return drained
 
     def _settle_reply(self, header: dict, frames: List[bytes]):
         fut = self._pending.pop(header["i"], None)

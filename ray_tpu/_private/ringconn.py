@@ -101,6 +101,22 @@ class RingConnection:
         # max_msg is half the ring capacity; cap ≈ 4 capacities.
         self._backlog_max = BACKLOG_RING_CAPACITIES * 2 * ring.max_msg
         self._drainer_running = False
+        # Round 16: drain-wide batch handoff — every request of one pump
+        # drain goes to fast_batch in ONE pass (one corr-claim pass,
+        # O(task slots) executor wakeups per drain, not per message).
+        # Gate read once; config import is deferred like protocol's.
+        from ray_tpu._private.config import rt_config
+
+        self._batch_drain = bool(rt_config.pump_batch_drain)
+        # Pump economics (bench/tests): drains, messages, and a
+        # power-of-2 histogram of messages-per-drain. Written by the
+        # pump thread only; readers snapshot.
+        self.pump_stats: dict = {"drains": 0, "msgs": 0, "batch_hist": {}}
+        # Driver-side settle economics: reply frames applied per loop
+        # wakeup (the ring analog of Connection.settle_stats).
+        self.settle_stats: dict = {
+            "wakeups": 0, "frames": 0, "drained": 0, "max_batch": 0,
+        }
         self._pump = threading.Thread(
             target=self._pump_loop, daemon=True,
             name=f"rt-ringpump-{self.name}",
@@ -366,9 +382,20 @@ class RingConnection:
                     break
                 if not msgs:
                     continue
+                st = self.pump_stats
+                st["drains"] += 1
+                st["msgs"] += len(msgs)
+                b = 1
+                while b < len(msgs):
+                    b <<= 1
+                st["batch_hist"][b] = st["batch_hist"].get(b, 0) + 1
                 replies = []
                 slow = []
+                reqs = []  # drain-wide batch handoff (gate on)
                 fast = self.fast_dispatch
+                batch_drain = (
+                    self._batch_drain and self.fast_batch is not None
+                )
                 for m in msgs:
                     if faultpoints.ACTIVE:
                         try:
@@ -398,12 +425,26 @@ class RingConnection:
                             "reply" if header.get("r")
                             else str(header.get("m")),
                         )
+                    elif header.get("r"):
+                        # Reply arrival stamps are ALWAYS on: the
+                        # driver's push windows clock their AIMD on
+                        # push->arrival latency (driver-side settle
+                        # queueing excluded — it is not executor
+                        # congestion). One monotonic + dict store per
+                        # reply message.
+                        header["_fr"] = time.monotonic()
                     if header.get("r"):
                         if "bh" in header:
                             # Batched reply: sub-replies ride one message,
-                            # each under its own correlation id.
+                            # each under its own correlation id. The
+                            # arrival stamp rides onto every sub so the
+                            # driver can carve its settle dwell into the
+                            # pump-queue phase.
                             pos = 0
+                            fr_t = header.get("_fr")
                             for sub, n in zip(header["bh"], header["bn"]):
+                                if fr_t is not None:
+                                    sub["_fr"] = fr_t
                                 replies.append((sub, frames[pos:pos + n]))
                                 pos += n
                             if header.get("wa"):
@@ -435,6 +476,12 @@ class RingConnection:
                                 sub["_fr"] = header.get("_fr")
                             subs.append((sub, frames[pos:pos + n]))
                             pos += n
+                        if batch_drain:
+                            # Joined to the drain-wide handoff below:
+                            # sub-requests of EVERY batch message in this
+                            # drain share one claim pass + work queue.
+                            reqs.extend(subs)
+                            continue
                         if self.fast_batch is not None:
                             try:
                                 subs = self.fast_batch(subs, self)
@@ -453,6 +500,13 @@ class RingConnection:
                                     )
                             slow.append((sub, sfr))
                         continue
+                    if batch_drain:
+                        # Plain requests ride the same drain-wide handoff
+                        # (arrival order preserved: per-caller actor seq
+                        # admission sees them exactly as the per-message
+                        # path would).
+                        reqs.append((header, frames))
+                        continue
                     if fast is not None:
                         try:
                             if fast(header, frames, self):
@@ -462,6 +516,26 @@ class RingConnection:
                                 "ring fast dispatch failed; slow path"
                             )
                     slow.append((header, frames))
+                if reqs:
+                    # ONE batch handoff covering every request of this
+                    # drain; leftovers keep per-item fast/slow semantics.
+                    try:
+                        leftovers = self.fast_batch(reqs, self)
+                    except Exception:
+                        logger.exception(
+                            "ring drain batch dispatch failed; slow"
+                        )
+                        leftovers = reqs
+                    for sub, sfr in leftovers:
+                        if fast is not None:
+                            try:
+                                if fast(sub, sfr, self):
+                                    continue
+                            except Exception:
+                                logger.exception(
+                                    "ring fast dispatch failed; slow path"
+                                )
+                        slow.append((sub, sfr))
                 if replies or slow:
                     # One loop wakeup per drained batch, covering both reply
                     # resolution and slow-path request dispatch.
@@ -475,6 +549,14 @@ class RingConnection:
             self._teardown()
 
     def _apply_batch(self, replies, slow):
+        if replies:
+            st = self.settle_stats
+            st["wakeups"] += 1
+            st["frames"] += len(replies)
+            if len(replies) > 1:
+                st["drained"] += len(replies) - 1
+            if len(replies) > st["max_batch"]:
+                st["max_batch"] = len(replies)
         self._apply_replies(replies)
         for header, frames in slow:
             self.loop.create_task(self._handle_slow(header, frames))

@@ -20,6 +20,16 @@ function table becomes a fallback, not a hot path (reference: function
 table pushes ride the same channel as task specs in
 ``core_worker/transport``).
 
+Round 16 adds :class:`PushWindow` — the transit-pacing sibling: instead
+of a fixed per-slot fan-out (16 pushers x 16-task chunks = up to 256
+tasks parked between the driver's pending queue and the executor pool),
+each leased slot carries an AIMD congestion window clocked by observed
+chunk-settle latency, so a saturated executor stops accumulating parked
+chunks and an idle one ramps immediately (reference: the transport-level
+send-window discipline in TCP congestion control, applied to the task
+wire; the reference core worker bounds in-flight PushNormalTask per
+lease the same way its client streams bound outstanding requests).
+
 Round 15 adds the REPLY-side siblings, so result delivery amortizes the
 way submission does (reference: the core worker's reply path batches
 task results onto the submission channel; plasma inline-object returns):
@@ -298,6 +308,119 @@ class ReplyWindow:
         self.flushes += 1
         self.coalesced += len(batch)
         self._send(batch)
+
+
+class PushWindow:
+    """Adaptive in-flight push window for one leased slot (AIMD).
+
+    Units are TASKS in flight between the driver's pending queue and the
+    executor pool: a pusher asks :meth:`grant` for chunk capacity before
+    packing, and reports each chunk's settle via :meth:`on_settled` with
+    the observed push->reply latency. The window then self-clocks:
+
+    - **additive grow** on a clean drain (+1 task per settled chunk, up
+      to ``ceiling``) — an idle executor's settles come back fast and
+      often, so it ramps immediately;
+    - **multiplicative shrink** (x ``beta``, floored at ``floor``) when
+      settle latency inflates past ``latency_factor`` x the tracked
+      clean baseline — chunks are queueing in ring transit or the
+      executor pool, and parking more behind them only grows the queue.
+
+    The baseline tracks the MINIMUM observed settle latency with a slow
+    upward drift, so a durable latency regime change (the workload
+    itself got slower) re-baselines instead of shrinking forever;
+    ``min_base_s`` keeps micro-latency noise on a quiet box from reading
+    as 3x inflation.
+
+    Pure state + arithmetic on the caller's thread (the driver's event
+    loop): no locks, no clocks of its own — the caller supplies latency
+    measurements, which keeps the class unit-testable with synthetic
+    inflation exactly like :class:`ReplyWindow`'s synthetic acks.
+    """
+
+    __slots__ = ("floor", "ceiling", "_win", "_factor", "_beta",
+                 "_min_base_s", "_base_s", "inflight", "peak",
+                 "grows", "shrinks", "settled")
+
+    def __init__(self, initial: int = 64, floor: int = 4,
+                 ceiling: int = 256, latency_factor: float = 6.0,
+                 beta: float = 0.5, min_base_s: float = 0.002):
+        self.floor = max(int(floor), 1)
+        self.ceiling = max(int(ceiling), self.floor)
+        self._win = float(min(max(int(initial), self.floor), self.ceiling))
+        self._factor = float(latency_factor)
+        self._beta = float(beta)
+        self._min_base_s = float(min_base_s)
+        self._base_s: Optional[float] = None
+        self.inflight = 0
+        self.peak = int(self._win)
+        self.grows = 0
+        self.shrinks = 0
+        self.settled = 0
+
+    @property
+    def window(self) -> int:
+        return int(self._win)
+
+    def grant(self, want: int, min_grant: int = 1) -> int:
+        """How many of ``want`` tasks may enter flight now (0 = not
+        enough room; the caller waits for a sibling chunk to settle).
+        ``min_grant`` sets the smallest acceptable grant — pushers pass
+        half a chunk so a nearly-full window parks them instead of
+        fragmenting the burst into 1-2 task wire messages."""
+        room = int(self._win) - self.inflight
+        n = min(int(want), room)
+        if n < max(int(min_grant), 1):
+            return 0
+        self.inflight += n
+        return n
+
+    def release(self, n: int):
+        """Return unused/failed grant capacity without a pacing signal
+        (chunk packed smaller than granted, transport error paths)."""
+        if n > 0:
+            self.inflight = max(self.inflight - n, 0)
+
+    def on_settled(self, n: int, latency_s: float) -> bool:
+        """``n`` tasks settled after ``latency_s``: release their flight
+        slots and update the window. Returns True for a clean drain
+        (grew), False for an inflation shrink."""
+        self.inflight = max(self.inflight - n, 0)
+        if n <= 0:
+            return True
+        self.settled += n
+        base = self._base_s
+        if base is None:
+            self._base_s = max(latency_s, 0.0)
+            return True
+        if latency_s < base:
+            self._base_s = latency_s
+        else:
+            # Slow upward drift: ~50 settles to absorb a durable change.
+            self._base_s = base + 0.02 * (latency_s - base)
+        if latency_s > self._factor * max(base, self._min_base_s):
+            self._win = max(self._win * self._beta, float(self.floor))
+            self.shrinks += 1
+            return False
+        self._win = min(self._win + 1.0, float(self.ceiling))
+        self.grows += 1
+        if int(self._win) > self.peak:
+            self.peak = int(self._win)
+        return True
+
+    def reset(self):
+        """Cold re-ramp (chaos ``drop`` kind, slot loss): pacing state is
+        gone; capacity accounting for in-flight chunks is kept — their
+        settles still release correctly."""
+        self._win = float(self.floor)
+        self._base_s = None
+
+    def snapshot(self) -> dict:
+        return {
+            "window": int(self._win), "inflight": self.inflight,
+            "peak": self.peak, "grows": self.grows,
+            "shrinks": self.shrinks, "settled": self.settled,
+        }
 
 
 class ArgLedger:

@@ -42,11 +42,18 @@ never corrupt the sum; executor-side contributions are pure durations):
                 reply window before its multi-result frame went out
                 (executor span; zero when reply batching is off or the
                 result opened an idle window)
-    reply-ack   push RTT not covered by the executor's serve envelope or
-                the reply window: wire both ways + connection queuing
-                (derived). For chunked pushes this includes waiting
-                behind chunk-mates on the executor — the driver's
-                per-task push span starts at chunk send
+    pump-queue  time a reply frame sat between arrival at the DRIVER's
+                transport (ring pump pop / TCP recv) and its future
+                settling — loop handoff + settle queueing on a
+                saturated driver, measured entirely on the driver's
+                clock (Round 16 carved this out of reply-ack; the
+                multi-frame settle drain is what shrinks it)
+    reply-ack   push RTT not covered by the executor's serve envelope,
+                the reply window, or the driver's pump-queue dwell:
+                wire both ways + connection queuing (derived). For
+                chunked pushes this includes waiting behind chunk-mates
+                on the executor — the driver's per-task push span
+                starts at chunk send
     residual    wall − sum(above) — dispatch gaps, server queueing not
                 inside any named phase. Always shown.
 
@@ -67,7 +74,7 @@ logger = logging.getLogger(__name__)
 PHASES = (
     "submit", "submit-queue", "lease-wait", "warm-pool-hit",
     "fn-push", "kv-get", "arg-pull", "exec-queue", "exec", "result-push",
-    "reply-window", "reply-ack", "residual",
+    "reply-window", "pump-queue", "reply-ack", "residual",
 )
 
 # task.queued outcome -> phase name (see worker._pop_pending).
@@ -172,6 +179,10 @@ def task_breakdown(merged: List[Dict[str, Any]], task_id: str,
     # reply-ack stays what its name says — wire both ways + connection
     # queuing — even when the result rode a coalesced frame.
     phases["reply-window"] = dur.get("task.reply_window", 0.0)
+    # Round 16: reply dwell between the driver's transport arrival and
+    # the future settle (driver clock both ends) — carved out of the
+    # derived reply-ack the same way reply-window was.
+    phases["pump-queue"] = dur.get("task.pump_queue", 0.0)
     push = dur.get("task.push", 0.0)
     inner = (
         phases[fn_phase] + phases["arg-pull"] + phases["exec"]
@@ -184,7 +195,9 @@ def task_breakdown(merged: List[Dict[str, Any]], task_id: str,
     # the executor pool — is its own truthful phase instead of hiding in
     # the derived reply-ack. All durations, skew-free.
     phases["exec-queue"] = max(serve - inner, 0.0)
-    phases["reply-ack"] = max(push - serve - phases["reply-window"], 0.0)
+    phases["reply-ack"] = max(
+        push - serve - phases["reply-window"] - phases["pump-queue"], 0.0
+    )
     # Wall: driver-clock envelope. All driver spans live in one process,
     # so ts arithmetic is skew-free; fall back to the span extent when a
     # stage was sampled out or overwritten in the ring.

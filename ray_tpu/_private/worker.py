@@ -152,6 +152,12 @@ class _LeaseSlot:
     # whole CPU at the head — holding it while a sibling slot runs a long
     # task starves every other lease requester, e.g. nested tasks).
     idle_since: float = field(default_factory=time.monotonic)
+    # Adaptive in-flight push window (specframe.PushWindow), created on
+    # first push when rt_config.push_window is on; None = fixed fan-out.
+    pwin: Any = None
+    # Loop-side rendezvous for pushers parked on a full window: every
+    # settle/release sets it, parked siblings re-check their grant.
+    win_event: Any = None
 
 
 class _LeaseSet:
@@ -369,6 +375,18 @@ class CoreWorker:
         # Connections with an open ReplyWindow (shutdown must flush them:
         # buffered results never die with the process).
         self._reply_windows: List[Any] = []
+        # --- transit-plane pacing (round 16) ---
+        # Adaptive in-flight push windows: per-slot AIMD congestion
+        # control replacing the fixed 16x16 fan-out (gate + knobs cached
+        # once — these sit in the per-chunk pack loop).
+        self._push_window = bool(_rtc.push_window)
+        self._pwin_initial = int(_rtc.push_window_initial)
+        self._pwin_floor = int(_rtc.push_window_floor)
+        self._pwin_ceiling = int(_rtc.push_window_ceiling)
+        self._pwin_factor = float(_rtc.push_window_latency_factor)
+        # Retired per-peer window stats (slots released by the reaper
+        # fold their peak/grow/shrink counters here; bounded by peers).
+        self._pwin_retired: Dict[str, dict] = {}
         # Hot-path caches: rt_config attribute reads parse the env per
         # call — far too dear for once-per-task sites (re-arm deadline,
         # dedup-cache trim horizon).
@@ -412,7 +430,14 @@ class CoreWorker:
                        "arg_frames_interned": 0,
                        "arg_intern_bytes_saved": 0,
                        "arg_blobs_pushed": 0,
-                       "arg_intern_miss_retries": 0}
+                       "arg_intern_miss_retries": 0,
+                       # transit-plane economics (round 16; tests assert
+                       # O(drains) executor wakeups, not O(messages))
+                       "pump_batch_calls": 0,
+                       "pump_batch_items": 0,
+                       "pump_exec_wakeups": 0,
+                       "push_window_shrinks": 0,
+                       "push_window_waits": 0}
         # Submission batching: driver threads enqueue dispatch coroutines
         # here; ONE call_soon_threadsafe wakes the loop per burst instead of
         # one per task (the self-pipe write is a syscall per call).
@@ -1123,6 +1148,11 @@ class CoreWorker:
         returned for the per-item fast/slow paths, whose semantics are
         authoritative."""
         t_arr = time.monotonic()
+        # Transit economics (tests assert O(drains) executor wakeups):
+        # one call here per pump drain when pump_batch_drain is on, one
+        # per batch wire message when off.
+        self._stats["pump_batch_calls"] += 1
+        self._stats["pump_batch_items"] += len(items)
         ex = self.task_executor
         if ex is None or self._memory_monitor.is_pressing():
             return items
@@ -1185,6 +1215,7 @@ class CoreWorker:
         for c in range(nloops):
             try:
                 ex.submit(self._ring_execute_queue, dq, rconn, t_arr)
+                self._stats["pump_exec_wakeups"] += 1
             except RuntimeError:
                 # Executor shut down. Loops already submitted will drain
                 # the whole queue, so leftovers only exist when NONE got
@@ -3613,7 +3644,12 @@ class CoreWorker:
             lease_set = _LeaseSet(resources, strategy)
             self.leases[key] = lease_set
         fut = self.loop.create_future()
-        lease_set.pending.append((header, frames, fut))
+        # 4th element: wire-size estimate, computed ONCE at enqueue — the
+        # pack loop used to re-sum the head item's frames on every peek
+        # (O(frames) per loop iteration even when the first peek fit).
+        lease_set.pending.append(
+            (header, frames, fut, sum(len(fr) for fr in frames) + 4096)
+        )
         self._pump_leases(key, lease_set)
 
         def done(f):
@@ -3650,7 +3686,10 @@ class CoreWorker:
                     lease_set = _LeaseSet(resources, strategy)
                     self.leases[key] = lease_set
                 fut = self.loop.create_future()
-                lease_set.pending.append((header, frames, fut))
+                lease_set.pending.append(
+                    (header, frames, fut,
+                     sum(len(fr) for fr in frames) + 4096)
+                )
                 self._pump_leases(key, lease_set)
                 try:
                     await fut
@@ -3756,7 +3795,17 @@ class CoreWorker:
             slot = None
             for off in range(n):
                 s = slots[(lease_set.rr + off) % n]
-                if not s.draining and s.busy < self._PUSH_PIPELINE:
+                # With an adaptive window, pushers beyond what the
+                # window can feed would only park on the rendezvous
+                # event — cap spawn at window/chunk (+1 for ramp
+                # headroom) so a shrunk slot runs 2-3 pushers, not 16
+                # parked coroutines churning the loop.
+                cap = self._PUSH_PIPELINE
+                if s.pwin is not None:
+                    cap = min(
+                        cap, s.pwin.window // self._PUSH_BATCH + 1
+                    )
+                if not s.draining and s.busy < cap:
                     slot = s
                     lease_set.rr = (lease_set.rr + off + 1) % n
                     break
@@ -3819,7 +3868,8 @@ class CoreWorker:
             logger.warning("lease request failed: %s", e)
             # fail pending tasks if nothing can ever be granted
             if not lease_set.slots:
-                for header, _, fut in lease_set.pending:
+                for item in lease_set.pending:
+                    fut = item[2]
                     if not fut.done():
                         fut.set_exception(
                             exc.RayTpuError(f"lease request failed: {e}")
@@ -3976,7 +4026,11 @@ class CoreWorker:
         (lease-wait — cold worker spawns surface here too, the head
         blocks the grant until capacity exists), a warm-tagged grant
         names the warm-pool activation, otherwise it was plain
-        submit-queue depth. The stamp never reaches the wire."""
+        submit-queue depth. The stamp never reaches the wire.
+
+        Queue items carry a 4th element — the enqueue-time wire-size
+        estimate the pack loop peeks at — which is dropped here: chunks
+        stay (header, frames, fut) triples for every downstream path."""
         item = lease_set.pending.popleft()
         header = item[0]
         if self._reply_batching and "corr" not in header:
@@ -3998,7 +4052,7 @@ class CoreWorker:
                 fn=header.get("name") or header.get("fkey", "")[:10],
                 outcome=tag, phase=tag,
             )
-        return item
+        return item[:3] if len(item) > 3 else item
 
     async def _call_with_tcp_fallback(self, conn, addr, method, header, frames):
         """Issue an RPC on ``conn`` (usually a ring); when the encoded
@@ -4094,20 +4148,125 @@ class CoreWorker:
                     conn, addr, "push_task", wh, wf
                 )
 
+    async def _win_acquire(self, lease_set, slot):
+        """Acquire push-window capacity on ``slot`` before packing a
+        chunk. Returns ``(max_tasks, win)``: ``win`` is None when pacing
+        is off for this chunk (gate, or the ``worker.push.window``
+        faultpoint degraded it to the fixed fan-out) and ``max_tasks``
+        is then the static batch cap. A full window parks this pusher on
+        the slot's rendezvous event — sibling settles/releases set it —
+        with a short safety horizon re-check so a release lost to an
+        error path can never park a pusher forever. Returns ``(0, win)``
+        when the slot or queue went away while parked (the caller's
+        loop re-checks its own conditions)."""
+        if not self._push_window:
+            return self._PUSH_BATCH, None
+        if faultpoints.ACTIVE:
+            try:
+                act = await faultpoints.async_fire("worker.push.window")
+            except Exception as e:
+                # error kind: THIS chunk degrades to the fixed pre-pacing
+                # fan-out — the window is an optimization, never a
+                # correctness gate.
+                logger.debug("push-window pacing degraded: %s", e)
+                return self._PUSH_BATCH, None
+            if act == "drop" and slot.pwin is not None:
+                slot.pwin.reset()  # cold re-ramp from the floor
+        win = slot.pwin
+        if win is None:
+            win = slot.pwin = specframe.PushWindow(
+                initial=self._pwin_initial, floor=self._pwin_floor,
+                ceiling=self._pwin_ceiling,
+                latency_factor=self._pwin_factor,
+            )
+            slot.win_event = asyncio.Event()
+        # Grant quantum: accept at least half a chunk (clamped by the
+        # window itself) — a nearly-full window parks this pusher
+        # instead of fragmenting the burst into 1-2 task messages.
+        want = self._PUSH_BATCH
+        min_g = min(want, max(1, win.window // 2))
+        n = win.grant(want, min_g)
+        while n <= 0:
+            if (slot.draining or not lease_set.pending
+                    or slot not in lease_set.slots):
+                return 0, win
+            ev = slot.win_event
+            ev.clear()
+            min_g = min(want, max(1, win.window // 2))
+            n = win.grant(want, min_g)  # re-check: no missed wake
+            if n > 0:
+                break
+            self._stats["push_window_waits"] += 1
+            try:
+                await asyncio.wait_for(ev.wait(), 1.0)
+            except asyncio.TimeoutError:
+                logger.debug("push window full on %s for 1s; re-checking",
+                             slot.node_id[:8])
+            min_g = min(want, max(1, win.window // 2))
+            n = win.grant(want, min_g)
+        return n, win
+
+    def _win_settled(self, slot, win, n, latency_s):
+        """One chunk settled: feed the AIMD update and wake any pusher
+        parked on the slot's window."""
+        if not win.on_settled(n, latency_s):
+            self._stats["push_window_shrinks"] += 1
+        ev = slot.win_event
+        if ev is not None:
+            ev.set()
+
+    def _win_release(self, slot, win, n):
+        """Return grant capacity without a pacing signal (chunk packed
+        smaller than granted, transport error paths)."""
+        if win is None or n <= 0:
+            return
+        win.release(n)
+        ev = slot.win_event
+        if ev is not None:
+            ev.set()
+
+    def _record_pump_queue(self, tid, h, now):
+        """Driver-side ``pump-queue`` phase: a reply frame's dwell
+        between transport arrival (the ``_fr`` stamp the ring pump /
+        TCP recv loop writes on reply headers) and this settle — both
+        ends on the driver's clock, skew-free. Under saturation this is
+        the settle queueing that used to hide inside derived reply-ack;
+        sub-threshold dwell stays there (same discipline as the
+        reply-window phase: recording tax only where there is truth to
+        record)."""
+        arr = h.get("_fr")
+        if arr is not None and now - arr >= _WINDOW_DWELL_MIN_S:
+            taskpath.record_phase("pump_queue", tid, arr, now,
+                                  phase="pump-queue")
+
     async def _slot_pusher(self, key, lease_set, slot):
         """Drains pending tasks onto one leased slot until the queue (or the
         slot) is gone; many tasks amortize one coroutine. On the ring
-        transport a chunk of pending tasks rides one wire message."""
+        transport a chunk of pending tasks rides one wire message.
+        In-flight depth is paced by the slot's adaptive push window
+        (``_win_acquire``): each packed chunk holds window capacity from
+        push to settle, and the settle latency is the window's AIMD
+        clock."""
         try:
             while (lease_set.pending and slot in lease_set.slots
                    and not slot.draining):
                 chunk: List[tuple] = []
                 fut = None
+                win = None
+                held = 0  # window capacity this pusher holds (releases
+                # in the iteration's finally on every error path)
                 fl_t0 = time.monotonic()  # refined once the chunk is built
                 try:
                     ring = await self.get_ring(slot.addr)
                     if not lease_set.pending:
                         break  # drained by a sibling pusher during the await
+                    granted, win = await self._win_acquire(lease_set, slot)
+                    if granted <= 0:
+                        continue  # slot/queue changed while parked
+                    if win is not None:
+                        held = granted
+                    if not lease_set.pending:
+                        break  # drained while parked on the window
                     if ring is None:
                         conn = await self.get_peer(slot.addr)
                         if not lease_set.pending:
@@ -4115,15 +4274,19 @@ class CoreWorker:
                         chunk = [self._pop_pending(lease_set)]
                     else:
                         conn = ring
-                        # Pack tasks up to the batch count and the ring's
-                        # message budget; a task too big for the ring rides
-                        # TCP instead (same node, same semantics).
+                        # Pack tasks up to the granted window, the batch
+                        # count, and the ring's message budget; a task too
+                        # big for the ring rides TCP instead (same node,
+                        # same semantics).
                         budget = ring.max_msg - 65536
                         size = 0
                         while (lease_set.pending
-                               and len(chunk) < self._PUSH_BATCH):
-                            sz = sum(
-                                len(fr) for fr in lease_set.pending[0][1]
+                               and len(chunk) < granted):
+                            it = lease_set.pending[0]
+                            # Enqueue-time size estimate (4th element);
+                            # the O(frames) re-sum per peek is gone.
+                            sz = it[3] if len(it) > 3 else sum(
+                                len(fr) for fr in it[1]
                             ) + 4096
                             if sz > budget:
                                 if not chunk:
@@ -4137,6 +4300,12 @@ class CoreWorker:
                             chunk.append(self._pop_pending(lease_set))
                     if not chunk:
                         continue
+                    if held > len(chunk):
+                        # Packed fewer than granted (queue drained, byte
+                        # budget): the surplus goes back to siblings now.
+                        self._win_release(slot, win, held - len(chunk))
+                        held = len(chunk)
+                    t_send = time.monotonic()
                     fl = flight.ENABLED
                     if fl:
                         fl_t0 = time.monotonic()
@@ -4160,18 +4329,31 @@ class CoreWorker:
                             conn, slot.addr, header, frames,
                         )
                         self._handle_task_reply(header, h, rframes)
+                        t_now = time.monotonic()
+                        if win is not None:
+                            # AIMD clock: push -> reply ARRIVAL at the
+                            # transport, not -> this coroutine running —
+                            # a saturated driver loop's settle queueing
+                            # is pump-queue, not executor congestion.
+                            self._win_settled(
+                                slot, win, 1,
+                                (h.get("_fr") or t_now) - t_send,
+                            )
+                            held = 0
                         if not fut.done():
                             fut.set_result(None)
                         if fl:
                             # Span covers push → reply, i.e. dispatch +
                             # execution on the leased slot.
-                            t_now = time.monotonic()
                             flight.record("worker.task.push",
                                           header.get("tid"), "worker",
                                           fl_t0, t_now, fl_bytes, "ok")
                             taskpath.record_phase(
                                 "push", header.get("tid"), fl_t0, t_now,
                                 nbytes=fl_bytes,
+                            )
+                            self._record_pump_queue(
+                                header.get("tid"), h, t_now
                             )
                         continue
 
@@ -4209,12 +4391,23 @@ class CoreWorker:
                                     # This slot is done (e.g. OOM eviction);
                                     # the rest of the chunk goes back to the
                                     # queue for other slots — their futures
-                                    # must not be abandoned.
-                                    lease_set.pending.extend(chunk[i + 1:])
+                                    # must not be abandoned. Re-stamp the
+                                    # enqueue-time size estimate the pack
+                                    # loop peeks at.
+                                    lease_set.pending.extend(
+                                        (h2, f2, fu2,
+                                         sum(len(fr) for fr in f2) + 4096)
+                                        for h2, f2, fu2 in chunk[i + 1:]
+                                    )
                                     self._pump_leases(key, lease_set)
                                     return
+                        if win is not None:
+                            self._win_settled(slot, win, len(chunk),
+                                              time.monotonic() - t_send)
+                            held = 0
                         continue
                     stop = False
+                    arr_max = 0.0  # latest reply ARRIVAL (AIMD clock)
                     for i, ((header, frames, fut), rf) in enumerate(
                         zip(chunk, rfuts)
                     ):
@@ -4245,17 +4438,32 @@ class CoreWorker:
                                 stop = True
                             continue
                         self._handle_task_reply(header, h, rframes)
+                        arr = h.get("_fr")
+                        if arr is not None and arr > arr_max:
+                            arr_max = arr
                         if fl:
                             # Per-task push envelope (cid = task id): the
                             # chunk-level worker.task.push verb span stays
                             # for RPC attribution; this one anchors the
                             # task's driver-clock wall time.
+                            t_now = time.monotonic()
                             taskpath.record_phase(
-                                "push", header.get("tid"), fl_t0,
-                                time.monotonic(),
+                                "push", header.get("tid"), fl_t0, t_now,
+                            )
+                            self._record_pump_queue(
+                                header.get("tid"), h, t_now
                             )
                         if not fut.done():
                             fut.set_result(None)
+                    if win is not None:
+                        # AIMD clock: push -> last reply ARRIVAL; the
+                        # arrival->settle dwell is driver-side queueing
+                        # (pump-queue), not executor congestion.
+                        self._win_settled(
+                            slot, win, len(chunk),
+                            (arr_max or time.monotonic()) - t_send,
+                        )
+                        held = 0
                     if fl:
                         flight.record("worker.task.push",
                                       chunk[0][0].get("tid"), "worker",
@@ -4279,6 +4487,13 @@ class CoreWorker:
                         lease_set, slot, fut, e
                     ):
                         return
+                finally:
+                    # Window capacity must not leak on ANY exit (errors,
+                    # node loss, oversize fallback) — a leaked grant
+                    # shrinks the slot's effective window forever.
+                    if held:
+                        self._win_release(slot, win, held)
+                        held = 0
         finally:
             slot.busy = max(slot.busy - 1, 0)
             lease_set.saturated = False
@@ -4337,6 +4552,11 @@ class CoreWorker:
             lease_set.slots = keep
 
     def _release_slot(self, lease_set: _LeaseSet, slot: _LeaseSlot):
+        if slot.pwin is not None:
+            # Retire the slot's window stats so bench/tests still see
+            # peak/grow/shrink economics after the lease reaper returns
+            # the slot (bounded: one entry per peer address).
+            self._fold_pwin_stats(slot)
         try:
             self.gcs.notify(
                 "release_lease",
@@ -4349,6 +4569,83 @@ class CoreWorker:
         except protocol.ConnectionLost as e:
             logger.debug("release_lease for node %s dropped, head gone: %s",
                          slot.node_id, e)
+
+    def _fold_pwin_stats(self, slot):
+        """Fold one released slot's push-window counters into the
+        retired-per-peer table (max for window/peak, sums for the event
+        counters)."""
+        snap = slot.pwin.snapshot()
+        peer = f"{slot.addr[0]}:{slot.addr[1]}"
+        cur = self._pwin_retired.get(peer)
+        if cur is None:
+            self._pwin_retired[peer] = snap
+            return
+        cur["window"] = max(cur["window"], snap["window"])
+        cur["peak"] = max(cur["peak"], snap["peak"])
+        for k in ("grows", "shrinks", "settled"):
+            cur[k] += snap[k]
+
+    def transit_stats(self) -> dict:
+        """Transit-plane pacing snapshot for bench/tests: per-peer push
+        windows (live slots merged with retired ones), the ring pump's
+        drain batch-size histogram (served rings — the executor side of
+        every same-host peer), and frames-settled-per-recv-wakeup for
+        the TCP driver loop. Pure snapshot-time reads; no locks beyond
+        what the underlying counters already hold."""
+        push: Dict[str, dict] = {
+            peer: dict(snap) for peer, snap in self._pwin_retired.items()
+        }
+        for ls in self.leases.values():
+            for s in ls.slots:
+                if s.pwin is None:
+                    continue
+                snap = s.pwin.snapshot()
+                peer = f"{s.addr[0]}:{s.addr[1]}"
+                cur = push.get(peer)
+                if cur is None:
+                    push[peer] = snap
+                    continue
+                cur["window"] = max(cur["window"], snap["window"])
+                cur["peak"] = max(cur["peak"], snap["peak"])
+                for k in ("grows", "shrinks", "settled"):
+                    cur[k] += snap[k]
+        pump = {"drains": 0, "msgs": 0, "batch_hist": {}}
+        rings = [r for r in self._served_rings if not r._closed]
+        rings += [
+            r for r in self._ring_peers.values()
+            if r and not getattr(r, "_closed", True)
+        ]
+        for r in rings:
+            st = getattr(r, "pump_stats", None)
+            if not st:
+                continue
+            pump["drains"] += st.get("drains", 0)
+            pump["msgs"] += st.get("msgs", 0)
+            for k, v in st.get("batch_hist", {}).items():
+                key = str(k)
+                pump["batch_hist"][key] = (
+                    pump["batch_hist"].get(key, 0) + v
+                )
+        settle = {"wakeups": 0, "frames": 0, "drained": 0, "max_batch": 0}
+        conns = list(self.peers.values()) + rings
+        if self.gcs is not None:
+            conns.append(self.gcs)
+        for c in conns:
+            st = getattr(c, "settle_stats", None)
+            if not st:
+                continue
+            settle["wakeups"] += st.get("wakeups", 0)
+            settle["frames"] += st.get("frames", 0)
+            settle["drained"] += st.get("drained", 0)
+            settle["max_batch"] = max(
+                settle["max_batch"], st.get("max_batch", 0)
+            )
+        return {
+            "node_id": self.node_id,
+            "push_window": push,
+            "pump": pump,
+            "settle": settle,
+        }
 
     def _handle_task_reply(self, header, h, rframes):
         """Process a push_task reply: inline values, shm descriptors, errors."""
@@ -5481,6 +5778,26 @@ class CoreWorker:
                                 f"spill_{k}",
                                 description="object spill counter",
                             ).set(float(v))
+                    if self._push_window and self.leases:
+                        # Live adaptive push window per peer slot (max
+                        # across a peer's slots: the ramp level a reader
+                        # cares about). Bounded cardinality: peers.
+                        g = Gauge(
+                            "rt_push_window",
+                            description="adaptive in-flight push window "
+                                        "per peer (tasks)",
+                            tag_keys=("peer",),
+                        )
+                        agg: Dict[str, int] = {}
+                        for ls in self.leases.values():
+                            for s in ls.slots:
+                                if s.pwin is not None:
+                                    p = f"{s.addr[0]}:{s.addr[1]}"
+                                    agg[p] = max(
+                                        agg.get(p, 0), s.pwin.window
+                                    )
+                        for p, v in agg.items():
+                            g.set(float(v), tags={"peer": p})
                     if memtrack.ENABLED:
                         # Object-plane gauges (store bytes by kind, ref
                         # states, arena/graveyard, memory pressure) ride

@@ -541,6 +541,12 @@ CHAOS_SPECS = [
     # typed arg_intern_miss retry re-sends the exact bytes.
     "worker.arg.intern:error:0.2:0:117",
     "worker.arg.intern:drop:0.3:0:118",
+    # Transit pacing (round 16): error degrades a chunk to the fixed
+    # pre-pacing fan-out, drop cold-resets a slot's window to its floor
+    # — pacing is an optimization, so every workload must complete with
+    # zero leaked leases/objects either way.
+    "worker.push.window:error:0.3:0:119",
+    "worker.push.window:drop:0.3:0:120",
 ]
 
 

@@ -91,6 +91,39 @@ def test_taskpath_module_is_family_b_clean():
     assert json.loads(proc.stdout) == []
 
 
+def test_ringconn_module_is_family_b_clean():
+    """The round-16 batched pump handoff runs on the ring pump thread
+    and touches the connection's send lock from several threads: a
+    blocking call under that lock or a silent except-pass on the
+    drain/dispatch path is exactly the Family-B regression class
+    (``raytpu lint --framework`` over ringconn.py, the exact CI
+    invocation)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.lint",
+         os.path.join(REPO, "ray_tpu", "_private", "ringconn.py"),
+         "--framework", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout) == []
+
+
+def test_protocol_module_is_family_b_clean():
+    """The round-16 multi-frame settle drain parses wire messages
+    straight off the recv loop's reader buffer: a silent swallow there
+    (or a constant-sleep retry anywhere in the RPC core) would be the
+    costliest Family-B regression in the tree (``raytpu lint
+    --framework`` over protocol.py, the exact CI invocation)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.lint",
+         os.path.join(REPO, "ray_tpu", "_private", "protocol.py"),
+         "--framework", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout) == []
+
+
 def test_memtrack_module_is_family_b_clean():
     """The round-13 object-accounting plane snapshots refcount state and
     talks to the head's fan-out verb: a silent RPC swallow on the drain
