@@ -1,0 +1,45 @@
+"""Shared asyncio helpers for the framework planes.
+
+``spawn_logged`` is the sanctioned fire-and-forget: a bare
+``loop.create_task(coro())`` whose handle is dropped swallows the
+coroutine's exception until interpreter shutdown (asyncio only reports
+it when the task object is garbage-collected — for a long-lived driver
+that can be never). The lint rule RT303 flags exactly that shape;
+every background spawn in ``_private/`` goes through here instead, so
+a dying flusher/pusher/reaper leaves a log line pointing at itself.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Coroutine, Optional
+
+logger = logging.getLogger(__name__)
+
+
+def _report(task: "asyncio.Task", what: str) -> None:
+    if task.cancelled():
+        return
+    exc = task.exception()
+    if exc is not None:
+        logger.error("background task %s failed: %r", what, exc,
+                     exc_info=exc)
+
+
+def spawn_logged(loop: Optional[asyncio.AbstractEventLoop],
+                 coro: Coroutine, what: str) -> "asyncio.Task":
+    """``create_task`` + an exception-logging done callback.
+
+    ``loop=None`` uses the running loop (call from coroutines only).
+    ``what`` names the task in the failure log line (and the asyncio
+    task name, for ``rt timeline`` / debugger legibility).
+    """
+    if loop is None:
+        loop = asyncio.get_running_loop()
+    task = loop.create_task(coro)
+    try:
+        task.set_name(f"rt:{what}")
+    except AttributeError:  # pragma: no cover - very old loops
+        pass
+    task.add_done_callback(lambda t: _report(t, what))
+    return task

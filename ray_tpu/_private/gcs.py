@@ -30,6 +30,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import faultpoints, flight, protocol
+from ray_tpu._private.asyncio_util import spawn_logged
 from ray_tpu._private.ids import ActorID, NodeID, PlacementGroupID
 
 logger = logging.getLogger(__name__)
@@ -1417,7 +1418,8 @@ class HeadService:
                 return
             try:
                 loop.call_soon_threadsafe(
-                    lambda: loop.create_task(self._on_conn_closed(key))
+                    lambda: spawn_logged(loop, self._on_conn_closed(key),
+                                         "gcs.on_conn_closed")
                 )
             except RuntimeError:
                 pass
@@ -2084,7 +2086,7 @@ class HeadService:
             "metadata": h.get("metadata") or {},
         }
         self._wal_append({"op": "job", "job": dict(self.jobs[sub_id])})
-        asyncio.get_running_loop().create_task(self._watch_job(sub_id, proc))
+        spawn_logged(None, self._watch_job(sub_id, proc), "gcs.watch_job")
         return {"submission_id": sub_id}, []
 
     async def _watch_job(self, sub_id: str, proc):
@@ -2130,8 +2132,8 @@ class HeadService:
             info["stop_requested"] = True
             info["status"] = "STOPPING"
             proc.terminate()
-            loop = asyncio.get_running_loop()
-            loop.create_task(self._escalate_stop(proc))
+            spawn_logged(None, self._escalate_stop(proc),
+                         "gcs.escalate_stop")
         return {"stopped": True}, []
 
     async def _escalate_stop(self, proc, grace_s: float = 3.0):

@@ -24,6 +24,7 @@ from typing import Awaitable, Callable, Dict, List, Optional, Tuple
 import msgpack
 
 from ray_tpu._private import faultpoints, flight
+from ray_tpu._private.asyncio_util import spawn_logged
 
 logger = logging.getLogger(__name__)
 
@@ -311,9 +312,8 @@ class Connection:
                 # Arrival stamp: dispatch-side spans (and the head's
                 # queue-wait attribution) measure from here.
                 header["_fr"] = time.monotonic()
-            self._loop.create_task(
-                self._dispatch(header, frames)
-            )
+            spawn_logged(self._loop, self._dispatch(header, frames),
+                         "protocol.dispatch")
         return 0
 
     def _drain_buffered(self) -> int:

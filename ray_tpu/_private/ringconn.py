@@ -31,6 +31,7 @@ import time
 from typing import Callable, List, Optional, Tuple
 
 from ray_tpu._private import faultpoints, flight, protocol
+from ray_tpu._private.asyncio_util import spawn_logged
 from ray_tpu.native.ring import (
     NativeRing,
     RingClosed,
@@ -183,7 +184,8 @@ class RingConnection:
         self._backlog_bytes += len(data)
         if not self._drainer_running:
             self._drainer_running = True
-            self.loop.create_task(self._drain_backlog())
+            spawn_logged(self.loop, self._drain_backlog(),
+                         "ring.drain_backlog")
 
     async def _drain_backlog(self):
         try:
@@ -559,7 +561,8 @@ class RingConnection:
                 st["max_batch"] = len(replies)
         self._apply_replies(replies)
         for header, frames in slow:
-            self.loop.create_task(self._handle_slow(header, frames))
+            spawn_logged(self.loop, self._handle_slow(header, frames),
+                         "ring.handle_slow")
 
     async def _handle_slow(self, header: dict, frames: List[bytes]):
         reply = {"i": header["i"], "r": 1}

@@ -53,6 +53,7 @@ from ray_tpu._private import (
     specframe,
     taskpath,
 )
+from ray_tpu._private.asyncio_util import spawn_logged
 from ray_tpu._private.backoff import Backoff
 from ray_tpu._private.ids import (
     ActorID,
@@ -679,7 +680,8 @@ class CoreWorker:
 
             self.pubsub_handlers.setdefault("worker_logs", []).append(_echo)
         await self._connect_gcs()
-        self.loop.create_task(self._task_event_flusher())
+        spawn_logged(self.loop, self._task_event_flusher(),
+                     "worker.task_event_flusher")
         if not self.is_driver:
             from ray_tpu._private.config import rt_config
 
@@ -767,7 +769,8 @@ class CoreWorker:
             return
         try:
             self.loop.call_soon_threadsafe(
-                lambda: self.loop.create_task(self._reconnect_gcs())
+                lambda: spawn_logged(self.loop, self._reconnect_gcs(),
+                                     "worker.reconnect_gcs")
             )
         except RuntimeError:
             pass
@@ -2220,7 +2223,8 @@ class CoreWorker:
         keys = [k for k in self._fn_fetch_keys if k in self._fn_loading]
         self._fn_fetch_keys.clear()
         if keys:
-            self.loop.create_task(self._fetch_functions(keys))
+            spawn_logged(self.loop, self._fetch_functions(keys),
+                         "worker.fetch_functions")
 
     async def _fetch_functions(self, keys: List[str]):
         try:
@@ -2318,8 +2322,10 @@ class CoreWorker:
                 continue
             self._apply_borrow(oid, owner, my_addr, to_notify)
         for owner, oids in to_notify.items():
-            self.loop.create_task(
-                self._notify_owner_many(owner, "add_borrow", oids)
+            spawn_logged(
+                self.loop,
+                self._notify_owner_many(owner, "add_borrow", oids),
+                "worker.notify_owner.add_borrow",
             )
 
     def _drain_releases(self):
@@ -2368,12 +2374,16 @@ class CoreWorker:
             rec["count"] -= 1
             self._maybe_free(oid, free_sink=freed)
         for owner, oids in to_add.items():
-            self.loop.create_task(
-                self._notify_owner_many(owner, "add_borrow", oids)
+            spawn_logged(
+                self.loop,
+                self._notify_owner_many(owner, "add_borrow", oids),
+                "worker.notify_owner.add_borrow",
             )
         for owner, oids in to_release.items():
-            self.loop.create_task(
-                self._notify_owner_many(owner, "release_borrow", oids)
+            spawn_logged(
+                self.loop,
+                self._notify_owner_many(owner, "release_borrow", oids),
+                "worker.notify_owner.release_borrow",
             )
         # Registrations flush BEFORE frees: a register landing after the
         # free of the same (dying) object would leave the head directory
@@ -2502,8 +2512,10 @@ class CoreWorker:
             elif owner and tuple(owner) != my_addr:
                 to_release.setdefault(tuple(owner), []).append(oid)
         for owner, oids in to_release.items():
-            self.loop.create_task(
-                self._notify_owner_many(owner, "release_borrow", oids)
+            spawn_logged(
+                self.loop,
+                self._notify_owner_many(owner, "release_borrow", oids),
+                "worker.notify_owner.release_borrow",
             )
 
     async def _notify_owner(self, addr, method: str, oid: str):
@@ -3615,7 +3627,8 @@ class CoreWorker:
                             # coroutine is built only on failure.
                             coro_fn(*args)
                         else:
-                            self.loop.create_task(coro_fn(*args))
+                            spawn_logged(self.loop, coro_fn(*args),
+                                         "worker.submit_drain")
                     except Exception as e:
                         # One bad submission fails ITS task; it must not
                         # wedge the drain (a stuck _submit_scheduled flag
@@ -3657,10 +3670,12 @@ class CoreWorker:
                 return
             e = f.exception()
             if e is not None:
-                self.loop.create_task(
+                spawn_logged(
+                    self.loop,
                     self._dispatch_retry(
                         header, frames, resources, strategy, retries, e
-                    )
+                    ),
+                    "worker.dispatch_retry",
                 )
 
         fut.add_done_callback(done)
@@ -3814,7 +3829,8 @@ class CoreWorker:
                 break
             slot.busy += 1
             spawn_budget -= 1
-            self.loop.create_task(self._slot_pusher(key, lease_set, slot))
+            spawn_logged(self.loop, self._slot_pusher(key, lease_set, slot),
+                         "worker.slot_pusher")
         # Only the items NOT covered by a pusher spawned this pass warrant
         # new leases (requesting one per queued item would strand surplus
         # slots at the head until the reaper returns them — an idle surplus
@@ -3822,12 +3838,15 @@ class CoreWorker:
         need = spawn_budget
         if need > 0 and not lease_set.requesting:
             lease_set.requesting = True
-            self.loop.create_task(self._request_leases(key, lease_set, min(need, 64)))
+            spawn_logged(self.loop,
+                         self._request_leases(key, lease_set, min(need, 64)),
+                         "worker.request_leases")
         # Whenever slots are held, exactly one reaper must be alive to return
         # them once idle (grants can arrive after the queue already drained).
         if lease_set.slots and not lease_set.reaper_running:
             lease_set.reaper_running = True
-            self.loop.create_task(self._lease_reaper(key, lease_set))
+            spawn_logged(self.loop, self._lease_reaper(key, lease_set),
+                         "worker.lease_reaper")
 
     async def _request_leases(self, key, lease_set: _LeaseSet, count):
         from ray_tpu._private.config import rt_config
@@ -4827,7 +4846,8 @@ class CoreWorker:
             self._acreate_inflight = True
         for pc in batch:
             pc.fut = self.loop.create_future()
-        self.loop.create_task(self._send_actor_create_batch(batch))
+        spawn_logged(self.loop, self._send_actor_create_batch(batch),
+                     "worker.actor_create_batch")
 
     async def _send_actor_create_batch(self, batch):
         try:

@@ -215,8 +215,11 @@ class Autoscaler:
                     self._client.call(
                         "drain_node", {"node_id": pn["node_id"]}
                     )
-                except Exception:
-                    pass
+                except Exception as e:
+                    # Best-effort: the node is being terminated either
+                    # way, but a dropped drain should be diagnosable.
+                    logger.debug("drain_node %s failed: %s",
+                                 pn["node_id"], e)
                 self.provider.terminate_node(pn["provider_node_id"])
                 by_type[pn["node_type"]] -= 1
                 terminated.append(pn["provider_node_id"])

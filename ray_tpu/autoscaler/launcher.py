@@ -29,12 +29,15 @@ YAML schema::
 from __future__ import annotations
 
 import json
+import logging
 import os
 import signal
 import subprocess
 import sys
 import time
 from typing import Any, Dict, Optional
+
+from ray_tpu._private.backoff import Backoff
 
 from ray_tpu.autoscaler.autoscaler import (
     Autoscaler,
@@ -48,6 +51,8 @@ from ray_tpu.autoscaler.node_provider import (
     LocalNodeProvider,
     NodeProvider,
 )
+
+logger = logging.getLogger(__name__)
 
 
 def _state_dir() -> str:
@@ -147,8 +152,9 @@ def up(path: str, *, wait_for_min_workers: float = 0.0) -> Dict[str, Any]:
             except OSError:
                 pass
             deadline = time.monotonic() + 15
+            poll = Backoff(base=0.05, cap=0.5)
             while time.monotonic() < deadline and _pid_alive(mon):
-                time.sleep(0.1)
+                poll.sleep()
             if _pid_alive(mon):
                 try:
                     os.kill(mon, signal.SIGKILL)
@@ -184,6 +190,7 @@ def up(path: str, *, wait_for_min_workers: float = 0.0) -> Dict[str, Any]:
     )
     deadline = time.monotonic() + 60
     info = None
+    poll = Backoff(base=0.02, cap=0.25)
     while time.monotonic() < deadline:
         if os.path.exists(info_file):
             try:
@@ -193,7 +200,7 @@ def up(path: str, *, wait_for_min_workers: float = 0.0) -> Dict[str, Any]:
                 pass  # partially visible; retry
         if proc.poll() is not None:
             break
-        time.sleep(0.05)
+        poll.sleep()
     if info is None:
         proc.kill()
         raise RuntimeError(
@@ -250,6 +257,7 @@ def _wait_min_workers(cfg, address, timeout: float):
         int(nt.get("min_workers", 0)) for nt in cfg["node_types"].values()
     )
     deadline = time.monotonic() + timeout
+    poll = Backoff(base=0.25, cap=2.0)
     while time.monotonic() < deadline:
         try:
             client = SyncHeadClient(address)
@@ -258,9 +266,10 @@ def _wait_min_workers(cfg, address, timeout: float):
             alive = sum(1 for n in h["nodes"] if n.get("alive"))
             if alive >= want:
                 return True
-        except Exception:
-            pass
-        time.sleep(0.5)
+        except Exception as e:
+            logger.debug("get_nodes poll failed (head still coming up?): "
+                         "%s", e)
+        poll.sleep()
     return False
 
 
@@ -308,8 +317,9 @@ def down(path_or_name: str) -> bool:
         except OSError:
             pass
         deadline = time.monotonic() + 15
+        poll = Backoff(base=0.05, cap=0.5)
         while time.monotonic() < deadline and _pid_alive(mon_pid):
-            time.sleep(0.1)
+            poll.sleep()
     head_pid = state.get("head_pid")
     if _pid_alive(head_pid):
         try:
@@ -317,11 +327,12 @@ def down(path_or_name: str) -> bool:
         except OSError:
             pass
     deadline = time.monotonic() + 5
+    poll = Backoff(base=0.05, cap=0.5)
     while time.monotonic() < deadline and (
         _pid_alive(state.get("head_pid"))
         or _pid_alive(state.get("monitor_pid"))
     ):
-        time.sleep(0.1)
+        poll.sleep()
     for key in ("monitor_pid", "head_pid"):
         pid = state.get(key)
         if _pid_alive(pid):
@@ -332,11 +343,12 @@ def down(path_or_name: str) -> bool:
     # SIGKILL delivery + reaping are asynchronous: wait until both pids are
     # really gone so `down()` returning means the cluster is down.
     deadline = time.monotonic() + 10
+    poll = Backoff(base=0.02, cap=0.25)
     while time.monotonic() < deadline and (
         _pid_alive(state.get("head_pid"))
         or _pid_alive(state.get("monitor_pid"))
     ):
-        time.sleep(0.05)
+        poll.sleep()
     os.unlink(state_file)
     return True
 
@@ -366,8 +378,9 @@ def _monitor_main(config_path: str, address: str):
     signal.signal(signal.SIGTERM, term)
     runner.start()
     try:
+        idle = Backoff(base=0.2, cap=1.0)
         while not stop["flag"]:
-            time.sleep(0.2)
+            idle.sleep()
     finally:
         runner.stop()
         for n in provider.non_terminated_nodes():

@@ -11,11 +11,14 @@ spill to the object store and the channel carries the ObjectRef.
 """
 from __future__ import annotations
 
+import logging
 import pickle
 import struct
 import time
 from multiprocessing import resource_tracker, shared_memory
 from typing import Any, List, Optional
+
+logger = logging.getLogger(__name__)
 
 _MAGIC = 0x52544348  # "RTCH"
 _HDR = struct.Struct("<IIQQQBB6x")  # magic, cap, wseq, rseq, nbytes, kind, stop
@@ -285,8 +288,9 @@ class DeviceChannel:
                 try:
                     w.shm.free(oid)
                     w.gcs.notify("object_free", {"oids": [oid]})
-                except Exception:
-                    pass
+                except Exception as e:
+                    logger.debug("channel write cleanup of %s failed: %s",
+                                 oid, e)
             raise
         self._last_oid = oid
 
@@ -348,8 +352,9 @@ class DeviceChannel:
             # fan-out) can go.
             try:
                 w.gcs.notify("object_free", {"oids": [msg["oid"]]})
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("channel read free of %s failed: %s",
+                             msg["oid"], e)
         return jax.tree_util.tree_unflatten(msg["tree"], out_leaves)
 
     def close(self):
@@ -362,7 +367,8 @@ class DeviceChannel:
                     get_global_worker().gcs.notify(
                         "object_free", {"oids": [self._last_oid]}
                     )
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("channel close free of %s failed: %s",
+                             self._last_oid, e)
             self._last_oid = None
         self._ctl.close()

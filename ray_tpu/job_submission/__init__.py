@@ -11,6 +11,8 @@ import time
 from enum import Enum
 from typing import Any, Dict, Optional
 
+from ray_tpu._private.backoff import Backoff
+
 
 class JobStatus(str, Enum):
     PENDING = "PENDING"
@@ -79,13 +81,14 @@ class JobSubmissionClient:
     def wait_until_status(self, submission_id: str, timeout: float = 120.0,
                           target: Optional[JobStatus] = None) -> JobStatus:
         deadline = time.monotonic() + timeout
+        poll = Backoff(base=0.1, cap=1.0)
         while time.monotonic() < deadline:
             status = self.get_job_status(submission_id)
             if (target is not None and status == target) or (
                 target is None and status.is_terminal()
             ):
                 return status
-            time.sleep(0.2)
+            poll.sleep()
         raise TimeoutError(
             f"job {submission_id} not terminal within {timeout}s"
         )

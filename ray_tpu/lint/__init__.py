@@ -6,22 +6,37 @@ Entry points:
   (``--json`` for machine-readable output, ``--select RT2`` to scope).
 * Decoration time: ``RAY_TPU_LINT=1`` makes ``@ray_tpu.remote`` raise
   :class:`~ray_tpu.exceptions.LintError` on Family-A findings.
-* Self-check: ``tests/test_lint_self.py`` keeps ``ray_tpu/_private/``
-  free of Family-B findings.
+* Self-check: ``tests/test_lint_self.py`` keeps ``ray_tpu/`` free of
+  Family-B/C/D findings (``--framework`` over the whole tree).
+* Catalog: ``python -m ray_tpu.lint --regen`` rebuilds
+  ``lint/catalog.py``, the pinned wire/gate/chaos/phase tables Family D
+  checks the code against.
 
-See ``base.py`` for the rule model and ``PARITY.md`` ("Round-7") for the
-rule catalog and suppression syntax (``# raytpu: ignore[RULE]``).
+See ``base.py`` for the rule model and ``PARITY.md`` ("Round-7",
+"Round-17") for the rule catalog and suppression syntax
+(``# raytpu: ignore[RULE]``).
 """
-from ray_tpu.lint import framework_rules, user_rules  # noqa: F401 (registry)
+from ray_tpu.lint import (  # noqa: F401 (registry)
+    concurrency_rules,
+    framework_rules,
+    invariant_rules,
+    user_rules,
+)
 from ray_tpu.lint.base import (
+    FAMILY_CONCURRENCY,
     FAMILY_FRAMEWORK,
+    FAMILY_PROTOCOL,
     FAMILY_USER,
+    PROJECT_RULES,
     RULES,
     Finding,
     ModuleContext,
+    ProjectContext,
     Rule,
+    all_rules,
     lint_file,
     lint_paths,
+    lint_project,
     lint_source,
 )
 from ray_tpu.lint.decoration import (
@@ -31,16 +46,22 @@ from ray_tpu.lint.decoration import (
 )
 
 __all__ = [
+    "FAMILY_CONCURRENCY",
     "FAMILY_FRAMEWORK",
+    "FAMILY_PROTOCOL",
     "FAMILY_USER",
+    "PROJECT_RULES",
     "RULES",
     "Finding",
     "ModuleContext",
+    "ProjectContext",
     "Rule",
+    "all_rules",
     "check_actor_class",
     "check_remote_function",
     "lint_enabled",
     "lint_file",
     "lint_paths",
+    "lint_project",
     "lint_source",
 ]

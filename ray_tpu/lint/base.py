@@ -7,13 +7,22 @@ borrows, unplaceable resource shapes — only at runtime, deep inside a
 cluster; its own task-spec validation and ownership bookkeeping show the
 invariants are statically checkable at ``@remote`` decoration time.
 
-Two rule families:
+Four rule families:
 
 * **Family A (user code)** — rules that fire on functions/classes passed
   to ``@ray_tpu.remote``: ``RT101``-``RT104``.
 * **Family B (framework self-analysis)** — rules that keep
   ``ray_tpu/_private/`` honest about its own thread+lock discipline:
   ``RT201``-``RT204``.
+* **Family C (concurrency)** — asyncio/thread hazards in framework
+  code (blocking the core loop, touching a loop from the wrong thread,
+  fire-and-forget tasks): ``RT301``-``RT305``.
+* **Family D (protocol invariants)** — project-scope drift checks
+  between the code and the pinned ``lint/catalog.py`` (wire flags,
+  config gates, faultpoints, taskpath phases): ``RT401``-``RT404``.
+  These run over the whole scanned file set at once (a receiver branch
+  in one module satisfies a sender in another), so they activate for
+  directory scans and explicit ``--select RT4`` runs.
 
 Suppression: append ``# raytpu: ignore[RT201]`` (comma-separated ids, or
 bare ``# raytpu: ignore`` for all rules) to the flagged line.
@@ -28,10 +37,18 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 FAMILY_USER = "A"
 FAMILY_FRAMEWORK = "B"
+FAMILY_CONCURRENCY = "C"
+FAMILY_PROTOCOL = "D"
+
+#: Families whose rules run per-module (Family D runs per-project).
+MODULE_FAMILIES = (FAMILY_USER, FAMILY_FRAMEWORK, FAMILY_CONCURRENCY)
 
 _SUPPRESS_RE = re.compile(
     r"#\s*raytpu:\s*ignore(?:\[(?P<rules>[A-Za-z0-9_,\s]*)\])?"
 )
+
+
+_FAMILY_BY_PREFIX = {"RT1": "A", "RT2": "B", "RT3": "C", "RT4": "D"}
 
 
 @dataclasses.dataclass
@@ -42,11 +59,17 @@ class Finding:
     line: int
     col: int
 
+    @property
+    def family(self) -> str:
+        return _FAMILY_BY_PREFIX.get(self.rule[:3], "-")
+
     def format(self) -> str:
         return f"{self.file}:{self.line}:{self.col}: {self.rule} {self.message}"
 
     def to_dict(self) -> dict:
-        return dataclasses.asdict(self)
+        d = dataclasses.asdict(self)
+        d["family"] = self.family  # lets --json consumers filter by family
+        return d
 
 
 @dataclasses.dataclass
@@ -58,8 +81,13 @@ class Rule:
 
 
 #: rule id -> Rule. Populated by the ``@register`` decorators in
-#: user_rules.py / framework_rules.py at import time.
+#: user_rules.py / framework_rules.py / concurrency_rules.py at import
+#: time. Project-scope rules (Family D) live in ``PROJECT_RULES``.
 RULES: Dict[str, Rule] = {}
+
+#: rule id -> Rule whose check takes a :class:`ProjectContext` (all
+#: scanned modules at once). Populated by invariant_rules.py.
+PROJECT_RULES: Dict[str, Rule] = {}
 
 
 def register(rule_id: str, family: str, summary: str):
@@ -68,6 +96,32 @@ def register(rule_id: str, family: str, summary: str):
         return fn
 
     return deco
+
+
+def register_project(rule_id: str, family: str, summary: str):
+    def deco(fn):
+        PROJECT_RULES[rule_id] = Rule(rule_id, family, summary, fn)
+        return fn
+
+    return deco
+
+
+def all_rules() -> Dict[str, Rule]:
+    """Module + project rules in one registry view (populated)."""
+    _load_rule_modules()
+    merged = dict(RULES)
+    merged.update(PROJECT_RULES)
+    return merged
+
+
+def _load_rule_modules():
+    # Import for the registration side effect (idempotent).
+    from ray_tpu.lint import (  # noqa: F401
+        concurrency_rules,
+        framework_rules,
+        invariant_rules,
+        user_rules,
+    )
 
 
 def dotted(node: ast.AST) -> Optional[str]:
@@ -177,15 +231,37 @@ class ModuleContext:
         return finding.rule in {r.strip() for r in rules.split(",")}
 
 
+class ProjectContext:
+    """Every parsed module of one lint invocation, for project-scope
+    (Family D) rules: a wire flag packed in ``worker.py`` is satisfied
+    by its receiver branch in ``protocol.py``.
+
+    ``complete`` marks a scan that covered a whole directory tree —
+    only then may rules report *absence* findings (a catalog entry with
+    no site anywhere); partial scans (single files, fixture tests) only
+    report asymmetries among the sites they can see.
+    """
+
+    def __init__(self, modules: Sequence[ModuleContext],
+                 complete: bool = False):
+        self.modules = list(modules)
+        self.complete = complete
+        self._by_file = {m.filename: m for m in self.modules}
+
+    def suppressed(self, finding: Finding) -> bool:
+        ctx = self._by_file.get(finding.file)
+        return ctx.suppressed(finding) if ctx is not None else False
+
+
 def lint_source(source: str, filename: str = "<string>",
                 families: Sequence[str] = (FAMILY_USER, FAMILY_FRAMEWORK),
                 assume_remote: bool = False,
                 select: Optional[Sequence[str]] = None) -> List[Finding]:
-    """Run the registry against one module's source. ``select`` filters by
-    rule-id prefix (``["RT2"]`` -> Family B only)."""
-    # Import for the registration side effect (idempotent).
-    from ray_tpu.lint import framework_rules, user_rules  # noqa: F401
-
+    """Run the per-module registry against one module's source.
+    ``select`` filters by rule-id prefix (``["RT2"]`` -> Family B only).
+    Family D (project scope) runs through :func:`lint_paths` /
+    :func:`lint_project` instead."""
+    _load_rule_modules()
     ctx = ModuleContext(source, filename, assume_remote=assume_remote)
     findings: List[Finding] = []
     for rule in RULES.values():
@@ -195,6 +271,21 @@ def lint_source(source: str, filename: str = "<string>",
             continue
         findings.extend(rule.check(ctx))
     findings = [f for f in findings if not ctx.suppressed(f)]
+    findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_project(modules: Sequence[ModuleContext], complete: bool = False,
+                 select: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run the project-scope (Family D) rules over parsed modules."""
+    _load_rule_modules()
+    pctx = ProjectContext(modules, complete=complete)
+    findings: List[Finding] = []
+    for rule in PROJECT_RULES.values():
+        if select and not any(rule.rule_id.startswith(s) for s in select):
+            continue
+        findings.extend(rule.check(pctx))
+    findings = [f for f in findings if not pctx.suppressed(f)]
     findings.sort(key=lambda f: (f.file, f.line, f.col, f.rule))
     return findings
 
@@ -213,26 +304,64 @@ def _is_framework_path(path: str) -> bool:
     )
 
 
+def _is_lint_path(path: str) -> bool:
+    # The analyzer's own package: rule modules and the catalog are full
+    # of wire-flag / faultpoint string fixtures that would scan as fake
+    # pack/fire sites. Module rules still run; the project pass skips it.
+    parts = os.path.normpath(path).split(os.sep)
+    return any(
+        a == "ray_tpu" and b == "lint" for a, b in zip(parts, parts[1:])
+    )
+
+
 def lint_file(path: str, framework: Optional[bool] = None,
-              select: Optional[Sequence[str]] = None) -> List[Finding]:
-    """Lint one file. Family A always runs; Family B runs for files under
-    ``_private/`` (framework self-analysis) or when ``framework=True``."""
+              select: Optional[Sequence[str]] = None,
+              collect: Optional[List[ModuleContext]] = None
+              ) -> List[Finding]:
+    """Lint one file with the per-module families. Family A always runs;
+    Families B and C run for files under ``_private/`` (framework
+    self-analysis) or when ``framework=True``. A parsed
+    :class:`ModuleContext` is appended to ``collect`` for the caller's
+    project pass."""
     with open(path, "r", encoding="utf-8") as f:
         source = f.read()
     run_b = framework if framework is not None else _is_framework_path(path)
-    families = (FAMILY_USER, FAMILY_FRAMEWORK) if run_b else (FAMILY_USER,)
+    families = MODULE_FAMILIES if run_b else (FAMILY_USER,)
     try:
-        return lint_source(source, path, families=families, select=select)
+        findings = lint_source(source, path, families=families,
+                               select=select)
     except SyntaxError as exc:
         return [Finding("RT000", f"syntax error: {exc.msg}", path,
                         exc.lineno or 1, exc.offset or 0)]
+    if collect is not None and not _is_lint_path(path):
+        collect.append(ModuleContext(source, path))
+    return findings
+
+
+def _want_project_rules(select: Optional[Sequence[str]],
+                        scanned_dir: bool, framework: Optional[bool],
+                        modules: Sequence[ModuleContext]) -> bool:
+    # Family D needs cross-module visibility to mean anything, so by
+    # default it rides directory scans that include framework code;
+    # ``--select RT4...`` opts a partial (single-file / fixture) scan in
+    # explicitly.
+    if select:
+        return any(s == "RT" or s.startswith("RT4") for s in select)
+    if not scanned_dir:
+        return False
+    return framework is True or any(
+        _is_framework_path(m.filename) for m in modules
+    )
 
 
 def lint_paths(paths: Sequence[str], framework: Optional[bool] = None,
                select: Optional[Sequence[str]] = None) -> List[Finding]:
     findings: List[Finding] = []
+    modules: List[ModuleContext] = []
+    scanned_dir = False
     for path in paths:
         if os.path.isdir(path):
+            scanned_dir = True
             for root, dirs, files in os.walk(path):
                 dirs[:] = sorted(
                     d for d in dirs
@@ -241,8 +370,14 @@ def lint_paths(paths: Sequence[str], framework: Optional[bool] = None,
                 for name in sorted(files):
                     if name.endswith(".py"):
                         findings.extend(lint_file(
-                            os.path.join(root, name), framework, select
+                            os.path.join(root, name), framework, select,
+                            collect=modules,
                         ))
         else:
-            findings.extend(lint_file(path, framework, select))
+            findings.extend(lint_file(path, framework, select,
+                                      collect=modules))
+    if modules and _want_project_rules(select, scanned_dir, framework,
+                                       modules):
+        findings.extend(lint_project(modules, complete=scanned_dir,
+                                     select=select))
     return findings

@@ -1,0 +1,410 @@
+"""Generator for ``ray_tpu/lint/catalog.py`` (``--regen``).
+
+The catalog is the single source of truth Family D lints against; this
+module rebuilds its *derived* sections by scanning the tree:
+
+* ``FAULTPOINTS`` names — literal first args of ``faultpoints.fire`` /
+  ``async_fire`` calls under ``ray_tpu/`` (the lint package excluded);
+  ``matrixed`` is True when the name appears in a chaos-spec string
+  (``"point:kind:prob..."``) anywhere under ``tests/``.
+* ``GATES`` — ``rt_config.declare(name, bool, True, ...)`` entries in
+  ``_private/config.py`` (default-ON behavior gates).
+* ``PHASES`` — the ``PHASES`` tuple in ``_private/taskpath.py``.
+* ``STAGES`` — literal ``record_phase("<stage>", ...)`` /
+  ``flight.record("task.<stage>", ...)`` first args.
+
+Curated sections (``WIRE_FLAGS``, ``WIRE_BASE``, ``HEADER_VARS``,
+``HEADER_KWARGS``, ``DYNAMIC_FIRE_PREFIXES``) and every ``waive`` reason
+carry over from the existing catalog, so regenerating on a clean tree is
+a byte-for-byte no-op (tests pin this) and a new fire site / gate /
+phase shows up as a catalog diff the reviewer has to own.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_CHAOS_RE = re.compile(r"^([a-z_.]+):(error|drop|delay|crash):")
+
+
+def _repo_root() -> str:
+    # ray_tpu/lint/catalog_gen.py -> repo root two levels above ray_tpu.
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _iter_sources(root: str) -> List[Tuple[str, ast.AST]]:
+    out = []
+    pkg = os.path.join(root, "ray_tpu")
+    for dirp, dirs, files in os.walk(pkg):
+        dirs[:] = sorted(d for d in dirs
+                         if d not in ("__pycache__", "lint", ".git"))
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirp, name)
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    tree = ast.parse(f.read(), path)
+            except (SyntaxError, OSError):
+                continue
+            out.append((path, tree))
+    return out
+
+
+def scan_fire_names(root: str) -> List[str]:
+    names = set()
+    for _path, tree in _iter_sources(root):
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("fire", "async_fire")
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                names.add(node.args[0].value)
+    return sorted(names)
+
+
+def scan_matrixed(root: str) -> List[str]:
+    """Faultpoint names referenced by chaos-spec strings under tests/."""
+    names = set()
+    tests = os.path.join(root, "tests")
+    if not os.path.isdir(tests):
+        return []
+    for dirp, dirs, files in os.walk(tests):
+        dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            try:
+                with open(os.path.join(dirp, name), encoding="utf-8") as f:
+                    tree = ast.parse(f.read())
+            except (SyntaxError, OSError):
+                continue
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Constant)
+                        and isinstance(node.value, str)):
+                    m = _CHAOS_RE.match(node.value)
+                    if m:
+                        names.add(m.group(1))
+    return sorted(names)
+
+
+def scan_gates(root: str) -> List[str]:
+    """Default-ON bool gates declared in _private/config.py."""
+    path = os.path.join(root, "ray_tpu", "_private", "config.py")
+    gates = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (SyntaxError, OSError):
+        return gates
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "declare"
+                and len(node.args) >= 3
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[1], ast.Name)
+                and node.args[1].id == "bool"
+                and isinstance(node.args[2], ast.Constant)
+                and node.args[2].value is True):
+            gates.append(node.args[0].value)
+    return sorted(gates)
+
+
+def scan_phases(root: str) -> Tuple[str, ...]:
+    """The canonical PHASES tuple in _private/taskpath.py."""
+    path = os.path.join(root, "ray_tpu", "_private", "taskpath.py")
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (SyntaxError, OSError):
+        return ()
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "PHASES":
+                    if isinstance(node.value, ast.Tuple):
+                        return tuple(
+                            e.value for e in node.value.elts
+                            if isinstance(e, ast.Constant)
+                        )
+    return ()
+
+
+def scan_stages(root: str) -> List[str]:
+    stages = set()
+    for _path, tree in _iter_sources(root):
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            fn = node.func
+            name = (fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else None)
+            if name == "record_phase":
+                stages.add(node.args[0].value)
+            elif name == "record" and node.args[0].value.startswith("task."):
+                stages.add(node.args[0].value[len("task."):])
+    return sorted(stages)
+
+
+# ---------------------------------------------------------------- emission
+
+def _emit_str_seq(name: str, values: Sequence[str], kind: str) -> List[str]:
+    open_, close = (("(", ")") if kind == "tuple"
+                    else ("frozenset({", "})"))
+    lines = [f"{name} = {open_}"]
+    for v in values:
+        lines.append(f"    {v!r},")
+    lines.append(f"{close}")
+    return lines
+
+
+def _emit_flag_map(name: str, data: Dict[str, dict]) -> List[str]:
+    lines = [f"{name} = {{"]
+    for key in sorted(data):
+        entry = data[key]
+        lines.append(f"    {key!r}: {{")
+        for field in ("direction", "desc", "waive"):
+            lines.append(f"        {field!r}: {entry.get(field)!r},")
+        lines.append("    },")
+    lines.append("}")
+    return lines
+
+
+def _emit_info_map(name: str, data: Dict[str, dict],
+                   fields: Sequence[str]) -> List[str]:
+    lines = [f"{name} = {{"]
+    for key in sorted(data):
+        entry = data[key]
+        body = ", ".join(f"{f!r}: {entry.get(f)!r}" for f in fields)
+        lines.append(f"    {key!r}: {{{body}}},")
+    lines.append("}")
+    return lines
+
+
+_DOCSTRING = '''"""Pinned protocol/config/chaos/phase catalog (Family D source of truth).
+
+GENERATED by ``python -m ray_tpu.lint --regen`` (see
+``lint/catalog_gen.py``); regenerating on a clean tree is a no-op. Edit
+by hand only to (a) curate ``WIRE_FLAGS`` / ``WIRE_BASE`` /
+``HEADER_VARS`` entries for protocol changes, or (b) set a ``waive``
+reason string — waived entries are exempt from the corresponding RT4xx
+requirement but stay pinned here so the exemption is reviewable. Then
+run ``--regen``: derived sections (faultpoints, gates, phases, stages)
+rebuild from the tree and your curation carries over.
+
+Consumed by ``lint/invariant_rules.py``:
+
+* RT401 — every ``WIRE_FLAGS`` key needs a pack site AND a consume
+  site; short header keys packed outside ``WIRE_FLAGS``/``WIRE_BASE``
+  are uncataloged wire drift.
+* RT402 — every ``GATES`` entry must be declared default-ON in
+  ``rt_config`` and read somewhere with a reachable off-branch.
+* RT403 — every literal ``faultpoints.fire`` name must appear in
+  ``FAULTPOINTS`` and be chaos-matrixed or waived.
+* RT404 — every ``record_phase`` stage / ``phase=`` label must appear
+  in ``STAGES`` / ``PHASES``; ``PHASES`` must match
+  ``taskpath.PHASES`` exactly.
+"""'''
+
+
+def generate(root: Optional[str] = None) -> str:
+    """Render the full catalog.py source for ``root`` (repo root)."""
+    root = root or _repo_root()
+    try:
+        from ray_tpu.lint import catalog as cur
+    except ImportError:  # pragma: no cover - bootstrap only
+        cur = None
+
+    def curated(name, default):
+        return getattr(cur, name, default) if cur is not None else default
+
+    wire_flags = curated("WIRE_FLAGS", _SEED_WIRE_FLAGS)
+    wire_base = curated("WIRE_BASE", _SEED_WIRE_BASE)
+    header_vars = curated("HEADER_VARS", _SEED_HEADER_VARS)
+    header_kwargs = curated("HEADER_KWARGS", _SEED_HEADER_KWARGS)
+    dyn_prefixes = curated("DYNAMIC_FIRE_PREFIXES", _SEED_DYN_PREFIXES)
+    old_fps = curated("FAULTPOINTS", _SEED_FAULTPOINT_WAIVES)
+    old_gates = curated("GATES", {})
+
+    matrixed = set(scan_matrixed(root))
+    faultpoints = {}
+    for name in scan_fire_names(root):
+        prev = old_fps.get(name, {})
+        faultpoints[name] = {
+            "matrixed": name in matrixed if matrixed else
+            bool(prev.get("matrixed")),
+            "waive": prev.get("waive"),
+        }
+    gates = {
+        name: {"waive": old_gates.get(name, {}).get("waive")}
+        for name in scan_gates(root)
+    }
+    phases = scan_phases(root)
+    stages = scan_stages(root)
+
+    parts: List[str] = [_DOCSTRING, ""]
+    parts.extend(_emit_str_seq("HEADER_VARS", tuple(header_vars), "tuple"))
+    parts.append("")
+    parts.extend(_emit_str_seq("HEADER_KWARGS", tuple(header_kwargs),
+                               "tuple"))
+    parts.append("")
+    parts.extend(_emit_flag_map("WIRE_FLAGS", wire_flags))
+    parts.append("")
+    parts.extend(_emit_str_seq("WIRE_BASE", sorted(wire_base), "frozenset"))
+    parts.append("")
+    parts.extend(_emit_info_map("GATES", gates, ("waive",)))
+    parts.append("")
+    parts.extend(_emit_info_map("FAULTPOINTS", faultpoints,
+                                ("matrixed", "waive")))
+    parts.append("")
+    parts.extend(_emit_str_seq("DYNAMIC_FIRE_PREFIXES",
+                               tuple(dyn_prefixes), "tuple"))
+    parts.append("")
+    parts.extend(_emit_str_seq("PHASES", phases, "tuple"))
+    parts.append("")
+    parts.extend(_emit_str_seq("STAGES", tuple(stages), "tuple"))
+    parts.append("")
+    return "\n".join(parts)
+
+
+def catalog_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "catalog.py")
+
+
+def regen(root: Optional[str] = None, write: bool = True) -> bool:
+    """Regenerate catalog.py. Returns True when the file changed."""
+    text = generate(root)
+    path = catalog_path()
+    try:
+        with open(path, encoding="utf-8") as f:
+            old = f.read()
+    except OSError:
+        old = None
+    if old == text:
+        return False
+    if write:
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(text)
+    return True
+
+
+# ------------------------------------------------------------- bootstrap
+# Seeds used only when catalog.py does not exist yet (first generation);
+# afterwards the catalog itself is authoritative and these are inert.
+
+_SEED_HEADER_VARS = ("h", "h2", "hdr", "header", "sub")
+_SEED_HEADER_KWARGS = ("extras", "header")
+_SEED_DYN_PREFIXES = ("gcs.dispatch.",)
+
+_SEED_WIRE_FLAGS: Dict[str, dict] = {
+    "sp": {
+        "direction": "submitter -> executor",
+        "desc": "pre-framed spec template: frame 0 is the interned spec "
+                "blob, per-call header carries only deltas (SpecCache "
+                "decodes each distinct blob once)",
+        "waive": None,
+    },
+    "fb": {
+        "direction": "submitter -> executor",
+        "desc": "function-blob piggyback: the cloudpickle blob rides the "
+                "first push_task carrying that fkey to each peer "
+                "(FnPushLedger); the peer installs it without a kv_get",
+        "waive": None,
+    },
+    "bh": {
+        "direction": "executor -> submitter (reply)",
+        "desc": "coalesced multi-result frame: list of sub-reply headers, "
+                "each carrying its request's corr id under 'i' plus "
+                "per-item rets/e/ec",
+        "waive": None,
+    },
+    "bn": {
+        "direction": "executor -> submitter (reply)",
+        "desc": "frames-per-sub counts into the flat frame list "
+                "(zipped with bh)",
+        "waive": None,
+    },
+    "wa": {
+        "direction": "executor -> submitter (reply, TCP only)",
+        "desc": "window-ack request: the receiving pump answers a "
+                "wa-tagged frame with a oneway mrack that clocks the "
+                "sender's next ReplyWindow flush",
+        "waive": None,
+    },
+    "an": {
+        "direction": "submitter -> executor",
+        "desc": "per-arg frame sections: intern-worthy args get their own "
+                "serialized frames after the skeleton tuple; an lists "
+                "each section's frame count",
+        "waive": None,
+    },
+    "ai": {
+        "direction": "submitter -> executor",
+        "desc": "interned-arg references [[pos, digest]...]: these frames "
+                "are OMITTED from the wire; the executor re-inserts exact "
+                "bytes from its LRU or raises the typed arg_intern_miss",
+        "waive": None,
+    },
+    "aib": {
+        "direction": "submitter -> executor",
+        "desc": "intern requests [[pos, digest]...] for frames PRESENT on "
+                "this wire; the executor stores them under their digest "
+                "for the pushes behind this one",
+        "waive": None,
+    },
+    "_fr": {
+        "direction": "transport -> consumer (in-band stamp)",
+        "desc": "frame-arrival monotonic stamp set by the TCP recv loop / "
+                "ring pump; pump-queue attribution and deadline re-arm "
+                "read it (never serialized back out)",
+        "waive": None,
+    },
+    "_tq": {
+        "direction": "submitter (in-band stamp)",
+        "desc": "queued-at stamp set at submission enqueue and popped "
+                "before the wire; queue-wait attribution reads it",
+        "waive": None,
+    },
+}
+
+_SEED_WIRE_BASE = frozenset({
+    "aid", "bm", "cg", "corr", "e", "ec", "fid", "fkey", "i", "m",
+    "name", "nret", "oids", "r", "renv", "seq", "tid",
+})
+
+_SEED_FAULTPOINT_WAIVES: Dict[str, dict] = {
+    "devstore.reshard": {"matrixed": False, "waive":
+        "consumer-side reshard fallback after a sharding mismatch; "
+        "exercised directly by tests/test_devstore.py unit specs"},
+    "gcs.pubsub.publish": {"matrixed": False, "waive":
+        "pubsub is best-effort with subscriber poll fallback; a matrix "
+        "drop only slows convergence, asserted in targeted pubsub tests"},
+    "protocol.rpc.read": {"matrixed": False, "waive":
+        "reader-side corruption tears the connection down; ConnectionLost "
+        "recovery is covered by transport unit tests, and a matrix drop "
+        "here kills the whole pipe rather than one verb"},
+    "ring.push": {"matrixed": False, "waive":
+        "ring transport loss is matrixed end-to-end via "
+        "worker.task.push/worker.reply.window deadline-replay specs; the "
+        "raw ring point is exercised by tests/test_ring unit specs"},
+    "ring.pop": {"matrixed": False, "waive":
+        "see ring.push — pump-side loss rides the same deadline-replay "
+        "matrix coverage; raw point exercised by ring unit tests"},
+    "serve.proxy.route": {"matrixed": False, "waive":
+        "serve chaos matrix injects at the replica boundary "
+        "(serve.replica.call/stream); proxy route errors are asserted "
+        "directly in tests/test_serve resilience cases"},
+    "worker.dispatch.retry": {"matrixed": False, "waive":
+        "the point exists to force the dispatch retry path "
+        "deterministically in targeted tests; matrixing it would only "
+        "re-test the retry loop the other specs already traverse"},
+}

@@ -2,7 +2,10 @@
 into the main CLI as ``raytpu lint``).
 
 Exit code 0 = clean, 1 = findings, 2 = usage error. ``--json`` emits a
-machine-readable finding list for dashboard ingestion.
+machine-readable finding list (each record carries a ``family`` field so
+dashboards can filter) for ingestion. ``--regen`` rewrites
+``lint/catalog.py`` from the tree (see ``catalog_gen.py``); on a clean
+tree it is a no-op.
 """
 from __future__ import annotations
 
@@ -11,7 +14,16 @@ import json
 import sys
 from typing import List, Optional, Sequence
 
-from ray_tpu.lint.base import RULES, lint_paths
+from ray_tpu.lint.base import all_rules, lint_paths
+
+_FAMILY_TITLES = (
+    ("A", "user code (decoration-time gate, RAY_TPU_LINT=1)"),
+    ("B", "framework thread+lock discipline (_private/, serve/, "
+          "--framework)"),
+    ("C", "asyncio/thread concurrency hazards (same scope as B)"),
+    ("D", "protocol invariants vs lint/catalog.py (project-scope: "
+          "directory scans and --select RT4)"),
+)
 
 
 def run(paths: Sequence[str], json_out: bool = False,
@@ -31,34 +43,60 @@ def run(paths: Sequence[str], json_out: bool = False,
     return 1 if findings else 0
 
 
+def list_rules(stream=None) -> int:
+    stream = stream if stream is not None else sys.stdout
+    rules = all_rules()
+    for family, title in _FAMILY_TITLES:
+        fam_rules = sorted(
+            (r for r in rules.values() if r.family == family),
+            key=lambda r: r.rule_id,
+        )
+        if not fam_rules:
+            continue
+        print(f"Family {family} — {title}", file=stream)
+        for rule in fam_rules:
+            print(f"  {rule.rule_id}  {rule.summary}", file=stream)
+        print("", file=stream)
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="python -m ray_tpu.lint",
         description="AST-based distributed-correctness analyzer "
-                    "(rules RT1xx: user code, RT2xx: framework)",
+                    "(RT1xx: user code, RT2xx: framework locks, "
+                    "RT3xx: concurrency, RT4xx: protocol invariants)",
     )
     p.add_argument("paths", nargs="*", help="files or directories to lint")
     p.add_argument("--json", action="store_true", dest="json_out",
-                   help="emit findings as JSON")
+                   help="emit findings as JSON (records carry a 'family' "
+                        "field)")
     p.add_argument("--framework", action="store_true",
-                   help="run Family B (framework) rules on every file, "
-                        "not just ray_tpu/_private/")
+                   help="run Families B+C (framework) rules on every "
+                        "file, not just ray_tpu/_private/ and serve/")
     p.add_argument("--select", default=None,
                    help="comma-separated rule-id prefixes to run "
-                        "(e.g. RT2 or RT101,RT203)")
+                        "(e.g. RT2 or RT101,RT203 or RT2,RT3,RT4)")
     p.add_argument("--list-rules", action="store_true",
-                   help="print the rule registry and exit")
+                   help="print the rule registry grouped by family and "
+                        "exit")
+    p.add_argument("--regen", action="store_true",
+                   help="regenerate lint/catalog.py from the tree "
+                        "(derived sections rebuild, waivers carry over; "
+                        "no-op on a clean tree)")
     return p
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     if args.list_rules:
-        # Ensure the registry is populated.
-        from ray_tpu.lint import framework_rules, user_rules  # noqa: F401
+        return list_rules()
+    if args.regen:
+        from ray_tpu.lint import catalog_gen
 
-        for rule in sorted(RULES.values(), key=lambda r: r.rule_id):
-            print(f"{rule.rule_id}  [family {rule.family}]  {rule.summary}")
+        changed = catalog_gen.regen()
+        path = catalog_gen.catalog_path()
+        print(f"{path}: {'regenerated' if changed else 'up to date'}")
         return 0
     if not args.paths:
         build_parser().error("the following arguments are required: paths")

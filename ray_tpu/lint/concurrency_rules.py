@@ -1,0 +1,573 @@
+"""Family C — asyncio/thread concurrency hazards in framework code.
+
+RT301  blocking call inside an ``async def`` (stalls the core loop)
+RT302  event-loop object touched from a thread without *_threadsafe
+RT303  fire-and-forget ``create_task`` with no exception sink
+RT304  ``await`` while holding a sync ``threading.Lock``
+RT305  shared attribute written from both a thread and a coroutine
+       with no lock on either path (best-effort, tuned for low noise)
+
+The driver's core event loop shares submission, reply settling and
+bookkeeping (see ROADMAP "driver loop" item); these rules encode the
+defect classes that machine actually produces: a ``time.sleep`` or
+no-timeout ``Future.result()`` in a coroutine stalls every in-flight
+task at once (RT301); ``loop.create_task`` from the ring pump thread
+corrupts the loop's ready queue (RT302, asyncio's documented
+thread-unsafety); a dropped ``create_task`` handle swallows its
+exception forever (RT303, use ``_private.asyncio_util.spawn_logged``);
+an ``await`` under a sync lock deadlocks against executor threads that
+want the same lock (RT304).
+
+Deliberate executor-thread coroutine helpers can be allowlisted with an
+``@executor_thread``-style decorator (any decorator whose name contains
+``executor_thread``) or a ``# raytpu: executor-thread`` comment on the
+``def`` line; per-line ``# raytpu: ignore[RULE]`` works as everywhere.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu.lint.base import (
+    FAMILY_CONCURRENCY,
+    Finding,
+    ModuleContext,
+    dotted,
+    register,
+    terminal_name,
+)
+
+# ------------------------------------------------------------------ RT301
+
+# Dotted call targets that block the calling thread outright.
+_BLOCKING_DOTTED = {
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "select.select", "os.waitpid",
+    "socket.create_connection",
+}
+# Attribute calls that block regardless of receiver (socket I/O,
+# subprocess handshakes). Generic names (.send/.join/.read) stay out.
+_BLOCKING_ATTRS = {
+    "recv", "recvfrom", "recv_into", "accept", "sendall", "communicate",
+}
+
+_EXECUTOR_MARK = "raytpu: executor-thread"
+
+
+def _is_executor_allowlisted(ctx: ModuleContext, fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = terminal_name(target) or ""
+        if "executor_thread" in name:
+            return True
+    line = getattr(fn, "lineno", 0)
+    if 1 <= line <= len(ctx.lines):
+        if _EXECUTOR_MARK in ctx.lines[line - 1]:
+            return True
+    return False
+
+
+def _queueish(node: ast.AST) -> bool:
+    name = (terminal_name(node) or "").lower()
+    return name in ("q",) or "queue" in name
+
+
+class _AsyncBlockWalker(ast.NodeVisitor):
+    """RT301: blocking sync calls lexically inside async defs.
+
+    Awaited calls are fine by construction (``await q.get()`` parks the
+    coroutine, not the loop); ``fut.result()`` guarded by a
+    ``fut.done()`` test in an enclosing ``if`` is a completed-future
+    fast path, not a block.
+    """
+
+    def __init__(self, ctx: ModuleContext):
+        self.ctx = ctx
+        self.findings: List[Finding] = []
+        self._async_depth = 0
+        self._tests: List[ast.expr] = []  # enclosing if/while conditions
+        self._awaited: Set[int] = set()   # id() of calls under an Await
+
+    def visit_FunctionDef(self, node):
+        # A sync def nested in a coroutine runs wherever it is called
+        # (often an executor thread) — out of scope here.
+        depth, self._async_depth = self._async_depth, 0
+        self.generic_visit(node)
+        self._async_depth = depth
+
+    def visit_AsyncFunctionDef(self, node):
+        if _is_executor_allowlisted(self.ctx, node):
+            return
+        self._async_depth += 1
+        self.generic_visit(node)
+        self._async_depth -= 1
+
+    def visit_Await(self, node):
+        if isinstance(node.value, ast.Call):
+            self._awaited.add(id(node.value))
+        self.generic_visit(node)
+
+    def _visit_test_body(self, node):
+        self._tests.append(node.test)
+        self.generic_visit(node)
+        self._tests.pop()
+
+    visit_If = _visit_test_body
+    visit_While = _visit_test_body
+
+    def _done_guarded(self, recv: Optional[str]) -> bool:
+        if not recv:
+            return False
+        for test in self._tests:
+            for sub in ast.walk(test):
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr == "done"
+                        and dotted(sub.func.value) == recv):
+                    return True
+        return False
+
+    def _blocking_desc(self, call: ast.Call) -> Optional[str]:
+        if self.ctx.is_time_sleep(call):
+            return "time.sleep()"
+        name = dotted(call.func)
+        if name in _BLOCKING_DOTTED:
+            return f"{name}()"
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        attr = call.func.attr
+        if attr in _BLOCKING_ATTRS:
+            return f".{attr}()"
+        has_timeout = any(k.arg == "timeout" for k in call.keywords)
+        if attr == "result" and not call.args and not has_timeout:
+            if self._done_guarded(dotted(call.func.value)):
+                return None
+            return ".result() with no timeout"
+        if (attr == "get" and not call.args and not has_timeout
+                and _queueish(call.func.value)):
+            return ".get() with no timeout"
+        return None
+
+    def visit_Call(self, node):
+        if self._async_depth and id(node) not in self._awaited:
+            desc = self._blocking_desc(node)
+            if desc is not None:
+                self.findings.append(Finding(
+                    "RT301",
+                    f"blocking {desc} inside an async def — the call "
+                    "stalls the whole event loop (every in-flight "
+                    "task/reply on it), not just this coroutine; await "
+                    "the async form, add a timeout, or move the work to "
+                    "run_in_executor (mark deliberate executor-thread "
+                    f"helpers with '# {_EXECUTOR_MARK}')",
+                    self.ctx.filename, node.lineno, node.col_offset,
+                ))
+        self.generic_visit(node)
+
+
+@register("RT301", FAMILY_CONCURRENCY,
+          "blocking call inside an async def stalls the event loop")
+def check_async_blocking(ctx: ModuleContext) -> List[Finding]:
+    walker = _AsyncBlockWalker(ctx)
+    walker.visit(ctx.tree)
+    return walker.findings
+
+
+# ------------------------------------------------------- thread reachability
+
+def _local_functions(tree) -> Dict[Tuple[Optional[str], str], ast.AST]:
+    """(class or None, name) -> def node, for module-level and one-level
+    class-nested functions (the shapes this codebase uses)."""
+    out: Dict[Tuple[Optional[str], str], ast.AST] = {}
+
+    def add(node, cls):
+        out[(cls, node.name)] = node
+
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            add(node, None)
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    add(sub, node.name)
+    return out
+
+
+def _callable_ref_name(node: ast.AST) -> Optional[str]:
+    """``self._pump`` / ``_spawn`` / ``conn.close`` -> terminal name."""
+    return terminal_name(node)
+
+
+_THREADSAFE_BRIDGES = {"call_soon_threadsafe", "run_coroutine_threadsafe"}
+
+
+class _ThreadEntryCollector(ast.NodeVisitor):
+    """Find function names that run on non-loop threads: passed as
+    ``threading.Thread(target=...)``, executor ``.submit(fn)``, or
+    ``loop.run_in_executor(None, fn)`` — plus locally-defined callables
+    those functions call (one same-module transitive closure)."""
+
+    def __init__(self, tree):
+        self.tree = tree
+        self.entry_names: Set[str] = set()
+
+    def visit_Call(self, node):
+        fn = node.func
+        attr = fn.attr if isinstance(fn, ast.Attribute) else None
+        name = dotted(fn) or ""
+        if name.endswith("Thread") or attr == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    ref = _callable_ref_name(kw.value)
+                    if ref:
+                        self.entry_names.add(ref)
+        elif attr == "submit" and node.args:
+            ref = _callable_ref_name(node.args[0])
+            if ref:
+                self.entry_names.add(ref)
+        elif attr == "run_in_executor" and len(node.args) >= 2:
+            ref = _callable_ref_name(node.args[1])
+            if ref:
+                self.entry_names.add(ref)
+        self.generic_visit(node)
+
+
+def _loop_side(name: str) -> bool:
+    """Naming convention: ``*_from_loop`` / ``*_on_loop`` helpers are
+    declared loop-thread-only (their callers carry the runtime
+    ``get_running_loop() is loop`` dispatch the AST cannot see)."""
+    return name.endswith("_from_loop") or name.endswith("_on_loop")
+
+
+def _thread_reachable(ctx: ModuleContext) -> Set[Tuple[Optional[str], str]]:
+    """Keys of ``_local_functions`` reachable from a thread entry point
+    without crossing a *_threadsafe bridge (cached per module).
+
+    ``async def``s are excluded on both ends: a coroutine function
+    passed to a thread would never run its body there, and the bodies
+    execute on whichever loop awaits them.
+    """
+    cached = getattr(ctx, "_thread_reachable", None)
+    if cached is not None:
+        return cached
+    funcs = _local_functions(ctx.tree)
+    collector = _ThreadEntryCollector(ctx.tree)
+    collector.visit(ctx.tree)
+
+    def eligible(key) -> bool:
+        return (not isinstance(funcs[key], ast.AsyncFunctionDef)
+                and not _loop_side(key[1]))
+
+    # Seed: every def whose name was used as a thread/executor target.
+    work = [k for k in funcs
+            if k[1] in collector.entry_names and eligible(k)]
+    seen: Set[Tuple[Optional[str], str]] = set(work)
+    while work:
+        cls, name = work.pop()
+        node = funcs[(cls, name)]
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            fn = sub.func
+            # Crossing call_soon_threadsafe(...) re-enters the loop
+            # thread; callables referenced in its args are safe.
+            if (isinstance(fn, ast.Attribute)
+                    and fn.attr in _THREADSAFE_BRIDGES):
+                continue
+            callee = _callable_ref_name(fn)
+            if not callee:
+                continue
+            for key in ((cls, callee), (None, callee)):
+                if key in funcs and key not in seen and eligible(key):
+                    seen.add(key)
+                    work.append(key)
+    ctx._thread_reachable = seen
+    return seen
+
+
+def _in_threadsafe_lambda(stack: List[ast.AST]) -> bool:
+    """Is the innermost frame a lambda/def passed to a *_threadsafe
+    bridge (so it executes on the loop thread after all)?"""
+    for node in stack:
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _THREADSAFE_BRIDGES):
+            return True
+    return False
+
+
+_LOOP_TOUCH_ATTRS = {"create_task", "call_soon", "call_later", "call_at",
+                     "stop"}
+
+
+class _LoopTouchWalker(ast.NodeVisitor):
+    """RT302: direct loop manipulation in thread-reachable functions."""
+
+    def __init__(self, ctx: ModuleContext):
+        self.ctx = ctx
+        self.findings: List[Finding] = []
+        self._reach = _thread_reachable(ctx)
+        self._class: Optional[str] = None
+        # Stack of "the code here runs on a thread" booleans, one per
+        # enclosing def/lambda. Nested defs and lambdas are deferred
+        # callbacks whose execution context the AST cannot prove, so
+        # they reset to False (best-effort, no false positives).
+        self._frames: List[bool] = []
+        self._stack: List[ast.AST] = []
+
+    @property
+    def _active(self) -> bool:
+        return bool(self._frames and self._frames[-1])
+
+    def visit_ClassDef(self, node):
+        prev, self._class = self._class, node.name
+        self.generic_visit(node)
+        self._class = prev
+
+    def _visit_fn(self, node):
+        key = (self._class, node.name)
+        alt = (None, node.name)
+        nested = bool(self._frames)
+        active = (not nested and (key in self._reach
+                                  or alt in self._reach))
+        self._frames.append(active)
+        self.generic_visit(node)
+        self._frames.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def visit_Lambda(self, node):
+        self._frames.append(False)
+        self.generic_visit(node)
+        self._frames.pop()
+
+    def visit_Call(self, node):
+        self._stack.append(node)
+        try:
+            if self._active and not _in_threadsafe_lambda(self._stack):
+                fn = node.func
+                attr = fn.attr if isinstance(fn, ast.Attribute) else None
+                recv = (terminal_name(fn.value) or "" if
+                        isinstance(fn, ast.Attribute) else "")
+                loopish = "loop" in recv.lower()
+                ensure = dotted(fn) in ("asyncio.ensure_future",)
+                if ensure or (attr == "create_task") or (
+                        loopish and attr in _LOOP_TOUCH_ATTRS):
+                    what = dotted(fn) or f".{attr}"
+                    self.findings.append(Finding(
+                        "RT302",
+                        f"{what}() from a function reachable from a "
+                        "thread entry point (Thread target / executor "
+                        "submit) — asyncio loops are not thread-safe; "
+                        "hop through loop.call_soon_threadsafe(...) or "
+                        "asyncio.run_coroutine_threadsafe(...) instead",
+                        self.ctx.filename, node.lineno, node.col_offset,
+                    ))
+            self.generic_visit(node)
+        finally:
+            self._stack.pop()
+
+
+@register("RT302", FAMILY_CONCURRENCY,
+          "event-loop object touched from a non-loop thread")
+def check_loop_from_thread(ctx: ModuleContext) -> List[Finding]:
+    walker = _LoopTouchWalker(ctx)
+    walker.visit(ctx.tree)
+    return walker.findings
+
+
+# ------------------------------------------------------------------ RT303
+
+def _is_spawn_call(node: ast.Call) -> bool:
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and fn.attr in ("create_task",
+                                                     "ensure_future"):
+        return True
+    return False
+
+
+@register("RT303", FAMILY_CONCURRENCY,
+          "fire-and-forget create_task with no exception sink")
+def check_dropped_task(ctx: ModuleContext) -> List[Finding]:
+    findings = []
+    for node in ast.walk(ctx.tree):
+        call = None
+        if (isinstance(node, ast.Expr) and isinstance(node.value, ast.Call)
+                and _is_spawn_call(node.value)):
+            call = node.value
+        elif (isinstance(node, ast.Lambda)
+                and isinstance(node.body, ast.Call)
+                and _is_spawn_call(node.body)):
+            # ``lambda: loop.create_task(...)`` handed to call_soon* —
+            # the callback's return value is dropped just the same.
+            call = node.body
+        if call is None:
+            continue
+        findings.append(Finding(
+            "RT303",
+            "task handle dropped — if the coroutine raises, the "
+            "exception is swallowed until interpreter shutdown (or "
+            "forever); use _private.asyncio_util.spawn_logged(...) "
+            "which attaches an exception-logging done callback, or "
+            "store/await/gather the handle",
+            ctx.filename, call.lineno, call.col_offset,
+        ))
+    return findings
+
+
+# ------------------------------------------------------------------ RT304
+
+def _is_lock_expr(node: ast.AST) -> bool:
+    name = terminal_name(node)
+    return name is not None and "lock" in name.lower()
+
+
+class _AwaitUnderLockWalker(ast.NodeVisitor):
+    """RT304: ``await`` inside a *sync* ``with <lock>``. The coroutine
+    parks mid-critical-section holding a threading.Lock; any executor
+    thread contending on it blocks until the loop resumes this
+    coroutine — which may itself need that executor. ``async with``
+    (asyncio locks) parks only coroutines and is fine."""
+
+    def __init__(self, ctx: ModuleContext):
+        self.ctx = ctx
+        self.findings: List[Finding] = []
+        self._held: List[str] = []
+
+    def _visit_fn(self, node):
+        held, self._held = self._held, []
+        self.generic_visit(node)
+        self._held = held
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def visit_With(self, node):
+        locks = [terminal_name(item.context_expr) or "lock"
+                 for item in node.items
+                 if _is_lock_expr(item.context_expr)]
+        self._held.extend(locks)
+        self.generic_visit(node)
+        if locks:
+            del self._held[len(self._held) - len(locks):]
+
+    def visit_AsyncWith(self, node):
+        # asyncio locks: not a thread hazard; do not track, do descend.
+        self.generic_visit(node)
+
+    def visit_Await(self, node):
+        if self._held:
+            self.findings.append(Finding(
+                "RT304",
+                f"await while holding sync lock '{self._held[-1]}' — "
+                "the coroutine parks with the threading.Lock held and "
+                "every executor thread contending on it stalls "
+                "(deadlock if resuming needs that executor); release "
+                "before awaiting or switch to asyncio.Lock",
+                self.ctx.filename, node.lineno, node.col_offset,
+            ))
+        self.generic_visit(node)
+
+
+@register("RT304", FAMILY_CONCURRENCY,
+          "await while holding a sync threading.Lock")
+def check_await_under_lock(ctx: ModuleContext) -> List[Finding]:
+    walker = _AwaitUnderLockWalker(ctx)
+    walker.visit(ctx.tree)
+    return walker.findings
+
+
+# ------------------------------------------------------------------ RT305
+
+class _AttrWriteCollector(ast.NodeVisitor):
+    """Per class: ``self.X = ...`` / ``self.X += ...`` sites, tagged
+    with the enclosing function and whether a lock was lexically held."""
+
+    def __init__(self):
+        # class -> attr -> list of (fn_name, is_async_fn, under_lock,
+        #                           line, col)
+        self.writes: Dict[str, Dict[str, List[tuple]]] = {}
+        self._class: Optional[str] = None
+        self._fn: Optional[tuple] = None  # (name, is_async)
+        self._lock_depth = 0
+
+    def visit_ClassDef(self, node):
+        prev, self._class = self._class, node.name
+        self.generic_visit(node)
+        self._class = prev
+
+    def _visit_fn(self, node, is_async):
+        prev, self._fn = self._fn, (node.name, is_async)
+        self.generic_visit(node)
+        self._fn = prev
+
+    def visit_FunctionDef(self, node):
+        self._visit_fn(node, False)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._visit_fn(node, True)
+
+    def _visit_with(self, node):
+        locks = sum(1 for item in node.items
+                    if _is_lock_expr(item.context_expr))
+        self._lock_depth += locks
+        self.generic_visit(node)
+        self._lock_depth -= locks
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+    def _record(self, target):
+        if (self._class and self._fn
+                and isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"):
+            name, is_async = self._fn
+            if name == "__init__" or "lock" in target.attr.lower():
+                return
+            self.writes.setdefault(self._class, {}).setdefault(
+                target.attr, []
+            ).append((name, is_async, self._lock_depth > 0,
+                      target.lineno, target.col_offset))
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._record(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._record(node.target)
+        self.generic_visit(node)
+
+
+@register("RT305", FAMILY_CONCURRENCY,
+          "shared attribute written from both a thread and a coroutine "
+          "without a lock")
+def check_unlocked_shared_write(ctx: ModuleContext) -> List[Finding]:
+    reach = _thread_reachable(ctx)
+    thread_fns = {name for cls, name in reach}
+    collector = _AttrWriteCollector()
+    collector.visit(ctx.tree)
+    findings = []
+    for cls, attrs in collector.writes.items():
+        for attr, sites in attrs.items():
+            thread_sites = [s for s in sites
+                            if s[0] in thread_fns and not s[1]]
+            coro_sites = [s for s in sites if s[1]]
+            if not thread_sites or not coro_sites:
+                continue
+            if any(s[2] for s in thread_sites + coro_sites):
+                continue  # at least one side synchronizes; best-effort
+            fn_t, _, _, line, col = thread_sites[0]
+            fn_c = coro_sites[0][0]
+            findings.append(Finding(
+                "RT305",
+                f"self.{attr} written from thread-reachable "
+                f"'{fn_t}' and coroutine '{fn_c}' with no lock on "
+                "either path — torn/lost updates under the GIL's "
+                "bytecode-boundary interleaving; guard both writes "
+                "with one lock or confine the attribute to one side",
+                ctx.filename, line, col,
+            ))
+    return findings

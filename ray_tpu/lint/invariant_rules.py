@@ -1,0 +1,510 @@
+"""Family D — protocol/config/chaos/phase invariants vs lint/catalog.py.
+
+RT401  wire-flag asymmetry (packed without a receiver branch, consumed
+       without a sender, or packed outside the pinned catalog)
+RT402  config-gate drift (catalog vs rt_config declarations; a gate
+       that is never read or never branched on is dead weight)
+RT403  faultpoint drift (fire site not in the catalog; a cataloged
+       point that is neither chaos-matrixed nor waived)
+RT404  taskpath phase drift (span stage / phase label outside the
+       pinned tables; catalog PHASES != taskpath.PHASES)
+
+These are *project-scope* rules (``base.PROJECT_RULES``): a flag packed
+in ``worker.py`` is satisfied by its receiver branch in ``protocol.py``,
+so they run over every module of one lint invocation at once. Absence
+findings (a catalog entry with no site anywhere) only fire on
+``complete`` scans — a whole-directory pass — never on single-file or
+fixture scans, which can only prove asymmetries among the sites they
+can see.
+
+Wire-site heuristics (kept deliberately name-based, like the Family B
+lock rules): a *pack* is a short string key written into a dict bound
+to a ``HEADER_VARS`` name (subscript store, ``setdefault``, or a dict
+literal assigned to such a name / passed via a ``HEADER_KWARGS``
+keyword); a *consume* is ``.get``/``.pop``/``in``/subscript-load on the
+same names. Keys longer than 4 chars are verb-payload fields, not the
+compact task-wire flag namespace, and stay out of scope.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu.lint import catalog
+from ray_tpu.lint.base import (
+    FAMILY_PROTOCOL,
+    Finding,
+    ModuleContext,
+    ProjectContext,
+    _is_framework_path,
+    dotted,
+    register_project,
+    terminal_name,
+)
+
+_SHORT_KEY_RE = re.compile(r"^_?[a-z][a-z0-9_]{0,3}$")
+
+Site = Tuple[str, int, int]  # (file, line, col)
+
+
+def _catalog_file() -> str:
+    try:
+        path = os.path.abspath(catalog.__file__)
+        rel = os.path.relpath(path)
+        return rel if not rel.startswith("..") else path
+    except (AttributeError, ValueError):
+        return "ray_tpu/lint/catalog.py"
+
+
+def _absence(rule: str, message: str) -> Finding:
+    """A finding with no code site (catalog entry matched nothing)."""
+    return Finding(rule, message, _catalog_file(), 1, 0)
+
+
+# ------------------------------------------------------------- wire scan
+
+def _is_header_name(node: ast.AST) -> bool:
+    t = terminal_name(node)
+    return t is not None and t in catalog.HEADER_VARS
+
+
+def _wire_sites(pctx: ProjectContext) -> Tuple[Dict[str, List[Site]],
+                                               Dict[str, List[Site]]]:
+    cached = getattr(pctx, "_wire_sites", None)
+    if cached is not None:
+        return cached
+    packs: Dict[str, List[Site]] = {}
+    consumes: Dict[str, List[Site]] = {}
+
+    def pack(key, node, f):
+        packs.setdefault(key, []).append((f, node.lineno, node.col_offset))
+
+    def consume(key, node, f):
+        consumes.setdefault(key, []).append(
+            (f, node.lineno, node.col_offset))
+
+    for mod in pctx.modules:
+        # The task wire lives in the framework core; on complete scans
+        # skip user-facing trees where short dict keys are unrelated.
+        if pctx.complete and not _is_framework_path(mod.filename):
+            continue
+        f = mod.filename
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if (isinstance(t, ast.Subscript)
+                            and _is_header_name(t.value)
+                            and isinstance(t.slice, ast.Constant)
+                            and isinstance(t.slice.value, str)):
+                        pack(t.slice.value, t, f)
+                    elif (isinstance(t, (ast.Name, ast.Attribute))
+                            and _is_header_name(t)
+                            and isinstance(node.value, ast.Dict)):
+                        for k in node.value.keys:
+                            if (isinstance(k, ast.Constant)
+                                    and isinstance(k.value, str)):
+                                pack(k.value, k, f)
+            elif isinstance(node, ast.Call):
+                fn = node.func
+                if (isinstance(fn, ast.Attribute)
+                        and _is_header_name(fn.value) and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    key = node.args[0].value
+                    if fn.attr == "setdefault":
+                        pack(key, node, f)
+                    elif fn.attr in ("get", "pop"):
+                        consume(key, node, f)
+                for kw in node.keywords:
+                    if kw.arg in catalog.HEADER_KWARGS:
+                        for sub in ast.walk(kw.value):
+                            if isinstance(sub, ast.Dict):
+                                for k in sub.keys:
+                                    if (isinstance(k, ast.Constant)
+                                            and isinstance(k.value, str)):
+                                        pack(k.value, k, f)
+            elif (isinstance(node, ast.Compare)
+                    and isinstance(node.left, ast.Constant)
+                    and isinstance(node.left.value, str)
+                    and len(node.ops) == 1
+                    and isinstance(node.ops[0], (ast.In, ast.NotIn))
+                    and node.comparators
+                    and _is_header_name(node.comparators[0])):
+                consume(node.left.value, node, f)
+            elif (isinstance(node, ast.Subscript)
+                    and isinstance(node.ctx, ast.Load)
+                    and _is_header_name(node.value)
+                    and isinstance(node.slice, ast.Constant)
+                    and isinstance(node.slice.value, str)):
+                consume(node.slice.value, node, f)
+    pctx._wire_sites = (packs, consumes)
+    return packs, consumes
+
+
+@register_project("RT401", FAMILY_PROTOCOL,
+                  "wire-flag asymmetry vs the pinned catalog")
+def check_wire_flags(pctx: ProjectContext) -> List[Finding]:
+    packs, consumes = _wire_sites(pctx)
+    findings: List[Finding] = []
+    for key, entry in catalog.WIRE_FLAGS.items():
+        if entry.get("waive"):
+            continue
+        p, c = packs.get(key), consumes.get(key)
+        if p and not c:
+            f, line, col = p[0]
+            findings.append(Finding(
+                "RT401",
+                f"wire flag '{key}' is packed here but no receiver "
+                "branch consumes it in the scanned set — the bytes ride "
+                "every frame for nothing, or the receiver silently "
+                "ignores a behavior the sender thinks it negotiated; "
+                "add the consume branch or retire the flag from "
+                "lint/catalog.py WIRE_FLAGS",
+                f, line, col,
+            ))
+        elif c and not p:
+            f, line, col = c[0]
+            findings.append(Finding(
+                "RT401",
+                f"wire flag '{key}' is consumed here but never packed "
+                "by any sender in the scanned set — dead receiver "
+                "branch, or the sender side lost the flag in a "
+                "refactor; restore the pack site or retire the flag "
+                "from lint/catalog.py WIRE_FLAGS",
+                f, line, col,
+            ))
+        elif not p and not c and pctx.complete:
+            findings.append(_absence(
+                "RT401",
+                f"cataloged wire flag '{key}' has no pack or consume "
+                "site anywhere in the tree — stale catalog entry; "
+                "remove it (or waive with a reason) in lint/catalog.py",
+            ))
+    known = set(catalog.WIRE_FLAGS) | set(catalog.WIRE_BASE)
+    for key, sites in sorted(packs.items()):
+        if key in known or not _SHORT_KEY_RE.match(key):
+            continue
+        f, line, col = sites[0]
+        findings.append(Finding(
+            "RT401",
+            f"header key '{key}' is packed onto the wire but absent "
+            "from lint/catalog.py (WIRE_FLAGS/WIRE_BASE) — every wire "
+            "key must be pinned so senders and receivers cannot drift; "
+            "catalog it with direction + description",
+            f, line, col,
+        ))
+    return findings
+
+
+# ------------------------------------------------------------- gate scan
+
+def _rtconfig_aliases(mod: ModuleContext) -> Set[str]:
+    names = {"rt_config"}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name == "rt_config" and alias.asname:
+                    names.add(alias.asname)
+    return names
+
+
+_COERCIONS = {"bool", "int", "float", "str"}
+_BRANCH_NODES = (ast.BoolOp, ast.UnaryOp, ast.Compare, ast.IfExp)
+
+
+def _parents(mod: ModuleContext) -> Dict[int, ast.AST]:
+    cached = getattr(mod, "_parent_map", None)
+    if cached is None:
+        cached = {}
+        for node in ast.walk(mod.tree):
+            for child in ast.iter_child_nodes(node):
+                cached[id(child)] = node
+        mod._parent_map = cached
+    return cached
+
+
+def _read_context(mod: ModuleContext, node: ast.AST) -> str:
+    """'branch' | 'assign' | 'return' | 'other' for a gate read site."""
+    parents = _parents(mod)
+    cur = node
+    while True:
+        parent = parents.get(id(cur))
+        if parent is None or isinstance(
+                parent, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.Module)):
+            return "other"
+        if isinstance(parent, (ast.If, ast.While)) and cur is parent.test:
+            return "branch"
+        if isinstance(parent, ast.Assert) and cur is parent.test:
+            return "branch"
+        if isinstance(parent, _BRANCH_NODES):
+            return "branch"
+        if isinstance(parent, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                               ast.NamedExpr)):
+            return "assign"
+        if isinstance(parent, ast.Return):
+            return "return"
+        if isinstance(parent, ast.Call):
+            fname = parent.func.id if isinstance(parent.func, ast.Name) \
+                else None
+            if fname not in _COERCIONS:
+                return "other"
+        cur = parent
+
+
+def _gate_sites(pctx: ProjectContext):
+    cached = getattr(pctx, "_gate_sites", None)
+    if cached is not None:
+        return cached
+    reads: Dict[str, List[Tuple[Site, str]]] = {}
+    declared_on: Dict[str, Site] = {}
+    declared: Set[str] = set()
+    for mod in pctx.modules:
+        aliases = _rtconfig_aliases(mod)
+        f = mod.filename
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Attribute)
+                    and isinstance(node.ctx, ast.Load)
+                    and terminal_name(node.value) in aliases
+                    and node.attr in catalog.GATES):
+                reads.setdefault(node.attr, []).append((
+                    (f, node.lineno, node.col_offset),
+                    _read_context(mod, node),
+                ))
+            elif isinstance(node, ast.Call):
+                fn = node.func
+                if not isinstance(fn, ast.Attribute):
+                    continue
+                if (fn.attr == "get" and terminal_name(fn.value) in aliases
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and node.args[0].value in catalog.GATES):
+                    reads.setdefault(node.args[0].value, []).append((
+                        (f, node.lineno, node.col_offset),
+                        _read_context(mod, node),
+                    ))
+                elif (fn.attr == "declare" and len(node.args) >= 3
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    name = node.args[0].value
+                    declared.add(name)
+                    if (isinstance(node.args[1], ast.Name)
+                            and node.args[1].id == "bool"
+                            and isinstance(node.args[2], ast.Constant)
+                            and node.args[2].value is True):
+                        declared_on[name] = (f, node.lineno,
+                                             node.col_offset)
+    pctx._gate_sites = (reads, declared_on, declared)
+    return reads, declared_on, declared
+
+
+@register_project("RT402", FAMILY_PROTOCOL,
+                  "behavior-gate parity vs rt_config declarations")
+def check_gates(pctx: ProjectContext) -> List[Finding]:
+    reads, declared_on, declared = _gate_sites(pctx)
+    findings: List[Finding] = []
+    for gate, entry in catalog.GATES.items():
+        if entry.get("waive"):
+            continue
+        sites = reads.get(gate, [])
+        if sites and not any(kind in ("branch", "assign", "return")
+                             for _s, kind in sites):
+            (f, line, col), _k = sites[0]
+            findings.append(Finding(
+                "RT402",
+                f"gate '{gate}' is read here but never branched on "
+                "(no if/while/ternary test, no assignment a later "
+                "branch could test, no return) — the off-path is "
+                "unreachable, so RT_"
+                f"{gate.upper()}=0 silently does nothing",
+                f, line, col,
+            ))
+        if not pctx.complete:
+            continue
+        if not sites:
+            findings.append(_absence(
+                "RT402",
+                f"cataloged gate '{gate}' is never read anywhere in the "
+                "tree — a default-ON behavior gate nobody consults is "
+                "dead config surface; wire it up or retire it from "
+                "rt_config and lint/catalog.py",
+            ))
+        if declared and gate not in declared_on:
+            findings.append(_absence(
+                "RT402",
+                f"cataloged gate '{gate}' is not declared as a "
+                "default-ON bool in rt_config — catalog/config drift; "
+                "run --regen or fix the declaration",
+            ))
+    if pctx.complete and declared:
+        for gate, (f, line, col) in sorted(declared_on.items()):
+            if gate not in catalog.GATES:
+                findings.append(Finding(
+                    "RT402",
+                    f"default-ON behavior gate '{gate}' declared here "
+                    "is missing from lint/catalog.py GATES — run "
+                    "``python -m ray_tpu.lint --regen`` so the gate "
+                    "catalog cannot drift from the declarations",
+                    f, line, col,
+                ))
+    return findings
+
+
+# -------------------------------------------------------- faultpoint scan
+
+def _fire_sites(pctx: ProjectContext) -> Dict[str, List[Site]]:
+    cached = getattr(pctx, "_fire_sites", None)
+    if cached is not None:
+        return cached
+    sites: Dict[str, List[Site]] = {}
+    for mod in pctx.modules:
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("fire", "async_fire")
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                sites.setdefault(node.args[0].value, []).append(
+                    (mod.filename, node.lineno, node.col_offset))
+    pctx._fire_sites = sites
+    return sites
+
+
+@register_project("RT403", FAMILY_PROTOCOL,
+                  "faultpoint drift vs the chaos catalog")
+def check_faultpoints(pctx: ProjectContext) -> List[Finding]:
+    sites = _fire_sites(pctx)
+    findings: List[Finding] = []
+    for name, locs in sorted(sites.items()):
+        if name in catalog.FAULTPOINTS:
+            continue
+        if any(name.startswith(p) for p in catalog.DYNAMIC_FIRE_PREFIXES):
+            continue
+        f, line, col = locs[0]
+        findings.append(Finding(
+            "RT403",
+            f"faultpoint '{name}' is fired here but absent from "
+            "lint/catalog.py FAULTPOINTS — every injection point must "
+            "be pinned (and chaos-matrixed or waived) so the fire-site "
+            "set and the chaos matrix cannot drift apart; run "
+            "``python -m ray_tpu.lint --regen``",
+            f, line, col,
+        ))
+    if not pctx.complete:
+        return findings
+    for name, entry in catalog.FAULTPOINTS.items():
+        if name not in sites:
+            findings.append(_absence(
+                "RT403",
+                f"cataloged faultpoint '{name}' has no fire site "
+                "anywhere in the tree — stale catalog entry; run "
+                "``python -m ray_tpu.lint --regen``",
+            ))
+        elif not entry.get("matrixed") and not entry.get("waive"):
+            f, line, col = sites[name][0]
+            findings.append(Finding(
+                "RT403",
+                f"faultpoint '{name}' is live but appears in no "
+                "chaos-matrix spec and carries no waiver — the matrix "
+                "can no longer prove the failure path works; add a "
+                "spec to CHAOS_SPECS (tests/test_faultpoints.py) or a "
+                "waive reason in lint/catalog.py",
+                f, line, col,
+            ))
+    return findings
+
+
+# ------------------------------------------------------------- phase scan
+
+def _phase_sites(pctx: ProjectContext):
+    cached = getattr(pctx, "_phase_sites", None)
+    if cached is not None:
+        return cached
+    stages: Dict[str, List[Site]] = {}
+    phases: Dict[str, List[Site]] = {}
+    taskpath_phases: Optional[Tuple[Tuple[str, ...], Site]] = None
+    for mod in pctx.modules:
+        f = mod.filename
+        is_taskpath = os.path.basename(f) == "taskpath.py"
+        for node in ast.walk(mod.tree):
+            if (is_taskpath and isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name) and t.id == "PHASES"
+                            for t in node.targets)
+                    and isinstance(node.value, ast.Tuple)):
+                taskpath_phases = (
+                    tuple(e.value for e in node.value.elts
+                          if isinstance(e, ast.Constant)),
+                    (f, node.lineno, node.col_offset),
+                )
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = (fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else None)
+            lit = (node.args[0].value
+                   if node.args and isinstance(node.args[0], ast.Constant)
+                   and isinstance(node.args[0].value, str) else None)
+            if name == "record_phase" and lit is not None:
+                stages.setdefault(lit, []).append(
+                    (f, node.lineno, node.col_offset))
+            elif (name == "record" and lit is not None
+                    and lit.startswith("task.")
+                    and dotted(fn) == "flight.record"):
+                stages.setdefault(lit[len("task."):], []).append(
+                    (f, node.lineno, node.col_offset))
+            if name in ("record_phase", "observe_phase"):
+                for kw in node.keywords:
+                    if (kw.arg == "phase"
+                            and isinstance(kw.value, ast.Constant)
+                            and isinstance(kw.value.value, str)):
+                        phases.setdefault(kw.value.value, []).append(
+                            (f, kw.value.lineno, kw.value.col_offset))
+    pctx._phase_sites = (stages, phases, taskpath_phases)
+    return stages, phases, taskpath_phases
+
+
+@register_project("RT404", FAMILY_PROTOCOL,
+                  "taskpath phase-catalog drift")
+def check_phases(pctx: ProjectContext) -> List[Finding]:
+    stages, phases, taskpath_phases = _phase_sites(pctx)
+    findings: List[Finding] = []
+    for stage, locs in sorted(stages.items()):
+        if stage in catalog.STAGES:
+            continue
+        f, line, col = locs[0]
+        findings.append(Finding(
+            "RT404",
+            f"taskpath span stage '{stage}' recorded here is absent "
+            "from lint/catalog.py STAGES — the analyzer's "
+            "named+residual==wall decomposition silently lumps unknown "
+            "spans into the residual; run "
+            "``python -m ray_tpu.lint --regen`` and teach "
+            "taskpath.task_breakdown about the new stage",
+            f, line, col,
+        ))
+    for phase, locs in sorted(phases.items()):
+        if phase in catalog.PHASES:
+            continue
+        f, line, col = locs[0]
+        findings.append(Finding(
+            "RT404",
+            f"phase label '{phase}' observed here is absent from the "
+            "pinned PHASES table — rt_task_phase_seconds grows a "
+            "series the breakdown tables will never show; add it to "
+            "taskpath.PHASES and run --regen",
+            f, line, col,
+        ))
+    if pctx.complete and taskpath_phases is not None:
+        table, (f, line, col) = taskpath_phases
+        if table != tuple(catalog.PHASES):
+            findings.append(Finding(
+                "RT404",
+                "taskpath.PHASES and lint/catalog.py PHASES disagree "
+                f"({list(table)} vs {list(catalog.PHASES)}) — run "
+                "``python -m ray_tpu.lint --regen``",
+                f, line, col,
+            ))
+    return findings

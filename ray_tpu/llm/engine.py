@@ -24,6 +24,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ray_tpu._private.backoff import Backoff
 from ray_tpu.llm.config import LLMConfig, load_tokenizer
 
 
@@ -729,6 +730,9 @@ class DecodeEngine:
 
     def _loop(self):
         idle_since = None
+        # Jittered tick: 2ms while work flows (reset below), backing
+        # off to 20ms when idle so the park check isn't a busy spin.
+        tick = Backoff(base=0.002, cap=0.02)
         while not self._stopped:
             try:
                 with self._lock:
@@ -746,6 +750,7 @@ class DecodeEngine:
                 busy = False
             if busy or not self._pending.empty():
                 idle_since = None
+                tick.reset()
                 continue
             if idle_since is None:
                 idle_since = time.monotonic()
@@ -757,7 +762,7 @@ class DecodeEngine:
                         self._loop_thread = None
                         return
                 idle_since = None
-            time.sleep(0.002)
+            tick.sleep()
 
     def shutdown(self):
         self._stopped = True

@@ -24,6 +24,7 @@ import weakref
 from typing import List, Optional
 
 from ray_tpu import native as _native
+from ray_tpu._private.backoff import Backoff as _Backoff
 from ray_tpu._private.object_store import LocalShmStore
 
 logger = logging.getLogger(__name__)
@@ -109,8 +110,9 @@ class NativeArenaStore:
         # its init window (file exists, magic unset → EPROTO/EINVAL) must
         # wait it out, not fall back for the process's whole lifetime.
         deadline = time.monotonic() + 5.0
+        attach_poll = _Backoff(base=0.01, cap=0.1)
         while h < 0 and h != -2 and time.monotonic() < deadline:  # -2=ENOENT
-            time.sleep(0.02)
+            attach_poll.sleep()
             h = lib.rt_arena_attach(name.encode())
         if h < 0:
             raise RuntimeError(f"arena {name}: errno {-h}")

@@ -10,6 +10,7 @@ config.py:24`` ``_JaxBackend``.
 """
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import traceback
@@ -19,6 +20,8 @@ from typing import Any, Callable, Dict, List, Optional
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import JaxConfig, ScalingConfig
 from ray_tpu.train.context import TrainContext, _set_context
+
+logger = logging.getLogger(__name__)
 
 
 class TrainWorker:
@@ -297,5 +300,7 @@ class WorkerGroup:
                     "ns": namespace(self._experiment_name, nonce),
                     "prefix": "",
                 }))
-            except Exception:
-                pass
+            except Exception as e:
+                # Cleanup of a finished experiment's rendezvous keys is
+                # best-effort, but a dropped delete should be traceable.
+                logger.debug("collective namespace cleanup failed: %s", e)

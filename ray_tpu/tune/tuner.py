@@ -14,6 +14,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
+from ray_tpu._private.backoff import Backoff
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import RunConfig
 from ray_tpu.train.result import Result
@@ -432,6 +433,7 @@ class _TrialRunner:
         import ray_tpu
 
         exhausted = False
+        poll = Backoff(base=0.02, cap=0.25)
         while True:
             running = [t for t in self._trials if t.status == RUNNING]
             # launch up to the concurrency/resource cap
@@ -454,12 +456,13 @@ class _TrialRunner:
                 ):
                     self._save_state(force=True)
                     return self._trials
-                time.sleep(0.05)
+                poll.sleep()
                 continue
+            poll.reset()
             for trial in running:
                 self._poll_trial(trial)
             self._save_state()
-            time.sleep(0.02)
+            poll.sleep()
 
     def _poll_trial(self, trial: Trial):
         import ray_tpu

@@ -5,6 +5,7 @@ from __future__ import annotations
 import time
 from typing import Dict, List, Optional
 
+from ray_tpu._private.backoff import Backoff
 from ray_tpu._private.ids import PlacementGroupID
 from ray_tpu._private.worker import get_global_worker
 from ray_tpu.exceptions import PlacementGroupUnavailableError
@@ -23,13 +24,14 @@ class PlacementGroup:
     def ready(self, timeout: float = 30.0) -> bool:
         w = get_global_worker()
         deadline = time.monotonic() + timeout
+        poll = Backoff(base=0.01, cap=0.25)
         while time.monotonic() < deadline:
             h = w.run_sync(w._head_call("get_pg", {"pg_id": self.id}))[0]
             if h.get("found") and h["pg"]["state"] == "CREATED":
                 return True
             if h.get("found") and h["pg"]["state"] == "REMOVED":
                 return False
-            time.sleep(0.02)
+            poll.sleep()
         return False
 
     def wait(self, timeout_seconds: float = 30.0) -> bool:

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Framework self-scan gate: Families B (locks), C (concurrency) and D
+# (protocol invariants vs lint/catalog.py) must be clean over ray_tpu/.
+# Exits non-zero on any finding — wire this wherever CI runs; tier-1
+# runs the same scan through tests/test_lint_self.py (keep both in
+# sync: this script and the self-scan test pin the SAME invocation).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m ray_tpu.lint ray_tpu --framework --select RT2,RT3,RT4 "$@"

@@ -547,6 +547,15 @@ CHAOS_SPECS = [
     # zero leaked leases/objects either way.
     "worker.push.window:error:0.3:0:119",
     "worker.push.window:drop:0.3:0:120",
+    # Round-17 RT403 dividend (the lint catalog now pins the fire-site
+    # set; these were live points with no matrix row). Named/synchronous
+    # actor creation failing at the head must surface as a retryable
+    # error the client re-issues — same contract the batched verb
+    # already proves above.
+    "gcs.actor.create:error:0.2:0:121",
+    # Sender-side RPC delay: every control verb tolerates a slow write
+    # leg the same way it tolerates the matrixed slow reply leg.
+    "protocol.rpc.send:delay:0.2:0:122",
 ]
 
 

@@ -21,11 +21,17 @@ def rule_ids(findings):
     return sorted({f.rule for f in findings})
 
 
-def test_registry_has_both_families():
+def test_registry_has_all_families():
+    from ray_tpu.lint import PROJECT_RULES
+
     fams = {r.family for r in RULES.values()}
-    assert fams == {"A", "B"}
+    assert fams == {"A", "B", "C"}
     assert len([r for r in RULES.values() if r.family == "A"]) >= 4
     assert len([r for r in RULES.values() if r.family == "B"]) >= 4
+    assert len([r for r in RULES.values() if r.family == "C"]) >= 5
+    # Family D is project-scope and lives in its own registry.
+    assert {r.family for r in PROJECT_RULES.values()} == {"D"}
+    assert len(PROJECT_RULES) >= 4
 
 
 # ---------------------------------------------------------------- RT101

@@ -1,0 +1,630 @@
+"""Lint v2: Family C (asyncio/thread concurrency, RT301-RT305) and
+Family D (wire/gate/catalog invariants, RT401-RT404).
+
+Mirrors tests/test_lint.py: every rule gets a positive case (minimal
+snippet that triggers it) and a negative case (the fixed form passes).
+The Family-D liveness tests do exactly what the acceptance criterion
+demands: delete a wire flag's receiver branch, or add an uncataloged
+``faultpoints.fire`` name, on fixture source — and the scan flips red
+through the real CLI.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from ray_tpu.lint import (
+    FAMILY_CONCURRENCY,
+    ModuleContext,
+    lint_project,
+    lint_source,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_c(src):
+    return lint_source(textwrap.dedent(src), "<test>",
+                       families=(FAMILY_CONCURRENCY,))
+
+
+def lint_d(sources, complete=False):
+    mods = [ModuleContext(textwrap.dedent(s), f"<mod{i}>")
+            for i, s in enumerate(sources)]
+    return lint_project(mods, complete=complete)
+
+
+def rule_ids(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------- RT301
+def test_rt301_time_sleep_in_async_def_flagged():
+    findings = lint_c("""
+        import time
+
+        async def settle(self):
+            time.sleep(0.1)
+    """)
+    assert "RT301" in rule_ids(findings)
+    assert "event loop" in findings[0].message
+
+
+def test_rt301_result_without_timeout_flagged():
+    findings = lint_c("""
+        async def fetch(self, fut):
+            return fut.result()
+    """)
+    assert "RT301" in rule_ids(findings)
+
+
+def test_rt301_queue_get_without_timeout_flagged():
+    findings = lint_c("""
+        async def drain(self):
+            return self._queue.get()
+    """)
+    assert "RT301" in rule_ids(findings)
+
+
+def test_rt301_awaited_and_guarded_forms_clean():
+    findings = lint_c("""
+        import asyncio
+
+        async def settle(self, fut, q):
+            await asyncio.sleep(0.1)      # parks the coroutine, fine
+            item = await q.get()           # asyncio.Queue.get
+            if fut.done():
+                return fut.result()        # completed-future fast path
+            return await fut, item
+    """)
+    assert "RT301" not in rule_ids(findings)
+
+
+def test_rt301_executor_thread_allowlist():
+    findings = lint_c("""
+        import time
+
+        async def offloaded(self):  # raytpu: executor-thread
+            time.sleep(0.1)
+    """)
+    assert "RT301" not in rule_ids(findings)
+
+
+def test_rt301_nested_sync_def_not_flagged():
+    findings = lint_c("""
+        import time
+
+        async def submit(self, loop):
+            def work():
+                time.sleep(0.5)  # runs on the executor thread
+            return await loop.run_in_executor(None, work)
+    """)
+    assert "RT301" not in rule_ids(findings)
+
+
+# ---------------------------------------------------------------- RT302
+def test_rt302_create_task_from_thread_flagged():
+    findings = lint_c("""
+        import threading
+
+        class Pump:
+            def start(self):
+                threading.Thread(target=self._pump, daemon=True).start()
+
+            def _pump(self):
+                self.loop.create_task(self._drain())
+
+            async def _drain(self):
+                pass
+    """)
+    assert "RT302" in rule_ids(findings)
+    assert "thread" in findings[0].message
+
+
+def test_rt302_transitive_callee_flagged():
+    findings = lint_c("""
+        import threading
+
+        class Pump:
+            def start(self):
+                threading.Thread(target=self._pump).start()
+
+            def _pump(self):
+                self._dispatch()
+
+            def _dispatch(self):
+                self.loop.call_soon(self._cb)
+    """)
+    assert "RT302" in rule_ids(findings)
+
+
+def test_rt302_threadsafe_bridge_clean():
+    findings = lint_c("""
+        import asyncio
+        import threading
+
+        class Pump:
+            def start(self):
+                threading.Thread(target=self._pump).start()
+
+            def _pump(self):
+                asyncio.run_coroutine_threadsafe(self._drain(), self.loop)
+                self.loop.call_soon_threadsafe(self._wake)
+
+            async def _drain(self):
+                pass
+    """)
+    assert "RT302" not in rule_ids(findings)
+
+
+def test_rt302_loop_thread_code_clean():
+    """create_task from a function nothing submits to a thread is fine."""
+    findings = lint_c("""
+        class Conn:
+            def on_reply(self):
+                self.loop.create_task(self._settle())  # raytpu: ignore[RT303]
+
+            async def _settle(self):
+                pass
+    """)
+    assert "RT302" not in rule_ids(findings)
+
+
+# ---------------------------------------------------------------- RT303
+def test_rt303_dropped_create_task_flagged():
+    findings = lint_c("""
+        class W:
+            def kick(self):
+                self.loop.create_task(self._flush())
+
+            async def _flush(self):
+                pass
+    """)
+    assert "RT303" in rule_ids(findings)
+    assert "spawn_logged" in findings[0].message
+
+
+def test_rt303_lambda_create_task_flagged():
+    findings = lint_c("""
+        class W:
+            def kick(self):
+                self.loop.call_soon_threadsafe(
+                    lambda: self.loop.create_task(self._flush())
+                )
+    """)
+    assert "RT303" in rule_ids(findings)
+
+
+def test_rt303_stored_or_logged_clean():
+    findings = lint_c("""
+        from ray_tpu._private.asyncio_util import spawn_logged
+
+        class W:
+            def kick(self):
+                self._t = self.loop.create_task(self._flush())
+                spawn_logged(self.loop, self._flush(), "w.flush")
+
+            async def _flush(self):
+                pass
+    """)
+    assert "RT303" not in rule_ids(findings)
+
+
+# ---------------------------------------------------------------- RT304
+def test_rt304_await_under_sync_lock_flagged():
+    findings = lint_c("""
+        class Store:
+            async def put(self, data):
+                with self._lock:
+                    await self._write(data)
+    """)
+    assert "RT304" in rule_ids(findings)
+    assert "threading.Lock" in findings[0].message
+
+
+def test_rt304_async_lock_clean():
+    findings = lint_c("""
+        class Store:
+            async def put(self, data):
+                async with self._lock:
+                    await self._write(data)
+    """)
+    assert "RT304" not in rule_ids(findings)
+
+
+def test_rt304_await_outside_critical_section_clean():
+    findings = lint_c("""
+        class Store:
+            async def put(self, data):
+                with self._lock:
+                    self._pending.append(data)
+                await self._flush()
+    """)
+    assert "RT304" not in rule_ids(findings)
+
+
+# ---------------------------------------------------------------- RT305
+def test_rt305_unlocked_shared_write_flagged():
+    findings = lint_c("""
+        import threading
+
+        class Stats:
+            def start(self):
+                threading.Thread(target=self._tick).start()
+
+            def _tick(self):
+                self.count += 1
+
+            async def observe(self):
+                self.count = 0
+    """)
+    assert "RT305" in rule_ids(findings)
+    assert "count" in findings[0].message
+
+
+def test_rt305_locked_side_clean():
+    findings = lint_c("""
+        import threading
+
+        class Stats:
+            def start(self):
+                threading.Thread(target=self._tick).start()
+
+            def _tick(self):
+                with self._lock:
+                    self.count += 1
+
+            async def observe(self):
+                self.count = 0
+    """)
+    assert "RT305" not in rule_ids(findings)
+
+
+def test_rt305_single_sided_writes_clean():
+    findings = lint_c("""
+        import threading
+
+        class Stats:
+            def start(self):
+                threading.Thread(target=self._tick).start()
+
+            def _tick(self):
+                self.count += 1
+
+            async def observe(self):
+                return self.count  # read, not write
+    """)
+    assert "RT305" not in rule_ids(findings)
+
+
+# ---------------------------------------------------------------- RT401
+_SENDER = """
+    def pack(header, extra):
+        header["wa"] = 1
+        header["tid"] = extra
+"""
+_RECEIVER = """
+    def consume(h):
+        if h.get("wa"):
+            return True
+        return "wa" in h
+"""
+
+
+def test_rt401_symmetric_flag_clean():
+    findings = lint_d([_SENDER, _RECEIVER])
+    assert "RT401" not in rule_ids(findings)
+
+
+def test_rt401_deleted_receiver_branch_flips_red():
+    findings = lint_d([_SENDER])
+    msgs = [f.message for f in findings if f.rule == "RT401"]
+    assert any("'wa'" in m and "no receiver branch" in m for m in msgs)
+
+
+def test_rt401_deleted_sender_flips_red():
+    findings = lint_d([_RECEIVER])
+    msgs = [f.message for f in findings if f.rule == "RT401"]
+    assert any("'wa'" in m and "never packed" in m for m in msgs)
+
+
+def test_rt401_uncataloged_short_key_flagged():
+    findings = lint_d(["""
+        def pack(header):
+            header["zz"] = 1
+    """])
+    msgs = [f.message for f in findings if f.rule == "RT401"]
+    assert any("'zz'" in m and "absent from lint/catalog.py" in m
+               for m in msgs)
+
+
+def test_rt401_base_and_payload_keys_clean():
+    findings = lint_d(["""
+        def pack(header, payload):
+            header["tid"] = 1           # WIRE_BASE envelope key
+            payload = {"submission_id": "x"}  # not a header var
+            header["long_payload_key"] = payload  # >4 chars: verb field
+    """])
+    assert "RT401" not in rule_ids(findings)
+
+
+def test_rt401_cli_liveness(tmp_path):
+    """The acceptance check end-to-end: two fixture files are green
+    through the real CLI; deleting the receiver file flips it red."""
+    sender = tmp_path / "sender.py"
+    receiver = tmp_path / "receiver.py"
+    sender.write_text(textwrap.dedent(_SENDER))
+    receiver.write_text(textwrap.dedent(_RECEIVER))
+
+    def scan(paths):
+        proc = subprocess.run(
+            [sys.executable, "-m", "ray_tpu.lint", *paths,
+             "--select", "RT4", "--json"],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+        return proc.returncode, json.loads(proc.stdout)
+
+    rc, findings = scan([str(sender), str(receiver)])
+    assert rc == 0 and findings == []
+    rc, findings = scan([str(sender)])
+    assert rc == 1
+    assert [f["rule"] for f in findings] == ["RT401"]
+    assert findings[0]["family"] == "D"
+
+
+# ---------------------------------------------------------------- RT402
+def test_rt402_unbranched_gate_read_flagged():
+    findings = lint_d(["""
+        from ray_tpu._private.config import rt_config
+
+        def dump():
+            print(rt_config.reply_batching)
+    """])
+    msgs = [f.message for f in findings if f.rule == "RT402"]
+    assert any("reply_batching" in m and "never branched" in m
+               for m in msgs)
+
+
+def test_rt402_branched_and_cached_reads_clean():
+    findings = lint_d(["""
+        from ray_tpu._private.config import rt_config as _rtc
+
+        class W:
+            def __init__(self):
+                self._reply_batching = bool(_rtc.reply_batching)
+                if _rtc.push_window:
+                    self._pace = True
+    """])
+    assert "RT402" not in rule_ids(findings)
+
+
+def test_rt402_undeclared_catalog_gate_flagged_on_complete_scan():
+    findings = lint_d(["""
+        rt_config.declare("brand_new_gate", bool, True, "doc")
+    """], complete=True)
+    msgs = [f.message for f in findings if f.rule == "RT402"]
+    assert any("brand_new_gate" in m and "missing from lint/catalog.py"
+               in m for m in msgs)
+
+
+# ---------------------------------------------------------------- RT403
+def test_rt403_uncataloged_fire_site_flips_red():
+    findings = lint_d(["""
+        from ray_tpu._private import faultpoints
+
+        def f():
+            faultpoints.fire("rogue.new.point")
+    """])
+    msgs = [f.message for f in findings if f.rule == "RT403"]
+    assert any("rogue.new.point" in m for m in msgs)
+
+
+def test_rt403_cataloged_and_dynamic_fires_clean():
+    findings = lint_d(["""
+        from ray_tpu._private import faultpoints
+
+        async def f(method):
+            faultpoints.fire("worker.pull")
+            await faultpoints.async_fire(f"gcs.dispatch.{method}")
+            faultpoints.fire("gcs.dispatch.lease")
+    """])
+    assert "RT403" not in rule_ids(findings)
+
+
+def test_rt403_cli_liveness(tmp_path):
+    """Acceptance check: an uncataloged fire name on fixture source
+    flips the scan red through the real CLI."""
+    mod = tmp_path / "firing.py"
+    mod.write_text(textwrap.dedent("""
+        from ray_tpu._private import faultpoints
+
+        def f():
+            faultpoints.fire("worker.pull")
+    """))
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.lint", str(mod),
+         "--select", "RT4", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout
+    mod.write_text(mod.read_text().replace(
+        '"worker.pull"', '"worker.not.in.catalog"'))
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.lint", str(mod),
+         "--select", "RT4", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    findings = json.loads(proc.stdout)
+    assert [f["rule"] for f in findings] == ["RT403"]
+
+
+# ---------------------------------------------------------------- RT404
+def test_rt404_unknown_stage_flagged():
+    findings = lint_d(["""
+        from ray_tpu._private import taskpath
+
+        def f(tid):
+            taskpath.record_phase("bogus_stage", tid, 0.0, 1.0)
+    """])
+    msgs = [f.message for f in findings if f.rule == "RT404"]
+    assert any("bogus_stage" in m for m in msgs)
+
+
+def test_rt404_unknown_phase_label_flagged():
+    findings = lint_d(["""
+        from ray_tpu._private import taskpath
+
+        def f(tid):
+            taskpath.record_phase("exec", tid, 0.0, 1.0,
+                                  phase="bogus-phase")
+    """])
+    msgs = [f.message for f in findings if f.rule == "RT404"]
+    assert any("bogus-phase" in m for m in msgs)
+
+
+def test_rt404_known_stage_and_phase_clean():
+    findings = lint_d(["""
+        from ray_tpu._private import taskpath, flight
+
+        def f(tid):
+            taskpath.record_phase("exec", tid, 0.0, 1.0, phase="exec")
+            flight.record("task.serve", tid, "task", 0.0, 1.0)
+    """])
+    assert "RT404" not in rule_ids(findings)
+
+
+# ------------------------------------------------------- catalog / regen
+def test_catalog_regen_is_noop_on_clean_tree():
+    from ray_tpu.lint import catalog_gen
+
+    assert catalog_gen.regen(root=REPO, write=False) is False
+
+
+def test_catalog_generate_deterministic():
+    from ray_tpu.lint import catalog_gen
+
+    assert catalog_gen.generate(REPO) == catalog_gen.generate(REPO)
+
+
+def test_catalog_regen_cli_reports_up_to_date():
+    from ray_tpu.lint import catalog_gen
+
+    before = open(catalog_gen.catalog_path()).read()
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.lint", "--regen"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0
+    assert "up to date" in proc.stdout
+    assert open(catalog_gen.catalog_path()).read() == before
+
+
+def test_catalog_faultpoints_all_matrixed_or_waived():
+    """The RT403 contract, asserted directly on the catalog: every
+    pinned faultpoint either has a chaos-matrix row or a reason."""
+    from ray_tpu.lint import catalog
+
+    bad = [name for name, e in catalog.FAULTPOINTS.items()
+           if not e.get("matrixed") and not e.get("waive")]
+    assert bad == []
+
+
+def test_catalog_matrixed_matches_chaos_specs():
+    """The catalog's ``matrixed`` bits and the live CHAOS_SPECS list
+    cannot drift: regen derives one from the other, and this pins it."""
+    from ray_tpu.lint import catalog, catalog_gen
+
+    matrixed = set(catalog_gen.scan_matrixed(REPO))
+    for name, e in catalog.FAULTPOINTS.items():
+        assert e["matrixed"] == (name in matrixed), name
+
+
+def test_catalog_phases_match_taskpath():
+    from ray_tpu._private import taskpath
+    from ray_tpu.lint import catalog
+
+    assert tuple(catalog.PHASES) == tuple(taskpath.PHASES)
+
+
+# -------------------------------------------------- spawn_logged satellite
+def test_spawn_logged_logs_background_failure(caplog):
+    import asyncio
+    import logging
+
+    async def boom():
+        raise RuntimeError("kapow")
+
+    from ray_tpu._private.asyncio_util import spawn_logged
+
+    async def main():
+        t = spawn_logged(None, boom(), "test.boom")
+        with pytest.raises(RuntimeError):
+            await t
+
+    with caplog.at_level(logging.ERROR, "ray_tpu._private.asyncio_util"):
+        asyncio.run(main())
+    assert any("test.boom" in r.message and "kapow" in r.message
+               for r in caplog.records)
+
+
+def test_spawn_logged_quiet_on_success_and_cancel(caplog):
+    import asyncio
+    import logging
+
+    from ray_tpu._private.asyncio_util import spawn_logged
+
+    async def ok():
+        return 42
+
+    async def forever():
+        await asyncio.Event().wait()
+
+    async def main():
+        t1 = spawn_logged(None, ok(), "test.ok")
+        t2 = spawn_logged(asyncio.get_running_loop(), forever(),
+                         "test.cancel")
+        assert await t1 == 42
+        t2.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await t2
+
+    with caplog.at_level(logging.ERROR, "ray_tpu._private.asyncio_util"):
+        asyncio.run(main())
+    assert caplog.records == []
+
+
+# ------------------------------------------------------------ CLI surface
+def test_list_rules_grouped_by_family():
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.lint", "--list-rules"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0
+    out = proc.stdout
+    for header in ("Family A", "Family B", "Family C", "Family D"):
+        assert header in out
+    # Family D rules must print under the Family D header.
+    assert out.index("RT301") < out.index("Family D") < out.index("RT401")
+
+
+def test_json_findings_carry_family(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import time
+
+        async def f():
+            time.sleep(1.0)
+    """))
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.lint", str(bad), "--framework",
+         "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    findings = json.loads(proc.stdout)
+    assert [f["rule"] for f in findings] == ["RT301"]
+    assert findings[0]["family"] == "C"

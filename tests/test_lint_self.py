@@ -1,9 +1,12 @@
-"""Framework self-analysis stays clean (Family B over ray_tpu/_private/).
+"""Framework self-analysis stays clean (Families B+C+D over ray_tpu/).
 
 This is the tier-1 wiring for ``python -m ray_tpu.lint ray_tpu/``: a new
-blocking-call-under-lock, lock-order inversion, silent RPC swallow, or
-constant-sleep retry loop in the framework fails fast here, plus unit
-coverage for each Family-B rule on minimal snippets.
+blocking-call-under-lock, lock-order inversion, silent RPC swallow,
+constant-sleep retry loop (Family B), event-loop concurrency hazard
+(Family C, tests/test_lint_concurrency.py holds the unit cases), or
+wire/gate/chaos/phase catalog drift (Family D) in the framework fails
+fast here, plus unit coverage for each Family-B rule on minimal
+snippets.
 """
 import json
 import os
@@ -216,6 +219,51 @@ def test_cli_module_scan_json_clean():
     findings = json.loads(proc.stdout)
     assert findings == [], proc.stdout
     assert proc.returncode == 0, proc.stderr
+
+
+def test_full_tree_families_bcd_clean():
+    """Lint v2 self-scan, the exact ``scripts/lint_check.sh``
+    invocation: Families B (locks), C (concurrency) and D (wire/gate/
+    chaos/phase invariants vs lint/catalog.py) over the WHOLE tree with
+    --framework. A new blocking call in a coroutine, a fire-and-forget
+    create_task, a wire flag whose receiver branch was refactored away,
+    an un-matrixed faultpoint, or a phase name the analyzer doesn't
+    know — any of these fails tier-1 right here."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.lint", "ray_tpu", "--framework",
+         "--select", "RT2,RT3,RT4", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=240,
+    )
+    findings = json.loads(proc.stdout)
+    assert findings == [], "\n".join(
+        f"{f['file']}:{f['line']}: {f['rule']} {f['message']}"
+        for f in findings
+    )
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_lint_check_script_in_sync():
+    """scripts/lint_check.sh is the CI entry point for the scan the
+    test above just ran — pin that it invokes the SAME families over
+    the SAME tree (running it twice in tier-1 would only burn wall
+    clock re-proving the identical result)."""
+    script = os.path.join(REPO, "scripts", "lint_check.sh")
+    with open(script) as f:
+        body = f.read()
+    assert ("python -m ray_tpu.lint ray_tpu --framework "
+            "--select RT2,RT3,RT4") in body
+    assert os.access(script, os.X_OK)
+
+
+def test_catalog_in_sync_with_tree():
+    """``--regen`` on a clean tree is a no-op — i.e. lint/catalog.py was
+    regenerated after the last faultpoint/gate/phase change."""
+    from ray_tpu.lint import catalog_gen
+
+    assert catalog_gen.regen(root=REPO, write=False) is False, (
+        "lint/catalog.py is stale: run `python -m ray_tpu.lint --regen` "
+        "and commit the diff"
+    )
 
 
 def test_cli_reports_seeded_finding(tmp_path):
