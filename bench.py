@@ -447,10 +447,16 @@ def run_flight_benchmarks(quick: bool = False, phases: bool = False,
         node id — a handful of spread probes covers small clusters).
         BENCH_r09's attribution needs these three series: peak/steady
         push-window per peer, pump messages-per-drain, and frames
-        settled per driver recv wakeup."""
+        settled per driver recv wakeup. Round 20 adds the driver-loop
+        scale-out ledgers: settle_plane / pack_plane snapshots and the
+        per-shard pusher table (chunks/tasks per rt-pusher loop) ride
+        the driver snapshot; pusher_shard_count is surfaced even when
+        the auto knob resolves to 0 shards (small hosts), so an A/B
+        over RT_PUSHER_LOOP_SHARDS reads from the bench JSON alone."""
         import ray_tpu
 
         stats = {"driver": w.transit_stats()}
+        stats["driver"]["pusher_shard_count"] = len(w._pusher_loops)
 
         @ray_tpu.remote
         def _probe(_i):

@@ -283,6 +283,7 @@ def _init_locked(address, num_cpus, num_nodes, resources, labels,
         if boot_err:
             raise boot_err[0]
         driver._install_ref_hooks()
+        driver._start_pusher_shards()
         _cluster = LocalCluster(
             head, driver.gcs_addr, job_id, driver,
             session_dir=session_dir,

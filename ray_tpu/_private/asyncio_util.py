@@ -43,3 +43,24 @@ def spawn_logged(loop: Optional[asyncio.AbstractEventLoop],
         pass
     task.add_done_callback(lambda t: _report(t, what))
     return task
+
+
+def spawn_threadsafe(loop: asyncio.AbstractEventLoop,
+                     coro: Coroutine, what: str):
+    """``spawn_logged`` across threads (round 20): schedule ``coro`` on a
+    loop owned by ANOTHER thread — the driver's main loop handing a
+    pusher to a shard loop — with the same you-will-hear-about-failures
+    contract. Returns the ``concurrent.futures.Future`` tracking the
+    coroutine."""
+    fut = asyncio.run_coroutine_threadsafe(coro, loop)
+
+    def _report_cf(f):
+        if f.cancelled():
+            return
+        exc = f.exception()
+        if exc is not None:
+            logger.error("background task %s failed: %r", what, exc,
+                         exc_info=exc)
+
+    fut.add_done_callback(_report_cf)
+    return fut

@@ -423,3 +423,46 @@ rt_config.declare(
     "catalog). Empty disables injection entirely (hot paths pay one "
     "boolean check). Reference: RAY_testing_rpc_failure hooks in "
     "src/ray/rpc/grpc_client.h.")
+rt_config.declare(
+    "driver_settle_thread", bool, True,
+    "Driver settle plane (round 20): coalesced reply frames from the "
+    "TCP recv loop hand off to a dedicated settle worker thread that "
+    "splits/decodes them off-loop and settles futures in batches — "
+    "ONE call_soon_threadsafe per drain per target loop, never one "
+    "per frame. The ring pump never queues to the plane (it is itself "
+    "off-loop): attachment switches it to prepare each drain's "
+    "replies in place on the pump thread. The handoff queue is "
+    "bounded (full queue degrades that frame to the inline on-loop "
+    "path, so backpressure never loses a reply) and its depth exports "
+    "as rt_settle_queue_depth. Driver-only; the carved-out wait "
+    "appears as the settle-dwell task phase. Auto stand-down on "
+    "single-core hosts (the plane thread would contend with the loop "
+    "for the GIL) unless RT_DRIVER_SETTLE_THREAD is set explicitly. "
+    "Off (RT_DRIVER_SETTLE_THREAD=0): replies settle inline on the "
+    "recv/pump wakeup, the pre-round-20 behavior (reference: the "
+    "dedicated reply-handling asio loop in core_worker's "
+    "client_call_manager).")
+rt_config.declare(
+    "submit_pack_thread", bool, True,
+    "Driver submission pack plane (round 20): submit_task hands the "
+    "per-task wire-size accounting, lineage bookkeeping, and dispatch "
+    "enqueue to a pack worker thread that feeds the event loop "
+    "pre-framed batches — one loop wakeup and one lease pump per "
+    "submit burst instead of one per task, shrinking the submit-queue "
+    "leg at 5k-task scale. Bounded handoff; a full queue (or the "
+    "driver.submit.pack faultpoint) degrades that submission to the "
+    "inline _enqueue_dispatch path, so no task is ever lost. Off "
+    "(RT_SUBMIT_PACK_THREAD=0): submissions enqueue inline from the "
+    "caller thread, the pre-round-20 behavior (reference: the "
+    "CoreWorker submit queue draining on its dedicated io thread).")
+rt_config.declare(
+    "pusher_loop_shards", int, -1,
+    "Sharded pusher event loops (round 20, driver-only): lease slots "
+    "hash by peer address onto N dedicated pusher loops, each owning "
+    "its peers' PushWindows, so chunk packing and push pacing stop "
+    "serializing behind the driver's main loop. -1 = auto "
+    "(min(2, cores-1); 0 on small hosts), 0 = off: pushers run on the "
+    "main loop, the pre-round-20 behavior. Cross-loop touches (peer/"
+    "ring connect, task-reply application, slot bookkeeping) marshal "
+    "to the main loop; a slot never migrates between shards "
+    "(reference: core_worker's per-connection asio strands).")

@@ -163,6 +163,21 @@ CATALOG: Dict[str, tuple] = {
         "error forces — and drop really performs — an interned-frame "
         "eviction right before lookup, so the typed arg_intern_miss "
         "error makes the pusher re-send the exact bytes"),
+    "driver.settle.handoff": (
+        "worker", ("error", "delay", "drop"),
+        "reply-frame handoff to the driver's settle plane (round 20, "
+        "one per coalesced frame batch): error/drop = the handoff is "
+        "refused and THAT batch settles inline on the event loop — the "
+        "plane is an optimization, never a correctness gate; no frame "
+        "is ever lost. delay stalls the offer (backpressure: depth "
+        "climbs toward the bounded queue's reject threshold)"),
+    "driver.submit.pack": (
+        "worker", ("error", "delay", "drop"),
+        "per-task handoff to the driver's submission pack plane (round "
+        "20): error/drop degrade THAT submission to the inline "
+        "pack-and-enqueue path — the task is never lost, only "
+        "un-offloaded; delay stalls the submitting caller thread, not "
+        "the event loop"),
     "serve.replica.call": (
         "serve", ("error", "delay"),
         "handle->replica dispatch, client side, BEFORE the request can "

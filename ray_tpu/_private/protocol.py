@@ -206,6 +206,10 @@ class Connection:
         self.settle_stats: Dict[str, int] = {
             "wakeups": 0, "frames": 0, "drained": 0, "max_batch": 0,
         }
+        # Round 20: the driver attaches its SettlePlane here (None on
+        # nodes and with RT_DRIVER_SETTLE_THREAD=0) — reply frames then
+        # hand off to the plane thread instead of settling inline.
+        self.settle_plane = None
 
     FLUSH_BYTES = 256 * 1024
 
@@ -283,6 +287,18 @@ class Connection:
             # flight plane carves the arrival->settle dwell into the
             # pump-queue phase (both ends on the driver's clock).
             header.setdefault("_fr", time.monotonic())
+            sp = self.settle_plane
+            if sp is not None:
+                # Round 20: hand the WHOLE coalesced frame to the settle
+                # plane — splitting and future settling leave this loop.
+                # The handoff stamp lands BEFORE the offer so the plane
+                # thread can never observe an unstamped header; a
+                # rejected offer (bounded queue full, chaos injection)
+                # un-stamps and settles inline — degraded, never lost.
+                header["_sq"] = time.monotonic()
+                if sp.offer(self, (header, frames)):
+                    return 0
+                header.pop("_sq", None)
             if "bh" in header:
                 # Coalesced multi-result frame: sub-replies ride
                 # one message, each under its own correlation id
@@ -353,6 +369,46 @@ class Connection:
                 )
             else:
                 fut.set_result((header, frames))
+
+    # ----------------------------------------------- round-20 settle plane
+    def _settle_prepare(self, payload):
+        """SettlePlane contract, PLANE-THREAD side: split a coalesced
+        reply frame into per-correlation subs off-loop. ``_pending`` has
+        no lock (it is loop-thread state, iterated by ``_teardown``), so
+        the pop + future settle stay on the loop in the returned apply
+        op — the plane still wins: splitting happens here and N frames
+        re-enter the loop as ONE scheduled call."""
+        header, frames = payload
+        flat = []
+        ack = False
+        if "bh" in header:
+            pos = 0
+            fr_t = header.get("_fr")
+            sq_t = header.get("_sq")
+            for sub, n in zip(header["bh"], header["bn"]):
+                if fr_t is not None:
+                    sub["_fr"] = fr_t
+                if sq_t is not None:
+                    sub["_sq"] = sq_t
+                flat.append((sub, frames[pos:pos + n]))
+                pos += n
+            ack = bool(header.get("wa"))
+        else:
+            flat.append((header, frames))
+        return [(self._loop, self._settle_apply_on_loop, (flat, ack))]
+
+    def _settle_apply_on_loop(self, data):
+        """Loop-side settle of plane-prepared subs. After teardown the
+        pending futures were already failed with ConnectionLost — the
+        pops all miss and this is a no-op, never a double settle."""
+        flat, ack = data
+        for sub, fr in flat:
+            self._settle_reply(sub, fr)
+        if ack and not self._closed:
+            try:
+                self.notify("mrack")
+            except (RpcError, OSError) as e:
+                logger.debug("window ack dropped (%s): %s", self.name, e)
 
     def _teardown(self):
         if self._closed:

@@ -118,6 +118,13 @@ class RingConnection:
         self.settle_stats: dict = {
             "wakeups": 0, "frames": 0, "drained": 0, "max_batch": 0,
         }
+        # Round 20: the driver attaches its SettlePlane here as the
+        # settle-discipline switch. The pump thread never queues to the
+        # plane (it is itself off-loop already); attachment means the
+        # pump prepares each drain's replies in place — pops + per-loop
+        # bucketing — and stamps the handoff for settle-dwell
+        # attribution.
+        self.settle_plane = None
         self._pump = threading.Thread(
             target=self._pump_loop, daemon=True,
             name=f"rt-ringpump-{self.name}",
@@ -256,7 +263,11 @@ class RingConnection:
             header.update(extras)
         if flight.ENABLED and "corr" not in header and "fid" not in header:
             header["fid"] = flight.next_id()
-        fut = self.loop.create_future()
+        # The future homes on the CALLING loop (round 20: sharded pusher
+        # loops await ring calls from their own threads; reply settling
+        # routes by fut.get_loop()). On the main loop this is exactly
+        # self.loop.create_future().
+        fut = asyncio.get_running_loop().create_future()
         with self._plock:
             self._pending[cid] = fut
         try:
@@ -280,7 +291,9 @@ class RingConnection:
         self._send_auto(header, frames)
 
     def call_batch(self, method: str, items) -> list:
-        """Issue many requests in ONE ring message (must run on the loop).
+        """Issue many requests in ONE ring message (must run on an event
+        loop thread — the driver's main loop, or a round-20 pusher shard
+        whose loop then owns the returned futures).
 
         ``items``: [(extras, frames)]. Returns one future per item; the
         receiver replies to each sub-request individually under its own
@@ -290,6 +303,10 @@ class RingConnection:
         """
         if self._closed:
             raise protocol.ConnectionLost(f"ring {self.name} closed")
+        try:
+            floop = asyncio.get_running_loop()
+        except RuntimeError:
+            floop = self.loop
         futs = []
         subs = []
         counts = []
@@ -298,7 +315,7 @@ class RingConnection:
         with self._plock:
             for extras, frames in items:
                 cid = next(self._ids)
-                fut = self.loop.create_future()
+                fut = floop.create_future()
                 self._pending[cid] = fut
                 futs.append(fut)
                 sub = {"i": cid, **(extras or {})}
@@ -538,6 +555,33 @@ class RingConnection:
                                     "ring fast dispatch failed; slow path"
                                 )
                         slow.append((sub, sfr))
+                if replies:
+                    if self.settle_plane is not None:
+                        # Round 20: this pump thread IS the ring's
+                        # settle plane — it already runs off the event
+                        # loop, so queueing the drain to the driver's
+                        # plane THREAD would only insert a second,
+                        # GIL-starved hop on the reply path (measured on
+                        # the 1-core A/B box: 616ms median reply dwell
+                        # through the queued plane vs 145ms settling
+                        # from here). Prepare in place — pop futures,
+                        # bucket by owning loop — and re-enter each loop
+                        # once per drain. The handoff stamp lands first:
+                        # the driver carves arrival->handoff into
+                        # pump-queue and handoff->settle into
+                        # settle-dwell.
+                        t_sq = time.monotonic()
+                        for h, _f in replies:
+                            h["_sq"] = t_sq
+                        for floop, fn, ops in self._settle_prepare(
+                                replies):
+                            try:
+                                floop.call_soon_threadsafe(fn, ops)
+                            except RuntimeError:
+                                # That loop already closed (shutdown):
+                                # its futures were failed by teardown.
+                                pass
+                        replies = []
                 if replies or slow:
                     # One loop wakeup per drained batch, covering both reply
                     # resolution and slow-path request dispatch.
@@ -608,10 +652,26 @@ class RingConnection:
         self.send_reply(reply, rframes)
 
     def _apply_replies(self, replies):
+        forwarded = None
         for header, frames in replies:
             with self._plock:
                 fut = self._pending.pop(header.get("i"), None)
             if fut is None or fut.done():
+                continue
+            try:
+                floop = fut.get_loop()
+            except Exception:
+                floop = self.loop
+            if floop is not self.loop:
+                # Round 20: a future homed on a pusher-shard loop (the
+                # settle plane normally routes these, but the plane may
+                # be off or full while shards are on). Group and forward
+                # — settling a foreign loop's future inline would race
+                # its callbacks.
+                if forwarded is None:
+                    forwarded = {}
+                forwarded.setdefault(floop, []).append(
+                    self._reply_op(fut, header, frames))
                 continue
             if header.get("e") is not None:
                 fut.set_exception(
@@ -619,6 +679,64 @@ class RingConnection:
                 )
             else:
                 fut.set_result((header, frames))
+        if forwarded:
+            for floop, ops in forwarded.items():
+                try:
+                    floop.call_soon_threadsafe(
+                        self._settle_ops_on_loop, ops)
+                except RuntimeError:
+                    pass  # shard loop closed at shutdown
+
+    @staticmethod
+    def _reply_op(fut, header, frames):
+        """(fut, value, is_error) op consumed by _settle_ops_on_loop."""
+        if header.get("e") is not None:
+            return (fut,
+                    protocol.RpcError(header["e"], code=header.get("ec")),
+                    True)
+        return (fut, (header, frames), False)
+
+    # ----------------------------------------------- round-20 settle plane
+    def _settle_prepare(self, replies):
+        """SettlePlane contract, PLANE-THREAD side: pop this drain's
+        futures under the pending lock and bucket ready-to-apply ops by
+        each future's owning loop — the plane then re-enters every loop
+        once per drain. Stats stay single-writer (this runs only on the
+        plane thread)."""
+        st = self.settle_stats
+        st["wakeups"] += 1
+        st["frames"] += len(replies)
+        if len(replies) > 1:
+            st["drained"] += len(replies) - 1
+        if len(replies) > st["max_batch"]:
+            st["max_batch"] = len(replies)
+        with self._plock:
+            pend = self._pending
+            popped = [(pend.pop(h.get("i"), None), h, fr)
+                      for h, fr in replies]
+        buckets = {}
+        for fut, h, fr in popped:
+            if fut is None:
+                continue
+            try:
+                floop = fut.get_loop()
+            except Exception:
+                floop = self.loop
+            buckets.setdefault(floop, []).append(self._reply_op(fut, h, fr))
+        return [(floop, self._settle_ops_on_loop, ops)
+                for floop, ops in buckets.items()]
+
+    def _settle_ops_on_loop(self, ops):
+        """Apply prepared (fut, value, is_error) ops on the loop that
+        owns the futures. A future cancelled while its reply was in
+        flight (deadline re-arm) is simply skipped."""
+        for fut, val, is_err in ops:
+            if fut.done():
+                continue
+            if is_err:
+                fut.set_exception(val)
+            else:
+                fut.set_result(val)
 
     # ------------------------------------------------------------ teardown
 
@@ -637,18 +755,31 @@ class RingConnection:
         with self._plock:
             pending, self._pending = dict(self._pending), {}
 
-        def fail_all():
-            for fut in pending.values():
-                if not fut.done():
-                    fut.set_exception(
-                        protocol.ConnectionLost(f"ring {self.name} lost")
-                    )
-
         if pending:
-            try:
-                self.loop.call_soon_threadsafe(fail_all)
-            except RuntimeError:
-                pass
+            # Group by owning loop (round 20: pusher-shard futures), one
+            # scheduled failure pass per loop. Single-loop topology keeps
+            # the pre-round-20 one-callback shape.
+            buckets: dict = {}
+            for fut in pending.values():
+                try:
+                    floop = fut.get_loop()
+                except Exception:
+                    floop = self.loop
+                buckets.setdefault(floop, []).append(fut)
+            for floop, futs in buckets.items():
+
+                def fail_all(futs=futs):
+                    for fut in futs:
+                        if not fut.done():
+                            fut.set_exception(
+                                protocol.ConnectionLost(
+                                    f"ring {self.name} lost")
+                            )
+
+                try:
+                    floop.call_soon_threadsafe(fail_all)
+                except RuntimeError:
+                    pass
         if self.on_close is not None:
             try:
                 self.on_close(self)

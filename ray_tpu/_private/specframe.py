@@ -47,15 +47,37 @@ task results onto the submission channel; plasma inline-object returns):
 - :class:`ArgInternCache` is the executing side's bounded LRU for those
   interned frames; an evicted digest surfaces as a typed miss the pusher
   answers by re-sending the exact bytes.
+
+Round 20 adds the driver's loop scale-out primitives (reference: the
+core worker runs on a dedicated asio loop with per-connection strands;
+here the single Python event loop splits into cooperating planes):
+
+- :class:`PlaneQueue` is the bounded cross-thread handoff all planes
+  share: producers ``offer()`` items from any thread, one dedicated
+  worker thread drains the queue in whole batches, and a full queue
+  rejects the offer so the producer degrades to its inline on-loop
+  path — backpressure never loses work.
+- :class:`SettlePlane` rides a PlaneQueue to move TCP reply settling
+  off the event loop: the recv loop hands whole coalesced reply frames
+  over; the plane thread splits/decodes them and re-enters each target
+  event loop with ONE ``call_soon_threadsafe`` per drain per loop
+  (grouping by the future's owning loop is what lets sharded pusher
+  loops settle correctly too). The ring pump never queues here — it
+  already runs off-loop, so attachment just switches it to prepare
+  each drain's replies in place on the pump thread under the same
+  per-loop-bucketed discipline.
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 import msgpack
+
+logger = logging.getLogger(__name__)
 
 # Keys a spec template may carry; everything else in a push_task header is
 # a per-call delta (tid, fkey, nret, argrefs, borrows, trace, corr ids).
@@ -511,3 +533,191 @@ class ArgInternCache:
                 blob = self._entries.pop(d, None)
                 if blob is not None:
                     self._bytes -= len(blob)
+
+
+# --------------------------------------------------------------------------
+# Round 20: driver loop scale-out planes.
+
+
+def _apply_plane_ops(ops):
+    """Loop-side applier for a settle-plane drain: one scheduled call
+    runs every (fn, payload) op the plane bucketed for this loop. A
+    single op failing must not strand the rest of the batch — each op's
+    futures belong to a different connection/task set."""
+    for fn, data in ops:
+        try:
+            fn(data)
+        except Exception:
+            logger.exception("settle-plane apply failed")
+
+
+class PlaneQueue:
+    """Bounded cross-thread handoff queue with a dedicated drain thread.
+
+    The shared primitive under the round-20 driver planes: producers
+    (the TCP recv loop, ring pump threads, submitting caller threads)
+    ``offer()`` items; ONE worker thread wakes per burst, swaps out the
+    whole backlog, and hands it to ``worker`` as a single batch — the
+    economics every plane wants (O(drains) downstream wakeups, never
+    O(items)).
+
+    Backpressure is rejection, not blocking: a full queue makes
+    ``offer()`` return False and the producer falls back to its inline
+    on-loop path. The plane is an optimization — it must never be able
+    to wedge or lose the hot path, so nothing here waits on the
+    consumer. ``close()`` drains what is queued, then joins the thread
+    (drivers create planes per process; tests create many workers and
+    must not leak threads).
+    """
+
+    def __init__(self, name: str, worker: Callable[[list], None],
+                 maxsize: int = 1024):
+        self._worker = worker
+        self._dq: deque = deque()
+        self._event = threading.Event()
+        self._closed = False
+        self.maxsize = int(maxsize)
+        self.stats = {
+            "handoffs": 0, "rejects": 0, "drains": 0, "items": 0,
+            "max_drain": 0, "peak_depth": 0,
+        }
+        self._thread = threading.Thread(
+            target=self._run, name=name, daemon=True)
+        self._thread.start()
+
+    def depth(self) -> int:
+        return len(self._dq)
+
+    def offer(self, item) -> bool:
+        """Enqueue from any thread; False = full/closed (caller goes
+        inline). deque.append is atomic under the GIL — the depth check
+        is advisory (the bound may briefly overshoot by one item per
+        racing producer, which is fine for a backpressure valve)."""
+        if self._closed:
+            return False
+        dq = self._dq
+        st = self.stats
+        if len(dq) >= self.maxsize:
+            st["rejects"] += 1
+            return False
+        dq.append(item)
+        st["handoffs"] += 1
+        d = len(dq)
+        if d > st["peak_depth"]:
+            st["peak_depth"] = d
+        self._event.set()
+        return True
+
+    def _run(self):
+        dq = self._dq
+        ev = self._event
+        st = self.stats
+        while True:
+            ev.wait()
+            ev.clear()
+            batch = []
+            while dq:
+                try:
+                    batch.append(dq.popleft())
+                except IndexError:
+                    break
+            if batch:
+                st["drains"] += 1
+                st["items"] += len(batch)
+                if len(batch) > st["max_drain"]:
+                    st["max_drain"] = len(batch)
+                try:
+                    self._worker(batch)
+                except Exception:
+                    logger.exception("plane %s drain failed",
+                                     self._thread.name)
+            # Re-check AFTER the drain: close() sets the event exactly
+            # once, and a concurrent clear() above could otherwise eat
+            # that wakeup and park this thread in wait() forever.
+            if self._closed and not dq:
+                return
+
+    def close(self, timeout: float = 1.0):
+        self._closed = True
+        self._event.set()
+        if self._thread is not threading.current_thread():
+            self._thread.join(timeout=timeout)
+
+    def snapshot(self) -> dict:
+        out = dict(self.stats)
+        out["depth"] = self.depth()
+        return out
+
+
+class SettlePlane:
+    """Round-20 settle plane: reply settling off the driver's event loop.
+
+    Producers hand (owner, payload) pairs over — the TCP recv loop
+    offers a whole coalesced reply frame. (The ring pump never offers:
+    it is itself an off-loop thread, so it runs the SAME prepare/apply
+    discipline in place — ``_settle_prepare`` on the pump thread — and
+    queueing here would only add a second cross-thread hop to the reply
+    path.) The plane thread asks each owner to PREPARE
+    the payload off-loop (``owner._settle_prepare(payload)`` returns
+    ``[(target_loop, apply_fn, data), ...]``: splitting multi-result
+    frames, popping ring futures under their lock, building exception
+    objects), buckets the prepared ops by target event loop, and
+    re-enters each loop with ONE ``call_soon_threadsafe`` per drain —
+    the call-counted O(drains) wakeup contract
+    (``tests/test_driver_loops.py``). Grouping by the future's owning
+    loop is load-bearing: with sharded pusher loops, one drain can
+    carry futures homed on several loops.
+
+    A full queue (or the ``driver.settle.handoff`` faultpoint) degrades
+    the producer to the inline on-loop settle path — frames are never
+    lost, only un-offloaded.
+    """
+
+    FAULT = "driver.settle.handoff"
+
+    def __init__(self, maxsize: int = 1024):
+        self.q = PlaneQueue("rt-settle", worker=self._drain_on_plane,
+                            maxsize=maxsize)
+        self.applies = 0  # call_soon_threadsafe count, O(drains x loops)
+
+    def depth(self) -> int:
+        return self.q.depth()
+
+    def offer(self, owner, payload) -> bool:
+        """True = the plane took the frame (producer must NOT settle
+        inline). Fault injection degrades to inline: error/drop reject
+        the offer, delay stalls the producer then proceeds."""
+        from ray_tpu._private import faultpoints
+
+        if faultpoints.ACTIVE:
+            try:
+                if faultpoints.fire("driver.settle.handoff") == "drop":
+                    return False
+            except Exception:
+                return False
+        return self.q.offer((owner, payload))
+
+    def _drain_on_plane(self, batch):
+        buckets: Dict[Any, list] = {}
+        for owner, payload in batch:
+            try:
+                for loop, fn, data in owner._settle_prepare(payload):
+                    buckets.setdefault(loop, []).append((fn, data))
+            except Exception:
+                logger.exception("settle-plane prepare failed")
+        for loop, ops in buckets.items():
+            try:
+                loop.call_soon_threadsafe(_apply_plane_ops, ops)
+                self.applies += 1
+            except RuntimeError:
+                # Loop already closed (shutdown); its futures were
+                # failed by connection teardown.
+                pass
+
+    def close(self, timeout: float = 1.0):
+        self.q.close(timeout=timeout)
+
+    def snapshot(self) -> dict:
+        out = self.q.snapshot()
+        out["applies"] = self.applies
+        return out

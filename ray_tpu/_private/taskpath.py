@@ -47,7 +47,14 @@ never corrupt the sum; executor-side contributions are pure durations):
                 settling — loop handoff + settle queueing on a
                 saturated driver, measured entirely on the driver's
                 clock (Round 16 carved this out of reply-ack; the
-                multi-frame settle drain is what shrinks it)
+                multi-frame settle drain is what shrinks it). With the
+                Round-20 settle plane the span ends at the plane
+                HANDOFF, not the settle
+    settle-dwell time a handed-off reply frame spent on the driver's
+                settle plane — worker-queue depth plus the cross-loop
+                hop back to the owning futures (driver clock; zero when
+                driver_settle_thread is off, the dwell then stays in
+                pump-queue)
     reply-ack   push RTT not covered by the executor's serve envelope,
                 the reply window, or the driver's pump-queue dwell:
                 wire both ways + connection queuing (derived). For
@@ -74,7 +81,7 @@ logger = logging.getLogger(__name__)
 PHASES = (
     "submit", "submit-queue", "lease-wait", "warm-pool-hit",
     "fn-push", "kv-get", "arg-pull", "exec-queue", "exec", "result-push",
-    "reply-window", "pump-queue", "reply-ack", "residual",
+    "reply-window", "pump-queue", "settle-dwell", "reply-ack", "residual",
 )
 
 # task.queued outcome -> phase name (see worker._pop_pending).
@@ -181,8 +188,12 @@ def task_breakdown(merged: List[Dict[str, Any]], task_id: str,
     phases["reply-window"] = dur.get("task.reply_window", 0.0)
     # Round 16: reply dwell between the driver's transport arrival and
     # the future settle (driver clock both ends) — carved out of the
-    # derived reply-ack the same way reply-window was.
+    # derived reply-ack the same way reply-window was. Round 20 splits
+    # it at the settle-plane handoff stamp: arrival->handoff stays
+    # pump-queue (transport-side), handoff->settle is the plane's own
+    # dwell (queue depth + the cross-loop hop).
     phases["pump-queue"] = dur.get("task.pump_queue", 0.0)
+    phases["settle-dwell"] = dur.get("task.settle_dwell", 0.0)
     push = dur.get("task.push", 0.0)
     inner = (
         phases[fn_phase] + phases["arg-pull"] + phases["exec"]
@@ -196,7 +207,8 @@ def task_breakdown(merged: List[Dict[str, Any]], task_id: str,
     # the derived reply-ack. All durations, skew-free.
     phases["exec-queue"] = max(serve - inner, 0.0)
     phases["reply-ack"] = max(
-        push - serve - phases["reply-window"] - phases["pump-queue"], 0.0
+        push - serve - phases["reply-window"] - phases["pump-queue"]
+        - phases["settle-dwell"], 0.0
     )
     # Wall: driver-clock envelope. All driver spans live in one process,
     # so ts arithmetic is skew-free; fall back to the span extent when a

@@ -53,7 +53,7 @@ from ray_tpu._private import (
     specframe,
     taskpath,
 )
-from ray_tpu._private.asyncio_util import spawn_logged
+from ray_tpu._private.asyncio_util import spawn_logged, spawn_threadsafe
 from ray_tpu._private.backoff import Backoff
 from ray_tpu._private.ids import (
     ActorID,
@@ -159,6 +159,10 @@ class _LeaseSlot:
     # Loop-side rendezvous for pushers parked on a full window: every
     # settle/release sets it, parked siblings re-check their grant.
     win_event: Any = None
+    # Round 20: the pusher-shard loop this slot's pushers first ran on
+    # (peer-address affinity invariant — the window/event above are only
+    # single-loop-safe because a slot never migrates between shards).
+    shard_loop: Any = None
 
 
 class _LeaseSet:
@@ -171,6 +175,11 @@ class _LeaseSet:
         # deque: pushers pop from the FRONT; a list's pop(0) memmoves the
         # whole backlog per task (O(n^2) across a queued-1M submission).
         self.pending: deque = deque()
+        # Round 20: guards the peek+pop sections of the pack loop ONLY
+        # when pushers run on sharded loops (two shards draining one
+        # scheduling key would otherwise race the head item). The
+        # single-loop path never takes it.
+        self.plock = threading.Lock()
         self.requesting = False
         self.rr = 0  # rotating slot-pick cursor (see _pump_leases)
         # True after a full rotation found no pusher headroom; cleared when
@@ -394,6 +403,44 @@ class CoreWorker:
         self._push_deadline_s = float(_rtc.rpc_deadline_s)
         self._apush_horizon_s = 2.0 * self._push_deadline_s + 5.0
         self._apush_done_n = 0
+        # --- driver loop scale-out (round 20) ---
+        # Three planes, created in start_driver (driver-only; gates
+        # cached here so hot paths pay one attribute read): the settle
+        # plane moves reply splitting/future routing off the event loop,
+        # the pack plane moves per-task submit accounting off the caller
+        # hot path, and pusher shards move chunk packing + push pacing
+        # onto dedicated loops keyed by peer address.
+        # Settle auto stand-down on single-core hosts (the pusher-shard
+        # auto discipline applied to the plane thread): with one CPU the
+        # plane thread competes with the event loop for the GIL, so
+        # every TCP reply handoff pays a scheduler round-trip with zero
+        # parallel win — measured on the 1-core A/B box as 616ms median
+        # reply dwell through the queued plane vs 145ms settling inline.
+        # An EXPLICIT RT_DRIVER_SETTLE_THREAD setting wins either way
+        # (tests pin the plane live on small hosts with =1). The pack
+        # plane has no such guard: its win — O(drains) loop-enqueue
+        # wakeups instead of O(tasks) — relieves the loop on any host
+        # (same-box A/B: queue-wait 392ms with it vs 538ms without).
+        multi_core = (os.cpu_count() or 1) >= 2
+        self._settle_thread = bool(_rtc.driver_settle_thread) and (
+            multi_core or "RT_DRIVER_SETTLE_THREAD" in os.environ)
+        self._submit_pack = bool(_rtc.submit_pack_thread)
+        self._settle_plane: Optional[specframe.SettlePlane] = None
+        self._pack_plane: Optional[specframe.PlaneQueue] = None
+        if is_driver:
+            # Created here (not in start_driver) so BOTH driver boot
+            # paths — local cluster and explicit-address connect — have
+            # the planes up before _async_setup attaches connections.
+            if self._settle_thread:
+                self._settle_plane = specframe.SettlePlane()
+            if self._submit_pack:
+                self._pack_plane = specframe.PlaneQueue(
+                    "rt-submit-pack", worker=self._pack_drain,
+                    maxsize=4096,
+                )
+        self._pusher_loops: List[Any] = []
+        self._pusher_threads: List[threading.Thread] = []
+        self._pusher_shard_stats: List[Dict[str, int]] = []
         # Function-table miss coalescing: fkey -> shared load future, plus
         # the keys queued for the next batched kv_get_batch.
         self._fn_loading: Dict[str, asyncio.Future] = {}
@@ -438,7 +485,11 @@ class CoreWorker:
                        "pump_batch_items": 0,
                        "pump_exec_wakeups": 0,
                        "push_window_shrinks": 0,
-                       "push_window_waits": 0}
+                       "push_window_waits": 0,
+                       # driver loop scale-out (round 20; must stay 0 —
+                       # a break means slot affinity failed and a slot's
+                       # window crossed shard loops)
+                       "pusher_shard_affinity_breaks": 0}
         # Submission batching: driver threads enqueue dispatch coroutines
         # here; ONE call_soon_threadsafe wakes the loop per burst instead of
         # one per task (the self-pipe write is a syscall per call).
@@ -596,7 +647,41 @@ class CoreWorker:
         self.loop_thread.start()
         if not ready.wait(timeout=30):
             raise RuntimeError("core loop failed to start")
+        self._start_pusher_shards()
         self._install_ref_hooks()
+
+    def _start_pusher_shards(self):
+        """Round 20: spin up the sharded pusher loops (driver-only).
+        Lease slots hash by peer address onto these loops in
+        _pump_leases; everything a pusher must touch on the MAIN loop
+        (peer/ring connect, task-reply application, slot bookkeeping)
+        marshals across explicitly in _slot_pusher."""
+        from ray_tpu._private.config import rt_config
+
+        n = int(rt_config.pusher_loop_shards)
+        if n < 0:
+            n = min(2, (os.cpu_count() or 1) - 1)
+        for i in range(max(n, 0)):
+            ready = threading.Event()
+            holder: Dict[str, Any] = {}
+
+            def runner(ready=ready, holder=holder):
+                loop = asyncio.new_event_loop()
+                asyncio.set_event_loop(loop)
+                holder["loop"] = loop
+                ready.set()
+                loop.run_forever()
+
+            t = threading.Thread(
+                target=runner, name=f"rt-pusher-{i}", daemon=True
+            )
+            t.start()
+            if not ready.wait(timeout=10):
+                logger.warning("pusher shard %d failed to start", i)
+                continue
+            self._pusher_loops.append(holder["loop"])
+            self._pusher_threads.append(t)
+            self._pusher_shard_stats.append({"chunks": 0, "tasks": 0})
 
     @staticmethod
     def _tune_gc():
@@ -702,6 +787,7 @@ class CoreWorker:
         self.gcs = await protocol.connect(
             self.gcs_addr, self._handle_rpc, name="gcs-client"
         )
+        self.gcs.settle_plane = self._settle_plane
         self.gcs.on_close = self._on_gcs_lost
         # Every registration call is deadline-bounded: a head that accepts
         # the TCP connection but drops replies must kick us back into the
@@ -988,6 +1074,7 @@ class CoreWorker:
             if conn is not None and not conn._closed:
                 return conn
             conn = await protocol.connect(addr, self._handle_rpc, name=f"peer-{addr}")
+            conn.settle_plane = self._settle_plane
             self.peers[addr] = conn
             return conn
 
@@ -1039,6 +1126,7 @@ class CoreWorker:
                 nring, self.loop, handler=self._handle_rpc,
                 name=f"ring-{addr[1]}",
             )
+            rc.settle_plane = self._settle_plane
             self._ring_peers[addr] = rc
             # Peer-process death is detected by the TCP conn: closing it
             # closes the ring too (the ring itself has no liveness probe).
@@ -3563,16 +3651,22 @@ class CoreWorker:
             # A re-executed generator would re-emit items: no retries.
             max_retries = 0
             self._task_streams[task_id.hex()] = {"count": None, "produced": 0}
+        pp = self._pack_plane
         refs = []
         if not streaming:
             for i in range(num_returns):
                 oid = ObjectID.for_return(task_id, i)
                 self._register_owned(oid.hex())
                 refs.append(ObjectRef(oid, tuple(self.addr)))
-            self._record_lineage(
-                task_id.hex(), header, frames, resources, strategy,
-                num_returns,
-            )
+            if pp is None:
+                # Pack plane on -> lineage bookkeeping moves to the pack
+                # thread (_pack_drain); it is already called from
+                # arbitrary caller threads, so the thread home changes,
+                # not the race discipline.
+                self._record_lineage(
+                    task_id.hex(), header, frames, resources, strategy,
+                    num_returns,
+                )
         self._stats["tasks_submitted"] += 1
         if fl:
             # Taskpath plane: the submit span (serialize/export/enqueue)
@@ -3588,10 +3682,38 @@ class CoreWorker:
                 fn=header["name"], phase="submit",
             )
             header["_tq"] = now
-        self._enqueue_dispatch(
-            self._dispatch_task_fast, (header, frames, resources, strategy,
-                                       max_retries, skey)
-        )
+        packed = False
+        if pp is not None:
+            # Round 20 pack plane: per-task wire-size accounting, lineage
+            # bookkeeping and the dispatch enqueue leave this caller
+            # thread; the plane feeds the loop whole pre-packed batches
+            # (one loop wakeup and one lease pump per burst, not per
+            # task). error/drop from the driver.submit.pack faultpoint —
+            # and a full plane queue — degrade THIS submission to the
+            # inline path below: the task is never lost, only
+            # un-offloaded.
+            ok = True
+            if faultpoints.ACTIVE:
+                try:
+                    ok = faultpoints.fire("driver.submit.pack") != "drop"
+                except Exception:
+                    ok = False
+            packed = ok and pp.offer(
+                (header, frames, resources, strategy, max_retries, skey,
+                 streaming, num_returns)
+            )
+        if not packed:
+            if pp is not None and not streaming:
+                # The plane rejected the handoff: make up the deferred
+                # lineage record inline before dispatch.
+                self._record_lineage(
+                    task_id.hex(), header, frames, resources, strategy,
+                    num_returns,
+                )
+            self._enqueue_dispatch(
+                self._dispatch_task_fast, (header, frames, resources,
+                                           strategy, max_retries, skey)
+            )
         if streaming:
             from ray_tpu.object_ref import StreamingObjectRefGenerator
 
@@ -3664,6 +3786,16 @@ class CoreWorker:
             (header, frames, fut, sum(len(fr) for fr in frames) + 4096)
         )
         self._pump_leases(key, lease_set)
+        fut.add_done_callback(
+            self._dispatch_retry_cb(header, frames, resources, strategy,
+                                    retries)
+        )
+
+    def _dispatch_retry_cb(self, header, frames, resources, strategy,
+                           retries):
+        """Done-callback for a dispatch future: failure spawns the retry
+        coroutine (shared by the inline fast path and the round-20
+        pack-plane drain)."""
 
         def done(f):
             if f.cancelled():
@@ -3678,7 +3810,51 @@ class CoreWorker:
                     "worker.dispatch_retry",
                 )
 
-        fut.add_done_callback(done)
+        return done
+
+    def _pack_drain(self, batch):
+        """Pack-plane worker (round 20), PLANE-THREAD side: the per-task
+        submit work that needs neither the caller nor the loop — wire-size
+        estimation over every frame, lineage bookkeeping — happens here,
+        and the whole batch re-enters the loop as ONE scheduled call."""
+        out = []
+        for (header, frames, resources, strategy, retries, skey,
+             streaming, nret) in batch:
+            if not streaming:
+                self._record_lineage(header["tid"], header, frames,
+                                     resources, strategy, nret)
+            out.append(
+                (header, frames, resources, strategy, retries, skey,
+                 sum(len(fr) for fr in frames) + 4096)
+            )
+        try:
+            self.loop.call_soon_threadsafe(self._drain_packed_on_loop, out)
+        except RuntimeError:
+            pass  # loop closed (shutdown); dispatch futures never existed
+
+    def _drain_packed_on_loop(self, batch):
+        """Loop-side apply of a pack-plane batch: create every dispatch
+        future in one pass, then ONE lease pump per scheduling key — the
+        inline path pumps once per task."""
+        pumped = {}
+        for header, frames, resources, strategy, retries, skey, size \
+                in batch:
+            key = skey if skey is not None else self._sched_key(
+                resources, strategy
+            )
+            lease_set = self.leases.get(key)
+            if lease_set is None:
+                lease_set = _LeaseSet(resources, strategy)
+                self.leases[key] = lease_set
+            fut = self.loop.create_future()
+            lease_set.pending.append((header, frames, fut, size))
+            fut.add_done_callback(
+                self._dispatch_retry_cb(header, frames, resources,
+                                        strategy, retries)
+            )
+            pumped[key] = lease_set
+        for key, lease_set in pumped.items():
+            self._pump_leases(key, lease_set)
 
     async def _dispatch_retry(self, header, frames, resources, strategy,
                               retries, first_err):
@@ -3829,8 +4005,19 @@ class CoreWorker:
                 break
             slot.busy += 1
             spawn_budget -= 1
-            spawn_logged(self.loop, self._slot_pusher(key, lease_set, slot),
-                         "worker.slot_pusher")
+            shard = self._shard_loop_for(slot)
+            if shard is None:
+                spawn_logged(self.loop,
+                             self._slot_pusher(key, lease_set, slot),
+                             "worker.slot_pusher")
+            else:
+                # Round 20: this slot's pushers live on its shard loop
+                # (peer-address affinity — a slot's chunks never
+                # interleave across loops, so its PushWindow and
+                # win_event stay single-loop).
+                spawn_threadsafe(shard,
+                                 self._slot_pusher(key, lease_set, slot),
+                                 "worker.slot_pusher")
         # Only the items NOT covered by a pusher spawned this pass warrant
         # new leases (requesting one per queued item would strand surplus
         # slots at the head until the reaper returns them — an idle surplus
@@ -4077,12 +4264,14 @@ class CoreWorker:
         """Issue an RPC on ``conn`` (usually a ring); when the encoded
         message exceeds the ring limit despite the caller's size
         pre-estimate, retry once over TCP to the same address. Server-side
-        seq admission tolerates mixed transports."""
+        seq admission tolerates mixed transports. Callable from shard
+        loops: ``_conn_call``/``_peer_on_loop`` marshal the TCP legs to
+        the driver loop (round 20)."""
         try:
-            return await conn.call(method, header, frames)
+            return await self._conn_call(conn, method, header, frames)
         except MessageTooBig:
-            tcp = await self.get_peer(addr)
-            return await tcp.call(method, header, frames)
+            tcp = await self._peer_on_loop(addr)
+            return await self._conn_call(tcp, method, header, frames)
 
     async def _await_chunk_settled(self, rfs, conn, addr, chunk):
         """Settle EVERY reply future of one pushed chunk under a shared
@@ -4254,9 +4443,144 @@ class CoreWorker:
         reply-window phase: recording tax only where there is truth to
         record)."""
         arr = h.get("_fr")
-        if arr is not None and now - arr >= _WINDOW_DWELL_MIN_S:
+        if arr is None:
+            return
+        sq = h.get("_sq")
+        if sq is not None and sq > arr:
+            # Round 20: the settle plane carved this dwell in two —
+            # arrival->handoff is still transport-side pump queueing,
+            # handoff->settle is the plane's own dwell (its queue depth
+            # plus the cross-loop hop). Both carry the same recording
+            # threshold; whichever halves stay sub-threshold land in
+            # derived reply-ack exactly as before.
+            if sq - arr >= _WINDOW_DWELL_MIN_S:
+                taskpath.record_phase("pump_queue", tid, arr, sq,
+                                      phase="pump-queue")
+            if now - sq >= _WINDOW_DWELL_MIN_S:
+                taskpath.record_phase("settle_dwell", tid, sq, now,
+                                      phase="settle-dwell")
+        elif now - arr >= _WINDOW_DWELL_MIN_S:
             taskpath.record_phase("pump_queue", tid, arr, now,
                                   phase="pump-queue")
+
+    # ---------------------------------------------------------- round 20:
+    # pusher-loop sharding. Slots hash onto N dedicated event loops by
+    # peer address; everything a pusher touches that is driver-loop state
+    # (lease bookkeeping, dispatch futures, TCP connections, the owned-
+    # object store behind _handle_task_reply) marshals through the
+    # helpers below. Slot affinity is the invariant that keeps the rest
+    # single-loop: ONE peer's slots always land on ONE shard, so a
+    # slot's push window, rendezvous event, and chunk ordering never
+    # interleave across loops.
+
+    def _shard_loop_for(self, slot):
+        """Pick the pusher loop for ``slot`` by peer-address hash.
+        Returns None when sharding is off (pushers stay on the driver
+        loop). First pick is recorded on the slot; a later disagreement
+        (the shard pool never changes mid-run, so this means a bug)
+        counts ``pusher_shard_affinity_breaks`` and re-pins."""
+        loops = self._pusher_loops
+        if not loops:
+            return None
+        loop = loops[hash(slot.addr) % len(loops)]
+        if slot.shard_loop is None:
+            slot.shard_loop = loop
+        elif slot.shard_loop is not loop:
+            self._stats["pusher_shard_affinity_breaks"] += 1
+            slot.shard_loop = loop
+        return loop
+
+    async def _main_coro(self, coro):
+        """Await ``coro`` on the DRIVER loop from a shard loop. The
+        cross-loop hop pair (schedule + wake) is the whole cost; results
+        and exceptions propagate unchanged."""
+        return await asyncio.wrap_future(
+            asyncio.run_coroutine_threadsafe(coro, self.loop)
+        )
+
+    async def _main_sync(self, fn, *args):
+        """Run a synchronous callable on the driver loop and await its
+        return value from a shard loop (``_pusher_rpc_error`` needs the
+        verdict before the pusher can decide to stop)."""
+        cf: SyncFuture = SyncFuture()
+
+        def _run():
+            try:
+                cf.set_result(fn(*args))
+            except BaseException as e:  # propagate to the awaiting shard
+                cf.set_exception(e)
+
+        self.loop.call_soon_threadsafe(_run)
+        return await asyncio.wrap_future(cf)
+
+    async def _peer_on_loop(self, addr):
+        """``get_peer`` from whatever loop the caller runs on: TCP
+        connections live on the driver loop (their ``_pending`` map is
+        loop-thread-only), so shard callers marshal the lookup."""
+        if asyncio.get_running_loop() is self.loop:
+            return await self.get_peer(addr)
+        return await self._main_coro(self.get_peer(addr))
+
+    async def _ring_on_loop(self, addr):
+        """``get_ring`` with the same cross-loop discipline as
+        ``_peer_on_loop`` (the ring cache and dial are driver-loop
+        state; the returned ring itself is cross-loop-callable)."""
+        if asyncio.get_running_loop() is self.loop:
+            return await self.get_ring(addr)
+        return await self._main_coro(self.get_ring(addr))
+
+    async def _conn_call(self, conn, method, header, frames):
+        """Issue ``conn.call`` from whatever loop the caller runs on.
+        Ring connections are cross-loop-safe (pending under ``_plock``,
+        reply futures settle on the calling loop); a TCP Connection's
+        pending map is owned by the driver loop, so shard-loop callers
+        marshal the whole call through it."""
+        from ray_tpu._private.ringconn import RingConnection
+
+        if (asyncio.get_running_loop() is self.loop
+                or isinstance(conn, RingConnection)):
+            return await conn.call(method, header, frames)
+        return await self._main_coro(conn.call(method, header, frames))
+
+    def _pop_pending_locked(self, lease_set):
+        """Pop one pending item under the lease set's pack lock, or None
+        when the queue drained first. With sharded pushers, slots of ONE
+        lease set can pack on different loops concurrently — the
+        peek/pop in the pack loop must be atomic against siblings."""
+        with lease_set.plock:
+            if not lease_set.pending:
+                return None
+            return self._pop_pending(lease_set)
+
+    def _chunk_settle_on_loop(self, items):
+        """Settle one pushed chunk's replies on the driver loop: shard
+        pushers collect ``(header, reply_header, reply_frames, fut)``
+        per chunk and flush them here in ONE cross-loop hop —
+        ``_handle_task_reply``'s owned-object/stream bookkeeping and the
+        dispatch futures are driver-loop state."""
+        for header, h, rframes, fut in items:
+            try:
+                self._handle_task_reply(header, h, rframes)
+            except Exception:
+                logger.exception("task reply settle failed")
+            if not fut.done():
+                fut.set_result(None)
+
+    def _pusher_exit_on_loop(self, key, lease_set, slot):
+        """A pusher's exit bookkeeping (busy decrement, drain release,
+        re-pump) — always on the driver loop; shard pushers marshal
+        their outer ``finally`` here."""
+        slot.busy = max(slot.busy - 1, 0)
+        lease_set.saturated = False
+        if slot.busy == 0:
+            slot.idle_since = time.monotonic()
+        if slot.draining and slot.busy == 0:
+            if slot in lease_set.slots:
+                lease_set.slots.remove(slot)
+                self._release_slot(lease_set, slot)
+        lease_set.last_active = time.monotonic()
+        if lease_set.pending:
+            self._pump_leases(key, lease_set)
 
     async def _slot_pusher(self, key, lease_set, slot):
         """Drains pending tasks onto one leased slot until the queue (or the
@@ -4265,18 +4589,31 @@ class CoreWorker:
         In-flight depth is paced by the slot's adaptive push window
         (``_win_acquire``): each packed chunk holds window capacity from
         push to settle, and the settle latency is the window's AIMD
-        clock."""
+        clock.
+
+        Round 20: with ``pusher_loop_shards`` on, this coroutine runs on
+        a SHARD loop (peer-address affinity). Transport I/O, window
+        pacing, and reply awaiting all stay here; driver-loop state —
+        lease bookkeeping, dispatch futures, TCP connections, reply
+        settling — marshals through the ``*_on_loop`` helpers. A chunk's
+        settles flush in ONE cross-loop hop (the per-iteration finally),
+        so the driver loop pays O(chunks), not O(tasks)."""
+        my_loop = asyncio.get_running_loop()
+        on_shard = my_loop is not self.loop
+        shard_idx = (self._pusher_loops.index(my_loop)
+                     if on_shard and my_loop in self._pusher_loops else -1)
         try:
             while (lease_set.pending and slot in lease_set.slots
                    and not slot.draining):
                 chunk: List[tuple] = []
+                settles: List[tuple] = []  # shard mode: (hdr, h, fr, fut)
                 fut = None
                 win = None
                 held = 0  # window capacity this pusher holds (releases
                 # in the iteration's finally on every error path)
                 fl_t0 = time.monotonic()  # refined once the chunk is built
                 try:
-                    ring = await self.get_ring(slot.addr)
+                    ring = await self._ring_on_loop(slot.addr)
                     if not lease_set.pending:
                         break  # drained by a sibling pusher during the await
                     granted, win = await self._win_acquire(lease_set, slot)
@@ -4287,38 +4624,51 @@ class CoreWorker:
                     if not lease_set.pending:
                         break  # drained while parked on the window
                     if ring is None:
-                        conn = await self.get_peer(slot.addr)
+                        conn = await self._peer_on_loop(slot.addr)
                         if not lease_set.pending:
                             break
-                        chunk = [self._pop_pending(lease_set)]
+                        it = self._pop_pending_locked(lease_set)
+                        if it is not None:
+                            chunk = [it]
                     else:
                         conn = ring
                         # Pack tasks up to the granted window, the batch
                         # count, and the ring's message budget; a task too
                         # big for the ring rides TCP instead (same node,
-                        # same semantics).
+                        # same semantics). The whole peek/pop pass holds
+                        # the pack lock (no awaits inside): sharded
+                        # siblings of this lease set pack concurrently.
                         budget = ring.max_msg - 65536
                         size = 0
-                        while (lease_set.pending
-                               and len(chunk) < granted):
-                            it = lease_set.pending[0]
-                            # Enqueue-time size estimate (4th element);
-                            # the O(frames) re-sum per peek is gone.
-                            sz = it[3] if len(it) > 3 else sum(
-                                len(fr) for fr in it[1]
-                            ) + 4096
-                            if sz > budget:
-                                if not chunk:
-                                    conn = await self.get_peer(slot.addr)
-                                    if lease_set.pending:
-                                        chunk = [self._pop_pending(lease_set)]
-                                break
-                            if size + sz > budget and chunk:
-                                break
-                            size += sz
-                            chunk.append(self._pop_pending(lease_set))
+                        oversize = False
+                        with lease_set.plock:
+                            while (lease_set.pending
+                                   and len(chunk) < granted):
+                                it = lease_set.pending[0]
+                                # Enqueue-time size estimate (4th element);
+                                # the O(frames) re-sum per peek is gone.
+                                sz = it[3] if len(it) > 3 else sum(
+                                    len(fr) for fr in it[1]
+                                ) + 4096
+                                if sz > budget:
+                                    oversize = not chunk
+                                    break
+                                if size + sz > budget and chunk:
+                                    break
+                                size += sz
+                                chunk.append(self._pop_pending(lease_set))
+                        if oversize:
+                            conn = await self._peer_on_loop(slot.addr)
+                            it = self._pop_pending_locked(lease_set)
+                            if it is not None:
+                                chunk = [it]
                     if not chunk:
                         continue
+                    if shard_idx >= 0:
+                        # Single-writer per shard (slot affinity): no lock.
+                        st = self._pusher_shard_stats[shard_idx]
+                        st["chunks"] += 1
+                        st["tasks"] += len(chunk)
                     if held > len(chunk):
                         # Packed fewer than granted (queue drained, byte
                         # budget): the surplus goes back to siblings now.
@@ -4347,7 +4697,10 @@ class CoreWorker:
                             ),
                             conn, slot.addr, header, frames,
                         )
-                        self._handle_task_reply(header, h, rframes)
+                        if on_shard:
+                            settles.append((header, h, rframes, fut))
+                        else:
+                            self._handle_task_reply(header, h, rframes)
                         t_now = time.monotonic()
                         if win is not None:
                             # AIMD clock: push -> reply ARRIVAL at the
@@ -4359,7 +4712,7 @@ class CoreWorker:
                                 (h.get("_fr") or t_now) - t_send,
                             )
                             held = 0
-                        if not fut.done():
+                        if not on_shard and not fut.done():
                             fut.set_result(None)
                         if fl:
                             # Span covers push → reply, i.e. dispatch +
@@ -4395,30 +4748,52 @@ class CoreWorker:
                                     ),
                                     conn, slot.addr, header, frames,
                                 )
-                                self._handle_task_reply(header, h, rframes)
+                                if on_shard:
+                                    settles.append(
+                                        (header, h, rframes, fut)
+                                    )
+                                else:
+                                    self._handle_task_reply(
+                                        header, h, rframes
+                                    )
                                 if fl:
                                     taskpath.record_phase(
                                         "push", header.get("tid"), fl_t0,
                                         time.monotonic(),
                                     )
-                                if not fut.done():
+                                if not on_shard and not fut.done():
                                     fut.set_result(None)
                             except protocol.RpcError as e:
-                                if self._pusher_rpc_error(
-                                    lease_set, slot, fut, e
-                                ):
+                                if on_shard:
+                                    stop_now = await self._main_sync(
+                                        self._pusher_rpc_error,
+                                        lease_set, slot, fut, e,
+                                    )
+                                else:
+                                    stop_now = self._pusher_rpc_error(
+                                        lease_set, slot, fut, e
+                                    )
+                                if stop_now:
                                     # This slot is done (e.g. OOM eviction);
                                     # the rest of the chunk goes back to the
                                     # queue for other slots — their futures
                                     # must not be abandoned. Re-stamp the
                                     # enqueue-time size estimate the pack
                                     # loop peeks at.
-                                    lease_set.pending.extend(
-                                        (h2, f2, fu2,
-                                         sum(len(fr) for fr in f2) + 4096)
-                                        for h2, f2, fu2 in chunk[i + 1:]
-                                    )
-                                    self._pump_leases(key, lease_set)
+                                    with lease_set.plock:
+                                        lease_set.pending.extend(
+                                            (h2, f2, fu2,
+                                             sum(len(fr) for fr in f2)
+                                             + 4096)
+                                            for h2, f2, fu2 in chunk[i + 1:]
+                                        )
+                                    if on_shard:
+                                        self.loop.call_soon_threadsafe(
+                                            self._pump_leases,
+                                            key, lease_set,
+                                        )
+                                    else:
+                                        self._pump_leases(key, lease_set)
                                     return
                         if win is not None:
                             self._win_settled(slot, win, len(chunk),
@@ -4446,17 +4821,33 @@ class CoreWorker:
                                     rf, conn, slot.addr, header, frames
                                 )
                         except protocol.ConnectionLost:
-                            self._pusher_node_lost(
-                                lease_set, slot, [c[2] for c in chunk[i:]]
-                            )
+                            doomed = [c[2] for c in chunk[i:]]
+                            if on_shard:
+                                self.loop.call_soon_threadsafe(
+                                    self._pusher_node_lost,
+                                    lease_set, slot, doomed,
+                                )
+                            else:
+                                self._pusher_node_lost(
+                                    lease_set, slot, doomed
+                                )
                             return
                         except protocol.RpcError as e:
-                            if self._pusher_rpc_error(
+                            if on_shard:
+                                if await self._main_sync(
+                                    self._pusher_rpc_error,
+                                    lease_set, slot, fut, e,
+                                ):
+                                    stop = True
+                            elif self._pusher_rpc_error(
                                 lease_set, slot, fut, e
                             ):
                                 stop = True
                             continue
-                        self._handle_task_reply(header, h, rframes)
+                        if on_shard:
+                            settles.append((header, h, rframes, fut))
+                        else:
+                            self._handle_task_reply(header, h, rframes)
                         arr = h.get("_fr")
                         if arr is not None and arr > arr_max:
                             arr_max = arr
@@ -4472,7 +4863,7 @@ class CoreWorker:
                             self._record_pump_queue(
                                 header.get("tid"), h, t_now
                             )
-                        if not fut.done():
+                        if not on_shard and not fut.done():
                             fut.set_result(None)
                     if win is not None:
                         # AIMD clock: push -> last reply ARRIVAL; the
@@ -4497,15 +4888,26 @@ class CoreWorker:
                                       chunk[0][0].get("tid"), "worker",
                                       fl_t0, time.monotonic(), 0,
                                       "error:ConnectionLost")
-                    self._pusher_node_lost(
-                        lease_set, slot, [c[2] for c in chunk]
-                    )
+                    doomed = [c[2] for c in chunk]
+                    if on_shard:
+                        self.loop.call_soon_threadsafe(
+                            self._pusher_node_lost, lease_set, slot, doomed
+                        )
+                    else:
+                        self._pusher_node_lost(lease_set, slot, doomed)
                     return
                 except protocol.RpcError as e:
-                    if fut is not None and self._pusher_rpc_error(
-                        lease_set, slot, fut, e
-                    ):
-                        return
+                    if fut is not None:
+                        if on_shard:
+                            if await self._main_sync(
+                                self._pusher_rpc_error,
+                                lease_set, slot, fut, e,
+                            ):
+                                return
+                        elif self._pusher_rpc_error(
+                            lease_set, slot, fut, e
+                        ):
+                            return
                 finally:
                     # Window capacity must not leak on ANY exit (errors,
                     # node loss, oversize fallback) — a leaked grant
@@ -4513,18 +4915,21 @@ class CoreWorker:
                     if held:
                         self._win_release(slot, win, held)
                         held = 0
+                    if settles:
+                        # ONE cross-loop hop settles the whole chunk
+                        # (shard mode only appends here). Ordering vs a
+                        # node-lost marshal above is FIFO on the driver
+                        # loop, and the two cover disjoint futures.
+                        self.loop.call_soon_threadsafe(
+                            self._chunk_settle_on_loop, settles
+                        )
         finally:
-            slot.busy = max(slot.busy - 1, 0)
-            lease_set.saturated = False
-            if slot.busy == 0:
-                slot.idle_since = time.monotonic()
-            if slot.draining and slot.busy == 0:
-                if slot in lease_set.slots:
-                    lease_set.slots.remove(slot)
-                    self._release_slot(lease_set, slot)
-            lease_set.last_active = time.monotonic()
-            if lease_set.pending:
-                self._pump_leases(key, lease_set)
+            if on_shard:
+                self.loop.call_soon_threadsafe(
+                    self._pusher_exit_on_loop, key, lease_set, slot
+                )
+            else:
+                self._pusher_exit_on_loop(key, lease_set, slot)
 
     async def _lease_reaper(self, key, lease_set: _LeaseSet):
         """Return idle leases to the head (reference: lease idle timeout in
@@ -4659,12 +5064,23 @@ class CoreWorker:
             settle["max_batch"] = max(
                 settle["max_batch"], st.get("max_batch", 0)
             )
-        return {
+        out = {
             "node_id": self.node_id,
             "push_window": push,
             "pump": pump,
             "settle": settle,
         }
+        # Round 20 planes: present only when the gate created them, so
+        # gates-off snapshots stay byte-identical to round 19's.
+        if self._settle_plane is not None:
+            out["settle_plane"] = self._settle_plane.snapshot()
+        if self._pack_plane is not None:
+            out["pack_plane"] = self._pack_plane.snapshot()
+        if self._pusher_shard_stats:
+            out["pusher_shards"] = [
+                dict(s) for s in self._pusher_shard_stats
+            ]
+        return out
 
     def _handle_task_reply(self, header, h, rframes):
         """Process a push_task reply: inline values, shm descriptors, errors."""
@@ -5818,6 +6234,15 @@ class CoreWorker:
                                     )
                         for p, v in agg.items():
                             g.set(float(v), tags={"peer": p})
+                    if self._settle_plane is not None:
+                        # Settle-plane backlog (round 20): sustained
+                        # depth near the handoff bound means reply
+                        # settling, not the driver loop, is the choke.
+                        Gauge(
+                            "rt_settle_queue_depth",
+                            description="reply frames queued at the "
+                                        "driver settle plane",
+                        ).set(float(self._settle_plane.q.depth()))
                     if memtrack.ENABLED:
                         # Object-plane gauges (store bytes by kind, ref
                         # states, arena/graveyard, memory pressure) ride
@@ -6958,6 +7383,24 @@ class CoreWorker:
 
     def shutdown(self):
         self._shutdown = True
+        # Round 20 planes drain BEFORE transports tear down: a queued
+        # reply frame still settles (its futures fail later with the
+        # connections if the peer is already gone), and a queued packed
+        # submit either dispatches or fails with the loop — never lost
+        # silently in a worker thread.
+        if self._pack_plane is not None:
+            self._pack_plane.close()
+            self._pack_plane = None
+        if self._settle_plane is not None:
+            for c in list(self.peers.values()):
+                c.settle_plane = None
+            for rc in list(self._ring_peers.values()):
+                if rc is not False:
+                    rc.settle_plane = None
+            if self.gcs is not None:
+                self.gcs.settle_plane = None
+            self._settle_plane.close()
+            self._settle_plane = None
         # Reply windows first, while every transport is still up: results
         # buffered behind an in-flight ack (short-lived executors, a
         # graceful remove_node drain) must reach their submitters before
@@ -7020,6 +7463,15 @@ class CoreWorker:
             fut.result(timeout=5)
         except Exception:
             pass
+        for shard in self._pusher_loops:
+            try:
+                shard.call_soon_threadsafe(shard.stop)
+            except RuntimeError:
+                pass  # already stopped
+        for t in self._pusher_threads:
+            t.join(timeout=2)
+        self._pusher_loops = []
+        self._pusher_threads = []
         if self.loop_thread is not None:
             self.loop.call_soon_threadsafe(self.loop.stop)
             self.loop_thread.join(timeout=5)

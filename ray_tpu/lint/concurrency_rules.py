@@ -203,8 +203,10 @@ _THREADSAFE_BRIDGES = {"call_soon_threadsafe", "run_coroutine_threadsafe"}
 
 class _ThreadEntryCollector(ast.NodeVisitor):
     """Find function names that run on non-loop threads: passed as
-    ``threading.Thread(target=...)``, executor ``.submit(fn)``, or
-    ``loop.run_in_executor(None, fn)`` — plus locally-defined callables
+    ``threading.Thread(target=...)``, executor ``.submit(fn)``,
+    ``loop.run_in_executor(None, fn)``, or a plane-queue ``worker=``
+    callback (round 20: ``PlaneQueue(..., worker=fn)`` runs ``fn`` on
+    the plane's dedicated thread) — plus locally-defined callables
     those functions call (one same-module transitive closure)."""
 
     def __init__(self, tree):
@@ -229,6 +231,14 @@ class _ThreadEntryCollector(ast.NodeVisitor):
             ref = _callable_ref_name(node.args[1])
             if ref:
                 self.entry_names.add(ref)
+        for kw in node.keywords:
+            # Plane handoff idiom (round 20): a ``worker=`` callback —
+            # ``PlaneQueue(..., worker=fn)`` — drains batches on the
+            # plane's own thread, never the loop.
+            if kw.arg == "worker":
+                ref = _callable_ref_name(kw.value)
+                if ref:
+                    self.entry_names.add(ref)
         self.generic_visit(node)
 
 

@@ -556,6 +556,17 @@ CHAOS_SPECS = [
     # Sender-side RPC delay: every control verb tolerates a slow write
     # leg the same way it tolerates the matrixed slow reply leg.
     "protocol.rpc.send:delay:0.2:0:122",
+    # Driver loop scale-out (round 20): a refused settle-plane handoff
+    # settles THAT reply batch inline on the event loop; a refused
+    # pack-plane handoff packs THAT submission inline on the caller
+    # thread. Either way every frame/task completes — the planes are
+    # optimizations, never correctness gates — with zero leaked
+    # leases/objects.
+    "driver.settle.handoff:error:0.3:0:123",
+    "driver.settle.handoff:drop:0.3:0:124",
+    "driver.settle.handoff:delay:0.2:0:125",
+    "driver.submit.pack:error:0.3:0:126",
+    "driver.submit.pack:drop:0.3:0:127",
 ]
 
 
@@ -574,6 +585,10 @@ def test_chaos_matrix(spec, monkeypatch, chaos_flight_trace):
     monkeypatch.setenv("RT_LEASE_REQUEST_TIMEOUT_S", "1")
     monkeypatch.setenv("RT_RPC_RETRIES", "6")
     monkeypatch.setenv("RT_FAULT_SPEC", spec)
+    if spec.startswith("driver.settle.handoff"):
+        # The settle plane auto-stands-down on single-core hosts; these
+        # rows exercise the handoff path itself, so pin it live.
+        monkeypatch.setenv("RT_DRIVER_SETTLE_THREAD", "1")
     ray_tpu.init(num_cpus=2)
     try:
         fp.configure(spec)

@@ -97,6 +97,33 @@ def test_queue_and_fn_phase_naming():
     assert b2["phases"]["submit-queue"] == pytest.approx(0.050)
 
 
+def test_settle_dwell_carved_from_pump_queue_and_phases_pinned():
+    """Round 20: the settle plane splits the old pump-queue dwell at the
+    handoff stamp — arrival->handoff stays pump-queue, handoff->settle
+    is settle-dwell — and BOTH subtract from derived reply-ack. The
+    PHASES tuple is pinned exhaustively: a new recorded stage that
+    isn't mapped here would silently lump into the residual."""
+    assert taskpath.PHASES == (
+        "submit", "submit-queue", "lease-wait", "warm-pool-hit",
+        "fn-push", "kv-get", "arg-pull", "exec-queue", "exec",
+        "result-push", "reply-window", "pump-queue", "settle-dwell",
+        "reply-ack", "residual",
+    )
+    spans = _synthetic_task() + [
+        _span("task.pump_queue", "t1", 0.150, 0.004),
+        _span("task.settle_dwell", "t1", 0.154, 0.003),
+    ]
+    b = taskpath.task_breakdown(spans, "t1")
+    p = b["phases"]
+    assert p["pump-queue"] == pytest.approx(0.004)
+    assert p["settle-dwell"] == pytest.approx(0.003)
+    # reply-ack = push - serve - reply-window - pump-queue - settle-dwell
+    assert p["reply-ack"] == pytest.approx(0.010 - 0.004 - 0.003)
+    assert sum(p.values()) == pytest.approx(b["wall_s"])
+    # Exhaustiveness: every phase the breakdown emits is a pinned name.
+    assert set(p) == set(taskpath.PHASES)
+
+
 def test_breakdown_unknown_task_is_none():
     assert taskpath.task_breakdown(_synthetic_task(), "nope") is None
     assert taskpath.task_breakdown([], "t1") is None
